@@ -1,0 +1,30 @@
+"""quoracle_tpu — TPU-native recursive agent orchestration with multi-LLM consensus.
+
+A ground-up JAX/XLA re-design of the capabilities of shelvick/quoracle
+(reference: /root/reference, an Elixir/OTP Phoenix application). Instead of
+fanning each consensus round out to hosted LLM APIs over HTTPS
+(reference lib/quoracle/models/model_query.ex:88-131), the model pool lives
+in-tree on TPU: a consensus round is a batched generate step over local
+open-weights models sharded across the slice, with embeddings as an
+on-device XLA encoder.
+
+Layer map (mirrors SURVEY.md §1, re-designed TPU/Python-first):
+
+  web/          dashboard (aiohttp + SSE)          <- reference lib/quoracle_web/
+  persistence/  tasks + SQLite state               <- reference lib/quoracle/tasks/, repo.ex
+  agent/        asyncio actor runtime              <- reference lib/quoracle/agent/
+  consensus/    consensus pipeline (pure logic)    <- reference lib/quoracle/consensus/
+  models/       JAX model runtime (replaces the    <- reference lib/quoracle/models/
+                entire remote provider layer)
+  actions/      gated action vocabulary            <- reference lib/quoracle/actions/
+  governance/   profiles / groves / skills /fields <- reference lib/quoracle/{profiles,groves,skills,fields}/
+  infra/        budget, costs, bus, secrets, audit <- reference lib/quoracle/{budget,costs,pubsub,security}/
+  parallel/     mesh + sharding specs (TPU-only, no reference counterpart)
+  ops/          attention + pallas kernels         (TPU-only, no reference counterpart)
+
+Cardinal architectural rule carried over from the reference (root AGENTS.md:5-33):
+**no global state** — every component receives its registry, bus, backend, and db
+explicitly. This is what lets the whole test suite run in parallel.
+"""
+
+__version__ = "0.1.0"

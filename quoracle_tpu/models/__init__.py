@@ -1,0 +1,13 @@
+"""JAX model runtime: the in-tree replacement for the reference's entire model
+provider layer (reference lib/quoracle/models/ + lib/quoracle/providers/ —
+SURVEY.md §2.3). Where the reference resolves credentials and fans out HTTPS
+requests per model, this package loads open-weights models onto the TPU mesh
+and serves batched generate/embed steps from HBM-resident KV caches.
+"""
+
+from quoracle_tpu.models.config import (  # noqa: F401
+    ModelConfig,
+    get_model_config,
+    list_models,
+    register_model,
+)

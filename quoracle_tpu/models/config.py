@@ -1,0 +1,165 @@
+"""Model catalog: architecture configs + serving metadata.
+
+Replaces the reference's LLMDB catalog (reference
+lib/quoracle/models/llm_db_model_loader.ex) — context windows, output limits and
+pricing lived in an external hex package there; here the catalog is the single
+in-tree registry of models the TPU runtime can serve, keyed by the same
+``provider:model`` spec format the reference uses (reference
+lib/quoracle/models/local_model_helper.ex:13-19 is the precedent for an in-tree
+provider bypass; ours is the ``xla:`` provider).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture + serving config for one decoder-only transformer.
+
+    Covers the Llama/Mistral/Gemma/Qwen families (RMSNorm, RoPE, GQA/MQA,
+    gated MLP). Per-family quirks are expressed as data, not subclasses, so a
+    single traced forward function serves every family.
+    """
+
+    name: str
+    vocab_size: int
+    dim: int
+    n_layers: int
+    n_heads: int
+    n_kv_heads: int
+    ffn_dim: int
+    head_dim: Optional[int] = None  # defaults to dim // n_heads
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    activation: str = "silu"  # "silu" (llama/mistral) or "gelu" (gemma)
+    tie_embeddings: bool = False
+    # Gemma multiplies token embeddings by sqrt(dim) (data, not code, per-family).
+    scale_embeddings: bool = False
+    # Gemma's RMSNorm computes (1 + w) * normed(x).
+    rmsnorm_plus_one: bool = False
+    # Sliding-window attention size (Mistral); None = full causal.
+    sliding_window: Optional[int] = None
+    # Optional logit soft-capping (Gemma-2 style); None = off.
+    final_logit_softcap: Optional[float] = None
+
+    # --- serving metadata (what the reference pulled from LLMDB) ---
+    context_window: int = 8192
+    output_limit: int = 4096
+    # Cost per 1M tokens (USD) for budget accounting parity with the
+    # reference's cost pipeline; on-TPU serving is "free" but agents still
+    # budget, so these are nominal accounting rates.
+    input_cost_per_mtok: float = 0.05
+    output_cost_per_mtok: float = 0.15
+    eos_token_id: int = 2
+    bos_token_id: int = 1
+
+    def __post_init__(self):
+        if self.head_dim is None:
+            object.__setattr__(self, "head_dim", self.dim // self.n_heads)
+        assert self.n_heads % self.n_kv_heads == 0, "GQA requires n_heads % n_kv_heads == 0"
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.n_heads // self.n_kv_heads
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+_REGISTRY: dict[str, ModelConfig] = {}
+
+
+def register_model(cfg: ModelConfig) -> ModelConfig:
+    _REGISTRY[cfg.name] = cfg
+    return cfg
+
+
+def get_model_config(spec: str) -> ModelConfig:
+    """Look up by model spec. Accepts ``xla:name`` or bare ``name``.
+
+    Mirrors the reference's ``provider:model`` spec parsing
+    (reference lib/quoracle/models/model_query.ex model_spec format).
+    """
+    name = spec.split(":", 1)[1] if ":" in spec else spec
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {spec!r}; registered: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+# --- production-scale pool (the BASELINE.json north-star trio) ---
+
+LLAMA3_8B = register_model(ModelConfig(
+    name="llama-3-8b",
+    vocab_size=128256, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+    ffn_dim=14336, rope_theta=500000.0, norm_eps=1e-5,
+    context_window=8192, output_limit=4096,
+    eos_token_id=128001, bos_token_id=128000,
+))
+
+MISTRAL_7B = register_model(ModelConfig(
+    name="mistral-7b",
+    vocab_size=32000, dim=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+    ffn_dim=14336, rope_theta=1000000.0, norm_eps=1e-5,
+    context_window=32768, output_limit=8192, sliding_window=4096,
+))
+
+GEMMA_7B = register_model(ModelConfig(
+    name="gemma-7b",
+    vocab_size=256000, dim=3072, n_layers=28, n_heads=16, n_kv_heads=16,
+    ffn_dim=24576, head_dim=256, rope_theta=10000.0, norm_eps=1e-6,
+    activation="gelu", tie_embeddings=True, scale_embeddings=True,
+    rmsnorm_plus_one=True,
+    context_window=8192, output_limit=4096,
+))
+
+# --- bench-scale models (fit a single v5e chip with headroom; same families) ---
+
+LLAMA_1B = register_model(ModelConfig(
+    name="llama-1b",
+    vocab_size=32768, dim=2048, n_layers=16, n_heads=16, n_kv_heads=4,
+    ffn_dim=5632, rope_theta=500000.0,
+    context_window=8192, output_limit=4096,
+))
+
+MISTRAL_1B = register_model(ModelConfig(
+    name="mistral-1b",
+    vocab_size=32768, dim=2048, n_layers=16, n_heads=16, n_kv_heads=4,
+    ffn_dim=5632, rope_theta=1000000.0, sliding_window=4096,
+    context_window=16384, output_limit=4096,
+))
+
+GEMMA_1B = register_model(ModelConfig(
+    name="gemma-1b",
+    vocab_size=32768, dim=1792, n_layers=14, n_heads=14, n_kv_heads=14,
+    ffn_dim=7168, head_dim=128, activation="gelu", tie_embeddings=True,
+    scale_embeddings=True, rmsnorm_plus_one=True, norm_eps=1e-6,
+    context_window=8192, output_limit=4096,
+))
+
+# --- tiny test models (CPU-mesh friendly; divisible by 2 and 4 for tp tests) ---
+
+TINY = register_model(ModelConfig(
+    name="tiny",
+    vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    ffn_dim=128, context_window=512, output_limit=128,
+))
+
+TINY_GEMMA = register_model(ModelConfig(
+    name="tiny-gemma",
+    vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=4,
+    ffn_dim=128, activation="gelu", tie_embeddings=True,
+    scale_embeddings=True, rmsnorm_plus_one=True,
+    context_window=512, output_limit=128,
+))
+
+TINY_POOL = ["xla:tiny", "xla:tiny-gemma"]
+BENCH_POOL = ["xla:llama-1b", "xla:mistral-1b", "xla:gemma-1b"]
+NORTH_STAR_POOL = ["xla:llama-3-8b", "xla:mistral-7b", "xla:gemma-7b"]

@@ -1,0 +1,227 @@
+"""Batched prefill + decode: the generate step that replaces the reference's
+per-model HTTPS fan-out (reference lib/quoracle/models/model_query.ex:88-131,
+Task.async per model -> ReqLLM.generate_text). A consensus round here is ONE
+batched call per pool member with per-row sampling params.
+
+Functional core (this file) is pure and jit-compiled; the stateful Engine
+handles padding, shape-bucketing (to bound recompiles), RNG, and
+detokenization. Decode runs a ``lax.while_loop`` with static bounds and
+early-exits when every row has emitted EOS — shape-static, data-dependent
+only in trip count, exactly what XLA wants.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quoracle_tpu.models.config import ModelConfig
+from quoracle_tpu.models.sampling import sample_tokens
+from quoracle_tpu.models.transformer import KVCache, forward, init_cache
+
+
+def prefill(params: dict, cfg: ModelConfig, tokens: jax.Array,
+            prompt_lens: jax.Array, cache: KVCache) -> tuple[jax.Array, KVCache]:
+    """Fill the cache from right-padded prompts. Returns (last-token logits
+    [B, V], cache with lens = prompt_lens)."""
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    logits, cache = forward(
+        params, cfg, tokens, positions, cache,
+        write_offset=jnp.zeros((B,), jnp.int32),
+        kv_lens=prompt_lens,
+    )
+    last = jnp.take_along_axis(
+        logits, (prompt_lens - 1)[:, None, None].astype(jnp.int32), axis=1
+    )[:, 0, :]
+    return last, cache._replace(lens=prompt_lens.astype(jnp.int32))
+
+
+def decode(
+    params: dict,
+    cfg: ModelConfig,
+    cache: KVCache,
+    first_logits: jax.Array,   # [B, V] logits at the last prompt token
+    rng: jax.Array,
+    temperature: jax.Array,    # [B]
+    top_p: jax.Array,          # [B]
+    max_new: int,
+    eos_id: int,
+    active: jax.Array,         # [B] bool — False for batch-bucket padding rows
+    pad_id: int = 0,
+) -> tuple[jax.Array, jax.Array]:
+    """Autoregressive decode.
+
+    Returns (tokens [B, max_new], n_emitted [B]) where n_emitted counts real
+    tokens written per row INCLUDING a terminal EOS. The count is tracked in
+    the loop carry — output extraction must not scan for sentinels, because
+    pad_id can be a legitimate vocab token in real checkpoints.
+
+    Padding rows (``~active``) start done, so the EOS early-exit fires as
+    soon as every REAL row has finished.
+    """
+    B = first_logits.shape[0]
+
+    rng, k0 = jax.random.split(rng)
+    tok0 = sample_tokens(first_logits, k0, temperature, top_p)
+    done0 = ~active | (tok0 == eos_id)
+    out0 = jnp.full((B, max_new), pad_id, jnp.int32).at[:, 0].set(tok0)
+    n0 = jnp.where(active, 1, 0).astype(jnp.int32)
+
+    def cond(carry):
+        i, done, *_ = carry
+        return (i < max_new) & ~jnp.all(done)
+
+    def body(carry):
+        i, done, cur, out, n_emitted, cache, rng = carry
+        positions = cache.lens[:, None]
+        logits, cache = forward(
+            params, cfg, cur[:, None], positions, cache,
+            write_offset=cache.lens, kv_lens=cache.lens + 1,
+        )
+        rng, k = jax.random.split(rng)
+        nxt = sample_tokens(logits[:, 0, :], k, temperature, top_p)
+        nxt = jnp.where(done, pad_id, nxt)
+        out = jax.lax.dynamic_update_slice_in_dim(out, nxt[:, None], i, axis=1)
+        n_emitted = n_emitted + jnp.where(done, 0, 1).astype(jnp.int32)
+        cache = cache._replace(lens=cache.lens + jnp.where(done, 0, 1))
+        done = done | (nxt == eos_id)
+        return (i + 1, done, nxt, out, n_emitted, cache, rng)
+
+    # Feed the first sampled token through the loop starting at step 1.
+    init = (jnp.asarray(1, jnp.int32), done0, tok0, out0, n0, cache, rng)
+    _, done, _, out, n_emitted, cache, _ = jax.lax.while_loop(cond, body, init)
+    return out, n_emitted
+
+
+def _round_up(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return n
+
+
+@dataclasses.dataclass
+class GenResult:
+    token_ids: list[int]
+    text: str
+    n_prompt_tokens: int
+    n_gen_tokens: int
+    latency_s: float
+    finish_reason: str  # "stop" | "length"
+
+
+class GenerateEngine:
+    """Stateful serving wrapper around the functional core for ONE model.
+
+    Holds params (device-resident), compiles (prefill+decode) per shape
+    bucket, and exposes a list-in/list-out generate(). The pool runtime
+    (models/runtime.py) owns one Engine per pool member.
+    """
+
+    BATCH_BUCKETS = (1, 2, 4, 8, 16, 32)
+
+    def __init__(self, cfg: ModelConfig, params: dict, tokenizer,
+                 max_seq: Optional[int] = None, seed: int = 0,
+                 prompt_buckets: Sequence[int] = (128, 256, 512, 1024, 2048, 4096, 8192)):
+        self.cfg = cfg
+        self.params = params
+        self.tokenizer = tokenizer
+        self.max_seq = max_seq or cfg.context_window
+        self.prompt_buckets = tuple(b for b in prompt_buckets if b <= self.max_seq)
+        self._rng = jax.random.PRNGKey(seed)
+        self._step = self._build_step()
+
+    def _build_step(self):
+        cfg = self.cfg
+
+        @functools.partial(jax.jit, static_argnames=("max_new", "cache_len"))
+        def step(params, tokens, prompt_lens, rng, temperature, top_p, active,
+                 max_new: int, cache_len: int):
+            B = tokens.shape[0]
+            cache = init_cache(cfg, B, cache_len)
+            last_logits, cache = prefill(params, cfg, tokens, prompt_lens, cache)
+            out, n_emitted = decode(params, cfg, cache, last_logits, rng,
+                                    temperature, top_p, max_new, cfg.eos_token_id,
+                                    active=active, pad_id=self.tokenizer.pad_id)
+            return out, n_emitted
+
+        return step
+
+    def next_rng(self) -> jax.Array:
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    def generate(
+        self,
+        prompts: Sequence[Sequence[int]],
+        temperature: Sequence[float] | float = 1.0,
+        top_p: Sequence[float] | float = 1.0,
+        max_new_tokens: int = 256,
+        rng: Optional[jax.Array] = None,
+    ) -> list[GenResult]:
+        t0 = time.monotonic()
+        n = len(prompts)
+        if n == 0:
+            return []
+        temps = [temperature] * n if isinstance(temperature, (int, float)) else list(temperature)
+        tops = [top_p] * n if isinstance(top_p, (int, float)) else list(top_p)
+
+        max_prompt = max(len(p) for p in prompts)
+        if max_prompt + max_new_tokens > self.max_seq:
+            max_new_tokens = max(1, self.max_seq - max_prompt)
+        T = _round_up(max_prompt, self.prompt_buckets)
+        B = _round_up(n, self.BATCH_BUCKETS)
+        # Bucket the decode bound too: consensus computes a DYNAMIC max_tokens
+        # per round (reference per_model_query.ex:136-145), which would
+        # otherwise trigger one XLA compile per unique value. EOS still exits
+        # the while_loop early; results are truncated to the requested bound.
+        max_new = _round_up(max_new_tokens, (64, 128, 256, 512, 1024, 2048, 4096))
+
+        tokens = np.full((B, T), self.tokenizer.pad_id, np.int32)
+        lens = np.ones((B,), np.int32)  # padded rows get length 1 (harmless)
+        for i, p in enumerate(prompts):
+            tokens[i, :len(p)] = p
+            lens[i] = max(1, len(p))
+        temp_arr = np.zeros((B,), np.float32)
+        temp_arr[:n] = temps
+        top_arr = np.ones((B,), np.float32)
+        top_arr[:n] = tops
+        active = np.zeros((B,), bool)
+        active[:n] = True
+
+        out, n_emitted = self._step(
+            self.params, jnp.asarray(tokens), jnp.asarray(lens),
+            rng if rng is not None else self.next_rng(),
+            jnp.asarray(temp_arr), jnp.asarray(top_arr), jnp.asarray(active),
+            max_new=max_new, cache_len=T + max_new,
+        )
+        out = np.asarray(out)
+        n_emitted = np.asarray(n_emitted)
+        latency = time.monotonic() - t0
+
+        results = []
+        for i in range(n):
+            # Extract by emitted COUNT, not by sentinel scan: pad_id may be a
+            # real vocab token in HF checkpoints.
+            k = min(int(n_emitted[i]), max_new_tokens)
+            ids = [int(t) for t in out[i, :k]]
+            finish = "length"
+            if ids and ids[-1] == self.cfg.eos_token_id:
+                ids.pop()
+                finish = "stop"
+            results.append(GenResult(
+                token_ids=ids,
+                text=self.tokenizer.decode(ids),
+                n_prompt_tokens=len(prompts[i]),
+                n_gen_tokens=len(ids),
+                latency_s=latency,
+                finish_reason=finish,
+            ))
+        return results

@@ -1,0 +1,151 @@
+"""Tokenizers for the in-tree model pool.
+
+Replaces the reference's tiktoken Rust NIF, which only *estimated* token counts
+with a cl100k approximation plus a 12% safety margin (reference
+lib/quoracle/agent/token_manager.ex:19-24, per_model_query.ex:20-24). Here each
+served model counts with its *own* tokenizer, so context budgeting is exact and
+the margin drops to zero.
+
+Three implementations behind one interface:
+  * ByteTokenizer   — reversible byte-level vocab; tests, bench, tiny models.
+  * HFTokenizer     — wraps a ``tokenizers``-format tokenizer.json when real
+                      checkpoints are used.
+  * native C++ BPE  — see native/ (drop-in via the same interface).
+
+All are stateless after construction and safe to share across threads.
+"""
+
+from __future__ import annotations
+
+import abc
+from functools import lru_cache
+from typing import Sequence
+
+PAD_ID = 0
+BOS_ID = 1
+EOS_ID = 2
+_N_SPECIALS = 3
+
+
+class Tokenizer(abc.ABC):
+    """Interface the runtime, TokenManager, and consensus layers depend on."""
+
+    pad_id: int = PAD_ID
+    bos_id: int = BOS_ID
+    eos_id: int = EOS_ID
+
+    @abc.abstractmethod
+    def encode(self, text: str, add_bos: bool = False) -> list[int]: ...
+
+    @abc.abstractmethod
+    def decode(self, ids: Sequence[int]) -> str: ...
+
+    @property
+    @abc.abstractmethod
+    def vocab_size(self) -> int: ...
+
+    def count(self, text: str) -> int:
+        return len(self.encode(text))
+
+    # -- chat templating ----------------------------------------------------
+    # The reference sends provider-formatted message arrays over HTTPS; here
+    # we render them to a prompt string ourselves. One neutral template for
+    # every family keeps prompt-parity tests model-independent.
+
+    def render_chat(self, messages: Sequence[dict]) -> str:
+        parts = []
+        for m in messages:
+            role = m.get("role", "user")
+            content = m.get("content", "")
+            if not isinstance(content, str):
+                content = _stringify_content(content)
+            parts.append(f"<|{role}|>\n{content}\n")
+        parts.append("<|assistant|>\n")
+        return "".join(parts)
+
+    def encode_chat(self, messages: Sequence[dict]) -> list[int]:
+        return self.encode(self.render_chat(messages), add_bos=True)
+
+
+def _stringify_content(content) -> str:
+    """Multimodal content array -> text (parity with the reference's
+    ContentStringifier, reference lib/quoracle/utils/content_stringifier.ex)."""
+    if isinstance(content, list):
+        out = []
+        for part in content:
+            if isinstance(part, dict):
+                if part.get("type") == "text":
+                    out.append(part.get("text", ""))
+                elif part.get("type") in ("image", "image_url"):
+                    out.append("[image]")
+                else:
+                    out.append(str(part))
+            else:
+                out.append(str(part))
+        return "\n".join(out)
+    return str(content)
+
+
+class ByteTokenizer(Tokenizer):
+    """Byte-level reversible tokenizer: id = byte + 3 specials offset."""
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]:
+        ids = [b + _N_SPECIALS for b in text.encode("utf-8")]
+        return [self.bos_id] + ids if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        # Ids beyond the byte range can appear when a model's vocab is larger
+        # than the tokenizer's (tiny random-weight test models); skip them.
+        data = bytes(i - _N_SPECIALS for i in ids
+                     if _N_SPECIALS <= i < 256 + _N_SPECIALS)
+        return data.decode("utf-8", errors="replace")
+
+    @property
+    def vocab_size(self) -> int:
+        return 256 + _N_SPECIALS
+
+
+class HFTokenizer(Tokenizer):
+    """Binding over a HuggingFace ``tokenizers`` file (tokenizer.json)."""
+
+    def __init__(self, path: str, bos_id: int = BOS_ID, eos_id: int = EOS_ID):
+        from tokenizers import Tokenizer as _HF
+        self._tok = _HF.from_file(path)
+        self.bos_id = bos_id
+        self.eos_id = eos_id
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]:
+        ids = self._tok.encode(text, add_special_tokens=False).ids
+        return [self.bos_id] + ids if add_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    @property
+    def vocab_size(self) -> int:
+        return self._tok.get_vocab_size()
+
+
+@lru_cache(maxsize=None)
+def get_tokenizer(model_name: str, tokenizer_path: str | None = None) -> Tokenizer:
+    """Tokenizer for a catalog model. Tiny/bench models use bytes; real
+    checkpoints pass an explicit tokenizer.json path.
+
+    bos/eos ids come from the model's catalog entry so the tokenizer and the
+    engine's stop condition always agree (the engine stops on
+    ``ModelConfig.eos_token_id``)."""
+    from quoracle_tpu.models.config import get_model_config
+    try:
+        cfg = get_model_config(model_name)
+        bos, eos = cfg.bos_token_id, cfg.eos_token_id
+    except KeyError:
+        bos, eos = BOS_ID, EOS_ID
+    if tokenizer_path:
+        return HFTokenizer(tokenizer_path, bos_id=bos, eos_id=eos)
+    try:
+        from quoracle_tpu.native.tokenizer import NativeBPETokenizer, native_available
+        if native_available():
+            return NativeBPETokenizer.byte_level()
+    except ImportError:
+        pass
+    return ByteTokenizer()

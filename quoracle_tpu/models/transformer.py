@@ -1,0 +1,184 @@
+"""Decoder-only transformer forward pass, pure JAX.
+
+One traced function serves every family in the catalog (Llama/Mistral/Gemma
+quirks are ModelConfig data — see models/config.py). Design choices are
+TPU-first, not a translation of anything in the reference (which runs no model
+math locally, SURVEY.md §2.8):
+
+  * layers are STACKED on a leading axis and iterated with ``lax.scan`` —
+    one compiled layer body regardless of depth (fast compiles, XLA-friendly);
+  * params live in bf16; layernorm/softmax math in fp32;
+  * KV cache is a position-ordered padded buffer updated in-place via
+    ``lax.dynamic_update_slice_in_dim``; attention masks by integer lengths,
+    so the whole step is shape-static under jit;
+  * the same forward serves prefill (T = chunk) and decode (T = 1).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from quoracle_tpu.models.config import ModelConfig
+from quoracle_tpu.ops.attention import attend
+
+
+class KVCache(NamedTuple):
+    """Per-model KV buffer. k/v: [L, B, S, n_kv, head_dim]; lens: [B]."""
+
+    k: jax.Array
+    v: jax.Array
+    lens: jax.Array  # int32 valid length per row
+
+    @property
+    def max_len(self) -> int:
+        return self.k.shape[2]
+
+
+def init_cache(cfg: ModelConfig, batch: int, max_len: int,
+               dtype=jnp.bfloat16) -> KVCache:
+    shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, dtype),
+        v=jnp.zeros(shape, dtype),
+        lens=jnp.zeros((batch,), jnp.int32),
+    )
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
+    """Random-init params pytree (normal/sqrt(dim) — used for tests and bench;
+    real checkpoints come through models/loader.py)."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    L, D, F = cfg.n_layers, cfg.dim, cfg.ffn_dim
+    H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+
+    def normal(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32) * (fan_in ** -0.5)).astype(dtype)
+
+    lk = jax.random.split(k_layers, 7)
+    params = {
+        "embed": normal(k_embed, (cfg.vocab_size, D), D),
+        "layers": {
+            "attn_norm": jnp.ones((L, D), dtype),
+            "wq": normal(lk[0], (L, D, H * HD), D),
+            "wk": normal(lk[1], (L, D, KV * HD), D),
+            "wv": normal(lk[2], (L, D, KV * HD), D),
+            "wo": normal(lk[3], (L, H * HD, D), H * HD),
+            "mlp_norm": jnp.ones((L, D), dtype),
+            "w_gate": normal(lk[4], (L, D, F), D),
+            "w_up": normal(lk[5], (L, D, F), D),
+            "w_down": normal(lk[6], (L, F, D), F),
+        },
+        "final_norm": jnp.ones((D,), dtype),
+    }
+    if cfg.rmsnorm_plus_one:
+        # Gemma norm weights are a delta around 1; zero-init matches identity.
+        params["layers"]["attn_norm"] = jnp.zeros((L, D), dtype)
+        params["layers"]["mlp_norm"] = jnp.zeros((L, D), dtype)
+        params["final_norm"] = jnp.zeros((D,), dtype)
+    if not cfg.tie_embeddings:
+        params["lm_head"] = normal(k_head, (D, cfg.vocab_size), D)
+    return params
+
+
+def rmsnorm(x: jax.Array, w: jax.Array, eps: float, plus_one: bool) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    normed = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    wf = w.astype(jnp.float32)
+    if plus_one:
+        wf = 1.0 + wf
+    return (normed * wf).astype(x.dtype)
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding. x: [B, T, heads, hd]; positions: [B, T]."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = positions.astype(jnp.float32)[:, :, None, None] * freqs  # [B,T,1,half]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _activation(x: jax.Array, kind: str) -> jax.Array:
+    if kind == "silu":
+        return jax.nn.silu(x)
+    if kind == "gelu":
+        return jax.nn.gelu(x, approximate=True)
+    raise ValueError(f"unknown activation {kind!r}")
+
+
+def forward(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jax.Array,       # [B, T] int32
+    positions: jax.Array,    # [B, T] int32 absolute positions
+    cache: KVCache,
+    write_offset: jax.Array,  # [B] int32: where this chunk's kv entries land
+    kv_lens: jax.Array,       # [B] int32 valid kv count AFTER this chunk
+) -> tuple[jax.Array, KVCache]:
+    """Run the stack over a token chunk, updating the cache.
+
+    The kv buffer is position-ordered (a token at absolute position p lives at
+    buffer index p), so right-padded prompt rows simply leave garbage beyond
+    ``kv_lens[b]`` which the attention validity mask ignores; decode later
+    overwrites index ``lens[b]`` with the real next token.
+
+    Returns (logits [B, T, vocab] fp32, cache with k/v written at
+    ``write_offset``). The caller advances ``cache.lens`` — keeping length
+    bookkeeping out of the traced body lets the same trace serve speculative /
+    chunked prefill.
+    """
+    B, T = tokens.shape
+    x = params["embed"][tokens]  # gather: [B, T, D]
+    if cfg.scale_embeddings:
+        x = (x.astype(jnp.float32) * (cfg.dim ** 0.5)).astype(x.dtype)
+
+    # Offsets are per-row; rows share one buffer write position only when all
+    # offsets are equal. We write per-row with a vmap'd dynamic slice.
+    def write_row(buf_l, new_l, off):
+        # buf_l: [S, n_kv, hd]; new_l: [T, n_kv, hd]
+        return jax.lax.dynamic_update_slice_in_dim(buf_l, new_l, off, axis=0)
+
+    def layer_body(x, scanned):
+        p, k_buf, v_buf = scanned  # p: one layer's params; bufs: [B, S, kv, hd]
+        h = rmsnorm(x, p["attn_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
+        q = jnp.einsum("btd,dh->bth", h, p["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim)
+        k = jnp.einsum("btd,dh->bth", h, p["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        v = jnp.einsum("btd,dh->bth", h, p["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+
+        k_buf = jax.vmap(write_row)(k_buf, k, write_offset)
+        v_buf = jax.vmap(write_row)(v_buf, v, write_offset)
+
+        attn = attend(q, k_buf, v_buf, positions,
+                      kv_len=kv_lens,
+                      sliding_window=cfg.sliding_window)
+        x = x + jnp.einsum("bthd,hdD->btD", attn,
+                           p["wo"].reshape(cfg.n_heads, cfg.head_dim, cfg.dim))
+
+        h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
+        gate = _activation(jnp.einsum("btd,df->btf", h, p["w_gate"]), cfg.activation)
+        up = jnp.einsum("btd,df->btf", h, p["w_up"])
+        x = x + jnp.einsum("btf,fd->btd", gate * up, p["w_down"])
+        return x, (k_buf, v_buf)
+
+    x, (new_k, new_v) = jax.lax.scan(layer_body, x, (params["layers"], cache.k, cache.v))
+
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32), head.astype(jnp.float32))
+    if cfg.final_logit_softcap is not None:
+        c = cfg.final_logit_softcap
+        logits = c * jnp.tanh(logits / c)
+
+    return logits, KVCache(k=new_k, v=new_v, lens=cache.lens)
+
+
+def param_count(params: dict) -> int:
+    return sum(int(p.size) for p in jax.tree.leaves(params))

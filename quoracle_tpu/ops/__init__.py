@@ -1,0 +1,6 @@
+"""TPU compute ops: attention implementations and (later) pallas kernels.
+
+No reference counterpart — the reference (shelvick/quoracle) executes no model
+math locally (SURVEY.md §2.8); this package exists because the model pool is
+in-tree here.
+"""

@@ -1,0 +1,71 @@
+"""Attention ops for cache-backed decoding and prefill.
+
+Dense XLA implementations first — shaped so XLA tiles the contractions onto
+the MXU (contractions over head_dim / kv-length, batched over [B, heads]) and
+fuses the mask/softmax chain. A pallas ragged/paged decode kernel can slot in
+behind the same signatures later (see PAPERS.md: Ragged Paged Attention,
+arxiv 2604.15464).
+
+All functions are pure and shape-static: callers pass padded buffers plus
+integer lengths, never ragged structures.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def repeat_kv(x: jax.Array, q_per_kv: int) -> jax.Array:
+    """[B, S, n_kv, hd] -> [B, S, n_kv * q_per_kv, hd] by head repetition (GQA)."""
+    if q_per_kv == 1:
+        return x
+    b, s, n_kv, hd = x.shape
+    x = jnp.broadcast_to(x[:, :, :, None, :], (b, s, n_kv, q_per_kv, hd))
+    return x.reshape(b, s, n_kv * q_per_kv, hd)
+
+
+def attend(
+    q: jax.Array,            # [B, T, n_heads, hd]  (T = query chunk length)
+    k: jax.Array,            # [B, S, n_kv, hd]     (S = padded kv buffer length)
+    v: jax.Array,            # [B, S, n_kv, hd]
+    q_positions: jax.Array,  # [B, T] int32 absolute positions of the queries
+    kv_len: jax.Array,       # [B] int32 number of valid kv entries (<= S)
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Causal attention of a query chunk against a (partially filled) kv buffer.
+
+    Serves both prefill (T = prompt chunk) and decode (T = 1) — one code path,
+    two jit specializations. Masking combines:
+      * validity:  kv index < kv_len[b]
+      * causality: kv position <= query position (kv buffer is position-ordered,
+        so kv index == kv absolute position)
+      * sliding window (optional): query_pos - kv_pos < window
+    Returns [B, T, n_heads, hd].
+    """
+    b, t, n_heads, hd = q.shape
+    s = k.shape[1]
+    q_per_kv = n_heads // k.shape[2]
+
+    k = repeat_kv(k, q_per_kv)
+    v = repeat_kv(v, q_per_kv)
+
+    scale = hd ** -0.5
+    # [B, heads, T, S] — contraction over head_dim rides the MXU.
+    scores = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32) * scale,
+                        k.astype(jnp.float32))
+
+    kv_pos = jnp.arange(s, dtype=jnp.int32)[None, None, :]        # [1, 1, S]
+    qp = q_positions.astype(jnp.int32)[:, :, None]                # [B, T, 1]
+    valid = kv_pos < kv_len.astype(jnp.int32)[:, None, None]      # [B, T, S]
+    causal = kv_pos <= qp
+    mask = valid & causal
+    if sliding_window is not None:
+        mask = mask & (qp - kv_pos < sliding_window)
+
+    scores = jnp.where(mask[:, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -1,0 +1,23 @@
+"""Test environment: force an 8-virtual-device CPU mesh BEFORE jax imports.
+
+Multi-chip hardware is not available in CI; sharding tests run against
+``--xla_force_host_platform_device_count=8`` exactly as the driver's
+dryrun_multichip does. Real-TPU paths are exercised by bench.py, not tests.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_devices():
+    import jax
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

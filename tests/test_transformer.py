@@ -1,0 +1,125 @@
+"""Model-runtime core: forward correctness properties.
+
+Strategy per SURVEY.md §4: deterministic, parallel-safe unit tests with no
+shared state — every test builds its own params/caches.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quoracle_tpu.models.config import get_model_config
+from quoracle_tpu.models.transformer import (
+    forward, init_cache, init_params, param_count, rmsnorm, rope,
+)
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = get_model_config("xla:tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _full_forward(cfg, params, tokens):
+    B, T = tokens.shape
+    cache = init_cache(cfg, B, T)
+    positions = jnp.broadcast_to(jnp.arange(T)[None, :], (B, T)).astype(jnp.int32)
+    lens = jnp.full((B,), T, jnp.int32)
+    logits, cache = forward(params, cfg, tokens, positions, cache,
+                            write_offset=jnp.zeros((B,), jnp.int32), kv_lens=lens)
+    return logits, cache
+
+
+def test_forward_shapes(tiny):
+    cfg, params = tiny
+    tokens = jnp.ones((2, 7), jnp.int32)
+    logits, cache = _full_forward(cfg, params, tokens)
+    assert logits.shape == (2, 7, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert cache.k.shape == (cfg.n_layers, 2, 7, cfg.n_kv_heads, cfg.head_dim)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_causality(tiny):
+    """Changing a future token must not change past logits."""
+    cfg, params = tiny
+    key = jax.random.PRNGKey(1)
+    toks = jax.random.randint(key, (1, 8), 0, cfg.vocab_size)
+    logits_a, _ = _full_forward(cfg, params, toks)
+    toks_b = toks.at[0, 6].set((toks[0, 6] + 1) % cfg.vocab_size)
+    logits_b, _ = _full_forward(cfg, params, toks_b)
+    np.testing.assert_allclose(np.asarray(logits_a[0, :6]),
+                               np.asarray(logits_b[0, :6]), rtol=2e-4, atol=2e-4)
+    assert not np.allclose(np.asarray(logits_a[0, 6]), np.asarray(logits_b[0, 6]))
+
+
+def test_incremental_matches_full(tiny):
+    """Prefill(t0..t6) then decode(t7) == full forward of t0..t7."""
+    cfg, params = tiny
+    toks = jax.random.randint(jax.random.PRNGKey(2), (2, 8), 0, cfg.vocab_size)
+    full_logits, _ = _full_forward(cfg, params, toks)
+
+    cache = init_cache(cfg, 2, 8)
+    pos = jnp.broadcast_to(jnp.arange(7)[None, :], (2, 7)).astype(jnp.int32)
+    _, cache = forward(params, cfg, toks[:, :7], pos, cache,
+                       write_offset=jnp.zeros((2,), jnp.int32),
+                       kv_lens=jnp.full((2,), 7, jnp.int32))
+    cache = cache._replace(lens=jnp.full((2,), 7, jnp.int32))
+    last_logits, _ = forward(params, cfg, toks[:, 7:8],
+                             jnp.full((2, 1), 7, jnp.int32), cache,
+                             write_offset=cache.lens,
+                             kv_lens=cache.lens + 1)
+    np.testing.assert_allclose(np.asarray(last_logits[:, 0]),
+                               np.asarray(full_logits[:, 7]), rtol=2e-3, atol=2e-3)
+
+
+def test_ragged_prefill_ignores_padding(tiny):
+    """A short prompt right-padded with junk must produce the same logits at
+    its last real token as the unpadded run (validity masking)."""
+    cfg, params = tiny
+    toks = jax.random.randint(jax.random.PRNGKey(3), (1, 5), 0, cfg.vocab_size)
+    logits_exact, _ = _full_forward(cfg, params, toks)
+
+    padded = jnp.concatenate(
+        [toks, jax.random.randint(jax.random.PRNGKey(4), (1, 3), 0, cfg.vocab_size)],
+        axis=1)
+    cache = init_cache(cfg, 1, 8)
+    pos = jnp.broadcast_to(jnp.arange(8)[None, :], (1, 8)).astype(jnp.int32)
+    logits_padded, _ = forward(params, cfg, padded, pos, cache,
+                               write_offset=jnp.zeros((1,), jnp.int32),
+                               kv_lens=jnp.asarray([5], jnp.int32))
+    np.testing.assert_allclose(np.asarray(logits_padded[0, 4]),
+                               np.asarray(logits_exact[0, 4]), rtol=2e-4, atol=2e-4)
+
+
+def test_gemma_family_variant_runs():
+    cfg = get_model_config("tiny-gemma")
+    params = init_params(cfg, jax.random.PRNGKey(5))
+    assert "lm_head" not in params  # tied embeddings
+    tokens = jnp.ones((1, 4), jnp.int32)
+    logits, _ = _full_forward(cfg, params, tokens)
+    assert logits.shape == (1, 4, cfg.vocab_size)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_sliding_window_masks_distant_tokens():
+    from quoracle_tpu.models.config import ModelConfig, register_model
+    cfg = ModelConfig(name="tiny-swa", vocab_size=128, dim=32, n_layers=1,
+                      n_heads=2, n_kv_heads=2, ffn_dim=64, sliding_window=4,
+                      context_window=64)
+    params = init_params(cfg, jax.random.PRNGKey(6))
+    toks = jax.random.randint(jax.random.PRNGKey(7), (1, 12), 0, cfg.vocab_size)
+    logits_a, _ = _full_forward(cfg, params, toks)
+    # Mutate a token > window away from the last position: logits at the last
+    # position must be unchanged.
+    toks_b = toks.at[0, 2].set((toks[0, 2] + 1) % cfg.vocab_size)
+    logits_b, _ = _full_forward(cfg, params, toks_b)
+    np.testing.assert_allclose(np.asarray(logits_a[0, 11]),
+                               np.asarray(logits_b[0, 11]), rtol=2e-4, atol=2e-4)
+
+
+def test_param_count_tiny(tiny):
+    cfg, params = tiny
+    assert param_count(params) > cfg.vocab_size * cfg.dim
