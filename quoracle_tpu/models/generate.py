@@ -23,23 +23,29 @@ import numpy as np
 
 from quoracle_tpu.models.config import ModelConfig
 from quoracle_tpu.models.sampling import sample_tokens
-from quoracle_tpu.models.transformer import KVCache, forward, init_cache
+from quoracle_tpu.models.transformer import (
+    KVCache, forward_hidden, init_cache, project_logits,
+)
 
 
 def prefill(params: dict, cfg: ModelConfig, tokens: jax.Array,
             prompt_lens: jax.Array, cache: KVCache) -> tuple[jax.Array, KVCache]:
     """Fill the cache from right-padded prompts. Returns (last-token logits
-    [B, V], cache with lens = prompt_lens)."""
+    [B, V], cache with lens = prompt_lens).
+
+    The head projection happens AFTER gathering each row's last hidden state —
+    projecting the full [B, T, vocab] tensor first would cost ~4 GB/row fp32
+    at llama-3-8b scale for values that are immediately discarded."""
     B, T = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
-    logits, cache = forward(
+    hidden, cache = forward_hidden(
         params, cfg, tokens, positions, cache,
         write_offset=jnp.zeros((B,), jnp.int32),
         kv_lens=prompt_lens,
     )
-    last = jnp.take_along_axis(
-        logits, (prompt_lens - 1)[:, None, None].astype(jnp.int32), axis=1
-    )[:, 0, :]
+    last_h = jnp.take_along_axis(
+        hidden, (prompt_lens - 1)[:, None, None].astype(jnp.int32), axis=1)
+    last = project_logits(params, cfg, last_h)[:, 0, :]
     return last, cache._replace(lens=prompt_lens.astype(jnp.int32))
 
 
@@ -54,6 +60,7 @@ def decode(
     max_new: int,
     eos_id: int,
     active: jax.Array,         # [B] bool — False for batch-bucket padding rows
+    row_limit: jax.Array,      # [B] int32 per-row generation budget (<= max_new)
     pad_id: int = 0,
 ) -> tuple[jax.Array, jax.Array]:
     """Autoregressive decode.
@@ -63,16 +70,20 @@ def decode(
     the loop carry — output extraction must not scan for sentinels, because
     pad_id can be a legitimate vocab token in real checkpoints.
 
-    Padding rows (``~active``) start done, so the EOS early-exit fires as
-    soon as every REAL row has finished.
+    ``max_new`` is the STATIC loop/buffer bound (shape-bucketed for compile
+    caching); ``row_limit`` is the TRACED per-row budget — min(requested
+    max_new_tokens, context_window - prompt_len). A row stops at EOS or at
+    its limit, so bucketing never costs extra forward steps and no row's
+    positions run past the context window. Padding rows (``~active``) start
+    done, so the early-exit fires when every REAL row has finished.
     """
     B = first_logits.shape[0]
 
     rng, k0 = jax.random.split(rng)
     tok0 = sample_tokens(first_logits, k0, temperature, top_p)
-    done0 = ~active | (tok0 == eos_id)
-    out0 = jnp.full((B, max_new), pad_id, jnp.int32).at[:, 0].set(tok0)
     n0 = jnp.where(active, 1, 0).astype(jnp.int32)
+    done0 = ~active | (tok0 == eos_id) | (n0 >= row_limit)
+    out0 = jnp.full((B, max_new), pad_id, jnp.int32).at[:, 0].set(tok0)
 
     def cond(carry):
         i, done, *_ = carry
@@ -81,17 +92,18 @@ def decode(
     def body(carry):
         i, done, cur, out, n_emitted, cache, rng = carry
         positions = cache.lens[:, None]
-        logits, cache = forward(
+        hidden, cache = forward_hidden(
             params, cfg, cur[:, None], positions, cache,
             write_offset=cache.lens, kv_lens=cache.lens + 1,
         )
+        logits = project_logits(params, cfg, hidden)
         rng, k = jax.random.split(rng)
         nxt = sample_tokens(logits[:, 0, :], k, temperature, top_p)
         nxt = jnp.where(done, pad_id, nxt)
         out = jax.lax.dynamic_update_slice_in_dim(out, nxt[:, None], i, axis=1)
         n_emitted = n_emitted + jnp.where(done, 0, 1).astype(jnp.int32)
         cache = cache._replace(lens=cache.lens + jnp.where(done, 0, 1))
-        done = done | (nxt == eos_id)
+        done = done | (nxt == eos_id) | (n_emitted >= row_limit)
         return (i + 1, done, nxt, out, n_emitted, cache, rng)
 
     # Feed the first sampled token through the loop starting at step 1.
@@ -105,6 +117,12 @@ def _round_up(n: int, buckets: Sequence[int]) -> int:
         if n <= b:
             return b
     return n
+
+
+class ContextOverflowError(ValueError):
+    """Prompt does not fit the model's context window. The condensation layer
+    catches this and retries after condensing (reference semantics:
+    per_model_query.ex:93-120 retry-on-context-overflow)."""
 
 
 @dataclasses.dataclass
@@ -143,13 +161,14 @@ class GenerateEngine:
 
         @functools.partial(jax.jit, static_argnames=("max_new", "cache_len"))
         def step(params, tokens, prompt_lens, rng, temperature, top_p, active,
-                 max_new: int, cache_len: int):
+                 row_limit, max_new: int, cache_len: int):
             B = tokens.shape[0]
             cache = init_cache(cfg, B, cache_len)
             last_logits, cache = prefill(params, cfg, tokens, prompt_lens, cache)
             out, n_emitted = decode(params, cfg, cache, last_logits, rng,
                                     temperature, top_p, max_new, cfg.eos_token_id,
-                                    active=active, pad_id=self.tokenizer.pad_id)
+                                    active=active, row_limit=row_limit,
+                                    pad_id=self.tokenizer.pad_id)
             return out, n_emitted
 
         return step
@@ -174,21 +193,30 @@ class GenerateEngine:
         tops = [top_p] * n if isinstance(top_p, (int, float)) else list(top_p)
 
         max_prompt = max(len(p) for p in prompts)
-        if max_prompt + max_new_tokens > self.max_seq:
-            max_new_tokens = max(1, self.max_seq - max_prompt)
+        if max_prompt >= self.max_seq:
+            # The context layer (condensation) is responsible for fitting
+            # prompts; a prompt at/over the window is a caller bug, parallel
+            # to the reference's context-overflow error path
+            # (per_model_query.ex:93-120) — loud, never silent garbage.
+            raise ContextOverflowError(
+                f"prompt of {max_prompt} tokens >= max_seq {self.max_seq} "
+                f"for model {self.cfg.name}")
         T = _round_up(max_prompt, self.prompt_buckets)
         B = _round_up(n, self.BATCH_BUCKETS)
         # Bucket the decode bound too: consensus computes a DYNAMIC max_tokens
         # per round (reference per_model_query.ex:136-145), which would
-        # otherwise trigger one XLA compile per unique value. EOS still exits
-        # the while_loop early; results are truncated to the requested bound.
-        max_new = _round_up(max_new_tokens, (64, 128, 256, 512, 1024, 2048, 4096))
+        # otherwise trigger one XLA compile per unique value. Per-row TRACED
+        # limits stop each row at its own budget, so bucketing costs nothing.
+        max_new = _round_up(min(max_new_tokens, self.max_seq - 1),
+                            (64, 128, 256, 512, 1024, 2048, 4096))
 
         tokens = np.full((B, T), self.tokenizer.pad_id, np.int32)
         lens = np.ones((B,), np.int32)  # padded rows get length 1 (harmless)
+        limits = np.ones((B,), np.int32)
         for i, p in enumerate(prompts):
             tokens[i, :len(p)] = p
             lens[i] = max(1, len(p))
+            limits[i] = max(1, min(max_new_tokens, self.max_seq - lens[i]))
         temp_arr = np.zeros((B,), np.float32)
         temp_arr[:n] = temps
         top_arr = np.ones((B,), np.float32)
@@ -200,6 +228,7 @@ class GenerateEngine:
             self.params, jnp.asarray(tokens), jnp.asarray(lens),
             rng if rng is not None else self.next_rng(),
             jnp.asarray(temp_arr), jnp.asarray(top_arr), jnp.asarray(active),
+            jnp.asarray(limits),
             max_new=max_new, cache_len=T + max_new,
         )
         out = np.asarray(out)

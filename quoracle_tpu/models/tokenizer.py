@@ -142,10 +142,11 @@ def get_tokenizer(model_name: str, tokenizer_path: str | None = None) -> Tokeniz
         bos, eos = BOS_ID, EOS_ID
     if tokenizer_path:
         return HFTokenizer(tokenizer_path, bos_id=bos, eos_id=eos)
+    tok: Tokenizer
     try:
         from quoracle_tpu.native.tokenizer import NativeBPETokenizer, native_available
-        if native_available():
-            return NativeBPETokenizer.byte_level()
+        tok = NativeBPETokenizer.byte_level() if native_available() else ByteTokenizer()
     except ImportError:
-        pass
-    return ByteTokenizer()
+        tok = ByteTokenizer()
+    tok.bos_id, tok.eos_id = bos, eos
+    return tok

@@ -1,0 +1,59 @@
+"""Training step (fine-tuning) for catalog models.
+
+The reference has no training at all (every model is a hosted API); an
+in-tree pool makes fine-tuning a new first-class capability — e.g. adapting a
+pool member on accumulated ACE lessons. Also the substrate for the driver's
+multichip dry-run: one jitted step over the dp×tp mesh with the same param
+shardings the serving path uses (parallel/mesh.py), so XLA lays grads and
+optimizer state out exactly like the weights (psum over dp for grads rides
+ICI).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from quoracle_tpu.models.config import ModelConfig
+from quoracle_tpu.models.transformer import KVCache, forward, init_cache
+
+
+class TrainState(NamedTuple):
+    params: dict
+    opt_state: optax.OptState
+    step: jax.Array
+
+
+def make_optimizer(lr: float = 1e-4, weight_decay: float = 0.01):
+    return optax.adamw(lr, weight_decay=weight_decay)
+
+
+def loss_fn(params: dict, cfg: ModelConfig, tokens: jax.Array,
+            loss_mask: jax.Array) -> jax.Array:
+    """Next-token cross-entropy over [B, T] token batches.
+
+    Runs the same forward as serving (cache write is a no-op cost at T=S);
+    one code path to maintain and the dry-run exercises the real model.
+    """
+    B, T = tokens.shape
+    cache = init_cache(cfg, B, T, dtype=jnp.bfloat16)
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    logits, _ = forward(params, cfg, tokens, positions, cache,
+                        write_offset=jnp.zeros((B,), jnp.int32),
+                        kv_lens=jnp.full((B,), T, jnp.int32))
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = loss_mask[:, 1:].astype(jnp.float32)
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def train_step(state: TrainState, cfg: ModelConfig, optimizer,
+               tokens: jax.Array, loss_mask: jax.Array) -> tuple[TrainState, jax.Array]:
+    loss, grads = jax.value_and_grad(loss_fn)(state.params, cfg, tokens, loss_mask)
+    updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
+    params = optax.apply_updates(state.params, updates)
+    return TrainState(params, opt_state, state.step + 1), loss

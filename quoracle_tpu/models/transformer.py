@@ -112,7 +112,7 @@ def _activation(x: jax.Array, kind: str) -> jax.Array:
     raise ValueError(f"unknown activation {kind!r}")
 
 
-def forward(
+def forward_hidden(
     params: dict,
     cfg: ModelConfig,
     tokens: jax.Array,       # [B, T] int32
@@ -121,17 +121,16 @@ def forward(
     write_offset: jax.Array,  # [B] int32: where this chunk's kv entries land
     kv_lens: jax.Array,       # [B] int32 valid kv count AFTER this chunk
 ) -> tuple[jax.Array, KVCache]:
-    """Run the stack over a token chunk, updating the cache.
+    """Run the stack over a token chunk, updating the cache; returns final
+    hidden states [B, T, D] (pre-head) — see project_logits.
 
     The kv buffer is position-ordered (a token at absolute position p lives at
     buffer index p), so right-padded prompt rows simply leave garbage beyond
     ``kv_lens[b]`` which the attention validity mask ignores; decode later
     overwrites index ``lens[b]`` with the real next token.
 
-    Returns (logits [B, T, vocab] fp32, cache with k/v written at
-    ``write_offset``). The caller advances ``cache.lens`` — keeping length
-    bookkeeping out of the traced body lets the same trace serve speculative /
-    chunked prefill.
+    The caller advances ``cache.lens`` — keeping length bookkeeping out of
+    the traced body lets the same trace serve speculative / chunked prefill.
     """
     B, T = tokens.shape
     x = params["embed"][tokens]  # gather: [B, T, D]
@@ -171,13 +170,33 @@ def forward(
     x, (new_k, new_v) = jax.lax.scan(layer_body, x, (params["layers"], cache.k, cache.v))
 
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
+    return x, KVCache(k=new_k, v=new_v, lens=cache.lens)
+
+
+def project_logits(params: dict, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
+    """Final hidden states [B, T, D] -> logits [B, T, vocab] fp32.
+
+    Split from the stack so prefill can gather ONE position per row before
+    projecting — at llama-3-8b scale a full [B, 8192, 128256] fp32 logits
+    tensor is ~4 GB/row and would blow HBM for a value that's 99.99% discarded.
+    """
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = jnp.einsum("btd,dv->btv", x.astype(jnp.float32), head.astype(jnp.float32))
+    logits = jnp.einsum("btd,dv->btv", hidden.astype(jnp.float32),
+                        head.astype(jnp.float32))
     if cfg.final_logit_softcap is not None:
         c = cfg.final_logit_softcap
         logits = c * jnp.tanh(logits / c)
+    return logits
 
-    return logits, KVCache(k=new_k, v=new_v, lens=cache.lens)
+
+def forward(params: dict, cfg: ModelConfig, tokens: jax.Array,
+            positions: jax.Array, cache: KVCache, write_offset: jax.Array,
+            kv_lens: jax.Array) -> tuple[jax.Array, KVCache]:
+    """forward_hidden + full-sequence head projection. Convenience for
+    tests/training; serving paths gather positions from forward_hidden first."""
+    hidden, cache = forward_hidden(params, cfg, tokens, positions, cache,
+                                   write_offset, kv_lens)
+    return project_logits(params, cfg, hidden), cache
 
 
 def param_count(params: dict) -> int:

@@ -1,0 +1,17 @@
+"""Device-mesh parallelism: sharding specs + collectives layout.
+
+TPU-only layer with no reference counterpart — the reference's "distribution"
+is actor concurrency on one BEAM node (SURVEY.md §2.9); model-level
+parallelism here is new capability: tensor parallel within a pool member,
+data parallel across consensus batch rows, sequence parallel (ring attention)
+for long context, all expressed as jax.sharding annotations over one Mesh so
+XLA inserts ICI collectives.
+"""
+
+from quoracle_tpu.parallel.mesh import (  # noqa: F401
+    cache_spec,
+    data_spec,
+    make_mesh,
+    param_specs,
+    shard_params,
+)

@@ -1,0 +1,102 @@
+"""Mesh construction + PartitionSpecs for the model runtime.
+
+Sharding philosophy (scaling-book recipe): pick a mesh, annotate params and
+activations with NamedSharding, let XLA/GSPMD insert the collectives, which
+ride ICI. Axes:
+
+  dp — data parallel: consensus batch rows ([model-pool member x agent] rows)
+  tp — tensor parallel: attention heads / ffn columns within one pool member
+  sp — sequence parallel: long-context ring attention (ops/ring_attention.py)
+
+A 3-model pool on a v5e-8 is three sub-meshes (static chip partition, host
+scheduler launches the three generates concurrently) OR one mesh where the
+pool rides the dp axis; both are expressible here because specs only name
+axes, never device counts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from quoracle_tpu.models.config import ModelConfig
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    tp: Optional[int] = None,
+    axis_names: Sequence[str] = ("dp", "tp"),
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a dp×tp mesh over the first n_devices devices.
+
+    tp defaults to all devices (dp=1): latency-optimal for a single agent's
+    consensus round; callers raise dp when many agents decode concurrently.
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    n = n_devices or len(devs)
+    devs = devs[:n]
+    tp = tp or n
+    assert n % tp == 0, f"{n} devices not divisible by tp={tp}"
+    arr = np.array(devs).reshape(n // tp, tp)
+    return Mesh(arr, axis_names=tuple(axis_names))
+
+
+def _largest_tp_divisor(n_kv_heads: int, tp_size: int) -> int:
+    d = min(n_kv_heads, tp_size)
+    while n_kv_heads % d or tp_size % d:
+        d -= 1
+    return d
+
+
+def param_specs(cfg: ModelConfig) -> dict:
+    """PartitionSpec pytree matching transformer.init_params' structure.
+
+    Megatron-style: qkv/gate/up shard the OUTPUT feature dim (heads / ffn
+    columns), wo/down shard the INPUT dim — the pre-matmul activations stay
+    replicated-by-row and GSPMD inserts one psum per block. Embedding shards
+    the vocab axis (the gather and the logit matmul both parallelize).
+    """
+    specs = {
+        "embed": P("tp", None),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "mlp_norm": P(None, None),
+            "w_gate": P(None, None, "tp"),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+        },
+        "final_norm": P(None),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, "tp")
+    return specs
+
+
+def cache_spec(cfg: ModelConfig, mesh: Mesh) -> P:
+    """KV cache [L, B, S, n_kv, hd]: batch on dp, kv heads on tp (when they
+    divide; MQA/MHA mismatches fall back to replicated kv heads)."""
+    tp_size = mesh.shape.get("tp", 1)
+    kv_axis = "tp" if cfg.n_kv_heads % tp_size == 0 else None
+    return P(None, "dp", None, kv_axis, None)
+
+
+def data_spec() -> P:
+    """Token batches [B, T]: rows ride dp."""
+    return P("dp", None)
+
+
+def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
+    """Place a params pytree onto the mesh per param_specs."""
+    specs = param_specs(cfg)
+    return jax.tree.map(
+        lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )

@@ -68,6 +68,21 @@ def test_max_tokens_respected(engine):
     assert res.n_gen_tokens <= 5
 
 
+def test_overlong_prompt_raises(engine):
+    from quoracle_tpu.models.generate import ContextOverflowError
+    tok = engine.tokenizer
+    with pytest.raises(ContextOverflowError):
+        engine.generate([tok.encode("x" * 300, add_bos=True)], max_new_tokens=4)
+
+
+def test_per_row_limit_near_window(engine):
+    """A prompt near the window decodes only up to the window, not past it."""
+    tok = engine.tokenizer
+    p = tok.encode("x" * 250, add_bos=True)  # 251 tokens, max_seq=256
+    r = engine.generate([p], temperature=1.0, max_new_tokens=64)[0]
+    assert r.n_gen_tokens <= 256 - 251
+
+
 def test_sample_tokens_greedy_vs_temp():
     logits = jnp.asarray([[0.0, 5.0, 1.0], [0.0, 5.0, 1.0]], jnp.float32)
     out = sample_tokens(logits, jax.random.PRNGKey(0),
