@@ -1,0 +1,14 @@
+"""Actions layer: the gated action vocabulary agents execute.
+
+Re-design of the reference's lib/quoracle/actions/ (SURVEY.md §2.4): schemas
++ validation + consensus merge rules as pure data/logic here, execution via
+per-action router tasks in router.py.
+"""
+
+from quoracle_tpu.actions.schema import (  # noqa: F401
+    ACTIONS,
+    ActionSchema,
+    batchable_sync_actions,
+    batchable_async_actions,
+    get_schema,
+)

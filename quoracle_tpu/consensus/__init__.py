@@ -1,0 +1,10 @@
+"""Consensus pipeline: the decision core.
+
+Re-design of the reference's lib/quoracle/consensus/ (SURVEY.md §2.2): every
+agent decision queries a pool of models in parallel (ONE batched TPU generate
+step here — models/runtime.py), parses/validates the proposed actions,
+clusters them by schema-aware fingerprints, and either executes the majority
+action or runs refinement rounds with temperature descent until one emerges.
+"""
+
+from quoracle_tpu.consensus.engine import ConsensusEngine, ConsensusOutcome  # noqa: F401

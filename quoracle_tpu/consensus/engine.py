@@ -1,0 +1,241 @@
+"""Consensus orchestrator: query pool -> parse -> validate -> cluster ->
+majority / refinement loop.
+
+Parity with the reference's Agent.Consensus
+(reference lib/quoracle/agent/consensus.ex:64,113,129,269-293,295,332-390)
+re-shaped for the TPU runtime: the per-model fan-out of the reference (one
+Task + HTTPS call per model) is ONE ModelBackend.query call whose rows carry
+per-model temperatures — on the TPUBackend that is a single batched generate
+step per pool member, refinement rounds included (SURVEY.md §7: batched
+refinement is where the TPU design wins over sequential HTTPS).
+
+Pure-logic layer: no persistence, no event bus — the agent runtime (M7)
+wires those around it. Dependencies (backend, embedder) arrive explicitly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Optional, Sequence
+
+from quoracle_tpu.actions.validator import validate_params, validate_wait_param
+from quoracle_tpu.consensus.aggregator import (
+    build_refinement_prompt, cluster_proposals, find_majority_cluster,
+)
+from quoracle_tpu.consensus.parser import (
+    ActionProposal, ParseFailure, parse_response,
+)
+from quoracle_tpu.consensus.result import Decision, pick_winner
+from quoracle_tpu.consensus.rules import EmbedAccumulator
+from quoracle_tpu.consensus.temperature import temperature_for_round
+from quoracle_tpu.models.runtime import ModelBackend, QueryRequest
+
+DEFAULT_THRESHOLD = 0.5          # reference consensus/manager.ex:11-21
+DEFAULT_MAX_REFINEMENT_ROUNDS = 4
+REASONING_WINDOW_ROUNDS = 2      # sliding window of refinement history kept
+
+
+@dataclasses.dataclass
+class ConsensusConfig:
+    model_pool: list[str]
+    max_refinement_rounds: int = DEFAULT_MAX_REFINEMENT_ROUNDS
+    threshold: float = DEFAULT_THRESHOLD
+    force_reflection: bool = False   # single-model pools still refine once
+    allowed_actions: Optional[set[str]] = None
+    profile_optional_spawn: bool = False
+    max_tokens: Optional[int] = None
+
+
+@dataclasses.dataclass
+class ModelFailure:
+    model_spec: str
+    error: str
+    correction: Optional[str] = None  # feeds per-model correction feedback
+    raw_text: str = ""                # the failing response, for history
+
+
+@dataclasses.dataclass
+class ConsensusOutcome:
+    status: str                      # "ok" | "all_invalid" | "all_failed"
+    decision: Optional[Decision] = None
+    proposals: list[ActionProposal] = dataclasses.field(default_factory=list)
+    failures: list[ModelFailure] = dataclasses.field(default_factory=list)
+    rounds_used: int = 1
+    latency_ms: float = 0.0
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    cost: float = 0.0
+    embed_texts: int = 0
+    bug_reports: list[tuple[str, str]] = dataclasses.field(default_factory=list)
+    condense_requests: dict[str, int] = dataclasses.field(default_factory=dict)
+    # Refinement transcript per model, for history merging by the agent layer:
+    # list of (refinement_prompt, model_response_text) pairs, capped to the
+    # sliding window (reference consensus/manager.ex:82-93).
+    refinement_history: dict[str, list[tuple[str, str]]] = \
+        dataclasses.field(default_factory=dict)
+
+
+class ConsensusEngine:
+    """One instance per agent; stateless between decide() calls."""
+
+    def __init__(self, backend: ModelBackend, config: ConsensusConfig,
+                 log: Optional[Callable[[str, dict], None]] = None):
+        self.backend = backend
+        self.config = config
+        self._log = log or (lambda event, data: None)
+
+    # ------------------------------------------------------------------
+
+    def decide(self, messages_per_model: dict[str, list[dict]]) -> ConsensusOutcome:
+        """Run the full consensus process over per-model message histories.
+
+        ``messages_per_model`` maps model_spec -> chat messages (system prompt
+        included) — each pool member fills its own context window (reference
+        per-model histories, README.md:642-650).
+        """
+        t0 = time.monotonic()
+        cfg = self.config
+        outcome = ConsensusOutcome(status="ok")
+        pool = list(cfg.model_pool)
+        # Working copy: refinement appends to these, not the caller's lists.
+        histories = {m: list(msgs) for m, msgs in messages_per_model.items()}
+        acc = EmbedAccumulator()
+
+        max_rounds = 1 + max(0, cfg.max_refinement_rounds)
+        single_model = len(pool) == 1 and not cfg.force_reflection
+
+        proposals: list[ActionProposal] = []
+        round_num = 0
+        while round_num < max_rounds:
+            round_num += 1
+            proposals, failures = self._query_round(histories, pool, round_num,
+                                                    outcome)
+            if not proposals:
+                outcome.failures = failures
+                outcome.status = ("all_failed" if all(
+                    f.correction is None for f in failures) else "all_invalid")
+                outcome.rounds_used = round_num
+                outcome.latency_ms = (time.monotonic() - t0) * 1000
+                return outcome
+
+            if single_model:
+                break
+
+            clusters = cluster_proposals(proposals, self.backend, acc)
+            majority = find_majority_cluster(clusters, len(proposals),
+                                             round_num, cfg.threshold)
+            self._log("consensus_round", {
+                "round": round_num, "clusters": len(clusters),
+                "responses": len(proposals), "majority": majority is not None})
+            # force_reflection: a round-1 majority is not accepted as-is; the
+            # pool reviews once before committing (reference consensus.ex
+            # single-model/force_reflection refinement, :304-329).
+            reflect_first = (cfg.force_reflection and round_num == 1
+                             and max_rounds > 1)
+            if (majority is not None and not reflect_first) \
+                    or round_num >= max_rounds:
+                outcome.decision = pick_winner(clusters, len(proposals),
+                                               round_num, majority,
+                                               self.backend, acc)
+                break
+
+            # No accepted majority: append refinement prompt + own response
+            # per model; failed models get their correction feedback so the
+            # next round doesn't replay the identical prompt.
+            for p in proposals:
+                own_prompt = build_refinement_prompt(
+                    clusters, p, round_num + 1, cfg.max_refinement_rounds)
+                h = histories.setdefault(p.model_spec, [])
+                h.append({"role": "assistant", "content": p.raw_text})
+                h.append({"role": "user", "content": own_prompt})
+                rh = outcome.refinement_history.setdefault(p.model_spec, [])
+                rh.append((own_prompt, p.raw_text))
+                del rh[:-REASONING_WINDOW_ROUNDS]
+            for f in failures:
+                if f.correction is None:
+                    continue
+                h = histories.setdefault(f.model_spec, [])
+                if f.raw_text:
+                    h.append({"role": "assistant", "content": f.raw_text})
+                h.append({"role": "user", "content": f.correction})
+
+        if outcome.decision is None:
+            # Single-model fast path (reference consensus.ex:267-275 analog):
+            # the lone valid proposal IS the decision, full confidence.
+            clusters = cluster_proposals(proposals, self.backend, acc)
+            majority = find_majority_cluster(clusters, len(proposals), 1,
+                                             cfg.threshold)
+            outcome.decision = pick_winner(clusters, len(proposals),
+                                           round_num, majority,
+                                           self.backend, acc)
+
+        outcome.rounds_used = round_num
+        outcome.embed_texts = acc.texts
+        outcome.latency_ms = (time.monotonic() - t0) * 1000
+        return outcome
+
+    # ------------------------------------------------------------------
+
+    def _query_round(self, histories: dict[str, list[dict]], pool: list[str],
+                     round_num: int, outcome: ConsensusOutcome,
+                     ) -> tuple[list[ActionProposal], list[ModelFailure]]:
+        cfg = self.config
+        requests = [
+            QueryRequest(
+                model_spec=m,
+                # Snapshot: refinement mutates histories after the request is
+                # built; a live reference would retro-edit recorded calls.
+                messages=list(histories.get(m, [])),
+                temperature=temperature_for_round(
+                    m, round_num, cfg.max_refinement_rounds),
+                max_tokens=cfg.max_tokens,
+            )
+            for m in pool
+        ]
+        results = self.backend.query(requests)
+
+        proposals: list[ActionProposal] = []
+        failures: list[ModelFailure] = []
+        for res in results:
+            outcome.prompt_tokens += res.usage.prompt_tokens
+            outcome.completion_tokens += res.usage.completion_tokens
+            outcome.cost += res.usage.cost
+            if not res.ok:
+                failures.append(ModelFailure(res.model_spec, res.error))
+                continue
+            parsed = parse_response(res.model_spec, res.text)
+            if isinstance(parsed, ParseFailure):
+                failures.append(ModelFailure(
+                    res.model_spec, parsed.error,
+                    correction=f"Your previous response was invalid: "
+                               f"{parsed.error}. Respond with a single JSON "
+                               f'object {{"action", "params", "reasoning", '
+                               f'"wait"}}.',
+                    raw_text=res.text))
+                continue
+            errors = validate_params(
+                parsed.action, parsed.params,
+                allowed_actions=cfg.allowed_actions,
+                profile_optional=cfg.profile_optional_spawn)
+            wait_error = validate_wait_param(parsed.action, parsed.wait)
+            if wait_error:
+                errors.append(wait_error)
+            if errors:
+                failures.append(ModelFailure(
+                    res.model_spec,
+                    f"invalid {parsed.action} params: " + "; ".join(errors),
+                    correction="Your previous response failed validation: "
+                               + "; ".join(errors)
+                               + ". Correct the parameters and respond again.",
+                    raw_text=res.text))
+                continue
+            if parsed.condense:
+                outcome.condense_requests[parsed.model_spec] = parsed.condense
+            if parsed.bug_report:
+                outcome.bug_reports.append((parsed.model_spec, parsed.bug_report))
+            proposals.append(parsed)
+
+        outcome.proposals = proposals
+        outcome.failures = failures
+        return proposals, failures

@@ -182,7 +182,7 @@ class GenerateEngine:
         prompts: Sequence[Sequence[int]],
         temperature: Sequence[float] | float = 1.0,
         top_p: Sequence[float] | float = 1.0,
-        max_new_tokens: int = 256,
+        max_new_tokens: Sequence[int] | int = 256,
         rng: Optional[jax.Array] = None,
     ) -> list[GenResult]:
         t0 = time.monotonic()
@@ -191,6 +191,13 @@ class GenerateEngine:
             return []
         temps = [temperature] * n if isinstance(temperature, (int, float)) else list(temperature)
         tops = [top_p] * n if isinstance(top_p, (int, float)) else list(top_p)
+        # Per-row decode budgets: consensus rows grouped into one batch keep
+        # their own caps (traced row limits; the static bound is the max).
+        if isinstance(max_new_tokens, int):
+            row_budgets = [max_new_tokens] * n
+        else:
+            row_budgets = [int(m) for m in max_new_tokens]
+            assert len(row_budgets) == n
 
         max_prompt = max(len(p) for p in prompts)
         if max_prompt >= self.max_seq:
@@ -207,7 +214,7 @@ class GenerateEngine:
         # per round (reference per_model_query.ex:136-145), which would
         # otherwise trigger one XLA compile per unique value. Per-row TRACED
         # limits stop each row at its own budget, so bucketing costs nothing.
-        max_new = _round_up(min(max_new_tokens, self.max_seq - 1),
+        max_new = _round_up(min(max(row_budgets), self.max_seq - 1),
                             (64, 128, 256, 512, 1024, 2048, 4096))
 
         tokens = np.full((B, T), self.tokenizer.pad_id, np.int32)
@@ -216,7 +223,7 @@ class GenerateEngine:
         for i, p in enumerate(prompts):
             tokens[i, :len(p)] = p
             lens[i] = max(1, len(p))
-            limits[i] = max(1, min(max_new_tokens, self.max_seq - lens[i]))
+            limits[i] = max(1, min(row_budgets[i], self.max_seq - lens[i]))
         temp_arr = np.zeros((B,), np.float32)
         temp_arr[:n] = temps
         top_arr = np.ones((B,), np.float32)
@@ -239,7 +246,7 @@ class GenerateEngine:
         for i in range(n):
             # Extract by emitted COUNT, not by sentinel scan: pad_id may be a
             # real vocab token in HF checkpoints.
-            k = min(int(n_emitted[i]), max_new_tokens)
+            k = min(int(n_emitted[i]), row_budgets[i])
             ids = [int(t) for t in out[i, :k]]
             finish = "length"
             if ids and ids[-1] == self.cfg.eos_token_id:

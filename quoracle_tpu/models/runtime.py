@@ -1,0 +1,287 @@
+"""ModelBackend: the seam between the consensus pipeline and model execution.
+
+This interface replaces the reference's entire provider layer — where
+ModelQuery fanned out one HTTPS task per model
+(reference lib/quoracle/models/model_query.ex:51,88-131), here
+``query()`` receives the whole round and batches rows per pool member into
+single generate steps on the TPU. Two implementations:
+
+  * TPUBackend  — real serving: one GenerateEngine per pool member + an
+    EmbeddingEncoder; zero external calls.
+  * MockBackend — deterministic, scripted; the test seam the reference gets
+    from mock: model specs + injectable model_query_fn
+    (reference consensus/manager.ex:17-21, per_model_query.ex:84,227).
+
+Both are handed to components explicitly (no globals), preserving the
+reference's cardinal DI rule (root AGENTS.md:5-33).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+import time
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+
+from quoracle_tpu.models.config import ModelConfig, get_model_config
+from quoracle_tpu.models.generate import ContextOverflowError, GenerateEngine
+from quoracle_tpu.models.tokenizer import Tokenizer, get_tokenizer
+
+
+@dataclasses.dataclass
+class QueryRequest:
+    """One model's slice of a consensus round."""
+    model_spec: str                    # "xla:llama-3-8b"
+    messages: list[dict]               # chat messages (system injected already)
+    temperature: float = 1.0
+    top_p: float = 1.0
+    max_tokens: Optional[int] = None   # None = dynamic (window - input, capped)
+
+
+@dataclasses.dataclass
+class Usage:
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    cost: float = 0.0
+
+
+@dataclasses.dataclass
+class QueryResult:
+    model_spec: str
+    text: str = ""
+    usage: Usage = dataclasses.field(default_factory=Usage)
+    latency_ms: float = 0.0
+    error: Optional[str] = None        # None = success
+    permanent_error: bool = False      # parity: only auth-type errors are
+                                       # permanent (model_query.ex:322-332)
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+
+class ModelBackend(abc.ABC):
+    """What the consensus layer depends on. All methods are synchronous and
+    thread-safe; the agent runtime calls them from executor threads."""
+
+    @abc.abstractmethod
+    def query(self, requests: Sequence[QueryRequest]) -> list[QueryResult]: ...
+
+    @abc.abstractmethod
+    def embed(self, texts: Sequence[str]) -> list[np.ndarray]: ...
+
+    @abc.abstractmethod
+    def count_tokens(self, model_spec: str, text: str) -> int: ...
+
+    def count_message_tokens(self, model_spec: str, messages: Sequence[dict]) -> int:
+        from quoracle_tpu.models.tokenizer import _stringify_content
+        total = 0
+        for m in messages:
+            content = m.get("content", "")
+            if not isinstance(content, str):
+                content = _stringify_content(content)
+            total += self.count_tokens(model_spec, content) + 4  # role overhead
+        return total
+
+    @abc.abstractmethod
+    def context_window(self, model_spec: str) -> int: ...
+
+    @abc.abstractmethod
+    def output_limit(self, model_spec: str) -> int: ...
+
+
+# ---------------------------------------------------------------------------
+# TPU backend
+# ---------------------------------------------------------------------------
+
+# Dynamic max_tokens floor: a round must leave at least this much room for
+# the response (reference per_model_query.ex:17-18 — 4096 output floor).
+OUTPUT_FLOOR = 256
+
+
+class TPUBackend(ModelBackend):
+    """Serves a pool of catalog models resident on the chip/mesh.
+
+    With exact tokenizers there is no 12% estimation margin (reference
+    per_model_query.ex:20-24) — max_tokens = window - exact_input, floored.
+    """
+
+    def __init__(self, pool: Sequence[str], *, seed: int = 0,
+                 embed_model: Optional[str] = None,
+                 engines: Optional[dict[str, GenerateEngine]] = None,
+                 embedder=None, init_params_fn=None):
+        import jax
+        from quoracle_tpu.models.embeddings import EmbeddingEncoder
+        from quoracle_tpu.models.transformer import init_params
+
+        self.pool = list(pool)
+        self.engines: dict[str, GenerateEngine] = dict(engines or {})
+        init_fn = init_params_fn or init_params
+        for i, spec in enumerate(self.pool):
+            if spec in self.engines:
+                continue
+            cfg = get_model_config(spec)
+            params = init_fn(cfg, jax.random.PRNGKey(seed + i))
+            self.engines[spec] = GenerateEngine(
+                cfg, params, get_tokenizer(spec), seed=seed + i)
+
+        if embedder is not None:
+            self.embedder = embedder
+        else:
+            espec = embed_model or self.pool[0]
+            if espec in self.engines:
+                e = self.engines[espec]
+                eparams, ecfg, etok = e.params, e.cfg, e.tokenizer
+            else:
+                ecfg = get_model_config(espec)
+                eparams = init_fn(ecfg, jax.random.PRNGKey(seed + 101))
+                etok = get_tokenizer(espec)
+            self.embedder = EmbeddingEncoder(ecfg, eparams, etok)
+
+    # -- ModelBackend --
+
+    def query(self, requests: Sequence[QueryRequest]) -> list[QueryResult]:
+        """Group rows by pool member; one batched generate per member.
+
+        Members run sequentially on a single chip; on a multi-chip mesh each
+        member owns a sub-mesh and the host scheduler overlaps them
+        (SURVEY.md §7 hard part 1)."""
+        by_model: dict[str, list[int]] = {}
+        for i, r in enumerate(requests):
+            by_model.setdefault(r.model_spec, []).append(i)
+
+        results: list[Optional[QueryResult]] = [None] * len(requests)
+        for spec, idxs in by_model.items():
+            engine = self.engines.get(spec)
+            if engine is None:
+                for i in idxs:
+                    results[i] = QueryResult(
+                        model_spec=spec, error=f"unknown model {spec!r}",
+                        permanent_error=True)
+                continue
+            t0 = time.monotonic()
+            prompts, temps, tops, budgets, live_idxs = [], [], [], [], []
+            max_seq = engine.max_seq
+            for i in idxs:
+                r = requests[i]
+                ids = engine.tokenizer.encode_chat(r.messages)
+                if len(ids) >= max_seq:
+                    # Per-ROW overflow: only the oversized row errors; the
+                    # rest of the group still runs (the condensation layer
+                    # retries this model after condensing).
+                    results[i] = QueryResult(
+                        model_spec=spec,
+                        error=f"context_overflow: prompt {len(ids)} tokens "
+                              f">= window {max_seq}")
+                    continue
+                prompts.append(ids)
+                temps.append(r.temperature)
+                tops.append(r.top_p)
+                window, out_lim = engine.cfg.context_window, engine.cfg.output_limit
+                budget = min(out_lim, max(OUTPUT_FLOOR, window - len(ids)))
+                budgets.append(min(r.max_tokens, budget) if r.max_tokens else budget)
+                live_idxs.append(i)
+            if not live_idxs:
+                continue
+            try:
+                gens = engine.generate(
+                    prompts, temperature=temps, top_p=tops,
+                    max_new_tokens=budgets)
+            except ContextOverflowError as e:
+                for i in live_idxs:
+                    results[i] = QueryResult(model_spec=spec,
+                                             error=f"context_overflow: {e}")
+                continue
+            latency_ms = (time.monotonic() - t0) * 1000
+            cfg = engine.cfg
+            for i, g in zip(live_idxs, gens):
+                cost = (g.n_prompt_tokens * cfg.input_cost_per_mtok
+                        + g.n_gen_tokens * cfg.output_cost_per_mtok) / 1e6
+                results[i] = QueryResult(
+                    model_spec=spec, text=g.text,
+                    usage=Usage(g.n_prompt_tokens, g.n_gen_tokens, cost),
+                    latency_ms=latency_ms)
+        return [r for r in results if r is not None]
+
+    def embed(self, texts: Sequence[str]) -> list[np.ndarray]:
+        return self.embedder.embed(texts)
+
+    def count_tokens(self, model_spec: str, text: str) -> int:
+        return self.engines[model_spec].tokenizer.count(text)
+
+    def context_window(self, model_spec: str) -> int:
+        return get_model_config(model_spec).context_window
+
+    def output_limit(self, model_spec: str) -> int:
+        return get_model_config(model_spec).output_limit
+
+
+# ---------------------------------------------------------------------------
+# Mock backend (tests)
+# ---------------------------------------------------------------------------
+
+class MockBackend(ModelBackend):
+    """Deterministic scripted backend.
+
+    ``respond`` maps a QueryRequest to response text; default echoes a valid
+    wait-action JSON so agent loops terminate. Per-model scripts let consensus
+    tests drive disagreement/malformed/invalid scenarios the way the
+    reference's MockResponseGenerator does
+    (reference agent/consensus/mock_response_generator.ex:31-45).
+    Every call is recorded for assertion (the reference's message-capture
+    ``model_query_fn`` seam).
+    """
+
+    DEFAULT_POOL = ["mock:consensus-model-1", "mock:consensus-model-2",
+                    "mock:consensus-model-3"]
+
+    def __init__(self, respond: Optional[Callable[[QueryRequest], str]] = None,
+                 scripts: Optional[dict[str, list[str]]] = None,
+                 embedder=None, context_window_tokens: int = 128_000,
+                 output_limit_tokens: int = 4096,
+                 latency_ms: float = 0.0):
+        from quoracle_tpu.models.embeddings import HashingEmbedder
+        self._respond = respond
+        self._scripts = {k: list(v) for k, v in (scripts or {}).items()}
+        self._embedder = embedder or HashingEmbedder()
+        self._window = context_window_tokens
+        self._output_limit = output_limit_tokens
+        self._latency_ms = latency_ms
+        self.calls: list[QueryRequest] = []
+
+    def query(self, requests: Sequence[QueryRequest]) -> list[QueryResult]:
+        out = []
+        for r in requests:
+            self.calls.append(r)
+            script = self._scripts.get(r.model_spec)
+            if script:
+                text = script.pop(0)
+            elif self._respond is not None:
+                text = self._respond(r)
+            else:
+                text = ('{"action": "wait", "params": {"duration": 1}, '
+                        '"reasoning": "mock default"}')
+            if text == "__error__":
+                out.append(QueryResult(model_spec=r.model_spec,
+                                       error="scripted failure"))
+                continue
+            n_in = self.count_message_tokens(r.model_spec, r.messages)
+            out.append(QueryResult(
+                model_spec=r.model_spec, text=text,
+                usage=Usage(n_in, max(1, len(text) // 4), 0.0),
+                latency_ms=self._latency_ms))
+        return out
+
+    def embed(self, texts: Sequence[str]) -> list[np.ndarray]:
+        return self._embedder.embed(texts)
+
+    def count_tokens(self, model_spec: str, text: str) -> int:
+        return max(1, len(text) // 4)
+
+    def context_window(self, model_spec: str) -> int:
+        return self._window
+
+    def output_limit(self, model_spec: str) -> int:
+        return self._output_limit

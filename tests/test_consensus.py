@@ -1,0 +1,389 @@
+"""Consensus pipeline: parser, validator, rules, clustering, engine.
+
+Mirrors the reference's test strategy (SURVEY.md §4): deterministic mock
+backend with per-model scripts, injectable embedder, no shared state.
+"""
+
+import json
+
+import pytest
+
+from quoracle_tpu.actions.schema import ACTIONS, get_schema
+from quoracle_tpu.actions.validator import validate_params, validate_wait_param
+from quoracle_tpu.consensus.aggregator import (
+    cluster_proposals, find_majority_cluster,
+)
+from quoracle_tpu.consensus.engine import ConsensusConfig, ConsensusEngine
+from quoracle_tpu.consensus.json_utils import extract_json, stable_dumps
+from quoracle_tpu.consensus.parser import ParseFailure, parse_response
+from quoracle_tpu.consensus.rules import merge_values, merge_wait
+from quoracle_tpu.consensus.temperature import temperature_for_round
+from quoracle_tpu.models.embeddings import HashingEmbedder
+from quoracle_tpu.models.runtime import MockBackend
+
+POOL = MockBackend.DEFAULT_POOL
+EMB = HashingEmbedder()
+
+
+def action_json(action, params, wait=False, reasoning="r", **extra):
+    return json.dumps({"action": action, "params": params, "wait": wait,
+                       "reasoning": reasoning, **extra})
+
+
+def msgs():
+    return {m: [{"role": "user", "content": "decide"}] for m in POOL}
+
+
+# --- json extraction --------------------------------------------------------
+
+def test_extract_json_plain_fenced_and_prose():
+    obj = {"action": "wait", "params": {}}
+    assert extract_json(json.dumps(obj)) == obj
+    assert extract_json(f"Sure!\n```json\n{json.dumps(obj)}\n```\nDone.") == obj
+    assert extract_json(f"I think {json.dumps(obj)} is best") == obj
+    assert extract_json("no json here") is None
+    assert extract_json('{"a": "brace { in string }"}') == {"a": "brace { in string }"}
+
+
+# --- parser -----------------------------------------------------------------
+
+def test_parse_valid_with_condense_and_bug_report():
+    text = action_json("wait", {"duration": 5}, wait=False,
+                       condense=3, bug_report="prompt contradicts itself")
+    p = parse_response("m1", text)
+    assert p.action == "wait" and p.condense == 3
+    assert p.bug_report == "prompt contradicts itself"
+
+
+def test_parse_unknown_action_fails():
+    p = parse_response("m1", action_json("fly_to_moon", {}))
+    assert isinstance(p, ParseFailure)
+
+
+def test_parse_garbage_fails():
+    assert isinstance(parse_response("m1", "I cannot decide"), ParseFailure)
+
+
+# --- validator --------------------------------------------------------------
+
+def test_validator_missing_required():
+    errs = validate_params("send_message", {"target": "parent"})
+    assert any("content" in e for e in errs)
+
+
+def test_validator_type_and_enum():
+    errs = validate_params("send_message",
+                          {"target": "parent", "content": 5})
+    assert any("must be string" in e for e in errs)
+    errs = validate_params("call_api", {"url": "http://x", "method": "BREW"})
+    assert any("one of" in e for e in errs)
+
+
+def test_validator_xor_shell():
+    assert validate_params("execute_shell", {}) != []
+    assert validate_params("execute_shell", {"command": "ls"}) == []
+    assert validate_params("execute_shell", {"check_id": "c1"}) == []
+    assert validate_params("execute_shell",
+                           {"command": "ls", "check_id": "c1"}) != []
+
+
+def test_validator_capability_gating():
+    errs = validate_params("execute_shell", {"command": "ls"},
+                           allowed_actions={"wait", "send_message"})
+    assert any("not permitted" in e for e in errs)
+
+
+def test_validator_batch_rules():
+    good = {"actions": [
+        {"action": "file_read", "params": {"path": "/tmp/x"}},
+        {"action": "execute_shell", "params": {"command": "ls"}}]}
+    assert validate_params("batch_sync", good) == []
+    nested = {"actions": [{"action": "batch_sync", "params": good}]}
+    assert validate_params("batch_sync", nested) != []
+    spawn_in_sync = {"actions": [{"action": "spawn_child", "params": {}}]}
+    assert validate_params("batch_sync", spawn_in_sync) != []
+
+
+def test_validator_wait_param():
+    assert validate_wait_param("send_message", None) is not None
+    assert validate_wait_param("send_message", True) is None
+    assert validate_wait_param("send_message", 30) is None
+    assert validate_wait_param("send_message", -2) is not None
+    assert validate_wait_param("wait", None) is None  # wait needs no wait
+
+
+# --- merge rules ------------------------------------------------------------
+
+def test_merge_mode_union_percentile_structural():
+    assert merge_values(("mode",), ["a", "b", "a"], EMB) == "a"
+    assert merge_values(("union",), [["a", "b"], ["b", "c"]], EMB) == ["a", "b", "c"]
+    assert merge_values(("percentile", 50), [10, 20, 1000], EMB) == 20
+    assert merge_values(("percentile", 50), [10, 20], EMB) in (10, 20)
+    merged = merge_values(("structural",), [{"a": 1, "b": 2}, {"a": 1, "c": 3}], EMB)
+    assert merged == {"a": 1, "b": 2, "c": 3}
+
+
+def test_merge_semantic_picks_central():
+    vals = ["make the report file", "create the report file", "zzzz qqqq"]
+    out = merge_values(("semantic", 0.5), vals, EMB)
+    assert out in vals[:2]
+
+
+def test_merge_wait_voting():
+    assert merge_wait([False, False, True]) is False
+    assert merge_wait([True, True, 30]) is True
+    assert merge_wait([10, 30, 50]) == 30
+    assert merge_wait([0, 0, True]) is False
+    assert merge_wait([None, None]) is None
+
+
+# --- temperature ------------------------------------------------------------
+
+def test_temperature_descent():
+    t1 = temperature_for_round("xla:llama-3-8b", 1)
+    t3 = temperature_for_round("xla:llama-3-8b", 3)
+    t5 = temperature_for_round("xla:llama-3-8b", 5)
+    assert t1 == 1.0 and t1 > t3 > t5 >= 0.2
+    assert temperature_for_round("gpt-4o", 1) == 2.0
+    assert temperature_for_round("gpt-4o", 99) == 0.4
+
+
+# --- clustering -------------------------------------------------------------
+
+def _proposal(model, action, params, wait=False):
+    p = parse_response(model, action_json(action, params, wait=wait))
+    assert not isinstance(p, ParseFailure), p
+    return p
+
+
+def test_cluster_exact_params_split():
+    a = _proposal("m1", "file_read", {"path": "/a"})
+    b = _proposal("m2", "file_read", {"path": "/b"})
+    c = _proposal("m3", "file_read", {"path": "/a"})
+    clusters = cluster_proposals([a, b, c], EMB)
+    assert sorted(c.size for c in clusters) == [1, 2]
+
+
+def test_cluster_semantic_params_join():
+    a = _proposal("m1", "answer_engine", {"query": "capital city of France"})
+    b = _proposal("m2", "answer_engine", {"query": "capital city of France?"})
+    clusters = cluster_proposals([a, b], EMB)
+    assert len(clusters) == 1
+
+
+def test_cluster_batch_sequence_order():
+    sync1 = _proposal("m1", "batch_sync", {"actions": [
+        {"action": "file_read", "params": {"path": "/a"}},
+        {"action": "execute_shell", "params": {"command": "ls"}}]})
+    sync2 = _proposal("m2", "batch_sync", {"actions": [
+        {"action": "execute_shell", "params": {"command": "ls"}},
+        {"action": "file_read", "params": {"path": "/a"}}]})
+    assert len(cluster_proposals([sync1, sync2], EMB)) == 2  # order matters
+
+    async1 = _proposal("m1", "batch_async", {"actions": [
+        {"action": "file_read", "params": {"path": "/a"}},
+        {"action": "execute_shell", "params": {"command": "ls"}}]})
+    async2 = _proposal("m2", "batch_async", {"actions": [
+        {"action": "execute_shell", "params": {"command": "ls"}},
+        {"action": "file_read", "params": {"path": "/a"}}]})
+    assert len(cluster_proposals([async1, async2], EMB)) == 1  # order ignored
+
+
+def test_majority_round1_unanimity():
+    a = _proposal("m1", "wait", {})
+    b = _proposal("m2", "wait", {})
+    c = _proposal("m3", "file_read", {"path": "/a"})
+    clusters = cluster_proposals([a, b, c], EMB)
+    assert find_majority_cluster(clusters, 3, round_num=1) is None
+    assert find_majority_cluster(clusters, 3, round_num=2).size == 2
+
+
+# --- engine end-to-end ------------------------------------------------------
+
+def test_engine_unanimous_consensus():
+    resp = action_json("send_message", {"target": "parent", "content": "done"})
+    backend = MockBackend(scripts={m: [resp] for m in POOL})
+    engine = ConsensusEngine(backend, ConsensusConfig(model_pool=POOL))
+    out = engine.decide(msgs())
+    assert out.status == "ok"
+    assert out.decision.kind == "consensus"
+    assert out.decision.action == "send_message"
+    assert out.decision.confidence == 1.0
+    assert out.rounds_used == 1
+
+
+def test_engine_refinement_converges():
+    agree = action_json("wait", {"duration": 5})
+    dissent = action_json("file_read", {"path": "/x"})
+    backend = MockBackend(scripts={
+        POOL[0]: [agree, agree],
+        POOL[1]: [agree, agree],
+        POOL[2]: [dissent, agree],   # converges in round 2
+    })
+    engine = ConsensusEngine(backend, ConsensusConfig(model_pool=POOL))
+    out = engine.decide(msgs())
+    assert out.decision.kind == "consensus"
+    assert out.rounds_used == 2
+    assert out.decision.action == "wait"
+    # Refinement prompt was appended to each model's query messages.
+    refinement_calls = [c for c in backend.calls
+                        if any("skeptical reviewer" in str(m.get("content"))
+                               for m in c.messages)]
+    assert len(refinement_calls) == 3
+
+
+def test_engine_persistent_split_forces_decision():
+    a = action_json("file_read", {"path": "/a"})
+    b = action_json("execute_shell", {"command": "ls"})
+    c = action_json("wait", {})
+    backend = MockBackend(scripts={POOL[0]: [a] * 5, POOL[1]: [b] * 5,
+                                   POOL[2]: [c] * 5})
+    engine = ConsensusEngine(backend, ConsensusConfig(model_pool=POOL,
+                                                      max_refinement_rounds=2))
+    out = engine.decide(msgs())
+    assert out.decision.kind == "forced_decision"
+    assert out.rounds_used == 3  # initial + 2 refinements
+    # Tiebreak by action priority: execute_shell(30) beats file_read(30)?
+    # Both 30 -> falls to wait score then order; file_read proposed first.
+    assert out.decision.action in ("file_read", "execute_shell")
+    assert out.decision.confidence <= 0.5
+
+
+def test_engine_invalid_filtered_majority_of_valid():
+    good = action_json("wait", {"duration": 2})
+    bad = "utter garbage"
+    backend = MockBackend(scripts={POOL[0]: [good], POOL[1]: [good],
+                                   POOL[2]: [bad]})
+    engine = ConsensusEngine(backend, ConsensusConfig(model_pool=POOL))
+    out = engine.decide(msgs())
+    assert out.decision.kind == "consensus"  # 2/2 valid = unanimity
+    assert len(out.failures) == 1
+    assert out.failures[0].correction is not None
+
+
+def test_engine_all_invalid_reports_corrections():
+    backend = MockBackend(scripts={m: ["garbage"] for m in POOL})
+    engine = ConsensusEngine(backend, ConsensusConfig(model_pool=POOL))
+    out = engine.decide(msgs())
+    assert out.status == "all_invalid"
+    assert all(f.correction for f in out.failures)
+
+
+def test_engine_all_failed():
+    backend = MockBackend(scripts={m: ["__error__"] for m in POOL})
+    engine = ConsensusEngine(backend, ConsensusConfig(model_pool=POOL))
+    out = engine.decide(msgs())
+    assert out.status == "all_failed"
+
+
+def test_engine_single_model_fast_path():
+    resp = action_json("todo", {"items": ["a", "b"]})
+    backend = MockBackend(scripts={"m1": [resp]})
+    engine = ConsensusEngine(backend, ConsensusConfig(model_pool=["m1"]))
+    out = engine.decide({"m1": [{"role": "user", "content": "go"}]})
+    assert out.decision.kind == "consensus"
+    assert out.decision.confidence == 1.0
+    assert len(backend.calls) == 1
+
+
+def test_engine_capability_gating_filters():
+    shell = action_json("execute_shell", {"command": "rm -rf /"})
+    waitr = action_json("wait", {})
+    backend = MockBackend(scripts={POOL[0]: [shell], POOL[1]: [waitr],
+                                   POOL[2]: [waitr]})
+    engine = ConsensusEngine(backend, ConsensusConfig(
+        model_pool=POOL, allowed_actions={"wait", "send_message"}))
+    out = engine.decide(msgs())
+    assert out.decision.action == "wait"
+    assert any("not permitted" in f.error for f in out.failures)
+
+
+def test_engine_merges_params_across_cluster():
+    r1 = action_json("wait", {"duration": 10})
+    r2 = action_json("wait", {"duration": 30})
+    r3 = action_json("wait", {"duration": 20})
+    backend = MockBackend(scripts={POOL[0]: [r1], POOL[1]: [r2], POOL[2]: [r3]})
+    engine = ConsensusEngine(backend, ConsensusConfig(model_pool=POOL))
+    out = engine.decide(msgs())
+    assert out.decision.action == "wait"
+    assert out.decision.params["duration"] == 20  # median percentile
+
+
+def test_engine_collects_condense_and_bug_reports():
+    r = action_json("wait", {}, condense=4, bug_report="ambiguous instructions")
+    plain = action_json("wait", {})
+    backend = MockBackend(scripts={POOL[0]: [r], POOL[1]: [plain],
+                                   POOL[2]: [plain]})
+    engine = ConsensusEngine(backend, ConsensusConfig(model_pool=POOL))
+    out = engine.decide(msgs())
+    assert out.condense_requests == {POOL[0]: 4}
+    assert out.bug_reports == [(POOL[0], "ambiguous instructions")]
+
+
+def test_engine_correction_feedback_reaches_failed_model():
+    """A model that fails round 1 must see its correction in round 2, not a
+    byte-identical replay of the original prompt."""
+    good_a = action_json("file_read", {"path": "/a"})
+    good_b = action_json("execute_shell", {"command": "ls"})
+    backend = MockBackend(scripts={
+        POOL[0]: [good_a, good_a],
+        POOL[1]: [good_b, good_a],
+        POOL[2]: ["garbage", good_a],
+    })
+    engine = ConsensusEngine(backend, ConsensusConfig(model_pool=POOL))
+    out = engine.decide(msgs())
+    assert out.decision.action == "file_read"
+    m3_calls = [c for c in backend.calls if c.model_spec == POOL[2]]
+    assert len(m3_calls) == 2
+    round2 = m3_calls[1].messages
+    assert any("invalid" in str(m.get("content", "")) for m in round2)
+    assert any(m.get("content") == "garbage" for m in round2
+               if m.get("role") == "assistant")
+
+
+def test_engine_force_reflection_single_model():
+    """force_reflection: even a unanimous round 1 goes through one review
+    round before committing."""
+    resp = action_json("wait", {"duration": 3})
+    backend = MockBackend(scripts={"m1": [resp, resp]})
+    engine = ConsensusEngine(backend, ConsensusConfig(
+        model_pool=["m1"], force_reflection=True))
+    out = engine.decide({"m1": [{"role": "user", "content": "go"}]})
+    assert out.decision.kind == "consensus"
+    assert out.rounds_used == 2
+    assert len(backend.calls) == 2
+    assert any("skeptical reviewer" in str(m.get("content", ""))
+               for m in backend.calls[1].messages)
+
+
+def test_refinement_prompt_tags_own_cluster():
+    from quoracle_tpu.consensus.aggregator import (
+        build_refinement_prompt, cluster_proposals,
+    )
+    a = _proposal("m1", "file_read", {"path": "/a"})
+    b = _proposal("m2", "execute_shell", {"command": "ls"})
+    clusters = cluster_proposals([a, b], EMB)
+    prompt = build_refinement_prompt(clusters, b, 2, 4)
+    lines = [ln for ln in prompt.splitlines() if "YOUR proposal" in ln]
+    assert len(lines) == 1 and "execute_shell" in lines[0]
+
+
+# --- schema sanity ----------------------------------------------------------
+
+def test_all_22_actions_registered():
+    assert len(ACTIONS) == 22
+    expected = {"spawn_child", "wait", "send_message", "orient", "answer_engine",
+                "execute_shell", "fetch_web", "call_api", "call_mcp", "todo",
+                "generate_secret", "search_secrets", "dismiss_child",
+                "generate_images", "record_cost", "adjust_budget", "file_read",
+                "file_write", "learn_skills", "create_skill", "batch_sync",
+                "batch_async"}
+    assert set(ACTIONS) == expected
+
+
+def test_schema_rules_reference_known_params():
+    for name, schema in ACTIONS.items():
+        for param in schema.rules:
+            assert param in schema.params, f"{name}.{param}"
+        for param in schema.required:
+            assert param in schema.types, f"{name}.{param}"
