@@ -1,0 +1,106 @@
+"""Driver benchmark: 3-model consensus-round latency + tokens/sec/chip on TPU.
+
+Measures the framework's headline metric (BASELINE.json): the latency of one
+consensus round — every pool member generates its action proposal for the same
+agent turn — run entirely on-device, zero external LLM calls. The reference
+implements this round as one HTTPS request per model with p50 ≈ the slowest
+provider (reference lib/quoracle/models/model_query.ex:88-131); it publishes
+no numbers (BASELINE.md), so ``vs_baseline`` compares against the documented
+hosted-API estimate: a 3-model round at typical hosted p50s ≈ 7500 ms
+(slowest-of-3 for ~128 output tokens + provider overhead; see BASELINE.md).
+
+Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import sys
+import time
+
+HOSTED_BASELINE_MS = 7500.0  # BASELINE.md: estimated hosted-API 3-model round p50
+POOL = ["xla:llama-1b", "xla:mistral-1b", "xla:gemma-1b"]  # bench-scale trio
+MAX_NEW = 128
+N_ROUNDS = 5
+
+PROMPT = (
+    "You are an autonomous agent deciding your next action. Respond with a "
+    "JSON object {\"action\": ..., \"params\": {...}, \"reasoning\": ..., "
+    '"wait": false}. Available actions: send_message, todo, wait, orient, '
+    "spawn_child, execute_shell, file_read, file_write. Current task: survey "
+    "the repository layout and report the three largest source files to your "
+    "parent agent. Conversation so far: the parent asked for a structural "
+    "summary; you have already listed the top-level directories and found "
+    "src/, tests/, docs/. Decide the single next action that makes progress."
+)
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    import jax
+
+    from quoracle_tpu.models.config import get_model_config
+    from quoracle_tpu.models.generate import GenerateEngine
+    from quoracle_tpu.models.tokenizer import get_tokenizer
+    from quoracle_tpu.models.transformer import init_params
+    from quoracle_tpu.consensus.temperature import temperature_for_round
+
+    n_chips = len(jax.devices())
+    log(f"devices: {jax.devices()}")
+
+    engines = []
+    for i, spec in enumerate(POOL):
+        cfg = get_model_config(spec)
+        t0 = time.monotonic()
+        params = init_params(cfg, jax.random.PRNGKey(i))
+        jax.block_until_ready(params)
+        tok = get_tokenizer(cfg.name)
+        engines.append((spec, cfg, GenerateEngine(cfg, params, tok), tok))
+        log(f"{spec}: params ready in {time.monotonic() - t0:.1f}s")
+
+    def run_round(round_idx: int) -> tuple[float, int]:
+        """One consensus round: each pool member proposes an action."""
+        t0 = time.monotonic()
+        n_tokens = 0
+        for spec, cfg, engine, tok in engines:
+            temp = temperature_for_round(cfg.name, round_idx + 1)
+            ids = tok.encode(PROMPT, add_bos=True)
+            res = engine.generate([ids], temperature=temp, top_p=0.95,
+                                  max_new_tokens=MAX_NEW)
+            n_tokens += res[0].n_gen_tokens
+        return (time.monotonic() - t0) * 1000.0, n_tokens
+
+    t0 = time.monotonic()
+    run_round(0)  # warmup: compiles one (batch, prompt, decode) bucket per model
+    log(f"warmup (compile) {time.monotonic() - t0:.1f}s")
+
+    lat_ms, toks = [], 0
+    t_all = time.monotonic()
+    for r in range(N_ROUNDS):
+        ms, n = run_round(0)
+        lat_ms.append(ms)
+        toks += n
+        log(f"round {r}: {ms:.0f} ms, {n} tokens")
+    wall = time.monotonic() - t_all
+
+    p50 = statistics.median(lat_ms)
+    tps_chip = toks / wall / max(1, n_chips)
+    print(json.dumps({
+        "metric": "consensus_round_p50_latency",
+        "value": round(p50, 1),
+        "unit": "ms",
+        "vs_baseline": round(HOSTED_BASELINE_MS / p50, 2),
+        "tokens_per_sec_per_chip": round(tps_chip, 1),
+        "n_chips": n_chips,
+        "pool": POOL,
+        "rounds": N_ROUNDS,
+        "max_new_tokens": MAX_NEW,
+    }))
+
+
+if __name__ == "__main__":
+    main()

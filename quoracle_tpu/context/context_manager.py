@@ -1,0 +1,60 @@
+"""History -> chat messages.
+
+Parity with the reference's ContextManager (reference
+lib/quoracle/agent/context_manager.ex:22-50): chronological messages from a
+model's history, consecutive same-role messages merged (many providers and
+our chat templates reject role repetition), decision/result entries
+JSON-formatted so the model sees its own past decisions and their outcomes
+as structured data.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from quoracle_tpu.context.history import (
+    DECISION, RESULT, SUMMARY, HistoryEntry,
+)
+from quoracle_tpu.utils.normalize import to_json
+
+
+def _entry_text(entry: HistoryEntry) -> str:
+    if entry.kind == DECISION:
+        return "[DECISION] " + (entry.content if isinstance(entry.content, str)
+                                else to_json(entry.content))
+    if entry.kind == RESULT:
+        tag = f" action={entry.action_type}" if entry.action_type else ""
+        body = entry.content if isinstance(entry.content, str) else to_json(entry.content)
+        return f"[RESULT{tag}] {body}"
+    if entry.kind == SUMMARY:
+        body = entry.content if isinstance(entry.content, str) else to_json(entry.content)
+        return "[CONDENSED HISTORY SUMMARY] " + body
+    return entry.as_text()
+
+
+def build_conversation_messages(
+    history: Sequence[HistoryEntry],
+    context_summary: Optional[str] = None,
+    additional_context: Optional[str] = None,
+) -> list[dict]:
+    """Chronological chat messages with same-role merge. An optional context
+    summary / additional context is prepended as the opening user message
+    (reference context_manager.ex:22-50)."""
+    messages: list[dict] = []
+    preamble_parts = [p for p in (context_summary, additional_context) if p]
+    if preamble_parts:
+        messages.append({"role": "user", "content": "\n\n".join(preamble_parts)})
+    for entry in history:
+        role, text = entry.role(), _entry_text(entry)
+        if messages and messages[-1]["role"] == role:
+            messages[-1]["content"] += "\n\n" + text
+        else:
+            messages.append({"role": role, "content": text})
+    if not messages:
+        messages.append({"role": "user", "content": "(no history yet)"})
+    # Chat templates require the last message to be user-side for a new
+    # assistant turn; consensus always queries after an external event, but a
+    # decision-tail can occur after restore.
+    if messages[-1]["role"] == "assistant":
+        messages.append({"role": "user", "content": "(continue)"})
+    return messages

@@ -1,0 +1,8 @@
+"""Infra services: event bus, budget escrow, costs, security, audit.
+
+Re-designs the reference's cross-cutting services
+(reference lib/quoracle/{pubsub,budget,costs,security,audit}/ — SURVEY.md §2.6)
+for a single-process asyncio runtime. The cardinal rule carries over: every
+component receives its bus/ledger/db explicitly (reference root AGENTS.md:5-33
+"no global state"), which is what keeps the test suite parallel.
+"""

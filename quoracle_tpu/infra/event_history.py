@@ -1,0 +1,107 @@
+"""Bounded per-agent event history for UI mount replay.
+
+Parity with the reference's UI.EventHistory + RingBuffer
+(reference lib/quoracle/ui/event_history.ex:17-20 — 100 logs / 50 messages
+per agent, replayed when a dashboard view mounts mid-run). A plain object
+subscribed to the bus; no GenServer needed.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+from quoracle_tpu.infra.bus import EventBus, Subscription, TOPIC_ACTIONS, TOPIC_LIFECYCLE
+
+MAX_LOGS_PER_AGENT = 100      # reference ui/event_history.ex:17-20
+MAX_MESSAGES_PER_AGENT = 50
+
+
+class EventHistory:
+    """Ring buffers of recent events, keyed by agent. Subscribes to topic
+    prefixes on an explicit bus; `replay()` returns snapshots for a newly
+    mounted view."""
+
+    def __init__(self, bus: EventBus,
+                 max_logs: int = MAX_LOGS_PER_AGENT,
+                 max_messages: int = MAX_MESSAGES_PER_AGENT):
+        self.bus = bus
+        self.max_logs = max_logs
+        self.max_messages = max_messages
+        self._logs: dict[str, deque] = {}
+        self._messages: dict[str, deque] = {}
+        self._lifecycle: deque = deque(maxlen=max_logs)
+        self._actions: deque = deque(maxlen=max_logs)
+        self._lock = threading.Lock()
+        self._subs: list[Subscription] = [
+            bus.subscribe(TOPIC_LIFECYCLE, self._on_lifecycle),
+            bus.subscribe(TOPIC_ACTIONS, self._on_action),
+        ]
+
+    # Agent log/message topics are per-agent; the runtime calls track_agent
+    # when an agent spawns so its topics are captured from the start.
+    def track_agent(self, agent_id: str) -> None:
+        from quoracle_tpu.infra.bus import topic_agent_logs, topic_agent_state
+        with self._lock:
+            if agent_id in self._logs:
+                return
+            self._logs[agent_id] = deque(maxlen=self.max_logs)
+            self._messages[agent_id] = deque(maxlen=self.max_messages)
+        self._subs.append(self.bus.subscribe(
+            topic_agent_logs(agent_id), self._on_agent_event))
+        self._subs.append(self.bus.subscribe(
+            topic_agent_state(agent_id), self._on_agent_event))
+
+    def track_task(self, task_id: str) -> None:
+        from quoracle_tpu.infra.bus import topic_task_messages
+        self._subs.append(self.bus.subscribe(
+            topic_task_messages(task_id), self._on_task_message))
+
+    def _on_lifecycle(self, topic: str, event: dict) -> None:
+        with self._lock:
+            self._lifecycle.append(event)
+        if event.get("event") == "agent_spawned":
+            self.track_agent(event["agent_id"])
+
+    def _on_action(self, topic: str, event: dict) -> None:
+        with self._lock:
+            self._actions.append(event)
+
+    def _on_agent_event(self, topic: str, event: dict) -> None:
+        agent_id = event.get("agent_id")
+        if agent_id is None:
+            return
+        with self._lock:
+            buf = self._logs.setdefault(agent_id, deque(maxlen=self.max_logs))
+            buf.append(event)
+
+    def _on_task_message(self, topic: str, event: dict) -> None:
+        # topic is "tasks:<id>:messages"
+        agent_id = (event.get("message") or {}).get("agent_id") or event.get("task_id")
+        with self._lock:
+            buf = self._messages.setdefault(
+                agent_id, deque(maxlen=self.max_messages))
+            buf.append(event)
+
+    # -- replay ------------------------------------------------------------
+    def replay_logs(self, agent_id: str) -> list[dict]:
+        with self._lock:
+            return list(self._logs.get(agent_id, ()))
+
+    def replay_messages(self, key: str) -> list[dict]:
+        with self._lock:
+            return list(self._messages.get(key, ()))
+
+    def replay_lifecycle(self) -> list[dict]:
+        with self._lock:
+            return list(self._lifecycle)
+
+    def replay_actions(self) -> list[dict]:
+        with self._lock:
+            return list(self._actions)
+
+    def close(self) -> None:
+        for sub in self._subs:
+            sub.unsubscribe()
+        self._subs.clear()

@@ -1,0 +1,89 @@
+"""Secret templates + output scrubbing.
+
+Parity with the reference's Security.SecretResolver and
+Security.OutputScrubber (reference lib/quoracle/security/secret_resolver.ex:13-37,
+output_scrubber.ex:9-38): agents reference secrets as ``{{SECRET:name}}`` in
+action params; values are substituted just before execution and scrubbed out
+of action results before any model sees them.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import Any, Callable, Mapping, Optional
+
+logger = logging.getLogger(__name__)
+
+SECRET_RE = re.compile(r"\{\{SECRET:([A-Za-z0-9_\-\.]+)\}\}")
+MIN_SCRUB_LEN = 8  # reference output_scrubber.ex:9-38 — short values stay
+
+
+def resolve_secrets(params: Any, lookup: Callable[[str], Optional[str]],
+                    _used: Optional[set] = None) -> tuple[Any, set[str]]:
+    """Recursively substitute ``{{SECRET:name}}`` templates in params.
+
+    Missing secrets are left literal with a warning (reference
+    secret_resolver.ex:13-37 — an agent typo must not crash the action; the
+    literal template in the output makes the mistake visible). Returns
+    (resolved_params, set of secret names used) so callers can audit usage.
+    """
+    used: set[str] = set() if _used is None else _used
+
+    def sub(text: str) -> str:
+        def repl(m: re.Match) -> str:
+            name = m.group(1)
+            value = lookup(name)
+            if value is None:
+                logger.warning("secret %r not found; leaving template literal", name)
+                return m.group(0)
+            used.add(name)
+            return value
+        return SECRET_RE.sub(repl, text)
+
+    def walk(node: Any) -> Any:
+        if isinstance(node, str):
+            return sub(node)
+        if isinstance(node, Mapping):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        if isinstance(node, tuple):
+            return tuple(walk(v) for v in node)
+        return node
+
+    return walk(params), used
+
+
+def scrub_output(result: Any, secrets: Mapping[str, str]) -> Any:
+    """Replace secret *values* with ``[REDACTED:name]`` recursively in an
+    action result, longest value first so overlapping secrets can't leave a
+    recoverable suffix (reference output_scrubber.ex:9-38). Values shorter
+    than 8 chars are skipped — scrubbing "a" would shred unrelated text.
+    Applied at the router boundary before results enter model history
+    (reference actions/router.ex:324-331)."""
+    pairs = sorted(
+        ((name, val) for name, val in secrets.items()
+         if isinstance(val, str) and len(val) >= MIN_SCRUB_LEN),
+        key=lambda nv: len(nv[1]), reverse=True)
+    if not pairs:
+        return result
+
+    def scrub_text(text: str) -> str:
+        for name, val in pairs:
+            if val in text:
+                text = text.replace(val, f"[REDACTED:{name}]")
+        return text
+
+    def walk(node: Any) -> Any:
+        if isinstance(node, str):
+            return scrub_text(node)
+        if isinstance(node, Mapping):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [walk(v) for v in node]
+        if isinstance(node, tuple):
+            return tuple(walk(v) for v in node)
+        return node
+
+    return walk(result)

@@ -1,23 +1,35 @@
-"""Test environment: force an 8-virtual-device CPU mesh BEFORE jax imports.
+"""Test environment: force an 8-virtual-device CPU mesh BEFORE jax backends init.
 
 Multi-chip hardware is not available in CI; sharding tests run against
 ``--xla_force_host_platform_device_count=8`` exactly as the driver's
 dryrun_multichip does. Real-TPU paths are exercised by bench.py, not tests.
+
+The TPU tunnel in this image registers its PJRT plugin from a
+``sitecustomize.py`` at interpreter startup — before any conftest runs — and
+pins the ``JAX_PLATFORMS`` env var to the plugin's backend, so setting the
+env var here is too late. ``jax.config.update`` still works because XLA
+backends initialize lazily on first ``jax.devices()`` — no test module runs
+before this conftest finishes importing. XLA_FLAGS is also read lazily at
+backend init; any pre-existing device-count flag is overridden, not kept.
 """
 
 import os
+import re
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    _flags.strip() + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
 
 @pytest.fixture(scope="session")
 def eight_devices():
-    import jax
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs

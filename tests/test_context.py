@@ -1,0 +1,228 @@
+"""Context layer: token manager, message builder, condensation, ACE lessons."""
+
+import numpy as np
+import pytest
+
+from quoracle_tpu.context.condensation import (
+    condense_for_tokens, ensure_fits, inline_condense,
+)
+from quoracle_tpu.context.context_manager import build_conversation_messages
+from quoracle_tpu.context.history import (
+    DECISION, RESULT, SUMMARY, USER, AgentContext, HistoryEntry, Lesson,
+)
+from quoracle_tpu.context.lessons import accumulate_lessons
+from quoracle_tpu.context.message_builder import build_messages_for_model
+from quoracle_tpu.context.reflector import Reflection, _parse
+from quoracle_tpu.context.token_manager import TokenManager
+
+
+def words_counter(spec, text):
+    return len(text.split())
+
+
+def chars_counter(spec, text):
+    return len(text)
+
+
+def make_tm(limit=100, count=chars_counter):
+    return TokenManager(count, context_limit_fn=lambda s: limit)
+
+
+def fake_reflect(model_spec, entries):
+    return Reflection(lessons=[Lesson(type="factual", content="fact-1")],
+                      state=["halfway done"],
+                      summary_text=f"condensed {len(entries)} entries")
+
+
+class FakeEmbedder:
+    """Deterministic: identical text -> identical vector."""
+    def embed(self, texts):
+        out = []
+        for t in texts:
+            rng = np.random.default_rng(abs(hash(t)) % (2**32))
+            v = rng.normal(size=16)
+            out.append(v / np.linalg.norm(v))
+        return out
+
+
+# ----------------------------------------------------------- token manager
+
+def test_history_tokens_and_should_condense():
+    tm = make_tm(limit=10)
+    h = [HistoryEntry(USER, "abcde"), HistoryEntry(USER, "fghij")]
+    assert tm.history_tokens("m", h) == 10
+    assert tm.should_condense("m", h)
+    assert not tm.should_condense("m", h[:1])
+
+
+def test_split_for_condensation_80pct_keeps_tail():
+    tm = make_tm(limit=1000)
+    h = [HistoryEntry(USER, "x" * 10) for _ in range(10)]
+    removed, kept = tm.split_for_condensation("m", h)
+    assert len(removed) == 8  # 80% of 100 tokens -> 81 target -> 8 entries + 1
+    assert len(kept) == 2
+    assert kept == h[8:]
+
+
+def test_split_never_removes_below_two():
+    tm = make_tm()
+    h = [HistoryEntry(USER, "a"), HistoryEntry(USER, "b")]
+    removed, kept = tm.split_for_condensation("m", h)
+    assert removed == [] and len(kept) == 2
+
+
+def test_dynamic_max_tokens_floor():
+    tm = make_tm(limit=8192)
+    # plenty of room
+    assert tm.dynamic_max_tokens("m", 1000, 4096) == 4096
+    # below the 4096 floor AND below output_limit -> None (condense first)
+    assert tm.dynamic_max_tokens("m", 8000, 4096) is None
+    # small output_limit clears even with little room
+    tm_small = make_tm(limit=512)
+    assert tm_small.dynamic_max_tokens("m", 300, 128) == 128
+
+
+# -------------------------------------------------------- context manager
+
+def test_build_conversation_merges_roles_and_formats_kinds():
+    h = [
+        HistoryEntry(USER, "hello"),
+        HistoryEntry(USER, "again"),
+        HistoryEntry(DECISION, {"action": "todo", "params": {}}),
+        HistoryEntry(RESULT, {"status": "ok"}, action_type="todo"),
+    ]
+    msgs = build_conversation_messages(h)
+    assert [m["role"] for m in msgs] == ["user", "assistant", "user"]
+    assert "hello\n\nagain" in msgs[0]["content"]
+    assert "[DECISION]" in msgs[1]["content"]
+    assert "[RESULT action=todo]" in msgs[2]["content"]
+
+
+def test_build_conversation_trailing_assistant_gets_continue():
+    msgs = build_conversation_messages([HistoryEntry(DECISION, {"action": "wait"})])
+    assert msgs[-1]["role"] == "user"
+
+
+# --------------------------------------------------------- message builder
+
+def test_injection_order():
+    ctx = AgentContext()
+    ctx.append("m", HistoryEntry(USER, "first message"))
+    ctx.append("m", HistoryEntry(USER, "second message"))
+    ctx.context_lessons["m"] = [Lesson(type="factual", content="ACE-LESSON")]
+    ctx.model_states["m"] = ["ACE-STATE"]
+    ctx.todos = [{"task": "t1"}]
+    ctx.children = [{"agent_id": "c1"}]
+    ctx.budget_snapshot = {"available": "5"}
+    ctx.correction_feedback["m"] = "FIX-THIS"
+    tm = make_tm(limit=10000)
+    msgs = build_messages_for_model(
+        ctx, "m", system_prompt="SYSTEM", refinement_prompt="REFINE",
+        token_manager=tm)
+    assert msgs[0] == {"role": "system", "content": "SYSTEM"}
+    first_user = msgs[1]["content"]
+    assert first_user.startswith("[ACCUMULATED CONTEXT")
+    assert "ACE-LESSON" in first_user and "ACE-STATE" in first_user
+    last = msgs[-1]["content"]
+    # correction appears first in the last message; token meta at the end
+    assert last.startswith("[CORRECTION")
+    assert "REFINE" in last
+    assert last.index("REFINE") < last.index("[CURRENT TODO LIST]")
+    assert last.index("[CURRENT TODO LIST]") < last.index("[ACTIVE CHILD AGENTS]")
+    assert last.index("[ACTIVE CHILD AGENTS]") < last.index("[BUDGET]")
+    assert "[CONTEXT:" in last and last.rstrip().endswith("]")
+
+
+def test_no_optional_sections_minimal_messages():
+    ctx = AgentContext()
+    ctx.append("m", HistoryEntry(USER, "hi"))
+    msgs = build_messages_for_model(ctx, "m")
+    assert len(msgs) == 1
+    assert msgs[0]["content"] == "hi"
+
+
+# ----------------------------------------------------------- condensation
+
+def test_inline_condense_clamps_and_summarizes():
+    ctx = AgentContext()
+    for i in range(5):
+        ctx.append("m", HistoryEntry(USER, f"msg{i}"))
+    res = inline_condense(ctx, "m", n=10, reflect_fn=fake_reflect)
+    assert res.condensed and res.removed_entries == 3  # clamped to len-2
+    h = ctx.history("m")
+    assert h[0].kind == SUMMARY
+    assert "condensed 3 entries" in h[0].content
+    assert [e.content for e in h[1:]] == ["msg3", "msg4"]
+    assert ctx.model_states["m"] == ["halfway done"]
+    assert ctx.context_lessons["m"][0].content == "fact-1"
+
+
+def test_inline_condense_too_short_noop():
+    ctx = AgentContext()
+    ctx.append("m", HistoryEntry(USER, "a"))
+    res = inline_condense(ctx, "m", n=1, reflect_fn=fake_reflect)
+    assert not res.condensed
+
+
+def test_condense_for_tokens_shrinks():
+    ctx = AgentContext()
+    for i in range(10):
+        ctx.append("m", HistoryEntry(USER, "x" * 10))
+    tm = make_tm(limit=50)
+    before = tm.history_tokens("m", ctx.history("m"))
+    res = condense_for_tokens(ctx, "m", tm, fake_reflect)
+    assert res.condensed
+    after = tm.history_tokens("m", ctx.history("m"))
+    assert after < before
+
+
+def test_ensure_fits_condenses_until_budget():
+    ctx = AgentContext()
+    for i in range(20):
+        ctx.append("m", HistoryEntry(USER, "y" * 50))
+    tm = make_tm(limit=600)
+    budget = ensure_fits(ctx, "m", tm, fake_reflect, output_limit=128)
+    assert budget == 128
+    assert any(e.kind == SUMMARY for e in ctx.history("m"))
+
+
+# ---------------------------------------------------------------- lessons
+
+def test_lessons_dedup_merges_confidence():
+    emb = FakeEmbedder()
+    existing = accumulate_lessons([], [Lesson(type="factual", content="A")], emb)
+    merged = accumulate_lessons(existing, [Lesson(type="factual", content="A")], emb)
+    assert len(merged) == 1
+    assert merged[0].confidence == 2
+    merged2 = accumulate_lessons(merged, [Lesson(type="factual", content="B")], emb)
+    assert len(merged2) == 2
+
+
+def test_lessons_prune_keeps_high_confidence():
+    emb = FakeEmbedder()
+    existing = [Lesson(type="factual", content=f"L{i}", confidence=i,
+                       embedding=np.eye(16)[i % 16]) for i in range(5)]
+    out = accumulate_lessons(existing, [Lesson(type="factual", content="NEW")],
+                             emb, max_lessons=3)
+    assert len(out) == 3
+    assert min(l.confidence for l in out) >= 2 or any(l.content == "NEW" for l in out)
+
+
+# --------------------------------------------------------------- reflector
+
+def test_reflector_parse_valid_and_invalid():
+    raw = '```json\n{"lessons": [{"type": "factual", "content": "f"}], "state": [{"summary": "s"}]}\n```'
+    r = _parse(raw)
+    assert r.lessons[0].content == "f"
+    assert r.state == ["s"]
+    assert _parse("not json at all") is None
+    assert _parse('{"lessons": "wrong"}') is None
+
+
+def test_reflector_retries_then_gives_up():
+    from quoracle_tpu.models.runtime import MockBackend
+    backend = MockBackend(scripts={"mock:m": ["garbage", "more garbage",
+                                              "still garbage"]})
+    from quoracle_tpu.context.reflector import reflect
+    r = reflect(backend, "mock:m", [HistoryEntry(USER, "x")])
+    assert r.lessons == [] and "reflection unavailable" in r.summary_text
