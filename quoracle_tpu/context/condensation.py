@@ -103,11 +103,18 @@ def ensure_fits(ctx: AgentContext, model_spec: str, tm: TokenManager,
     condense until the dynamic output budget clears the floor. Returns the
     max_tokens to use, or None if the history cannot be made to fit (caller
     errors loudly)."""
+    prev_tokens: Optional[int] = None
     for _ in range(max_iterations):
         input_tokens = tm.history_tokens(model_spec, ctx.history(model_spec))
         budget = tm.dynamic_max_tokens(model_spec, input_tokens, output_limit)
         if budget is not None:
             return budget
+        if prev_tokens is not None and input_tokens >= prev_tokens:
+            # The last condensation didn't shrink the history (e.g. the
+            # replacement summary is as big as the lone removable entry) —
+            # stop burning reflection queries on a history that can't fit.
+            break
+        prev_tokens = input_tokens
         result = condense_for_tokens(ctx, model_spec, tm, reflect_fn, embedder)
         if not result.condensed:
             break

@@ -68,7 +68,11 @@ def accumulate_lessons(
             out.append(lesson)
 
     if len(out) > max_lessons:
-        # prune lowest-confidence first; ties keep newest knowledge
-        out.sort(key=lambda l: l.confidence, reverse=True)
-        out = out[:max_lessons]
+        # prune lowest-confidence first; ties keep newest knowledge (higher
+        # index = more recently learned, so it must outrank an equal-
+        # confidence older lesson — a plain stable sort would keep the old).
+        ranked = sorted(enumerate(out),
+                        key=lambda p: (-p[1].confidence, -p[0]))
+        ranked = sorted(ranked[:max_lessons], key=lambda p: p[0])
+        out = [l for _, l in ranked]
     return out

@@ -22,7 +22,7 @@ from quoracle_tpu.models.runtime import ModelBackend, QueryRequest
 logger = logging.getLogger(__name__)
 
 MAX_RETRIES = 2                      # reference reflector.ex:21
-MIN_REFLECTION_OUTPUT_TOKENS = 128
+REFLECTION_MAX_OUTPUT_TOKENS = 1024
 
 REFLECTION_SYSTEM_PROMPT = """\
 You are a reflective analyst, NOT an action-executing agent.
@@ -108,7 +108,7 @@ def reflect(backend: ModelBackend, model_spec: str,
                            f"Return ONLY the JSON object in the required format."}]
         results = backend.query([QueryRequest(
             model_spec=model_spec, messages=messages, temperature=0.3,
-            max_tokens=max(MIN_REFLECTION_OUTPUT_TOKENS, 1024))])
+            max_tokens=REFLECTION_MAX_OUTPUT_TOKENS)])
         res = results[0]
         if not res.ok:
             last_error = f"query failed: {res.error}"

@@ -18,9 +18,9 @@ from __future__ import annotations
 from typing import Callable, Optional, Sequence
 
 from quoracle_tpu.context.history import HistoryEntry
+from quoracle_tpu.models.config import OUTPUT_FLOOR
 
 DEFAULT_CONTEXT_LIMIT = 128_000   # reference token_manager.ex:9
-OUTPUT_FLOOR = 4096               # reference per_model_query.ex:17-18
 SAFETY_MARGIN = 1.02
 CONDENSE_FRACTION = 0.80          # token_manager.ex:164 "removes >80%"
 
@@ -51,8 +51,11 @@ class TokenManager:
         return sum(self.entry_tokens(model_spec, e) for e in history)
 
     def messages_tokens(self, model_spec: str, messages: Sequence[dict]) -> int:
+        """Same accounting as ModelBackend.count_message_tokens: content
+        tokens + 4/message for the rendered <|role|> framing — the two layers
+        must agree or budget math drifts from what encode_chat produces."""
         from quoracle_tpu.utils.normalize import stringify_content
-        return sum(self.count(model_spec, stringify_content(m.get("content")))
+        return sum(self.count(model_spec, stringify_content(m.get("content"))) + 4
                    for m in messages)
 
     def context_limit(self, model_spec: str) -> int:
@@ -97,10 +100,12 @@ class TokenManager:
     # -- dynamic output budget (reference per_model_query.ex:136-145) ------
     def dynamic_max_tokens(self, model_spec: str, input_tokens: int,
                            output_limit: int) -> Optional[int]:
-        """Room left for generation, or None if below the 4096 floor —
-        None tells the caller to condense before querying."""
+        """Room left for generation, or None if below the output floor —
+        None tells the caller to condense before querying. The floor is
+        min(OUTPUT_FLOOR, output_limit) so small-window models use their own
+        limit as the floor (same formula as TPUBackend.query)."""
         window = self.context_limit(model_spec)
         room = int(window - self.margin * input_tokens)
-        if room < OUTPUT_FLOOR and room < output_limit:
+        if room < min(OUTPUT_FLOOR, output_limit):
             return None
         return max(1, min(room, output_limit))

@@ -1,0 +1,5 @@
+"""Governance layer: profiles, capability gating, groves, skills, prompt fields.
+
+Reference: lib/quoracle/{profiles,groves,skills,fields}/ — cross-cutting rules
+that gate actions, shape prompts, and constrain spawn (SURVEY.md §1 layer 8).
+"""

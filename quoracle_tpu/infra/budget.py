@@ -119,12 +119,20 @@ class Escrow:
             child = self._states.pop(child_id, None)
             if alloc is None or parent_id is None:
                 return ZERO
-            spent = (child.spent + child.committed) if child else alloc
-            unspent = max(ZERO, alloc - spent)
+            # Out-of-order dismissal: re-parent this child's live children to
+            # the grandparent so their later release still credits a live
+            # ledger (their allocations move with them).
+            kid_alloc = ZERO
+            for k, p in list(self._parent.items()):
+                if p == child_id:
+                    self._parent[k] = parent_id
+                    kid_alloc += self._child_alloc.get(k, ZERO)
+            own_spent = child.spent if child else alloc
+            unspent = max(ZERO, alloc - own_spent - kid_alloc)
             parent = self._states.get(parent_id)
             if parent is not None and parent.limit is not None:
-                parent.committed -= alloc
-                parent.spent += min(alloc, spent)
+                parent.committed -= alloc - kid_alloc
+                parent.spent += min(alloc, own_spent)
             return unspent
 
     def adjust_child(self, parent_id: str, child_id: str, new_amount) -> BudgetState:

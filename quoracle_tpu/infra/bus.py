@@ -53,10 +53,19 @@ class EventBus:
 
     def subscribe_queue(self, topic: str,
                         queue: Optional[asyncio.Queue] = None) -> tuple[Subscription, asyncio.Queue]:
+        """Must be called from a running event loop; broadcasts may come from
+        any thread (e.g. an executor thread running backend.query), so the
+        push is marshalled onto the subscribing loop with
+        call_soon_threadsafe — a bare put_nowait from a foreign thread never
+        wakes the loop's waiting getters."""
         q: asyncio.Queue = queue if queue is not None else asyncio.Queue()
+        loop = asyncio.get_running_loop()
 
         def push(t: str, event: dict) -> None:
-            q.put_nowait((t, event))
+            try:
+                loop.call_soon_threadsafe(q.put_nowait, (t, event))
+            except RuntimeError:
+                pass  # loop closed: subscriber is gone, drop the event
 
         return self.subscribe(topic, push), q
 

@@ -14,6 +14,13 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+# Minimum room a consensus round must leave for the response (reference
+# per_model_query.ex:17-18 — 4096 output floor). Effective per-model floor is
+# min(OUTPUT_FLOOR, output_limit); shared by TPUBackend.query and
+# TokenManager.dynamic_max_tokens so both layers agree on when a history
+# "fits".
+OUTPUT_FLOOR = 4096
+
 
 @dataclasses.dataclass(frozen=True)
 class ModelConfig:

@@ -25,7 +25,9 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
-from quoracle_tpu.models.config import ModelConfig, get_model_config
+from quoracle_tpu.models.config import (
+    OUTPUT_FLOOR, ModelConfig, get_model_config,
+)
 from quoracle_tpu.models.generate import ContextOverflowError, GenerateEngine
 from quoracle_tpu.models.tokenizer import Tokenizer, get_tokenizer
 
@@ -95,11 +97,6 @@ class ModelBackend(abc.ABC):
 # ---------------------------------------------------------------------------
 # TPU backend
 # ---------------------------------------------------------------------------
-
-# Dynamic max_tokens floor: a round must leave at least this much room for
-# the response (reference per_model_query.ex:17-18 — 4096 output floor).
-OUTPUT_FLOOR = 256
-
 
 class TPUBackend(ModelBackend):
     """Serves a pool of catalog models resident on the chip/mesh.
@@ -180,7 +177,8 @@ class TPUBackend(ModelBackend):
                 temps.append(r.temperature)
                 tops.append(r.top_p)
                 window, out_lim = engine.cfg.context_window, engine.cfg.output_limit
-                budget = min(out_lim, max(OUTPUT_FLOOR, window - len(ids)))
+                floor = min(OUTPUT_FLOOR, out_lim)
+                budget = min(out_lim, max(floor, window - len(ids)))
                 budgets.append(min(r.max_tokens, budget) if r.max_tokens else budget)
                 live_idxs.append(i)
             if not live_idxs:

@@ -67,23 +67,10 @@ class Tokenizer(abc.ABC):
         return self.encode(self.render_chat(messages), add_bos=True)
 
 
-def _stringify_content(content) -> str:
-    """Multimodal content array -> text (parity with the reference's
-    ContentStringifier, reference lib/quoracle/utils/content_stringifier.ex)."""
-    if isinstance(content, list):
-        out = []
-        for part in content:
-            if isinstance(part, dict):
-                if part.get("type") == "text":
-                    out.append(part.get("text", ""))
-                elif part.get("type") in ("image", "image_url"):
-                    out.append("[image]")
-                else:
-                    out.append(str(part))
-            else:
-                out.append(str(part))
-        return "\n".join(out)
-    return str(content)
+# Single multimodal-content stringifier for the whole stack: chat rendering,
+# backend token counting, and TokenManager budgeting must all flatten content
+# identically or their counts drift apart.
+from quoracle_tpu.utils.normalize import stringify_content as _stringify_content
 
 
 class ByteTokenizer(Tokenizer):

@@ -30,8 +30,10 @@ def normalize_json(value: Any) -> Any:
         return {str(k): normalize_json(v) for k, v in value.items()}
     if isinstance(value, (list, tuple)):
         return [normalize_json(v) for v in value]
-    if isinstance(value, set):
-        return sorted(normalize_json(v) for v in value)
+    if isinstance(value, (set, frozenset)):
+        # key=repr: mixed-type sets ({1, "a"}) have no natural order and
+        # plain sorted() raises TypeError; repr gives a deterministic one.
+        return sorted((normalize_json(v) for v in value), key=repr)
     if isinstance(value, BaseException):
         return {"error": type(value).__name__, "message": str(value)}
     if hasattr(value, "__dict__") and not isinstance(value, type):

@@ -226,3 +226,57 @@ def test_reflector_retries_then_gives_up():
     from quoracle_tpu.context.reflector import reflect
     r = reflect(backend, "mock:m", [HistoryEntry(USER, "x")])
     assert r.lessons == [] and "reflection unavailable" in r.summary_text
+
+
+def test_lesson_prune_ties_keep_newest():
+    import numpy as np
+    from quoracle_tpu.context.history import Lesson
+    from quoracle_tpu.context.lessons import accumulate_lessons
+
+    class OrthoEmbedder:
+        """One-hot per unique text: no two lessons ever dedup-merge."""
+        def __init__(self):
+            self.seen = {}
+
+        def embed(self, texts):
+            out = []
+            for t in texts:
+                i = self.seen.setdefault(t, len(self.seen))
+                v = np.zeros(512, dtype=np.float32)
+                v[i] = 1.0
+                out.append(v)
+            return out
+
+    emb = OrthoEmbedder()
+    existing = [Lesson(type="factual", content=f"old fact {i}")
+                for i in range(100)]
+    existing = accumulate_lessons([], existing, emb)
+    out = accumulate_lessons(existing, [Lesson(type="factual",
+                                               content="brand new fact")],
+                             emb)
+    assert len(out) == 100
+    assert any(l.content == "brand new fact" for l in out)
+
+
+def test_ensure_fits_stops_without_progress():
+    from quoracle_tpu.context.condensation import ensure_fits
+    from quoracle_tpu.context.history import AgentContext, HistoryEntry, USER
+    from quoracle_tpu.context.reflector import Reflection
+    from quoracle_tpu.context.token_manager import TokenManager
+    calls = []
+
+    def reflect_fn(spec, entries):
+        calls.append(len(entries))
+        # Summary as large as what was removed: zero shrink.
+        return Reflection(lessons=[], state=[],
+                          summary_text="x" * sum(len(e.as_text())
+                                                 for e in entries))
+
+    ctx = AgentContext()
+    ctx.model_histories["m"] = [HistoryEntry(kind=USER, content="a" * 400),
+                                HistoryEntry(kind=USER, content="b" * 4000),
+                                HistoryEntry(kind=USER, content="c" * 4000)]
+    tm = TokenManager(lambda spec, text: len(text),
+                      context_limit_fn=lambda spec: 2000)
+    assert ensure_fits(ctx, "m", tm, reflect_fn, output_limit=512) is None
+    assert len(calls) <= 2  # stopped early, not 4 wasted reflections

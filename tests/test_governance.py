@@ -1,0 +1,129 @@
+"""Capability gating + system prompt builder tests.
+
+Mirrors the reference's profiles/capability_groups and prompt_builder tests
+(reference test/quoracle/profiles/, test/quoracle/consensus/prompt_builder*).
+"""
+
+import pytest
+
+from quoracle_tpu.actions.schema import ACTIONS
+from quoracle_tpu.consensus.prompt_builder import (
+    action_json_schema, build_system_prompt,
+)
+from quoracle_tpu.governance.capabilities import (
+    ALWAYS_ALLOWED, GROUP_ACTIONS, InvalidGroupError,
+    allowed_actions_for_groups, blocked_actions_for_groups, filter_actions,
+)
+
+
+class TestCapabilityGroups:
+    def test_base_actions_always_allowed(self):
+        assert allowed_actions_for_groups([]) == set(ALWAYS_ALLOWED)
+
+    def test_hierarchy_group_enables_spawn(self):
+        allowed = allowed_actions_for_groups(["hierarchy"])
+        assert "spawn_child" in allowed and "dismiss_child" in allowed
+        assert "execute_shell" not in allowed
+
+    def test_all_groups_cover_all_actions(self):
+        allowed = allowed_actions_for_groups(list(GROUP_ACTIONS))
+        assert allowed == set(ACTIONS)
+
+    def test_invalid_group_raises(self):
+        with pytest.raises(InvalidGroupError):
+            allowed_actions_for_groups(["nope"])
+
+    def test_filter_none_means_ungoverned(self):
+        assert filter_actions(["spawn_child", "wait"], None) == \
+            ["spawn_child", "wait"]
+
+    def test_forbidden_removed_after_gating(self):
+        out = filter_actions(list(ACTIONS), ["hierarchy"],
+                             forbidden=["spawn_child"])
+        assert "spawn_child" not in out and "dismiss_child" in out
+
+    def test_blocked_actions(self):
+        blocked = blocked_actions_for_groups([], ACTIONS)
+        assert "execute_shell" in blocked and "wait" not in blocked
+
+
+class TestActionJsonSchema:
+    def test_spawn_child_schema_shape(self):
+        js = action_json_schema(ACTIONS["spawn_child"])
+        assert js["action"] == "spawn_child"
+        assert "task_description" in js["params"]["required"]
+        assert js["params"]["properties"]["task_description"]["type"] == "string"
+
+    def test_profile_enum_injection(self):
+        js = action_json_schema(ACTIONS["spawn_child"],
+                                profile_names=["research", "builder"])
+        assert js["params"]["properties"]["profile"]["enum"] == \
+            ["research", "builder"]
+
+    def test_shell_xor_group_documented(self):
+        js = action_json_schema(ACTIONS["execute_shell"])
+        assert ["command", "check_id"] in js["exactly_one_of"]
+
+    def test_wait_not_required_for_wait_action(self):
+        assert "wait" not in action_json_schema(ACTIONS["wait"])
+
+
+class TestBuildSystemPrompt:
+    def test_contains_core_sections(self):
+        p = build_system_prompt()
+        assert "one agent within a multi-agent system" in p
+        assert "## Available Actions" in p
+        assert "## Response Format" in p
+        assert "<response_schema>" in p
+
+    def test_deterministic(self):
+        assert build_system_prompt() == build_system_prompt()
+
+    def test_capability_filtering_removes_schemas(self):
+        p = build_system_prompt(capability_groups=[])
+        assert "### spawn_child" not in p
+        assert "### send_message" in p
+        # Secrets docs only appear when secret actions are available.
+        assert "{{SECRET:name}}" not in p
+        p2 = build_system_prompt(capability_groups=["local_execution"])
+        assert "{{SECRET:name}}" in p2
+
+    def test_profile_section(self):
+        p = build_system_prompt(profile_name="research",
+                                profile_description="Web research agent",
+                                capability_groups=["file_read"])
+        assert "## Your Profile: research" in p
+        assert "Web research agent" in p
+        assert "Actions NOT available to you" in p
+
+    def test_field_system_prompt_in_identity(self):
+        p = build_system_prompt(field_system_prompt="<role>Analyst</role>")
+        assert "<role>Analyst</role>" in p
+        assert p.index("multi-agent system") < p.index("<role>")
+
+    def test_skills_sections(self):
+        p = build_system_prompt(
+            available_skills=[{"name": "scraping", "description": "scrape"}],
+            active_skills=[{"name": "scraping", "content": "Use httpx."}])
+        assert "## Available Skills" in p
+        assert "### Skill: scraping" in p
+        assert "Use httpx." in p
+
+    def test_grove_and_governance(self):
+        p = build_system_prompt(grove_path="/tmp/grove",
+                                governance_docs="No rm -rf.")
+        assert "## Grove Context" in p and "/tmp/grove" in p
+        assert "## Governance Rules" in p and "No rm -rf." in p
+
+    def test_untrusted_docs_present_when_fetch_web_allowed(self):
+        p = build_system_prompt()
+        assert "NO_EXECUTE" in p
+
+    def test_forbidden_actions_excluded(self):
+        p = build_system_prompt(forbidden_actions=["execute_shell"])
+        assert "### execute_shell" not in p
+
+    def test_examples_filtered_by_allowed(self):
+        p = build_system_prompt(capability_groups=[])
+        assert '"action": "spawn_child"' not in p
+        assert '"action": "send_message"' in p

@@ -220,3 +220,25 @@ def test_truncate_response():
     assert len(out) <= 60 + 10
     assert "truncated" in out
     assert out.startswith("a") and out.endswith("b")
+
+
+def test_normalize_mixed_type_set():
+    from quoracle_tpu.utils.normalize import to_json
+    # Mixed-type sets must serialize deterministically, not raise TypeError.
+    assert to_json({"ids": {1, "a"}}) == to_json({"ids": {"a", 1}})
+
+
+def test_escrow_out_of_order_release_preserves_budget():
+    from decimal import Decimal
+    from quoracle_tpu.infra.budget import Escrow
+    esc = Escrow()
+    esc.register("P", mode="root", limit=Decimal("10"))
+    esc.lock_for_child("P", "C", Decimal("10"))
+    esc.lock_for_child("C", "G", Decimal("4"))
+    esc.record_spend("G", Decimal("1"))
+    esc.release_child("C")          # parent released before grandchild
+    released = esc.release_child("G")
+    assert released == Decimal("3")  # G's unspent not silently lost
+    p = esc.get("P")
+    assert p.committed == Decimal("0")
+    assert p.spent <= Decimal("10")
