@@ -1,0 +1,533 @@
+"""Action executors: the behavior behind each action name.
+
+Each executor is ``async def fn(core, router, params) -> dict``. The schema
+layer (actions/schema.py) has already validated params by the time an
+executor runs — consensus filters invalid proposals before they can win
+(reference consensus.ex:269-293) — so executors only check *runtime*
+conditions (child exists, budget available, path allowed…).
+
+Coverage in this module (reference files in parens):
+  wait (actions/wait.ex), send_message (send_message.ex), orient (orient.ex),
+  todo (todo.ex), file_read / file_write (file_read.ex/file_write.ex),
+  execute_shell smart mode (shell.ex:13,24-35,66-114), spawn_child
+  (spawn.ex:7-20,109-161,184-227,412-433), dismiss_child (dismiss_child.ex),
+  adjust_budget / record_cost (adjust_budget.ex/record_cost.ex),
+  generate_secret / search_secrets (generate_secret.ex/search_secrets.ex),
+  batch_sync / batch_async (batch_sync.ex/batch_async.ex).
+The world-facing network actions (fetch_web, call_api, call_mcp,
+answer_engine, generate_images) and the skills actions live in
+actions/world.py / the skills subsystem and register themselves here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+import uuid
+from decimal import Decimal
+from typing import Any, Awaitable, Callable, Optional
+
+from quoracle_tpu.actions.schema import (
+    batchable_async_actions, batchable_sync_actions,
+)
+from quoracle_tpu.infra.budget import BudgetError
+
+Executor = Callable[[Any, Any, dict], Awaitable[dict]]
+
+EXECUTORS: dict[str, Executor] = {}
+
+
+class ActionError(Exception):
+    """Executor-level failure that becomes an error result (not a crash)."""
+
+
+def register(name: str) -> Callable[[Executor], Executor]:
+    def deco(fn: Executor) -> Executor:
+        EXECUTORS[name] = fn
+        return fn
+    return deco
+
+
+def get_executor(name: str) -> Executor:
+    fn = EXECUTORS.get(name)
+    if fn is None:
+        raise ActionError(f"action {name!r} is not available in this runtime")
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# Introspection / local state
+# ---------------------------------------------------------------------------
+
+@register("wait")
+async def wait_action(core, router, params: dict) -> dict:
+    """The wait itself is enacted by the Core on the action result
+    (reference consensus_handler.ex:264-292 wait-parameter semantics); the
+    executor just acknowledges."""
+    duration = params.get("duration")
+    return {"status": "ok", "waiting": duration if duration else "indefinite",
+            "reason": params.get("reason", "")}
+
+
+@register("orient")
+async def orient_action(core, router, params: dict) -> dict:
+    """Structured self-reflection: the value is the params themselves landing
+    in history (reference actions/orient.ex — 12 reflection fields)."""
+    return {"status": "ok", "reflection": dict(params)}
+
+
+@register("todo")
+async def todo_action(core, router, params: dict) -> dict:
+    """Replace the TODO list (reference actions/todo.ex — replacement, not
+    merge) and broadcast to the UI."""
+    items = params["items"]
+    core.ctx.todos = list(items)
+    core.deps.events.todo_updated(core.agent_id, core.ctx.todos)
+    return {"status": "ok", "items": len(core.ctx.todos)}
+
+
+# ---------------------------------------------------------------------------
+# Messaging
+# ---------------------------------------------------------------------------
+
+@register("send_message")
+async def send_message_action(core, router, params: dict) -> dict:
+    """Direct agent messaging: parent / children / announcement / agent id
+    (reference actions/send_message.ex; targets at schema.ex:13)."""
+    registry = core.deps.registry
+    target = params["target"]
+    message = {
+        "from": core.agent_id,
+        "content": params["content"],
+        "message_type": params.get("message_type", "info"),
+        "ts": time.time(),
+    }
+    if target == "parent":
+        regs = [registry.parent_of(core.agent_id)]
+        if regs[0] is None:
+            raise ActionError("agent has no parent")
+    elif target == "children":
+        regs = registry.children_of(core.agent_id)
+    elif target == "announcement":
+        regs = [r for r in registry.agents_for_task(core.config.task_id)
+                if r.agent_id != core.agent_id]
+    else:
+        reg = registry.lookup(target)
+        if reg is None:
+            raise ActionError(f"unknown target agent {target!r}")
+        regs = [reg]
+
+    delivered = []
+    for reg in regs:
+        reg.core.post({"type": "agent_message", **message})
+        delivered.append(reg.agent_id)
+    core.deps.events.task_message(core.config.task_id, {
+        **message, "targets": delivered})
+    return {"status": "ok", "delivered_to": delivered}
+
+
+# ---------------------------------------------------------------------------
+# Files
+# ---------------------------------------------------------------------------
+
+def _check_path(core, path: str, write: bool) -> str:
+    """Grove confinement hook (reference groves/hard_rule_enforcer.ex file
+    confinement). Until the governance milestone wires a grove, only the
+    agent's working_dir-relative resolution applies."""
+    p = os.path.abspath(os.path.join(core.config.working_dir, path))
+    grove = core.deps.grove
+    if grove is not None:
+        err = grove.check_file_path(p, write=write)
+        if err:
+            raise ActionError(err)
+    return p
+
+
+@register("file_read")
+async def file_read_action(core, router, params: dict) -> dict:
+    from quoracle_tpu.actions.router import truncate_output
+    path = _check_path(core, params["path"], write=False)
+    offset = int(params.get("offset") or 0)
+    limit = params.get("limit")
+    try:
+        with open(path, "r", errors="replace") as f:
+            lines = f.readlines()
+    except OSError as e:
+        raise ActionError(f"file_read failed: {e}")
+    selected = lines[offset: offset + int(limit) if limit else None]
+    return {"status": "ok", "path": path,
+            "content": truncate_output("".join(selected)),
+            "total_lines": len(lines)}
+
+
+@register("file_write")
+async def file_write_action(core, router, params: dict) -> dict:
+    path = _check_path(core, params["path"], write=True)
+    content = params["content"]
+    grove = core.deps.grove
+    if grove is not None:
+        err = grove.validate_file_schema(path, content)
+        if err:
+            raise ActionError(err)
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        mode = "a" if params.get("append") else "w"
+        with open(path, mode) as f:
+            f.write(content)
+    except OSError as e:
+        raise ActionError(f"file_write failed: {e}")
+    return {"status": "ok", "path": path, "bytes": len(content.encode())}
+
+
+# ---------------------------------------------------------------------------
+# Shell (smart mode)
+# ---------------------------------------------------------------------------
+
+@register("execute_shell")
+async def execute_shell_action(core, router, params: dict) -> dict:
+    """Smart mode (reference actions/shell.ex:13,24-26): sync result if the
+    command finishes within the threshold, otherwise async with a command_id
+    the agent polls/terminates via check_id (XOR-validated against command).
+    Output is pumped into the command's buffer from the moment of launch, so
+    nothing emitted before the sync/async handoff is ever lost."""
+    from quoracle_tpu.actions.router import (
+        ShellCommand, ShellOwner, pump_stream, truncate_output,
+    )
+
+    if params.get("check_id"):
+        owner = core.shell_routers.get(params["check_id"])
+        if owner is None:
+            raise ActionError(
+                f"no running command {params['check_id']!r} (already "
+                f"completed, terminated, or never existed)")
+        if params.get("terminate"):
+            return await owner.terminate_command()
+        return owner.poll_command()
+
+    command = params["command"]
+    working_dir = params.get("working_dir") or core.config.working_dir
+    grove = core.deps.grove
+    if grove is not None:
+        err = (grove.check_shell_command(command)
+               or grove.check_working_dir(working_dir))
+        if err:
+            raise ActionError(err)
+    if not os.path.isdir(working_dir):
+        raise ActionError(f"working_dir {working_dir!r} does not exist")
+
+    try:
+        # Own process group so terminate/timeout can kill the shell AND its
+        # descendants (the sh here does not exec; a lone kill of the shell
+        # would orphan the real command with the stdout pipe still open).
+        proc = await asyncio.create_subprocess_shell(
+            command, cwd=working_dir,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.STDOUT,
+            stdin=asyncio.subprocess.DEVNULL,
+            start_new_session=True)
+    except OSError as e:
+        raise ActionError(f"failed to start command: {e}")
+
+    from quoracle_tpu.actions.router import (
+        close_subprocess_transport, kill_process_group,
+    )
+    cmd = ShellCommand(command_id=f"cmd-{uuid.uuid4().hex[:10]}",
+                       command=command, proc=proc,
+                       started_at=time.monotonic())
+    pump = asyncio.ensure_future(pump_stream(proc.stdout, cmd.output))
+
+    threshold = core.deps.shell_sync_threshold_s
+    timeout = params.get("timeout")
+
+    def go_async() -> dict:
+        ShellOwner(core, cmd, pump).adopt(float(timeout) if timeout else None)
+        return {"status": "ok", "async": True, "command_id": cmd.command_id,
+                "command_status": "running",
+                "note": ("command still running; poll or terminate it with "
+                         f"execute_shell check_id={cmd.command_id!r}")}
+
+    try:
+        # Poll returncode for the sync window instead of proc.wait():
+        # asyncio's exit waiter is gated on pipe EOF, which a backgrounded
+        # descendant can hold open long after the process itself exits.
+        deadline = time.monotonic() + threshold
+        while proc.returncode is None and time.monotonic() < deadline:
+            await asyncio.sleep(min(0.005, threshold / 4))
+        if proc.returncode is None:
+            return go_async()
+        try:
+            # Process exited within the threshold; the pump ends at pipe
+            # EOF — which a backgrounded descendant can hold open, in which
+            # case the command is still producing output: treat as async.
+            await asyncio.wait_for(asyncio.shield(pump), timeout=threshold)
+        except asyncio.TimeoutError:
+            return go_async()
+    except asyncio.CancelledError:
+        # Core teardown cancelled the router mid-launch — this process has
+        # no ShellOwner yet, so reap it here or it leaks.
+        pump.cancel()
+        kill_process_group(proc)
+        close_subprocess_transport(proc)
+        raise
+    cmd.status = "completed"
+    cmd.exit_code = proc.returncode
+    close_subprocess_transport(proc)
+    return {"status": "ok", "sync": True, "exit_code": cmd.exit_code,
+            "output": truncate_output(cmd.output_text())}
+
+
+# ---------------------------------------------------------------------------
+# Lifecycle: spawn / dismiss
+# ---------------------------------------------------------------------------
+
+SPAWN_MAX_RETRIES = 3        # reference spawn.ex:412-433
+SPAWN_RETRY_DELAY_S = 0.2
+
+
+def _compose_child_system_prompt(params: dict) -> Optional[str]:
+    """Assemble the child's identity prompt from spawn fields. The full
+    hierarchical prompt-field transformation (reference
+    fields/prompt_field_manager.ex) replaces this in the governance
+    milestone; the composition order matches its provided-field rendering."""
+    parts = []
+    if params.get("role"):
+        parts.append(f"Your role: {params['role']}")
+    if params.get("cognitive_style"):
+        parts.append(f"Cognitive style: {params['cognitive_style']}")
+    if params.get("global_context"):
+        parts.append(f"Global context:\n{params['global_context']}")
+    if params.get("constraints"):
+        parts.append(f"Constraints you must respect:\n{params['constraints']}")
+    return "\n\n".join(parts) or None
+
+
+def _compose_initial_message(params: dict) -> str:
+    return "\n\n".join(
+        f"[{label}]\n{params[key]}" for label, key in (
+            ("TASK", "task_description"),
+            ("SUCCESS CRITERIA", "success_criteria"),
+            ("IMMEDIATE CONTEXT", "immediate_context"),
+            ("APPROACH GUIDANCE", "approach_guidance"),
+        ))
+
+
+@register("spawn_child")
+async def spawn_child_action(core, router, params: dict) -> dict:
+    """Async spawn (reference spawn.ex:7-20): child_id allocated and budget
+    escrowed synchronously, the child itself starts in a background task, and
+    the action returns immediately — success/failure arrives later as a
+    child_spawned / spawn_failed message to the parent."""
+    from quoracle_tpu.agent.state import AgentConfig, new_agent_id
+
+    deps, registry = core.deps, core.deps.registry
+    if registry.dismissing(core.agent_id):
+        raise ActionError("parent is being dismissed; refusing to spawn")
+
+    child_id = new_agent_id()
+    budget = params.get("budget")
+    if budget is None and core.budget_limit is not None:
+        # Reference spawn.ex:152-155: children of budgeted parents MUST get
+        # an explicit allocation or the escrow books don't balance.
+        raise ActionError("budget is required when the parent has a budget")
+    allocated: Optional[Decimal] = None
+    if budget is not None:
+        try:
+            allocated = Decimal(str(budget))
+            deps.escrow.lock_for_child(core.agent_id, child_id, allocated)
+        except (BudgetError, KeyError) as e:
+            raise ActionError(f"budget escrow failed: {e}")
+
+    profile = params.get("profile")
+    resolved = None
+    if deps.grove is not None:
+        resolved = deps.grove.resolve_spawn(profile, params)
+    cfg = AgentConfig(
+        agent_id=child_id,
+        task_id=core.config.task_id,
+        parent_id=core.agent_id,
+        model_pool=(resolved.model_pool if resolved else None)
+                    or list(core.config.model_pool),
+        profile=profile,
+        capability_groups=(resolved.capability_groups if resolved
+                           else core.config.capability_groups),
+        forbidden_actions=core.config.forbidden_actions,
+        max_refinement_rounds=core.config.max_refinement_rounds,
+        field_system_prompt=_compose_child_system_prompt(params),
+        profile_names=core.config.profile_names,
+        grove_path=core.config.grove_path,
+        governance_docs=core.config.governance_docs,
+        budget_mode="allocated" if allocated is not None else "na",
+        budget_limit=allocated,
+        working_dir=core.config.working_dir,
+    )
+    initial_message = _compose_initial_message(params)
+
+    async def do_spawn() -> None:
+        last_err: Optional[Exception] = None
+        for attempt in range(SPAWN_MAX_RETRIES):
+            # Re-check right before registering: terminate_tree may have
+            # flagged the parent between the sync check above and this task
+            # running (the spawn/dismiss race, reference core.ex:213-220).
+            if registry.dismissing(core.agent_id) \
+                    or registry.lookup(core.agent_id) is None:
+                last_err = RuntimeError("parent dismissed during spawn")
+                break
+            try:
+                child = await deps.supervisor.start_agent(cfg)
+                if registry.dismissing(core.agent_id) \
+                        or registry.lookup(core.agent_id) is None:
+                    # Parent was torn down after tree collection: this child
+                    # escaped the BFS, so reap it here — the subtree must
+                    # not grow during dismissal.
+                    await deps.supervisor.terminate_tree(
+                        child_id, by=core.agent_id, reason="parent dismissed")
+                    last_err = RuntimeError("parent dismissed during spawn")
+                    break
+                # UI learns about the child before any blocking waits
+                # (reference spawn.ex:264-272 broadcast-first ordering).
+                child.post({"type": "user_message",
+                            "content": initial_message,
+                            "from": core.agent_id})
+                core.post({"type": "child_spawned", "child_id": child_id,
+                           "profile": profile})
+                return
+            except Exception as e:                    # noqa: BLE001
+                last_err = e
+                await asyncio.sleep(SPAWN_RETRY_DELAY_S * (attempt + 1))
+        if allocated is not None:
+            try:
+                deps.escrow.release_child(child_id)
+            except (BudgetError, KeyError):
+                pass
+        core.post({"type": "spawn_failed", "child_id": child_id,
+                   "reason": f"{type(last_err).__name__}: {last_err}"})
+
+    core.track_background(asyncio.ensure_future(do_spawn()))
+    return {"status": "ok", "agent_id": child_id,
+            "budget_allocated": str(allocated) if allocated is not None else None}
+
+
+@register("dismiss_child")
+async def dismiss_child_action(core, router, params: dict) -> dict:
+    """Recursive subtree dismissal + budget absorption (reference
+    dismiss_child.ex + TreeTerminator, agent AGENTS.md:168-175)."""
+    child_id = params["child_id"]
+    reg = core.deps.registry.lookup(child_id)
+    if reg is None or reg.parent_id != core.agent_id:
+        raise ActionError(f"{child_id!r} is not a live child of this agent")
+    terminated = await core.deps.supervisor.terminate_tree(
+        child_id, by=core.agent_id, reason=params.get("reason", "dismissed"))
+    core.children = [c for c in core.children if c["agent_id"] != child_id]
+    core.ctx.children = list(core.children)
+    return {"status": "ok", "dismissed": child_id,
+            "agents_terminated": terminated}
+
+
+# ---------------------------------------------------------------------------
+# Budget / costs
+# ---------------------------------------------------------------------------
+
+@register("adjust_budget")
+async def adjust_budget_action(core, router, params: dict) -> dict:
+    child_id = params["child_id"]
+    if not any(c["agent_id"] == child_id for c in core.children):
+        raise ActionError(f"{child_id!r} is not a child of this agent")
+    try:
+        state = core.deps.escrow.adjust_child(
+            core.agent_id, child_id, Decimal(str(params["amount"])))
+    except (BudgetError, KeyError) as e:
+        raise ActionError(f"adjust_budget failed: {e}")
+    core.deps.events.budget_updated(child_id, state.snapshot())
+    return {"status": "ok", "child_id": child_id,
+            "new_allocation": str(params["amount"])}
+
+
+@register("record_cost")
+async def record_cost_action(core, router, params: dict) -> dict:
+    from quoracle_tpu.infra.costs import CostEntry
+    entry = core.deps.costs.record(CostEntry(
+        agent_id=core.agent_id, task_id=core.config.task_id,
+        amount=Decimal(str(params["amount"])), cost_type="manual",
+        description=params["description"]))
+    return {"status": "ok", "recorded": str(entry.amount)}
+
+
+# ---------------------------------------------------------------------------
+# Secrets
+# ---------------------------------------------------------------------------
+
+@register("generate_secret")
+async def generate_secret_action(core, router, params: dict) -> dict:
+    name = params["name"]
+    store = core.deps.secrets
+    if params.get("value"):
+        store.put(name, params["value"], params.get("description", ""),
+                  created_by=core.agent_id)
+    else:
+        store.generate(name, length=int(params.get("length") or 32),
+                       charset=params.get("charset") or "alphanumeric",
+                       description=params.get("description", ""),
+                       created_by=core.agent_id)
+    return {"status": "ok", "name": name,
+            "usage": f"reference it as {{{{SECRET:{name}}}}} in action params"}
+
+
+@register("search_secrets")
+async def search_secrets_action(core, router, params: dict) -> dict:
+    return {"status": "ok",
+            "secrets": core.deps.secrets.search(params["query"])}
+
+
+# ---------------------------------------------------------------------------
+# Batching
+# ---------------------------------------------------------------------------
+
+async def _run_sub_action(core, router, sub: dict) -> dict:
+    name = sub.get("action")
+    try:
+        fn = get_executor(name)
+        result = await fn(core, router, sub.get("params", {}))
+        if "status" not in result:
+            result["status"] = "ok"
+    except ActionError as e:
+        result = {"status": "error", "error": str(e)}
+    except Exception as e:                                 # noqa: BLE001
+        result = {"status": "error", "error": f"{type(e).__name__}: {e}"}
+    return {"action": name, **result}
+
+
+@register("batch_sync")
+async def batch_sync_action(core, router, params: dict) -> dict:
+    """Sequential sub-actions; an error stops the remainder (the agent sees
+    partial results and can re-plan). Batchable set per reference
+    action_list.ex:33-47."""
+    allowed = batchable_sync_actions()
+    results = []
+    for sub in params["actions"]:
+        if sub.get("action") not in allowed:
+            results.append({"action": sub.get("action"), "status": "error",
+                            "error": "not batchable in batch_sync"})
+            break
+        result = await _run_sub_action(core, router, sub)
+        results.append(result)
+        if result["status"] != "ok":
+            break
+    status = "ok" if all(r["status"] == "ok" for r in results) else "partial"
+    return {"status": status, "results": results}
+
+
+@register("batch_async")
+async def batch_async_action(core, router, params: dict) -> dict:
+    """Concurrent sub-actions (reference batch_async.ex — excludes only
+    wait/batch_*, action_list.ex:79)."""
+    allowed = batchable_async_actions()
+    subs = list(params["actions"])
+    for sub in subs:
+        if sub.get("action") not in allowed:
+            raise ActionError(
+                f"{sub.get('action')!r} is not batchable in batch_async")
+    results = await asyncio.gather(
+        *(_run_sub_action(core, router, sub) for sub in subs))
+    status = "ok" if all(r["status"] == "ok" for r in results) else "partial"
+    return {"status": status, "results": list(results)}

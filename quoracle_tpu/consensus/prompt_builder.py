@@ -187,7 +187,7 @@ Secrets are stored securely and can be used in action parameters.
 
 ALWAYS search for existing secrets before using or creating one — never
 guess names:
-1. Search: {"action": "search_secrets", "params": {"search_terms": ["project", "service"]}}
+1. Search: {"action": "search_secrets", "params": {"query": "project service"}}
 2. If found, use the EXACT name returned: {{SECRET:name}}
 3. If not found, create one with a specific name that encodes
    project + service + environment (e.g. acme_website_stripe_prod_api_key).
@@ -256,7 +256,7 @@ def _examples_section(allowed: Sequence[str]) -> str:
                          '"parent", "content": "Done: summary..."}, '
                          '"wait": true}'),
         ("todo", '{"reasoning": "Plan the work first.", "action": "todo", '
-                 '"params": {"todos": [{"task": "survey inputs", "status": '
+                 '"params": {"items": [{"task": "survey inputs", "status": '
                  '"in_progress"}]}, "wait": false}'),
         ("spawn_child", '{"reasoning": "Research can proceed in parallel.", '
                         '"action": "spawn_child", "params": '

@@ -9,8 +9,12 @@ of action results before any model sees them.
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import re
+import secrets as _pysecrets
+import threading
+import time
 from typing import Any, Callable, Mapping, Optional
 
 logger = logging.getLogger(__name__)
@@ -87,3 +91,93 @@ def scrub_output(result: Any, secrets: Mapping[str, str]) -> Any:
         return node
 
     return walk(result)
+
+
+# ---------------------------------------------------------------------------
+# Secret store
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Secret:
+    name: str
+    value: str
+    description: str = ""
+    created_by: Optional[str] = None
+    created_at: float = dataclasses.field(default_factory=time.time)
+
+
+@dataclasses.dataclass
+class SecretAccess:
+    """Audit-trail row (reference audit/secret_usage.ex, secret_usage table
+    migrations/20251025014144)."""
+    secret_name: str
+    agent_id: str
+    action: str
+    ts: float = dataclasses.field(default_factory=time.time)
+
+
+class SecretStore:
+    """Named secrets + usage audit. The reference encrypts values at rest
+    with Cloak AES-256-GCM (reference lib/quoracle/vault.ex) — here the
+    at-rest encryption belongs to the persistence layer; this in-memory store
+    holds plaintext for the resolver and never hands values to models
+    (scrub_output at the router boundary)."""
+
+    def __init__(self) -> None:
+        self._secrets: dict[str, Secret] = {}
+        self._audit: list[SecretAccess] = []
+        self._lock = threading.Lock()
+
+    def put(self, name: str, value: str, description: str = "",
+            created_by: Optional[str] = None) -> Secret:
+        s = Secret(name, value, description, created_by)
+        with self._lock:
+            self._secrets[name] = s
+        return s
+
+    CHARSETS = {
+        "alphanumeric": "ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+                        "abcdefghijklmnopqrstuvwxyz0123456789",
+        "hex": "0123456789abcdef",
+        "base64": "ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+                  "abcdefghijklmnopqrstuvwxyz0123456789+/",
+        "ascii": "ABCDEFGHIJKLMNOPQRSTUVWXYZ"
+                 "abcdefghijklmnopqrstuvwxyz0123456789"
+                 "!\"#$%&'()*+,-./:;<=>?@[\\]^_`{|}~",
+    }
+
+    def generate(self, name: str, *, length: int = 32,
+                 charset: str = "alphanumeric", description: str = "",
+                 created_by: Optional[str] = None) -> Secret:
+        """Generate a random secret (reference actions/generate_secret.ex —
+        length + charset params per the action schema)."""
+        alphabet = self.CHARSETS[charset]
+        value = "".join(_pysecrets.choice(alphabet) for _ in range(length))
+        return self.put(name, value, description, created_by)
+
+    def lookup(self, name: str, *, agent_id: str = "",
+               action: str = "") -> Optional[str]:
+        with self._lock:
+            s = self._secrets.get(name)
+            if s is not None and agent_id:
+                self._audit.append(SecretAccess(name, agent_id, action))
+            return s.value if s else None
+
+    def search(self, query: str = "") -> list[dict]:
+        """Name/description search; values are never returned (reference
+        actions/search_secrets.ex returns metadata only)."""
+        q = query.lower()
+        with self._lock:
+            return [{"name": s.name, "description": s.description,
+                     "created_by": s.created_by, "created_at": s.created_at}
+                    for s in self._secrets.values()
+                    if q in s.name.lower() or q in s.description.lower()]
+
+    def values(self) -> dict[str, str]:
+        """name -> value snapshot for scrub_output."""
+        with self._lock:
+            return {n: s.value for n, s in self._secrets.items()}
+
+    def audit_log(self) -> list[SecretAccess]:
+        with self._lock:
+            return list(self._audit)

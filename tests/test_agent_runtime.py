@@ -1,0 +1,578 @@
+"""Agent runtime: actor core loop, routers, spawn/dismiss trees, shell.
+
+Mirrors the reference's multi-agent 'distribution' testing style
+(reference SURVEY.md §4): real actor trees with per-test isolated
+registry/bus/backend — no shared state between tests, every test could run
+in parallel.
+"""
+
+import asyncio
+import json
+import re
+import time
+
+import pytest
+
+from quoracle_tpu.agent import (
+    AgentConfig, AgentDeps, AgentRegistry, AgentSupervisor,
+)
+from quoracle_tpu.context.history import DECISION, RESULT
+from quoracle_tpu.infra.bus import EventBus, AgentEvents, TOPIC_LIFECYCLE
+from quoracle_tpu.models.runtime import MockBackend
+
+POOL = MockBackend.DEFAULT_POOL
+
+
+def j(action, params=None, wait=False, reasoning="test"):
+    return json.dumps({"action": action, "params": params or {},
+                       "reasoning": reasoning, "wait": wait})
+
+
+WAIT_FOREVER = j("wait", {}, wait=False)
+
+
+def scripted(*entries):
+    """Same script for every pool member → unanimous round-1 consensus."""
+    return MockBackend(scripts={m: list(entries) for m in POOL},
+                       respond=lambda r: WAIT_FOREVER)
+
+
+def make_env(backend):
+    deps = AgentDeps.for_tests(backend)
+    sup = AgentSupervisor(deps)
+    return deps, sup
+
+
+def root_config(**over):
+    defaults = dict(agent_id="agent-root", task_id="task-1",
+                    model_pool=list(POOL))
+    defaults.update(over)
+    return AgentConfig(**defaults)
+
+
+async def until(cond, timeout=10.0, interval=0.01):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def decisions(core, model=POOL[0]):
+    return [e.content for e in core.ctx.history(model) if e.kind == DECISION]
+
+
+def results(core, model=POOL[0]):
+    return [e for e in core.ctx.history(model) if e.kind == RESULT]
+
+
+# ---------------------------------------------------------------------------
+
+def test_todo_then_wait_cycle():
+    async def main():
+        backend = scripted(
+            j("todo", {"items": [{"task": "greet", "done": False}]}),
+            j("wait", {}),
+        )
+        deps, sup = make_env(backend)
+        core = await sup.start_agent(root_config())
+        core.post({"type": "user_message", "content": "make a todo list",
+                   "from": "user"})
+        await until(lambda: len(decisions(core)) >= 2)
+        assert core.ctx.todos == [{"task": "greet", "done": False}]
+        assert decisions(core)[0]["action"] == "todo"
+        assert decisions(core)[1]["action"] == "wait"
+        # wait with no duration → indefinite idle, no pending actions
+        await until(lambda: not core.pending_actions)
+        assert not core.consensus_scheduled
+        # each model got its own history with the same decisions
+        for m in POOL:
+            assert len(decisions(core, m)) == 2
+        await sup.terminate_agent("agent-root")
+        assert deps.registry.lookup("agent-root") is None
+    run(main())
+
+
+def test_message_wakes_indefinitely_waiting_agent():
+    async def main():
+        backend = scripted(
+            j("wait", {}),                      # cycle 1: go idle
+            j("todo", {"items": [{"task": "respond"}]}),  # woken by message
+            j("wait", {}),
+        )
+        deps, sup = make_env(backend)
+        core = await sup.start_agent(root_config())
+        core.post({"type": "user_message", "content": "hold on", "from": "user"})
+        await until(lambda: len(decisions(core)) == 1)
+        await asyncio.sleep(0.05)
+        assert core.ctx.todos == []             # still idle
+        core.post({"type": "user_message", "content": "now act", "from": "user"})
+        await until(lambda: core.ctx.todos)
+        # the wake-up message was flushed into history as a batch
+        texts = [e.as_text() for e in core.ctx.history(POOL[0])]
+        assert any("now act" in t for t in texts)
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
+def test_timed_wait_fires_timeout():
+    async def main():
+        backend = scripted(
+            j("wait", {"duration": 1}),
+            j("todo", {"items": [{"task": "after-timeout"}]}),
+            j("wait", {}),
+        )
+        deps, sup = make_env(backend)
+        deps.shell_sync_threshold_s = 0.05
+        core = await sup.start_agent(root_config())
+        core.post({"type": "user_message", "content": "go", "from": "user"})
+        await until(lambda: core.ctx.todos, timeout=15)
+        texts = [e.as_text() for e in core.ctx.history(POOL[0])]
+        assert any("wait period elapsed" in t for t in texts)
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# Shell smart mode (reference shell.ex:13 — 100ms sync/async cutoff)
+# ---------------------------------------------------------------------------
+
+def test_shell_sync_fast_command():
+    async def main():
+        backend = scripted(
+            j("execute_shell", {"command": "echo fast-path"}),
+            j("wait", {}),
+        )
+        deps, sup = make_env(backend)
+        core = await sup.start_agent(root_config())
+        core.post({"type": "user_message", "content": "run it", "from": "user"})
+        await until(lambda: len(decisions(core)) >= 2)
+        first_result = results(core)[0].as_text()
+        assert "fast-path" in first_result
+        assert '"sync": true' in first_result.lower() or "sync" in first_result
+        # untrusted output is NO_EXECUTE-fenced before entering history
+        assert "NO_EXECUTE" in first_result
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
+def test_shell_async_slow_command_completion_notification():
+    async def main():
+        backend = scripted(
+            j("execute_shell", {"command": "sleep 0.4; echo slow-done"}),
+            j("wait", {}),     # after async-started result
+            j("wait", {}),     # after completion notification
+        )
+        deps, sup = make_env(backend)
+        core = await sup.start_agent(root_config())
+        core.post({"type": "user_message", "content": "run it", "from": "user"})
+        # async result registers a live command router
+        await until(lambda: core.shell_routers)
+        cmd_id = next(iter(core.shell_routers))
+        assert re.match(r"cmd-[0-9a-f]+", cmd_id)
+        # completion posts a system message and clears the router
+        await until(lambda: not core.shell_routers, timeout=15)
+        await until(lambda: len(decisions(core)) >= 3)
+        texts = [e.as_text() for e in core.ctx.history(POOL[0])]
+        assert any("slow-done" in t for t in texts)
+        assert any(cmd_id in t for t in texts)
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
+def test_shell_check_id_poll_and_terminate():
+    async def main():
+        backend = scripted(
+            j("execute_shell", {"command": "sleep 30"}),
+            j("wait", {}),
+        )
+        deps, sup = make_env(backend)
+        core = await sup.start_agent(root_config())
+        core.post({"type": "user_message", "content": "run", "from": "user"})
+        await until(lambda: core.shell_routers)
+        cmd_id = next(iter(core.shell_routers))
+        owner = core.shell_routers[cmd_id]
+        poll = owner.poll_command()
+        assert poll["command_status"] == "running"
+        term = await owner.terminate_command()
+        assert term["command_status"] == "terminated"
+        assert cmd_id not in core.shell_routers
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
+def test_shell_early_output_not_lost_on_async_handoff():
+    async def main():
+        # Output emitted BEFORE the sync threshold must survive into the
+        # completion notification (pump starts at launch).
+        backend = scripted(
+            j("execute_shell",
+              {"command": "echo early-marker; sleep 0.4; echo late-marker"}),
+            j("wait", {}),
+            j("wait", {}),
+        )
+        deps, sup = make_env(backend)
+        core = await sup.start_agent(root_config())
+        core.post({"type": "user_message", "content": "run", "from": "user"})
+        await until(lambda: core.shell_routers)      # async handoff happened
+        # completion notification (NOT the decision echoing the command)
+        await until(lambda: any(
+            "finished with status" in e.as_text()
+            for e in core.ctx.history(POOL[0])), timeout=15)
+        completion = next(t for t in
+                          (e.as_text() for e in core.ctx.history(POOL[0]))
+                          if "finished with status" in t)
+        assert "early-marker" in completion and "late-marker" in completion
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
+def test_shell_daemonizing_command_still_completes():
+    async def main():
+        # The shell exits quickly but a backgrounded descendant inherits
+        # stdout and holds the pipe open — completion must key off process
+        # exit, not pipe EOF.
+        backend = scripted(
+            j("execute_shell",
+              {"command": "sleep 5 >/dev/null & echo daemon-started; sleep 0.2"}),
+            j("wait", {}), j("wait", {}),
+        )
+        deps, sup = make_env(backend)
+        core = await sup.start_agent(root_config())
+        core.post({"type": "user_message", "content": "run", "from": "user"})
+        await until(lambda: any(
+            "finished with status completed" in e.as_text()
+            for e in core.ctx.history(POOL[0])), timeout=15)
+        completion = next(t for t in
+                          (e.as_text() for e in core.ctx.history(POOL[0]))
+                          if "finished with status" in t)
+        assert "daemon-started" in completion
+        assert not core.shell_routers
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
+def test_batch_with_two_slow_shells_gets_independent_owners():
+    async def main():
+        backend = scripted(
+            j("batch_async", {"actions": [
+                {"action": "execute_shell",
+                 "params": {"command": "sleep 0.35; echo done-one"}},
+                {"action": "execute_shell",
+                 "params": {"command": "sleep 0.45; echo done-two"}},
+            ]}),
+            j("wait", {}), j("wait", {}), j("wait", {}),
+        )
+        deps, sup = make_env(backend)
+        core = await sup.start_agent(root_config())
+        core.post({"type": "user_message", "content": "run", "from": "user"})
+        # both commands cross the threshold → two distinct owners
+        await until(lambda: len(core.shell_routers) == 2)
+        ids = list(core.shell_routers)
+        assert len(set(ids)) == 2
+        polls = [core.shell_routers[i].poll_command() for i in ids]
+        assert {p["command_id"] for p in polls} == set(ids)
+        # both complete independently and deliver their own output
+        await until(lambda: not core.shell_routers, timeout=15)
+        texts = " ".join(e.as_text() for e in core.ctx.history(POOL[0]))
+        assert "done-one" in texts and "done-two" in texts
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# Spawn / message / dismiss across a real tree
+# ---------------------------------------------------------------------------
+
+def spawn_params(**over):
+    p = dict(task_description="greet your parent",
+             success_criteria="parent greeted",
+             immediate_context="you were just created",
+             approach_guidance="send one message then wait",
+             profile="default")
+    p.update(over)
+    return p
+
+
+def tree_respond(r):
+    """Content-driven scripted behavior for a parent+child tree."""
+    joined = "\n".join(str(m.get("content", "")) for m in r.messages)
+    if "[TASK]" in joined:                       # this is the child
+        if '"delivered_to"' in joined:
+            return WAIT_FOREVER
+        return j("send_message",
+                 {"target": "parent", "content": "hello from child"})
+    # this is the root
+    if "hello from child" in joined:
+        m = re.search(r'from="(agent-[0-9a-f]+)"', joined)
+        return j("dismiss_child", {"child_id": m.group(1)})
+    if '"agent_id"' in joined:                    # spawn result seen
+        return WAIT_FOREVER
+    if '"dismissed"' in joined:
+        return WAIT_FOREVER
+    return j("spawn_child", spawn_params())
+
+
+def test_spawn_child_message_dismiss_flow():
+    async def main():
+        backend = MockBackend(respond=tree_respond)
+        deps, sup = make_env(backend)
+        seen = {"spawned": [], "dismissed": []}
+        def on_lifecycle(t, e):
+            # Handlers run synchronously inside the broadcast, so these
+            # observations can't race the fast spawn→dismiss sequence.
+            if e["event"] == "agent_spawned" and e.get("parent_id"):
+                seen["spawned"].append(e["agent_id"])
+                assert deps.registry.lookup(e["agent_id"]).parent_id == \
+                    e["parent_id"]
+            if e["event"] == "agent_dismissed":
+                seen["dismissed"].append(e["agent_id"])
+        deps.events.bus.subscribe(TOPIC_LIFECYCLE, on_lifecycle)
+        core = await sup.start_agent(root_config())
+        core.post({"type": "user_message", "content": "delegate the greeting",
+                   "from": "user"})
+        # child spawns, greets, and gets dismissed by the root
+        await until(lambda: seen["dismissed"], timeout=15)
+        child_id = seen["spawned"][0]
+        assert seen["dismissed"] == [child_id]
+        await until(lambda: deps.registry.lookup(child_id) is None)
+        await until(lambda: not core.children)
+        # root still alive, child gone
+        assert deps.registry.lookup("agent-root") is not None
+        assert len(deps.registry) == 1
+        texts = [e.as_text() for e in core.ctx.history(POOL[0])]
+        assert any("hello from child" in t for t in texts)
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
+def test_spawn_requires_budget_when_parent_budgeted():
+    async def main():
+        backend = scripted(
+            j("spawn_child", spawn_params()),   # no budget param → error
+            j("wait", {}),
+        )
+        deps, sup = make_env(backend)
+        core = await sup.start_agent(root_config(
+            budget_mode="root", budget_limit="10.0"))
+        core.post({"type": "user_message", "content": "go", "from": "user"})
+        await until(lambda: results(core))
+        first = results(core)[0].as_text()
+        assert "budget is required" in first
+        assert not core.children
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
+def test_spawn_with_budget_escrows_and_dismiss_releases():
+    async def main():
+        def respond(r):
+            joined = "\n".join(str(m.get("content", "")) for m in r.messages)
+            if "[TASK]" in joined:                      # child
+                if '"delivered_to"' in joined:
+                    return WAIT_FOREVER
+                return j("send_message",
+                         {"target": "parent", "content": "child done"})
+            if '"dismissed"' in joined:
+                return WAIT_FOREVER
+            if "child done" in joined:                  # root: dismiss now
+                m = re.search(r'from="(agent-[0-9a-f]+)"', joined)
+                return j("dismiss_child", {"child_id": m.group(1)})
+            if '"agent_id"' in joined:                  # spawn acked: wait
+                return WAIT_FOREVER
+            return j("spawn_child", spawn_params(budget=4))
+        backend = MockBackend(respond=respond)
+        deps, sup = make_env(backend)
+        # Capture escrow state at the instant the child comes alive —
+        # broadcast handlers run synchronously, so this observation can't
+        # race with the later dismissal.
+        snapshots = {}
+        def on_lifecycle(topic, e):
+            if e["event"] == "agent_spawned" and e.get("parent_id") == "agent-root":
+                snapshots["committed"] = deps.escrow.get("agent-root").committed
+                snapshots["child_limit"] = deps.escrow.get(e["agent_id"]).limit
+            if e["event"] == "agent_dismissed":
+                snapshots["dismissed"] = e["agent_id"]
+        deps.events.bus.subscribe(TOPIC_LIFECYCLE, on_lifecycle)
+        core = await sup.start_agent(root_config(
+            budget_mode="root", budget_limit="10.0"))
+        core.post({"type": "user_message", "content": "go", "from": "user"})
+        await until(lambda: "dismissed" in snapshots, timeout=20)
+        assert snapshots["committed"] == 4
+        assert snapshots["child_limit"] == 4
+        # dismissal released the unspent escrow back
+        assert deps.escrow.get("agent-root").committed == 0
+        assert len(deps.registry) == 1
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
+def test_terminate_tree_is_bottom_up_and_idempotent():
+    async def main():
+        backend = MockBackend(respond=lambda r: WAIT_FOREVER)
+        deps, sup = make_env(backend)
+        root = await sup.start_agent(root_config())
+        mid = await sup.start_agent(root_config(
+            agent_id="agent-mid", parent_id="agent-root"))
+        leaf = await sup.start_agent(root_config(
+            agent_id="agent-leaf", parent_id="agent-mid"))
+        assert len(deps.registry) == 3
+        n = await sup.terminate_tree("agent-mid", by="agent-root")
+        assert n == 2
+        assert deps.registry.lookup("agent-mid") is None
+        assert deps.registry.lookup("agent-leaf") is None
+        assert deps.registry.lookup("agent-root") is not None
+        # second dismissal is a no-op (dismissing flag, core.ex:213-220)
+        assert await sup.terminate_tree("agent-mid") == 0
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# Consensus failure → correction feedback → retry (agent AGENTS.md:204-214)
+# ---------------------------------------------------------------------------
+
+def test_consensus_retry_with_correction_feedback():
+    async def main():
+        backend = MockBackend(scripts={
+            m: ["this is not json at all",
+                j("todo", {"items": [{"task": "fixed"}]}),
+                WAIT_FOREVER]
+            for m in POOL}, respond=lambda r: WAIT_FOREVER)
+        deps, sup = make_env(backend)
+        core = await sup.start_agent(root_config())
+        core.post({"type": "user_message", "content": "go", "from": "user"})
+        await until(lambda: core.ctx.todos, timeout=15)
+        assert core.ctx.todos == [{"task": "fixed"}]
+        # correction feedback was injected into the retry round's messages
+        retry_calls = [c for c in backend.calls
+                       if any("previous response was invalid"
+                              in str(m.get("content", ""))
+                              for m in c.messages)]
+        assert retry_calls
+        # and cleared after the successful decision
+        assert core.ctx.correction_feedback == {}
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
+def test_consensus_stall_notifies_parent():
+    async def main():
+        bad = MockBackend(respond=lambda r: "never valid json")
+        deps, sup = make_env(bad)
+        parent_inbox = []
+        root = await sup.start_agent(root_config())
+        child = await sup.start_agent(root_config(
+            agent_id="agent-child", parent_id="agent-root",
+            max_consensus_retries=2))
+        # intercept the parent mailbox by watching its queued messages
+        child.post({"type": "user_message", "content": "go", "from": "user"})
+        await until(lambda: any(
+            "consensus stalled" in str(m.get("content", ""))
+            for m in root.queued_messages) or any(
+            "consensus stalled" in e.as_text()
+            for e in root.ctx.history(POOL[0])), timeout=20)
+        await sup.terminate_agent("agent-child")
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# Batch actions
+# ---------------------------------------------------------------------------
+
+def test_batch_sync_executes_in_order(tmp_path):
+    async def main():
+        backend = scripted(
+            j("batch_sync", {"actions": [
+                {"action": "todo", "params": {"items": [{"task": "a"}]}},
+                {"action": "file_write", "params": {
+                    "path": str(tmp_path / "out.txt"), "content": "hello"}},
+                {"action": "file_read", "params": {
+                    "path": str(tmp_path / "out.txt")}},
+            ]}),
+            j("wait", {}),
+        )
+        deps, sup = make_env(backend)
+        core = await sup.start_agent(root_config())
+        core.post({"type": "user_message", "content": "batch", "from": "user"})
+        await until(lambda: results(core))
+        r = results(core)[0].content["result"]
+        assert r["status"] == "ok"
+        assert [x["action"] for x in r["results"]] == \
+            ["todo", "file_write", "file_read"]
+        assert "hello" in r["results"][2]["content"]
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
+def test_batch_async_rejects_wait_at_validation():
+    async def main():
+        # wait is not batchable (reference action_list.ex:79) — the proposal
+        # is filtered at consensus validation, never reaching execution, and
+        # the models get correction feedback on the retry round.
+        backend = scripted(
+            j("batch_async", {"actions": [
+                {"action": "wait", "params": {}},
+            ]}),
+            j("wait", {}),
+        )
+        deps, sup = make_env(backend)
+        core = await sup.start_agent(root_config())
+        core.post({"type": "user_message", "content": "batch", "from": "user"})
+        await until(lambda: results(core))
+        assert decisions(core)[0]["action"] == "wait"   # retry round's pick
+        retry_calls = [c for c in backend.calls
+                       if any("failed validation" in str(m.get("content", ""))
+                              for m in c.messages)]
+        assert retry_calls
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# Secrets end-to-end: generate → reference in params → scrubbed output
+# ---------------------------------------------------------------------------
+
+def test_secret_resolution_and_scrubbing():
+    async def main():
+        backend = scripted(
+            j("generate_secret", {"name": "api_key", "length": 24}),
+            j("execute_shell", {"command": "echo token={{SECRET:api_key}}"}),
+            j("wait", {}),
+        )
+        deps, sup = make_env(backend)
+        core = await sup.start_agent(root_config())
+        core.post({"type": "user_message", "content": "go", "from": "user"})
+        await until(lambda: len(decisions(core)) >= 3, timeout=15)
+        value = deps.secrets.lookup("api_key")
+        assert value and len(value) == 24
+        shell_result = results(core)[1].as_text()
+        # the secret value was substituted for execution but scrubbed from
+        # the result the models see
+        assert value not in shell_result
+        assert "[REDACTED:api_key]" in shell_result
+        # audit trail recorded the access
+        assert any(a.secret_name == "api_key"
+                   for a in deps.secrets.audit_log())
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
+def test_registry_queries():
+    reg = AgentRegistry()
+    reg.register("a", object(), None, "t1")
+    reg.register("b", object(), "a", "t1")
+    reg.register("c", object(), "a", "t1")
+    reg.register("d", object(), None, "t2")
+    assert {r.agent_id for r in reg.children_of("a")} == {"b", "c"}
+    assert reg.parent_of("b").agent_id == "a"
+    assert [r.agent_id for r in reg.siblings_of("b")] == ["c"]
+    assert {r.agent_id for r in reg.agents_for_task("t1")} == {"a", "b", "c"}
+    with pytest.raises(Exception):
+        reg.register("a", object(), None, "t1")
