@@ -90,8 +90,11 @@ class AgentCore:
         self.pending_actions: dict[str, dict] = {}
         self.queued_messages: list[dict] = []
         self.consensus_scheduled = False
-        self.children: list[dict] = []
-        self.shell_routers: dict[str, ActionRouter] = {}
+        # Restore path: the persisted context carries the children tracker
+        self.children: list[dict] = list(self.ctx.children)
+        # command_id → ShellOwner (actions/router.py), registered on the
+        # async-mode handoff and serving later check_id decisions
+        self.shell_routers: dict[str, Any] = {}
         self.stopping = False
         self.stop_reason = "normal"
         self.stopped = asyncio.Event()
@@ -456,7 +459,9 @@ class AgentCore:
         wait = pending["wait"]
         if action == "wait" and result.get("status") == "ok":
             duration = pending["params"].get("duration")
-            wait = duration if duration else True
+            # absent → indefinite; 0 → continue now (duration=0 must not
+            # collapse into the indefinite case)
+            wait = True if duration is None else duration
         if self.queued_messages:
             # Events arrived while the action ran: they outrank the wait
             # directive (reference ActionResultHandler flushes queued

@@ -1,0 +1,135 @@
+"""CLI entry: run tasks from the terminal.
+
+The reference is a Phoenix server driven from a browser; the TPU-native
+build adds a first-class CLI (the minimum end-to-end slice of SURVEY.md §7:
+"CLI task entry"). The web dashboard consumes the same Runtime.
+
+Usage:
+    python -m quoracle_tpu.cli run "describe the task" \
+        [--backend mock|tpu] [--pool xla:llama-1b,...] [--db path.db] \
+        [--budget 5.00] [--profile name] [--watch-seconds 30]
+    python -m quoracle_tpu.cli resume --db path.db      # boot revival
+    python -m quoracle_tpu.cli status --db path.db
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+from quoracle_tpu.infra.bus import TOPIC_ACTIONS, TOPIC_LIFECYCLE
+from quoracle_tpu.runtime import Runtime, RuntimeConfig
+
+
+def _print_event(topic: str, event: dict) -> None:
+    kind = event.get("event")
+    agent = event.get("agent_id", "")
+    if kind == "agent_spawned":
+        line = f"+ {agent} spawned (parent={event.get('parent_id')})"
+    elif kind in ("agent_terminated", "agent_dismissed"):
+        line = f"- {agent} {kind.split('_')[1]}"
+    elif kind == "action_started":
+        line = f"  {agent} → {event.get('action')}"
+    elif kind == "action_completed":
+        line = f"  {agent} ✓ {event.get('action')} [{event.get('status')}]"
+    elif kind == "decision":
+        d = event.get("decision", {})
+        line = (f"  {agent} decided {d.get('action')} "
+                f"(confidence {d.get('confidence')}, rounds {d.get('rounds')})")
+    elif kind == "task_message":
+        m = event.get("message", {})
+        line = f"  ✉ {m.get('from')} → {m.get('targets')}: {m.get('content')}"
+    else:
+        return
+    print(line, flush=True)
+
+
+def _attach_printer(rt: Runtime) -> None:
+    rt.bus.subscribe(TOPIC_LIFECYCLE, _print_event)
+    rt.bus.subscribe(TOPIC_ACTIONS, _print_event)
+
+
+async def cmd_run(args: argparse.Namespace) -> int:
+    pool = args.pool.split(",") if args.pool else None
+    rt = Runtime(RuntimeConfig(db_path=args.db, backend=args.backend,
+                               model_pool=pool))
+    _attach_printer(rt)
+    if pool is None and args.profile is None:
+        # default pools per backend when neither --pool nor --profile names one
+        if args.backend == "tpu":
+            from quoracle_tpu.models.config import BENCH_POOL
+            pool = list(BENCH_POOL)
+        else:
+            from quoracle_tpu.models.runtime import MockBackend
+            pool = list(MockBackend.DEFAULT_POOL)
+    task_id, root = await rt.tasks.create_task(
+        args.description, model_pool=pool, profile=args.profile,
+        budget=args.budget)
+    rt.bus.subscribe(f"agents:{root.agent_id}:logs", _print_event)
+    rt.bus.subscribe(f"tasks:{task_id}:messages", _print_event)
+    print(f"task {task_id} started, root agent {root.agent_id}", flush=True)
+    try:
+        await asyncio.sleep(args.watch_seconds)
+    finally:
+        await rt.tasks.pause_task(task_id)
+        print(json.dumps(rt.status()), flush=True)
+        rt.close()
+    return 0
+
+
+async def cmd_resume(args: argparse.Namespace) -> int:
+    rt = Runtime(RuntimeConfig(db_path=args.db, backend=args.backend))
+    _attach_printer(rt)
+    result = await rt.boot()
+    print(json.dumps(result), flush=True)
+    try:
+        await asyncio.sleep(args.watch_seconds)
+    finally:
+        for task_id in result.get("revived", []):
+            await rt.tasks.pause_task(task_id)
+        rt.close()
+    return 0
+
+
+async def cmd_status(args: argparse.Namespace) -> int:
+    rt = Runtime(RuntimeConfig(db_path=args.db))
+    print(json.dumps(rt.status(), indent=2))
+    rt.close()
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="quoracle_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    def common(sp):
+        sp.add_argument("--db", default=":memory:")
+        sp.add_argument("--backend", choices=["mock", "tpu"], default="mock")
+        sp.add_argument("--watch-seconds", type=float, default=30.0)
+
+    runp = sub.add_parser("run", help="create a task and watch it")
+    runp.add_argument("description")
+    runp.add_argument("--pool", help="comma-separated model specs")
+    runp.add_argument("--profile")
+    runp.add_argument("--budget")
+    common(runp)
+
+    resp = sub.add_parser("resume", help="boot revival of persisted tasks")
+    common(resp)
+
+    statp = sub.add_parser("status", help="show tasks + agents")
+    statp.add_argument("--db", default=":memory:")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    handler = {"run": cmd_run, "resume": cmd_resume,
+               "status": cmd_status}[args.cmd]
+    return asyncio.run(handler(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

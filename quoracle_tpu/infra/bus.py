@@ -46,6 +46,10 @@ class EventBus:
         self._lock = threading.Lock()
 
     def subscribe(self, topic: str, handler: Handler) -> Subscription:
+        """Subscribe to one topic, or to every broadcast with topic="*"
+        (durable log writers and the dashboard tail the whole bus; the
+        reference gets this from its per-topic PubSub.subscribe calls on
+        known topic lists)."""
         sub = Subscription(topic, handler, self)
         with self._lock:
             self._subs.setdefault(topic, []).append(sub)
@@ -81,7 +85,9 @@ class EventBus:
         reference's safe_broadcast (agent_events.ex:21-29): a dying UI must
         not take an agent down with it."""
         with self._lock:
-            subs = list(self._subs.get(topic, []))
+            subs = list(self._subs.get(topic, ()))
+            if topic != "*":
+                subs += self._subs.get("*", ())
         for sub in subs:
             try:
                 sub.handler(topic, event)

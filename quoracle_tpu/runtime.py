@@ -1,0 +1,107 @@
+"""Composition root: builds and owns the whole service graph.
+
+The explicit-factory equivalent of the reference's supervision tree
+(reference lib/quoracle/application.ex:38-61: Vault → Repo → PubSub →
+Registry → EmbeddingCache → Task.Supervisor → Agent.DynSup → EventHistory →
+Endpoint, then boot revival at :74). There are no singletons: a Runtime owns
+one instance of each service and hands them to agents via AgentDeps — build
+two Runtimes and they share nothing (the reference's cardinal DI rule, root
+AGENTS.md:5-33).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from quoracle_tpu.agent.registry import AgentRegistry
+from quoracle_tpu.agent.state import AgentDeps
+from quoracle_tpu.agent.supervisor import AgentSupervisor
+from quoracle_tpu.context.token_manager import TokenManager
+from quoracle_tpu.infra.budget import Escrow
+from quoracle_tpu.infra.bus import AgentEvents, EventBus
+from quoracle_tpu.infra.costs import CostRecorder
+from quoracle_tpu.infra.event_history import EventHistory
+from quoracle_tpu.models.runtime import MockBackend, ModelBackend, TPUBackend
+from quoracle_tpu.persistence import Database, Persistence, TaskManager
+from quoracle_tpu.persistence.store import PersistentSecretStore
+
+
+@dataclasses.dataclass
+class RuntimeConfig:
+    db_path: str = ":memory:"
+    encryption_key: Optional[str] = None      # default: env QUORACLE_ENCRYPTION_KEY
+    backend: str = "mock"                     # "mock" | "tpu"
+    model_pool: Optional[list[str]] = None    # default pool for tpu backend
+    embed_model: Optional[str] = None
+    seed: int = 0
+
+
+class Runtime:
+    """One running quoracle_tpu node. Construct → (await) boot() → use
+    .tasks / .deps; close() tears everything down."""
+
+    def __init__(self, config: Optional[RuntimeConfig] = None,
+                 backend: Optional[ModelBackend] = None):
+        config = config if config is not None else RuntimeConfig()
+        self.config = config
+        self.db = Database(config.db_path,
+                           encryption_key=config.encryption_key)
+        self.store = Persistence(self.db)
+        self.bus = EventBus()
+        self.events = AgentEvents(self.bus)
+        self.history = EventHistory(self.bus)
+        self.escrow = Escrow()
+        self.costs = CostRecorder(escrow=self.escrow, events=self.events,
+                                  persist_fn=self.store.persist_cost)
+        self.backend = backend or self._build_backend(config)
+        self.token_manager = TokenManager(
+            self.backend.count_tokens,
+            context_limit_fn=self.backend.context_window)
+        self.secrets = PersistentSecretStore(self.db)
+        self.registry = AgentRegistry()
+        self.deps = AgentDeps(
+            backend=self.backend, registry=self.registry, supervisor=None,
+            events=self.events, escrow=self.escrow, costs=self.costs,
+            token_manager=self.token_manager, secrets=self.secrets,
+            persistence=self.store)
+        self.supervisor = AgentSupervisor(self.deps)
+        self.tasks = TaskManager(self.deps, self.store)
+        self.store.attach_bus(self.bus)
+
+    @staticmethod
+    def _build_backend(config: RuntimeConfig) -> ModelBackend:
+        if config.backend == "tpu":
+            from quoracle_tpu.models.config import BENCH_POOL
+            return TPUBackend(config.model_pool or list(BENCH_POOL),
+                              seed=config.seed,
+                              embed_model=config.embed_model)
+        return MockBackend()
+
+    async def boot(self) -> dict:
+        """Boot-time revival of persisted running tasks (reference
+        application.ex:71-74 → AgentRevival)."""
+        return await self.tasks.boot_revival()
+
+    async def shutdown(self) -> None:
+        """Graceful stop of every live agent, then release resources."""
+        await self.supervisor.stop_all()
+        self.close()
+
+    def close(self) -> None:
+        self.store.detach_bus()
+        self.history.close()
+        self.db.close()
+
+    # convenience passthroughs -------------------------------------------
+
+    def live_agents(self) -> list[str]:
+        return self.supervisor.live_agents()
+
+    def status(self) -> dict[str, Any]:
+        return {
+            "backend": type(self.backend).__name__,
+            "live_agents": len(self.registry),
+            "tasks": {t["id"]: t["status"]
+                      for t in self.store.list_tasks()},
+        }

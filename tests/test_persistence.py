@@ -1,0 +1,344 @@
+"""Persistence: durable state, task lifecycle, pause/restore, boot revival.
+
+Mirrors the reference's checkpoint/resume coverage (SURVEY.md §3.4/§5):
+continuous conversation persistence, pause → stopped rows → restore rebuilds
+the live tree with histories, revival finalizes stale states. Every test
+gets its own in-memory SQLite DB + registry + bus — full isolation.
+"""
+
+import asyncio
+import json
+import time
+
+import pytest
+
+from quoracle_tpu.agent import AgentConfig, AgentDeps, AgentSupervisor
+from quoracle_tpu.context.history import DECISION
+from quoracle_tpu.infra.costs import CostRecorder
+from quoracle_tpu.models.runtime import MockBackend
+from quoracle_tpu.persistence import Database, Persistence, TaskManager
+from quoracle_tpu.persistence.db import Vault
+from quoracle_tpu.persistence.store import (
+    PersistentSecretStore, deserialize_config, deserialize_context,
+    serialize_config, serialize_context,
+)
+
+POOL = MockBackend.DEFAULT_POOL
+
+
+def j(action, params=None, wait=False):
+    return json.dumps({"action": action, "params": params or {},
+                       "reasoning": "test", "wait": wait})
+
+
+def scripted(*entries):
+    return MockBackend(
+        scripts={m: list(entries) for m in POOL},
+        respond=lambda r: j("wait", {}))
+
+
+def make_stack(backend, db=None, key="test-key-123"):
+    db = db or Database(":memory:", encryption_key=key)
+    store = Persistence(db)
+    deps = AgentDeps.for_tests(backend,
+                               secrets=PersistentSecretStore(db))
+    deps.costs = CostRecorder(escrow=deps.escrow, events=deps.events,
+                              persist_fn=store.persist_cost)
+    sup = AgentSupervisor(deps)
+    tm = TaskManager(deps, store)
+    store.attach_bus(deps.events.bus)
+    return db, store, deps, sup, tm
+
+
+async def until(cond, timeout=10.0, interval=0.01):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+# ---------------------------------------------------------------------------
+# Vault / serialization
+# ---------------------------------------------------------------------------
+
+def test_vault_roundtrip_and_degraded_mode():
+    v = Vault("some-key")
+    blob, enc = v.encrypt("hunter2secret")
+    assert enc and blob != b"hunter2secret"
+    assert v.decrypt(blob, enc) == "hunter2secret"
+    degraded = Vault("")
+    blob2, enc2 = degraded.encrypt("plain")
+    assert not enc2 and blob2 == b"plain"
+    assert degraded.decrypt(blob2, enc2) == "plain"
+    with pytest.raises(RuntimeError):
+        degraded.decrypt(blob, True)
+
+
+def test_config_and_context_serialization_roundtrip():
+    from decimal import Decimal
+    from quoracle_tpu.context.history import (
+        AgentContext, HistoryEntry, Lesson, USER,
+    )
+    cfg = AgentConfig(agent_id="a1", task_id="t1", model_pool=list(POOL),
+                      parent_id="a0", profile="default",
+                      capability_groups=["communication"],
+                      forbidden_actions=("execute_shell",),
+                      budget_mode="allocated",
+                      budget_limit=Decimal("3.50"))
+    cfg2 = deserialize_config(serialize_config(cfg))
+    assert cfg2 == cfg
+
+    ctx = AgentContext()
+    ctx.append_all(HistoryEntry(kind=USER, content="hello"), POOL)
+    ctx.context_lessons[POOL[0]] = [Lesson("factual", "the sky is blue", 2)]
+    ctx.model_states[POOL[0]] = ["mid-task"]
+    ctx.todos = [{"task": "x"}]
+    ctx2 = deserialize_context(serialize_context(ctx, [{"agent_id": "c1"}]))
+    assert ctx2.model_histories[POOL[0]][0].content == "hello"
+    assert ctx2.context_lessons[POOL[0]][0].content == "the sky is blue"
+    assert ctx2.context_lessons[POOL[0]][0].confidence == 2
+    assert ctx2.todos == [{"task": "x"}]
+    assert ctx2.children == [{"agent_id": "c1"}]
+
+
+def test_persistent_secret_store_encrypts_at_rest():
+    db = Database(":memory:", encryption_key="k1")
+    store = PersistentSecretStore(db)
+    store.put("api_key", "super-secret-value", "test secret")
+    row = db.query_one("SELECT * FROM secrets WHERE name='api_key'")
+    assert row["encrypted"] == 1
+    assert b"super-secret-value" not in bytes(row["value"])
+    # lookup with agent audit writes secret_usage
+    assert store.lookup("api_key", agent_id="a1", action="call_api") \
+        == "super-secret-value"
+    usage = db.query("SELECT * FROM secret_usage")
+    assert usage and usage[0]["agent_id"] == "a1"
+    # a fresh store over the same DB decrypts
+    store2 = PersistentSecretStore(db)
+    assert store2.lookup("api_key") == "super-secret-value"
+
+
+# ---------------------------------------------------------------------------
+# Task lifecycle end-to-end
+# ---------------------------------------------------------------------------
+
+def test_create_task_persists_agent_and_events():
+    async def main():
+        backend = scripted(
+            j("todo", {"items": [{"task": "step-1"}]}), j("wait", {}))
+        db, store, deps, sup, tm = make_stack(backend)
+        task_id, root = await tm.create_task(
+            "plan the work", model_pool=list(POOL))
+        await until(lambda: root.ctx.todos)
+        await until(lambda: len(
+            [e for e in root.ctx.history(POOL[0]) if e.kind == DECISION]) >= 2)
+        # agent row persisted with conversation
+        rows = store.agents_for_task(task_id)
+        assert len(rows) == 1
+        assert rows[0]["context"].todos == [{"task": "step-1"}]
+        # durable logs + actions rows from the bus tail
+        assert db.query("SELECT * FROM logs WHERE agent_id=?",
+                        (root.agent_id,))
+        acts = db.query("SELECT * FROM actions WHERE agent_id=?",
+                        (root.agent_id,))
+        assert {a["action"] for a in acts} >= {"todo", "wait"}
+        assert all(a["status"] == "ok" for a in acts
+                   if a["completed_at"] is not None)
+        # cost rows written through
+        assert db.query("SELECT * FROM agent_costs")
+        await tm.pause_task(task_id)
+    run(main())
+
+
+def test_pause_then_restore_rebuilds_tree_with_history():
+    async def main():
+        def respond(r):
+            joined = "\n".join(str(m.get("content", "")) for m in r.messages)
+            if "[TASK]" in joined:
+                return j("wait", {})
+            if "resume-ping" in joined:
+                return j("todo", {"items": [{"task": "resumed"}]})
+            if '"agent_id"' in joined:
+                return j("wait", {})
+            return j("spawn_child", {
+                "task_description": "hold position",
+                "success_criteria": "n/a", "immediate_context": "n/a",
+                "approach_guidance": "wait", "profile": "default"})
+        backend = MockBackend(respond=respond)
+        db, store, deps, sup, tm = make_stack(backend)
+        task_id, root = await tm.create_task("delegate then idle",
+                                             model_pool=list(POOL))
+        await until(lambda: root.children)
+        child_id = root.children[0]["agent_id"]
+        root_id = root.agent_id
+        stopped = await tm.pause_task(task_id)
+        assert stopped == 2
+        assert len(deps.registry) == 0
+        assert store.get_task(task_id)["status"] == "paused"
+        rows = store.agents_for_task(task_id)
+        assert {r["agent_id"] for r in rows} == {root_id, child_id}
+        assert all(r["status"] == "stopped" for r in rows)
+
+        # restore into a FRESH runtime stack (new registry/supervisor/bus)
+        # over the same DB — the reboot scenario
+        deps2 = AgentDeps.for_tests(backend,
+                                    secrets=PersistentSecretStore(db))
+        sup2 = AgentSupervisor(deps2)
+        tm2 = TaskManager(deps2, store)
+        n = await tm2.restore_task(task_id)
+        assert n == 2
+        reg_root = deps2.registry.lookup(root_id)
+        reg_child = deps2.registry.lookup(child_id)
+        assert reg_root is not None and reg_child is not None
+        assert reg_child.parent_id == root_id
+        core2 = reg_root.core
+        # children tracker and history survived
+        assert [c["agent_id"] for c in core2.children] == [child_id]
+        texts = [e.as_text() for e in core2.ctx.history(POOL[0])]
+        assert any("delegate then idle" in t for t in texts)
+        # the restored agent is idle but wakes on a message
+        core2.post({"type": "user_message", "content": "resume-ping",
+                    "from": "user"})
+        await until(lambda: core2.ctx.todos == [{"task": "resumed"}])
+        await tm2.pause_task(task_id)
+    run(main())
+
+
+def test_boot_revival_restores_running_finalizes_pausing():
+    async def main():
+        backend = MockBackend(respond=lambda r: j("wait", {}))
+        db, store, deps, sup, tm = make_stack(backend)
+        # task 1: left 'running' in the DB (simulated crash: rows persist,
+        # no live agents)
+        t1, root1 = await tm.create_task("crash victim",
+                                         model_pool=list(POOL))
+        await until(lambda: not root1.consensus_scheduled
+                    and not root1.pending_actions
+                    and len(root1.ctx.history(POOL[0])) >= 3)
+        await sup.stop_all(t1)            # simulate crash: no status change
+        store.db.execute("UPDATE tasks SET status='running' WHERE id=?",
+                         (t1,))
+        # task 2: stuck mid-pause
+        t2, root2 = await tm.create_task("stale pauser",
+                                         model_pool=list(POOL))
+        await sup.stop_all(t2)
+        store.set_task_status(t2, "pausing")
+
+        deps2 = AgentDeps.for_tests(backend,
+                                    secrets=PersistentSecretStore(db))
+        AgentSupervisor(deps2)
+        tm2 = TaskManager(deps2, store)
+        result = await tm2.boot_revival()
+        assert result["revived"] == [t1]
+        assert result["failed"] == []
+        assert store.get_task(t2)["status"] == "paused"
+        assert deps2.registry.agents_for_task(t1)
+        assert not deps2.registry.agents_for_task(t2)
+        await tm2.pause_task(t1)
+    run(main())
+
+
+def test_restore_rebuilds_escrow_with_historical_spend():
+    async def main():
+        from decimal import Decimal
+        from quoracle_tpu.infra.costs import CostEntry
+        backend = MockBackend(respond=lambda r: j("wait", {}))
+        db, store, deps, sup, tm = make_stack(backend)
+        task_id, root = await tm.create_task(
+            "budgeted", model_pool=list(POOL), budget="10")
+        deps.costs.record(CostEntry(
+            agent_id=root.agent_id, task_id=task_id,
+            amount=Decimal("1.25"), cost_type="manual", description="x"))
+        await tm.pause_task(task_id)
+
+        deps2 = AgentDeps.for_tests(backend,
+                                    secrets=PersistentSecretStore(db))
+        AgentSupervisor(deps2)
+        tm2 = TaskManager(deps2, store)
+        await tm2.restore_task(task_id)
+        st = deps2.escrow.get(root.agent_id)
+        assert st.mode == "root" and st.limit == Decimal("10")
+        assert st.spent >= Decimal("1.25")
+        await tm2.pause_task(task_id)
+    run(main())
+
+
+def test_dismissal_deletes_rows():
+    async def main():
+        import re
+        seen = {}
+        def respond(r):
+            joined = "\n".join(str(m.get("content", "")) for m in r.messages)
+            if "[TASK]" in joined:                   # the child
+                if '"delivered_to"' in joined:
+                    return j("wait", {})
+                return j("send_message",
+                         {"target": "parent", "content": "child ready"})
+            if '"dismissed"' in joined:
+                return j("wait", {})
+            if "child ready" in joined:
+                # child is live and messaging → both rows must be durable
+                seen["rows_before_dismiss"] = \
+                    len(db.query("SELECT * FROM agents"))
+                m = re.search(r'from="(agent-[0-9a-f]+)"', joined)
+                return j("dismiss_child", {"child_id": m.group(1)})
+            if '"agent_id"' in joined:               # spawn acked: wait
+                return j("wait", {})
+            return j("spawn_child", {
+                "task_description": "ephemeral", "success_criteria": "n/a",
+                "immediate_context": "n/a", "approach_guidance": "wait",
+                "profile": "default"})
+        backend = MockBackend(respond=respond)
+        db, store, deps, sup, tm = make_stack(backend)
+        seen2 = {}
+        def on_lifecycle(t, e):
+            if e["event"] == "agent_dismissed":
+                seen2["dismissed"] = e["agent_id"]
+        from quoracle_tpu.infra.bus import TOPIC_LIFECYCLE
+        deps.events.bus.subscribe(TOPIC_LIFECYCLE, on_lifecycle)
+        task_id, root = await tm.create_task("spawn and dismiss",
+                                             model_pool=list(POOL))
+        await until(lambda: "dismissed" in seen2, timeout=15)
+        assert seen["rows_before_dismiss"] == 2
+        rows = store.agents_for_task(task_id)
+        assert len(rows) == 1 and rows[0]["agent_id"] == root.agent_id
+        await tm.pause_task(task_id)
+    run(main())
+
+
+def test_profiles_and_settings():
+    db = Database(":memory:")
+    store = Persistence(db)
+    store.save_profile("researcher", {
+        "model_pool": list(POOL), "capability_groups": ["communication"],
+        "max_refinement_rounds": 2})
+    assert store.get_profile("researcher")["max_refinement_rounds"] == 2
+    assert store.list_profiles() == ["researcher"]
+    store.set_setting("embedding_model", "xla:tiny")
+    assert store.get_setting("embedding_model") == "xla:tiny"
+    assert store.get_setting("missing", "dflt") == "dflt"
+
+
+def test_create_task_with_profile_resolution():
+    async def main():
+        backend = MockBackend(respond=lambda r: j("wait", {}))
+        db, store, deps, sup, tm = make_stack(backend)
+        store.save_profile("minimal", {
+            "model_pool": list(POOL),
+            "capability_groups": [],
+            "max_refinement_rounds": 1})
+        task_id, root = await tm.create_task("profiled task",
+                                             profile="minimal")
+        assert root.config.capability_groups == []
+        assert root.config.max_refinement_rounds == 1
+        assert root.config.profile_names == ("minimal",)
+        with pytest.raises(ValueError):
+            await tm.create_task("bad", profile="nope")
+        await tm.pause_task(task_id)
+    run(main())

@@ -1,0 +1,74 @@
+"""Runtime composition root + CLI surface."""
+
+import asyncio
+import json
+
+from quoracle_tpu.models.runtime import MockBackend
+from quoracle_tpu.runtime import Runtime, RuntimeConfig
+
+POOL = MockBackend.DEFAULT_POOL
+
+
+def j(action, params=None, wait=False):
+    return json.dumps({"action": action, "params": params or {},
+                       "reasoning": "t", "wait": wait})
+
+
+def test_runtime_full_stack_create_pause_reboot(tmp_path):
+    db_path = str(tmp_path / "q.db")
+
+    async def phase1():
+        rt = Runtime(RuntimeConfig(db_path=db_path, encryption_key="k"),
+                     backend=MockBackend(respond=lambda r: j("wait", {})))
+        task_id, root = await rt.tasks.create_task("hold", model_pool=list(POOL))
+        for _ in range(200):
+            await asyncio.sleep(0.02)
+            if len(root.ctx.history(POOL[0])) >= 3:
+                break
+        await rt.tasks.pause_task(task_id)
+        assert rt.status()["tasks"][task_id] == "paused"
+        # simulate crash-while-running for revival
+        rt.store.db.execute("UPDATE tasks SET status='running' WHERE id=?",
+                            (task_id,))
+        rt.close()
+        return task_id
+
+    async def phase2(task_id):
+        rt = Runtime(RuntimeConfig(db_path=db_path, encryption_key="k"),
+                     backend=MockBackend(respond=lambda r: j("wait", {})))
+        result = await rt.boot()
+        assert result["revived"] == [task_id]
+        assert len(rt.registry) == 1
+        await rt.shutdown()
+
+    task_id = asyncio.run(asyncio.wait_for(phase1(), 60))
+    asyncio.run(asyncio.wait_for(phase2(task_id), 60))
+
+
+def test_runtime_isolation():
+    # two runtimes share nothing (the cardinal DI rule)
+    rt1 = Runtime(backend=MockBackend())
+    rt2 = Runtime(backend=MockBackend())
+    assert rt1.registry is not rt2.registry
+    assert rt1.bus is not rt2.bus
+    assert rt1.escrow is not rt2.escrow
+    rt1.secrets.put("only-in-1", "value-123")
+    assert rt2.secrets.lookup("only-in-1") is None
+    rt1.close()
+    rt2.close()
+
+
+def test_cli_run_and_status(tmp_path, capsys):
+    from quoracle_tpu.cli import main
+    db_path = str(tmp_path / "cli.db")
+    rc = main(["run", "do nothing much", "--db", db_path,
+               "--watch-seconds", "1.5"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "task task-" in out
+    assert "spawned" in out
+    rc = main(["status", "--db", db_path])
+    assert rc == 0
+    out = capsys.readouterr().out
+    status = json.loads(out)
+    assert list(status["tasks"].values()) == ["paused"]
