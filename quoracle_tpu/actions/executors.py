@@ -132,13 +132,13 @@ async def send_message_action(core, router, params: dict) -> dict:
 # ---------------------------------------------------------------------------
 
 def _check_path(core, path: str, write: bool) -> str:
-    """Grove confinement hook (reference groves/hard_rule_enforcer.ex file
-    confinement). Until the governance milestone wires a grove, only the
-    agent's working_dir-relative resolution applies."""
+    """Grove confinement (reference groves/hard_rule_enforcer.ex file
+    confinement + path_security.ex): resolve relative to working_dir, then
+    check against the agent's node confinement."""
     p = os.path.abspath(os.path.join(core.config.working_dir, path))
-    grove = core.deps.grove
-    if grove is not None:
-        err = grove.check_file_path(p, write=write)
+    if core.grove is not None:
+        err = core.grove.check_file_path(p, write=write,
+                                         node=core.config.grove_node)
         if err:
             raise ActionError(err)
     return p
@@ -165,9 +165,8 @@ async def file_read_action(core, router, params: dict) -> dict:
 async def file_write_action(core, router, params: dict) -> dict:
     path = _check_path(core, params["path"], write=True)
     content = params["content"]
-    grove = core.deps.grove
-    if grove is not None:
-        err = grove.validate_file_schema(path, content)
+    if core.grove is not None:
+        err = core.grove.validate_file_schema(path, content)
         if err:
             raise ActionError(err)
     try:
@@ -207,10 +206,10 @@ async def execute_shell_action(core, router, params: dict) -> dict:
 
     command = params["command"]
     working_dir = params.get("working_dir") or core.config.working_dir
-    grove = core.deps.grove
-    if grove is not None:
-        err = (grove.check_shell_command(command)
-               or grove.check_working_dir(working_dir))
+    if core.grove is not None:
+        node = core.config.grove_node
+        err = (core.grove.check_shell_command(command, node)
+               or core.grove.check_working_dir(working_dir, node))
         if err:
             raise ActionError(err)
     if not os.path.isdir(working_dir):
@@ -285,23 +284,6 @@ SPAWN_MAX_RETRIES = 3        # reference spawn.ex:412-433
 SPAWN_RETRY_DELAY_S = 0.2
 
 
-def _compose_child_system_prompt(params: dict) -> Optional[str]:
-    """Assemble the child's identity prompt from spawn fields. The full
-    hierarchical prompt-field transformation (reference
-    fields/prompt_field_manager.ex) replaces this in the governance
-    milestone; the composition order matches its provided-field rendering."""
-    parts = []
-    if params.get("role"):
-        parts.append(f"Your role: {params['role']}")
-    if params.get("cognitive_style"):
-        parts.append(f"Cognitive style: {params['cognitive_style']}")
-    if params.get("global_context"):
-        parts.append(f"Global context:\n{params['global_context']}")
-    if params.get("constraints"):
-        parts.append(f"Constraints you must respect:\n{params['constraints']}")
-    return "\n\n".join(parts) or None
-
-
 def _compose_initial_message(params: dict) -> str:
     return "\n\n".join(
         f"[{label}]\n{params[key]}" for label, key in (
@@ -339,9 +321,47 @@ async def spawn_child_action(core, router, params: dict) -> dict:
             raise ActionError(f"budget escrow failed: {e}")
 
     profile = params.get("profile")
+    # Topology auto-injection (reference TopologyResolver
+    # apply_spawn_contract, spawn.ex:117): the grove edge this spawn follows
+    # assigns the child's node, skills, and any contract overrides.
+    from quoracle_tpu.governance.fields import (
+        accumulate_constraints, child_fields_from_spawn,
+        compose_field_prompt,
+    )
     resolved = None
-    if deps.grove is not None:
-        resolved = deps.grove.resolve_spawn(profile, params)
+    if core.grove is not None:
+        from quoracle_tpu.governance.grove import GroveError
+        try:
+            resolved = core.grove.resolve_spawn(core.config.grove_node,
+                                                params)
+        except GroveError as e:
+            if allocated is not None:
+                try:
+                    deps.escrow.release_child(child_id)
+                except (BudgetError, KeyError):
+                    pass
+            raise ActionError(str(e))
+    child_node = resolved.node if resolved else None
+    child_skills = tuple(params.get("skills") or ())
+    extra_constraints: list[str] = []
+    forbidden = set(core.config.forbidden_actions)
+    governance_docs = core.config.governance_docs
+    if resolved is not None:
+        child_skills += tuple(s for s in resolved.skills
+                              if s not in child_skills)
+        profile = resolved.profile or profile
+        if resolved.constraints:
+            extra_constraints.append(resolved.constraints)
+    if core.grove is not None:
+        forbidden |= core.grove.blocked_actions(child_node)
+        governance_docs = core.grove.governance_docs_for(child_node)
+
+    # Constraint accumulation down the tree (reference
+    # ConstraintAccumulator): child inherits every ancestor constraint.
+    inherited = accumulate_constraints(core.config.accumulated_constraints,
+                                       core.config.own_constraints)
+    inherited += tuple(extra_constraints)
+    fields = child_fields_from_spawn(params)
     cfg = AgentConfig(
         agent_id=child_id,
         task_id=core.config.task_id,
@@ -349,14 +369,20 @@ async def spawn_child_action(core, router, params: dict) -> dict:
         model_pool=(resolved.model_pool if resolved else None)
                     or list(core.config.model_pool),
         profile=profile,
-        capability_groups=(resolved.capability_groups if resolved
+        capability_groups=(resolved.capability_groups
+                           if resolved is not None
+                           and resolved.capability_groups is not None
                            else core.config.capability_groups),
-        forbidden_actions=core.config.forbidden_actions,
+        forbidden_actions=tuple(sorted(forbidden)),
         max_refinement_rounds=core.config.max_refinement_rounds,
-        field_system_prompt=_compose_child_system_prompt(params),
+        field_system_prompt=compose_field_prompt(fields, inherited),
+        own_constraints=params.get("constraints"),
+        accumulated_constraints=inherited,
         profile_names=core.config.profile_names,
         grove_path=core.config.grove_path,
-        governance_docs=core.config.governance_docs,
+        grove_node=child_node,
+        governance_docs=governance_docs,
+        active_skills=child_skills,
         budget_mode="allocated" if allocated is not None else "na",
         budget_limit=allocated,
         working_dir=core.config.working_dir,
@@ -477,6 +503,49 @@ async def generate_secret_action(core, router, params: dict) -> dict:
 async def search_secrets_action(core, router, params: dict) -> dict:
     return {"status": "ok",
             "secrets": core.deps.secrets.search(params["query"])}
+
+
+# ---------------------------------------------------------------------------
+# Skills (reference actions/learn_skills.ex / create_skill.ex)
+# ---------------------------------------------------------------------------
+
+@register("learn_skills")
+async def learn_skills_action(core, router, params: dict) -> dict:
+    """Load skills into the active set; invalidates the cached system prompt
+    so next cycle carries the skill content (reference core.ex:338-341)."""
+    loader = core.skills_loader
+    if loader is None:
+        raise ActionError("no skills directory is configured")
+    available = loader.all()
+    missing = [s for s in params["skills"] if s not in available]
+    if missing:
+        raise ActionError(
+            f"unknown skills: {', '.join(missing)}. Available: "
+            f"{', '.join(sorted(available)) or '(none)'}")
+    added = [s for s in params["skills"] if s not in core.active_skills]
+    core.active_skills.extend(added)
+    # Learned skills must survive pause/restore: mirror into the persisted
+    # config (restore reads config.active_skills).
+    core.config.active_skills = tuple(core.active_skills)
+    if core.deps.persistence is not None:
+        core.deps.persistence.persist_agent(core)
+    core.invalidate_system_prompt()
+    return {"status": "ok", "active_skills": list(core.active_skills),
+            "added": added}
+
+
+@register("create_skill")
+async def create_skill_action(core, router, params: dict) -> dict:
+    loader = core.skills_loader
+    if loader is None:
+        raise ActionError("no skills directory is configured")
+    from quoracle_tpu.governance.skills import SkillError
+    try:
+        skill = loader.create(params["name"], params["description"],
+                              params["content"])
+    except SkillError as e:
+        raise ActionError(str(e))
+    return {"status": "ok", "name": skill.name, "path": skill.path}
 
 
 # ---------------------------------------------------------------------------

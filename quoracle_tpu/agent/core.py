@@ -107,6 +107,25 @@ class AgentCore:
         self._system_prompt: Optional[str] = None
         self._reflect_fn = make_reflect_fn(deps.backend)
 
+        # Grove enforcement: explicit override (tests) or resolved from the
+        # manifest path this agent was spawned with.
+        self.grove = deps.grove
+        if self.grove is None and config.grove_path:
+            from quoracle_tpu.governance.grove import (
+                GroveEnforcer, load_grove,
+            )
+            # Fail CLOSED: an enforcement layer that can't load must stop
+            # the agent, not silently run it ungoverned (the exception
+            # propagates to the spawner / restorer).
+            self.grove = GroveEnforcer(load_grove(config.grove_path))
+        # Skills: grove-local directory shadows the global one
+        if self.grove is not None:
+            global_dir = getattr(deps.skills, "global_dir", None)
+            self.skills_loader = self.grove.skills_loader(global_dir)
+        else:
+            self.skills_loader = deps.skills
+        self.active_skills: list[str] = list(config.active_skills)
+
         allowed = filter_actions(list(ACTIONS), config.capability_groups,
                                  config.forbidden_actions)
         self.engine = ConsensusEngine(
@@ -116,6 +135,7 @@ class AgentCore:
                 max_refinement_rounds=config.max_refinement_rounds,
                 force_reflection=config.force_reflection,
                 allowed_actions=set(allowed),
+                profile_optional_spawn=self.grove is not None,
             ),
             log=lambda event, data: deps.events.log(
                 self.agent_id, "debug", event, **data))
@@ -295,6 +315,15 @@ class AgentCore:
         suspended awaiting this function."""
         deps, cfg = self.deps, self.config
         if self._system_prompt is None:
+            available, active = [], []
+            if self.skills_loader is not None:
+                loaded = self.skills_loader.all()
+                active = [loaded[n].as_dict() for n in self.active_skills
+                          if n in loaded]
+                available = [
+                    {"name": s.name, "description": s.description}
+                    for s in loaded.values()
+                    if s.name not in self.active_skills]
             self._system_prompt = build_system_prompt(
                 field_system_prompt=cfg.field_system_prompt,
                 capability_groups=cfg.capability_groups,
@@ -302,6 +331,8 @@ class AgentCore:
                 profile_name=cfg.profile,
                 profile_description=cfg.profile_description,
                 profile_names=cfg.profile_names,
+                available_skills=available,
+                active_skills=active,
                 grove_path=cfg.grove_path,
                 governance_docs=cfg.governance_docs,
             )

@@ -48,13 +48,19 @@ class AgentConfig:
     max_refinement_rounds: int = 4
     force_reflection: bool = False
 
-    # prompt fields (reference fields/prompt_field_manager.ex; round 1 keeps
-    # the assembled system-prompt string; the field system arrives with the
-    # governance milestone)
+    # prompt fields (reference fields/prompt_field_manager.ex): the composed
+    # identity block plus the raw pieces that flow down the tree
     field_system_prompt: Optional[str] = None
+    own_constraints: Optional[str] = None           # this agent's constraints
+    accumulated_constraints: tuple[str, ...] = ()   # every ancestor's
     profile_names: tuple[str, ...] = ()             # spawn enum injection
+    # grove (reference groves/): directory, this agent's topology node, and
+    # the governance docs resolved for it at spawn time
     grove_path: Optional[str] = None
+    grove_node: Optional[str] = None
     governance_docs: Optional[str] = None
+    # skills active at spawn/restore (names; content loads via SkillsLoader)
+    active_skills: tuple[str, ...] = ()
 
     # budget (reference core/state.ex:286-290 modes root/allocated/na)
     budget_mode: str = "na"
@@ -81,8 +87,11 @@ class AgentDeps:
     costs: CostRecorder
     token_manager: TokenManager
     secrets: SecretStore = dataclasses.field(default_factory=SecretStore)
-    persistence: Any = None          # persistence layer (milestone M8)
-    grove: Any = None                # grove enforcement (governance milestone)
+    persistence: Any = None          # persistence layer
+    grove: Any = None                # GroveEnforcer override (tests); agents
+                                     # normally resolve theirs from
+                                     # config.grove_path
+    skills: Any = None               # global SkillsLoader (optional)
     # test seams (reference injectable consensus_fn / delay_fn)
     consensus_fn: Optional[Callable] = None
     shell_sync_threshold_s: float = 0.1   # reference actions/shell.ex:13

@@ -3,3 +3,14 @@
 Reference: lib/quoracle/{profiles,groves,skills,fields}/ — cross-cutting rules
 that gate actions, shape prompts, and constrain spawn (SURVEY.md §1 layer 8).
 """
+
+from quoracle_tpu.governance.capabilities import (  # noqa: F401
+    allowed_actions_for_groups, filter_actions, validate_groups,
+)
+from quoracle_tpu.governance.fields import (  # noqa: F401
+    AgentFields, accumulate_constraints, compose_field_prompt,
+)
+from quoracle_tpu.governance.grove import (  # noqa: F401
+    GroveEnforcer, GroveManifest, list_groves, load_grove,
+)
+from quoracle_tpu.governance.skills import Skill, SkillsLoader  # noqa: F401

@@ -87,7 +87,8 @@ def deserialize_config(raw: str) -> Any:
     d = json.loads(raw)
     if d.get("budget_limit") is not None:
         d["budget_limit"] = Decimal(d["budget_limit"])
-    for k in ("forbidden_actions", "profile_names"):
+    for k in ("forbidden_actions", "profile_names",
+              "accumulated_constraints", "active_skills"):
         if d.get(k) is not None:
             d[k] = tuple(d[k])
     return AgentConfig(**d)

@@ -44,28 +44,71 @@ class TaskManager:
         return data
 
     async def create_task(
-        self, description: str, *,
+        self, description: Optional[str] = None, *,
         model_pool: Optional[list[str]] = None,
         profile: Optional[str] = None,
         budget: Optional[str] = None,
         system_prompt: Optional[str] = None,
         working_dir: str = "/tmp",
+        grove: Optional[str] = None,
         task_fields: Optional[dict] = None,
     ) -> tuple[str, Any]:
         """Create the task row, spawn the root agent, deliver the initial
-        message (reference task_manager.ex:39-92). Returns (task_id, root
-        core)."""
+        message (reference task_manager.ex:39-92). With ``grove`` (a grove
+        directory), the manifest's bootstrap pre-fills the missing fields
+        and the root agent becomes the topology root node (reference
+        BootstrapResolver + grove selector in the new-task modal). Returns
+        (task_id, root core)."""
         prof = self.resolve_profile(profile)
         pool = model_pool or prof.get("model_pool")
         if not pool:
             raise ValueError("a model_pool is required (directly or via "
                              "profile)")
+
+        enforcer = root_node = None
+        governance_docs = None
+        forbidden: tuple[str, ...] = ()
+        active_skills: tuple[str, ...] = ()
+        if grove is not None:
+            from quoracle_tpu.governance.fields import (
+                AgentFields, compose_field_prompt,
+            )
+            from quoracle_tpu.governance.grove import (
+                GroveEnforcer, load_grove,
+            )
+            enforcer = GroveEnforcer(load_grove(grove))
+            boot = enforcer.bootstrap_fields()
+            root_node = enforcer.manifest.root_node
+            description = description or boot.get("task_description")
+            active_skills = tuple(boot.get("skills") or ())
+            governance_docs = enforcer.governance_docs_for(root_node)
+            forbidden = tuple(sorted(enforcer.blocked_actions(root_node)))
+            ws = enforcer.workspace_dir()
+            if ws:
+                import os
+                os.makedirs(ws, exist_ok=True)
+                working_dir = ws
+            if system_prompt is None:
+                system_prompt = compose_field_prompt(AgentFields(
+                    role=boot.get("role"),
+                    cognitive_style=boot.get("cognitive_style"),
+                    global_context=boot.get("global_context"),
+                    delegation_strategy=boot.get("delegation_strategy"),
+                ))
+            if boot.get("success_criteria") and description:
+                description = (f"{description}\n\n[SUCCESS CRITERIA]\n"
+                               f"{boot['success_criteria']}")
+        if not description:
+            raise ValueError("a task description is required (directly or "
+                             "via the grove bootstrap)")
+
         task_id = new_task_id()
         self.store.create_task_row(task_id, task_fields or
                                    {"description": description},
                                    {"profile": profile,
                                     "model_pool": pool,
-                                    "budget": budget})
+                                    "budget": budget,
+                                    "grove": grove})
         config = AgentConfig(
             agent_id=new_agent_id(),
             task_id=task_id,
@@ -73,10 +116,15 @@ class TaskManager:
             profile=profile,
             profile_description=prof.get("description"),
             capability_groups=prof.get("capability_groups"),
+            forbidden_actions=forbidden,
             max_refinement_rounds=prof.get("max_refinement_rounds", 4),
             force_reflection=prof.get("force_reflection", False),
             field_system_prompt=system_prompt,
             profile_names=tuple(self.store.list_profiles()),
+            grove_path=grove,
+            grove_node=root_node,
+            governance_docs=governance_docs,
+            active_skills=active_skills,
             budget_mode="root" if budget is not None else "na",
             budget_limit=Decimal(budget) if budget is not None else None,
             working_dir=working_dir,

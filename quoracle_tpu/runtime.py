@@ -35,6 +35,8 @@ class RuntimeConfig:
     model_pool: Optional[list[str]] = None    # default pool for tpu backend
     embed_model: Optional[str] = None
     seed: int = 0
+    skills_dir: Optional[str] = None          # global skills directory
+    groves_dir: Optional[str] = None          # directory of grove dirs
 
 
 class Runtime:
@@ -60,11 +62,15 @@ class Runtime:
             context_limit_fn=self.backend.context_window)
         self.secrets = PersistentSecretStore(self.db)
         self.registry = AgentRegistry()
+        from quoracle_tpu.governance.skills import SkillsLoader
+        skills_dir = (config.skills_dir
+                      or self.store.get_setting("skills_dir"))
+        self.skills = SkillsLoader(global_dir=skills_dir)
         self.deps = AgentDeps(
             backend=self.backend, registry=self.registry, supervisor=None,
             events=self.events, escrow=self.escrow, costs=self.costs,
             token_manager=self.token_manager, secrets=self.secrets,
-            persistence=self.store)
+            persistence=self.store, skills=self.skills)
         self.supervisor = AgentSupervisor(self.deps)
         self.tasks = TaskManager(self.deps, self.store)
         self.store.attach_bus(self.bus)
@@ -97,6 +103,12 @@ class Runtime:
 
     def live_agents(self) -> list[str]:
         return self.supervisor.live_agents()
+
+    def list_groves(self) -> list:
+        from quoracle_tpu.governance.grove import list_groves
+        groves_dir = (self.config.groves_dir
+                      or self.store.get_setting("groves_dir"))
+        return list_groves(groves_dir) if groves_dir else []
 
     def status(self) -> dict[str, Any]:
         return {

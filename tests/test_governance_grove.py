@@ -1,0 +1,394 @@
+"""Groves, skills, prompt fields: loading, enforcement, topology, e2e.
+
+Mirrors the reference's groves/skills/fields test coverage (SURVEY.md §2.5):
+manifest parsing, hard rules (shell pattern + action block, scoped),
+confinement strict/warn with ** globs and symlink escapes, JSON-schema
+validation of file writes, spawn topology auto-injection, constraint
+accumulation, and skills loading/shadowing/creation — plus one live tree
+running inside a grove.
+"""
+
+import asyncio
+import json
+import os
+import time
+
+import pytest
+
+from quoracle_tpu.agent import AgentConfig, AgentDeps, AgentSupervisor
+from quoracle_tpu.governance.fields import (
+    AgentFields, accumulate_constraints, compose_field_prompt,
+)
+from quoracle_tpu.governance.grove import (
+    GroveEnforcer, GroveError, list_groves, load_grove,
+)
+from quoracle_tpu.governance.skills import (
+    SkillError, SkillsLoader, parse_skill_md, render_skill_md,
+)
+from quoracle_tpu.models.runtime import MockBackend
+
+POOL = MockBackend.DEFAULT_POOL
+
+
+def j(action, params=None, wait=False):
+    return json.dumps({"action": action, "params": params or {},
+                       "reasoning": "t", "wait": wait})
+
+
+def write_grove(tmp_path, *, confinement_mode="strict"):
+    g = tmp_path / "bench-grove"
+    g.mkdir()
+    ws = tmp_path / "workspace"
+    ws.mkdir()
+    (g / "GROVE.md").write_text(f"""---
+name: bench-grove
+description: test grove
+version: "1.0"
+topology:
+  root: coordinator
+  edges:
+    - parent: coordinator
+      child: worker
+      auto_inject:
+        skills: [worker-skill]
+        constraints: "Answer only from provided data."
+governance:
+  hard_rules:
+    - type: shell_pattern_block
+      pattern: "curl|wget"
+      message: "no network"
+      scope: [worker]
+    - type: action_block
+      actions: [fetch_web, call_api]
+      message: "no external sources"
+      scope: [worker]
+  injections:
+    - source: governance/integrity.md
+      inject_into: [coordinator, worker]
+      priority: high
+schemas:
+  - name: report
+    definition: schemas/report.schema.json
+    validate_on: file_write
+    path_pattern: "{ws}/runs/*/report.json"
+workspace: "{ws}"
+confinement_mode: {confinement_mode}
+confinement:
+  worker:
+    paths:
+      - {ws}/runs/**
+    read_only_paths:
+      - {ws}/data/**
+bootstrap:
+  skills: [coord-skill]
+  role: "Benchmark Coordinator"
+  cognitive_style: systematic
+  task_description_file: bootstrap/task.md
+---
+""")
+    (g / "governance").mkdir()
+    (g / "governance" / "integrity.md").write_text(
+        "Never fabricate results.")
+    (g / "schemas").mkdir()
+    (g / "schemas" / "report.schema.json").write_text(json.dumps({
+        "type": "object", "required": ["score"],
+        "properties": {"score": {"type": "number"}}}))
+    (g / "bootstrap").mkdir()
+    (g / "bootstrap" / "task.md").write_text("Run the benchmark end to end.")
+    (g / "skills").mkdir()
+    (g / "skills" / "worker-skill").mkdir()
+    (g / "skills" / "worker-skill" / "SKILL.md").write_text(
+        "---\nname: worker-skill\ndescription: how to answer\n---\n\n"
+        "Always answer with a single letter.")
+    (g / "skills" / "coord-skill").mkdir()
+    (g / "skills" / "coord-skill" / "SKILL.md").write_text(
+        "---\nname: coord-skill\ndescription: how to coordinate\n---\n\n"
+        "Spawn one worker per question.")
+    return str(g), str(ws)
+
+
+# ---------------------------------------------------------------------------
+# Manifest + enforcement units
+# ---------------------------------------------------------------------------
+
+def test_load_grove_manifest(tmp_path):
+    path, ws = write_grove(tmp_path)
+    m = load_grove(path)
+    assert m.name == "bench-grove"
+    assert m.root_node == "coordinator"
+    assert m.edges[0].child == "worker"
+    assert m.edges[0].auto_inject["skills"] == ["worker-skill"]
+    assert len(m.hard_rules) == 2
+    assert m.confinement_mode == "strict"
+    assert list_groves(str(tmp_path))[0].name == "bench-grove"
+    with pytest.raises(GroveError):
+        load_grove(str(tmp_path / "nope"))
+
+
+def test_hard_rules_scoped_by_node(tmp_path):
+    path, ws = write_grove(tmp_path)
+    enf = GroveEnforcer(load_grove(path))
+    assert enf.check_shell_command("curl http://x", "worker")
+    assert "no network" in enf.check_shell_command("wget x", "worker")
+    assert enf.check_shell_command("curl http://x", "coordinator") is None
+    assert enf.check_shell_command("echo hi", "worker") is None
+    assert enf.blocked_actions("worker") == {"fetch_web", "call_api"}
+    assert enf.blocked_actions("coordinator") == set()
+
+
+def test_confinement_strict_and_warn(tmp_path):
+    path, ws = write_grove(tmp_path)
+    enf = GroveEnforcer(load_grove(path))
+    ok_write = f"{ws}/runs/r1/report.json"
+    assert enf.check_file_path(ok_write, write=True, node="worker") is None
+    # read-only path refuses writes but allows reads
+    data = f"{ws}/data/q.json"
+    assert enf.check_file_path(data, write=True, node="worker")
+    assert enf.check_file_path(data, write=False, node="worker") is None
+    # outside everything
+    assert enf.check_file_path("/etc/passwd", write=False, node="worker")
+    # unconfined node passes
+    assert enf.check_file_path("/etc/passwd", write=True,
+                               node="coordinator") is None
+    # warn mode logs but allows
+    path2, ws2 = write_grove(tmp_path / "warn", confinement_mode="warn") \
+        if (tmp_path / "warn").mkdir() or True else (None, None)
+    enf2 = GroveEnforcer(load_grove(path2))
+    assert enf2.check_file_path("/etc/passwd", write=True,
+                                node="worker") is None
+
+
+def test_confinement_blocks_symlink_escape(tmp_path):
+    path, ws = write_grove(tmp_path)
+    enf = GroveEnforcer(load_grove(path))
+    runs = os.path.join(ws, "runs")
+    os.makedirs(runs, exist_ok=True)
+    os.symlink("/etc", os.path.join(runs, "sneaky"))
+    # resolves through the symlink to /etc/... → outside the allowed globs
+    assert enf.check_file_path(os.path.join(runs, "sneaky", "passwd"),
+                               write=True, node="worker")
+
+
+def test_schema_validation_on_file_write(tmp_path):
+    path, ws = write_grove(tmp_path)
+    enf = GroveEnforcer(load_grove(path))
+    target = f"{ws}/runs/r1/report.json"
+    assert enf.validate_file_schema(target, '{"score": 0.93}') is None
+    err = enf.validate_file_schema(target, '{"wrong": 1}')
+    assert err and "score" in err
+    assert "not JSON" in enf.validate_file_schema(target, "not json")
+    # non-matching paths are not validated
+    assert enf.validate_file_schema(f"{ws}/runs/r1/notes.txt",
+                                    "not json") is None
+
+
+def test_topology_resolution_and_governance_docs(tmp_path):
+    path, ws = write_grove(tmp_path)
+    enf = GroveEnforcer(load_grove(path))
+    res = enf.resolve_spawn("coordinator", {})
+    assert res.node == "worker"
+    assert res.skills == ("worker-skill",)
+    assert res.constraints == "Answer only from provided data."
+    # leaf nodes may not spawn (fail closed); out-of-topology agents may
+    with pytest.raises(GroveError):
+        enf.resolve_spawn("worker", {})
+    assert enf.resolve_spawn(None, {}).node is None
+    docs = enf.governance_docs_for("worker")
+    assert "Never fabricate" in docs
+    boot = enf.bootstrap_fields()
+    assert boot["task_description"] == "Run the benchmark end to end."
+    assert boot["role"] == "Benchmark Coordinator"
+
+
+def test_confinement_allows_tree_root_as_working_dir(tmp_path):
+    # 'p/**' must match p itself — a confined node needs the root of its
+    # allowed tree as a shell working dir
+    path, ws = write_grove(tmp_path)
+    enf = GroveEnforcer(load_grove(path))
+    runs = f"{ws}/runs"
+    os.makedirs(runs, exist_ok=True)
+    assert enf.check_working_dir(runs, "worker") is None
+    assert enf.check_working_dir(ws, "worker")       # parent still outside
+
+
+def test_relative_confinement_patterns_resolve_against_workspace(tmp_path):
+    g = tmp_path / "rel-grove"
+    g.mkdir()
+    ws = tmp_path / "rel-ws"
+    ws.mkdir()
+    (g / "GROVE.md").write_text(f"""---
+name: rel-grove
+workspace: "{ws}"
+confinement_mode: strict
+confinement:
+  solo:
+    paths: ["runs/**"]
+---
+""")
+    enf = GroveEnforcer(load_grove(str(g)))
+    assert enf.check_file_path(f"{ws}/runs/x.txt", write=True,
+                               node="solo") is None
+    # NOT relative to the process CWD
+    assert enf.check_file_path(os.path.abspath("runs/x.txt"), write=True,
+                               node="solo")
+
+
+def test_multi_edge_spawn_requires_disambiguation(tmp_path):
+    g = tmp_path / "multi-grove"
+    g.mkdir()
+    (g / "GROVE.md").write_text("""---
+name: multi-grove
+topology:
+  root: boss
+  edges:
+    - parent: boss
+      child: worker
+    - parent: boss
+      child: reviewer
+---
+""")
+    enf = GroveEnforcer(load_grove(str(g)))
+    with pytest.raises(GroveError):
+        enf.resolve_spawn("boss", {})                 # ambiguous
+    assert enf.resolve_spawn("boss", {"profile": "reviewer"}).node \
+        == "reviewer"
+    assert enf.resolve_spawn("boss", {"skills": ["worker"]}).node \
+        == "worker"
+
+
+# ---------------------------------------------------------------------------
+# Skills
+# ---------------------------------------------------------------------------
+
+def test_skills_loader_shadowing_and_create(tmp_path):
+    global_dir = tmp_path / "global-skills"
+    global_dir.mkdir()
+    (global_dir / "common.md").write_text(
+        "---\nname: common\ndescription: global version\n---\n\nG")
+    grove_dir = tmp_path / "grove-skills"
+    grove_dir.mkdir()
+    (grove_dir / "common.md").write_text(
+        "---\nname: common\ndescription: grove version\n---\n\nL")
+    loader = SkillsLoader(global_dir=str(global_dir),
+                          grove_dir=str(grove_dir))
+    assert loader.load("common").description == "grove version"
+    # creation writes SKILL.md into the global dir
+    s = loader.create("new-skill", "fresh", "Do the thing.")
+    assert os.path.isfile(s.path)
+    reloaded = SkillsLoader(global_dir=str(global_dir)).load("new-skill")
+    assert reloaded.content == "Do the thing."
+    assert loader.search("fresh")[0].name == "new-skill"
+    with pytest.raises(SkillError):
+        loader.create("bad name!", "x", "y")
+    rendered = render_skill_md("a", "b", "c")
+    assert parse_skill_md(rendered).name == "a"
+
+
+# ---------------------------------------------------------------------------
+# Fields
+# ---------------------------------------------------------------------------
+
+def test_field_composition_and_constraint_accumulation():
+    fields = AgentFields(role="Researcher", cognitive_style="skeptical",
+                         constraints="Cite sources.",
+                         global_context="Project X.")
+    prompt = compose_field_prompt(fields, ("Never spend money.",))
+    assert "Researcher" in prompt
+    assert "Challenge assumptions" in prompt          # style directive
+    assert "Never spend money." in prompt             # ancestor constraint
+    assert "Cite sources." in prompt
+    acc = accumulate_constraints(("a",), "b")
+    assert acc == ("a", "b")
+    assert accumulate_constraints((), None) == ()
+    # unknown style falls back to literal mention
+    p2 = compose_field_prompt(AgentFields(cognitive_style="zen"))
+    assert "zen" in p2
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: a live tree inside a grove
+# ---------------------------------------------------------------------------
+
+async def until(cond, timeout=15.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return
+        await asyncio.sleep(0.01)
+    raise AssertionError("condition not met")
+
+
+def test_grove_tree_end_to_end(tmp_path):
+    async def main():
+        path, ws = write_grove(tmp_path)
+
+        def respond(r):
+            joined = "\n".join(str(m.get("content", "")) for m in r.messages)
+            sp = joined  # system prompt is in the first message content
+            if "[TASK]" in joined:                    # the worker child
+                if "blocked-attempt-done" in joined:
+                    return j("wait", {})
+                if '"error"' in joined and "curl" in joined:
+                    return j("send_message", {
+                        "target": "parent",
+                        "content": "blocked-attempt-done"})
+                return j("execute_shell", {"command": "curl http://evil"})
+            if '"agent_id"' in joined:
+                return j("wait", {})
+            return j("spawn_child", {
+                "task_description": "answer q1", "success_criteria": "done",
+                "immediate_context": "ctx", "approach_guidance": "answer",
+                "profile": "default"})
+
+        backend = MockBackend(respond=respond)
+        deps = AgentDeps.for_tests(backend)
+        sup = AgentSupervisor(deps)
+        from quoracle_tpu.persistence import Database, Persistence, TaskManager
+        store = Persistence(Database(":memory:"))
+        tm = TaskManager(deps, store)
+        task_id, root = await tm.create_task(grove=path,
+                                             model_pool=list(POOL))
+        # bootstrap filled the description + root node + skills
+        assert root.config.grove_node == "coordinator"
+        assert root.config.field_system_prompt is not None
+        assert "Benchmark Coordinator" in root.config.field_system_prompt
+        assert root.active_skills == ["coord-skill"]
+        assert "Never fabricate" in root.config.governance_docs
+        texts = lambda: [e.as_text() for e in root.ctx.history(POOL[0])]
+        await until(lambda: any("Run the benchmark" in t for t in texts()))
+
+        # child spawned through the topology edge
+        await until(lambda: root.children)
+        child = deps.registry.lookup(root.children[0]["agent_id"]).core
+        assert child.config.grove_node == "worker"
+        assert "worker-skill" in child.active_skills
+        assert "fetch_web" in child.config.forbidden_actions
+        assert "Answer only from provided data." in \
+            child.config.field_system_prompt
+        # the worker's curl attempt is hard-blocked and it reports back
+        await until(lambda: any("blocked-attempt-done" in t
+                                for t in texts()))
+        ctexts = [e.as_text() for e in child.ctx.history(POOL[0])]
+        assert any("no network" in t for t in ctexts)
+        await tm.pause_task(task_id)
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_grove_system_prompt_carries_skills(tmp_path):
+    async def main():
+        path, ws = write_grove(tmp_path)
+        backend = MockBackend(respond=lambda r: j("wait", {}))
+        deps = AgentDeps.for_tests(backend)
+        sup = AgentSupervisor(deps)
+        from quoracle_tpu.persistence import Database, Persistence, TaskManager
+        tm = TaskManager(deps, Persistence(Database(":memory:")))
+        task_id, root = await tm.create_task(grove=path,
+                                             model_pool=list(POOL))
+        await until(lambda: backend.calls)
+        sys_prompt = backend.calls[0].messages[0]["content"]
+        # active skill content + available skill listing + governance docs
+        assert "Spawn one worker per question." in sys_prompt
+        assert "worker-skill" in sys_prompt
+        assert "Never fabricate results." in sys_prompt
+        await tm.pause_task(task_id)
+    asyncio.run(asyncio.wait_for(main(), 60))
