@@ -12,3 +12,7 @@ from quoracle_tpu.actions.schema import (  # noqa: F401
     batchable_async_actions,
     get_schema,
 )
+
+# Executor registration side effects: importing these fills EXECUTORS.
+from quoracle_tpu.actions import executors as _executors  # noqa: E402,F401
+from quoracle_tpu.actions import world as _world  # noqa: E402,F401

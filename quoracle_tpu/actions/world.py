@@ -1,0 +1,287 @@
+"""World-facing action executors: fetch_web, call_api, call_mcp,
+answer_engine, generate_images.
+
+Parity targets (reference files):
+  fetch_web     — actions/web.ex:12-36 (fetch → HTML-to-Markdown, image
+                  content-type handling, optional SSRF check, truncation)
+  call_api      — actions/api.ex + api/ submodules (REST + JSON-RPC +
+                  GraphQL adapters, auth handling, response parsing)
+  call_mcp      — actions/mcp.ex:1-20 (tool invocation through the MCP
+                  client, 120s default timeout)
+  answer_engine — actions/answer_engine.ex:1-52 (web-grounded answers with
+                  source extraction + cost recording; the reference grounds
+                  through a hosted grounding model — here grounding is an
+                  optional search fetch + the designated on-device answer
+                  model)
+  generate_images — actions/generate_images.ex + models/image_query.ex
+                  (multi-image generation with cost recording)
+
+Network I/O rides the injectable HTTP seam (infra/http.py); results are
+NO_EXECUTE-fenced by the Core before entering model history.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+from decimal import Decimal
+from typing import Any, Optional
+
+from quoracle_tpu.actions.executors import ActionError, register
+from quoracle_tpu.actions.router import truncate_output
+from quoracle_tpu.infra.http import SSRFError, check_ssrf
+from quoracle_tpu.utils.html_md import html_to_markdown
+
+FETCH_MAX_CHARS = 50_000
+IMAGE_MAX_BYTES = 512_000
+
+
+async def _http(core, url: str, method: str = "GET", headers=None,
+                body: Optional[bytes] = None,
+                timeout_s: float = 30.0):
+    """Run the (blocking) HTTP transport off-loop. When the SSRF guard is
+    on, it also re-checks every redirect hop (transports that don't accept
+    verify_url — test fakes — don't follow redirects anyway)."""
+    fn = core.deps.http
+    if fn is None:
+        raise ActionError("no HTTP transport configured (zero-egress mode)")
+    loop = asyncio.get_running_loop()
+    kwargs = {}
+    if core.deps.ssrf_check and fn is _default_transport():
+        kwargs["verify_url"] = check_ssrf
+    return await loop.run_in_executor(
+        None, lambda: fn(url, method, headers or {}, body, timeout_s,
+                         **kwargs))
+
+
+def _default_transport():
+    from quoracle_tpu.infra.http import urllib_http
+    return urllib_http
+
+
+# ---------------------------------------------------------------------------
+# fetch_web
+# ---------------------------------------------------------------------------
+
+@register("fetch_web")
+async def fetch_web_action(core, router, params: dict) -> dict:
+    url = params["url"]
+    if core.deps.ssrf_check:
+        try:
+            check_ssrf(url)
+        except SSRFError as e:
+            raise ActionError(f"fetch_web blocked: {e}")
+    resp = await _http(core, url,
+                       timeout_s=float(params.get("timeout") or 30))
+    ctype = resp.content_type
+    if ctype.startswith("image/"):
+        # Image responses return as base64 for multimodal use (reference
+        # web.ex image content-type handling), capped so one large image
+        # can't blow the context window (the reference compresses via
+        # libvips; our resize path is the native image preprocessor).
+        if len(resp.body) > IMAGE_MAX_BYTES:
+            return {"status": "ok", "url": resp.url or url,
+                    "content_type": ctype, "bytes": len(resp.body),
+                    "note": (f"image is {len(resp.body)} bytes "
+                             f"(> {IMAGE_MAX_BYTES} cap); not inlined")}
+        return {"status": "ok", "url": resp.url or url, "content_type": ctype,
+                "image_base64": base64.b64encode(resp.body).decode(),
+                "bytes": len(resp.body)}
+    text = resp.text()
+    if "html" in ctype or text.lstrip()[:1] == "<":
+        content = html_to_markdown(text)
+    else:
+        content = text
+    return {"status": "ok", "url": resp.url or url,
+            "http_status": resp.status, "content_type": ctype,
+            "content": truncate_output(content, FETCH_MAX_CHARS)}
+
+
+# ---------------------------------------------------------------------------
+# call_api (REST / JSON-RPC / GraphQL)
+# ---------------------------------------------------------------------------
+
+def _auth_headers(auth: Optional[dict]) -> dict[str, str]:
+    if not auth:
+        return {}
+    kind = auth.get("type", "bearer")
+    if kind == "bearer":
+        return {"Authorization": f"Bearer {auth.get('token', '')}"}
+    if kind == "basic":
+        cred = f"{auth.get('username', '')}:{auth.get('password', '')}"
+        return {"Authorization":
+                "Basic " + base64.b64encode(cred.encode()).decode()}
+    if kind == "header":
+        return {auth.get("name", "X-Api-Key"): auth.get("value", "")}
+    raise ActionError(f"unknown auth type {kind!r}")
+
+
+@register("call_api")
+async def call_api_action(core, router, params: dict) -> dict:
+    url = params["url"]
+    method = params["method"].upper()
+    protocol = params.get("protocol") or "rest"
+    headers = {**(params.get("headers") or {}),
+               **_auth_headers(params.get("auth"))}
+    body_param = params.get("body")
+    body: Optional[bytes] = None
+
+    if protocol == "jsonrpc":
+        method = "POST"
+        payload = {"jsonrpc": "2.0", "id": 1,
+                   "method": (body_param or {}).get("method"),
+                   "params": (body_param or {}).get("params", {})}
+        body = json.dumps(payload).encode()
+        headers.setdefault("content-type", "application/json")
+    elif protocol == "graphql":
+        method = "POST"
+        payload = {"query": (body_param or {}).get("query", ""),
+                   "variables": (body_param or {}).get("variables", {})}
+        body = json.dumps(payload).encode()
+        headers.setdefault("content-type", "application/json")
+    elif body_param is not None:
+        body = json.dumps(body_param).encode()
+        headers.setdefault("content-type", "application/json")
+
+    resp = await _http(core, url, method, headers, body,
+                       timeout_s=float(params.get("timeout") or 30))
+    out: dict[str, Any] = {"status": "ok", "http_status": resp.status,
+                           "url": url}
+    text = resp.text()
+    try:
+        parsed = json.loads(text)
+        if protocol == "jsonrpc" and isinstance(parsed, dict):
+            if parsed.get("error"):
+                out["error_detail"] = parsed["error"]
+            parsed = parsed.get("result", parsed)
+        if protocol == "graphql" and isinstance(parsed, dict):
+            if parsed.get("errors"):
+                out["error_detail"] = parsed["errors"]
+            parsed = parsed.get("data", parsed)
+        out["body"] = parsed
+    except json.JSONDecodeError:
+        out["body"] = truncate_output(text, FETCH_MAX_CHARS)
+    if resp.status >= 400:
+        out["status"] = "error"
+        out["error"] = f"HTTP {resp.status}"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# call_mcp
+# ---------------------------------------------------------------------------
+
+@register("call_mcp")
+async def call_mcp_action(core, router, params: dict) -> dict:
+    from quoracle_tpu.infra.mcp import MCPError
+    mcp = core.deps.mcp
+    if mcp is None:
+        raise ActionError("no MCP servers configured")
+    try:
+        result = await mcp.call_tool(
+            params["server"], params["tool"], params.get("arguments") or {},
+            timeout_s=float(params["timeout"]) if params.get("timeout")
+            else None)
+    except (MCPError, asyncio.TimeoutError) as e:
+        raise ActionError(f"call_mcp failed: {e}")
+    # MCP results carry a content list; flatten text parts for the history
+    content = (result or {}).get("content", [])
+    texts = [c.get("text", "") for c in content if c.get("type") == "text"]
+    raw = None
+    if not texts:
+        # Non-text content (screenshots, resources) can be megabytes of
+        # base64 — cap it like every other world-facing payload.
+        from quoracle_tpu.utils.normalize import to_json
+        raw = truncate_output(to_json(result), FETCH_MAX_CHARS)
+    return {"status": "error" if (result or {}).get("isError") else "ok",
+            "server": params["server"], "tool": params["tool"],
+            "content": truncate_output("\n".join(texts), FETCH_MAX_CHARS),
+            "raw": raw}
+
+
+# ---------------------------------------------------------------------------
+# answer_engine
+# ---------------------------------------------------------------------------
+
+@register("answer_engine")
+async def answer_engine_action(core, router, params: dict) -> dict:
+    """Grounded Q&A: optionally fetch search context through the HTTP seam,
+    then answer with the designated on-device answer model. The reference
+    delegates grounding to a hosted model's built-in search
+    (answer_engine.ex:1-52); on-device the grounding context is explicit."""
+    from quoracle_tpu.models.runtime import QueryRequest
+    query = params["query"]
+    deps = core.deps
+    sources: list[str] = []
+    context = ""
+    search_url = None
+    if deps.persistence is not None:
+        search_url = deps.persistence.get_setting("answer_engine_search_url")
+    if search_url and deps.http is not None:
+        import urllib.parse
+        url = search_url.replace("{query}", urllib.parse.quote(query))
+        try:
+            resp = await _http(core, url, timeout_s=20)
+            context = truncate_output(html_to_markdown(resp.text()), 20_000)
+            sources.append(url)
+        except Exception:
+            context = ""
+    answer_model = None
+    if deps.persistence is not None:
+        answer_model = deps.persistence.get_setting("answer_engine_model")
+    answer_model = answer_model or core.config.model_pool[0]
+
+    prompt = "Answer the question concisely and factually."
+    if params.get("focus"):
+        prompt += f" Focus: {params['focus']}."
+    user = (f"{context}\n\nQuestion: {query}" if context
+            else f"Question: {query}")
+    loop = asyncio.get_running_loop()
+    results = await loop.run_in_executor(None, lambda: deps.backend.query([
+        QueryRequest(model_spec=answer_model, messages=[
+            {"role": "system", "content": prompt},
+            {"role": "user", "content": user}], temperature=0.3)]))
+    res = results[0]
+    if not res.ok:
+        raise ActionError(f"answer engine query failed: {res.error}")
+    if res.usage.cost:
+        from quoracle_tpu.infra.costs import CostEntry
+        deps.costs.record(CostEntry(
+            agent_id=core.agent_id, task_id=core.config.task_id,
+            amount=Decimal(str(res.usage.cost)), cost_type="model",
+            model_spec=answer_model, input_tokens=res.usage.prompt_tokens,
+            output_tokens=res.usage.completion_tokens,
+            description="answer_engine"))
+    return {"status": "ok", "answer": res.text, "model": answer_model,
+            "sources": sources}
+
+
+# ---------------------------------------------------------------------------
+# generate_images
+# ---------------------------------------------------------------------------
+
+@register("generate_images")
+async def generate_images_action(core, router, params: dict) -> dict:
+    backend = core.deps.images
+    if backend is None:
+        raise ActionError("no image backend configured")
+    loop = asyncio.get_running_loop()
+    try:
+        images = await loop.run_in_executor(None, lambda: backend.generate(
+            params["prompt"], count=int(params.get("count") or 1),
+            size=params.get("size") or "256x256",
+            out_dir=core.config.working_dir))
+    except ValueError as e:
+        raise ActionError(str(e))
+    total_cost = sum(i.cost for i in images)
+    if total_cost:
+        from quoracle_tpu.infra.costs import CostEntry
+        core.deps.costs.record(CostEntry(
+            agent_id=core.agent_id, task_id=core.config.task_id,
+            amount=Decimal(str(total_cost)), cost_type="image",
+            description=f"generate_images x{len(images)}"))
+    return {"status": "ok",
+            "images": [{"path": i.path, "model": i.model,
+                        "width": i.width, "height": i.height}
+                       for i in images]}

@@ -92,6 +92,11 @@ class AgentDeps:
                                      # normally resolve theirs from
                                      # config.grove_path
     skills: Any = None               # global SkillsLoader (optional)
+    # world-facing seams (actions/world.py)
+    http: Any = None                 # HttpFn transport; None = zero-egress
+    ssrf_check: bool = True          # reference web.ex optional SSRF check
+    mcp: Any = None                  # MCPManager
+    images: Any = None               # ImageBackend
     # test seams (reference injectable consensus_fn / delay_fn)
     consensus_fn: Optional[Callable] = None
     shell_sync_threshold_s: float = 0.1   # reference actions/shell.ex:13

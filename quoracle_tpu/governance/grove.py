@@ -21,7 +21,6 @@ and every check takes it explicitly.
 from __future__ import annotations
 
 import dataclasses
-import fnmatch
 import logging
 import os
 import re
@@ -302,10 +301,9 @@ class GroveEnforcer:
         for rule in self.manifest.schemas:
             if rule.validate_on != "file_write":
                 continue
-            # relative path_patterns resolve against the workspace
-            pattern = rule.path_pattern
-            if not (_glob_match(real, pattern, self._pattern_base)
-                    or fnmatch.fnmatch(real, f"*/{pattern}")):
+            # relative path_patterns resolve against the workspace — never
+            # as a floating suffix match anywhere on the filesystem
+            if not _glob_match(real, rule.path_pattern, self._pattern_base):
                 continue
             import json
             try:

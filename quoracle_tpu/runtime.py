@@ -66,11 +66,18 @@ class Runtime:
         skills_dir = (config.skills_dir
                       or self.store.get_setting("skills_dir"))
         self.skills = SkillsLoader(global_dir=skills_dir)
+        from quoracle_tpu.infra.http import urllib_http
+        from quoracle_tpu.infra.mcp import MCPManager
+        from quoracle_tpu.models.images import ProceduralImageBackend
+        self.mcp = MCPManager(self.store.get_setting("mcp_servers") or {})
         self.deps = AgentDeps(
             backend=self.backend, registry=self.registry, supervisor=None,
             events=self.events, escrow=self.escrow, costs=self.costs,
             token_manager=self.token_manager, secrets=self.secrets,
-            persistence=self.store, skills=self.skills)
+            persistence=self.store, skills=self.skills,
+            http=urllib_http,
+            ssrf_check=bool(self.store.get_setting("ssrf_check", True)),
+            mcp=self.mcp, images=ProceduralImageBackend())
         self.supervisor = AgentSupervisor(self.deps)
         self.tasks = TaskManager(self.deps, self.store)
         self.store.attach_bus(self.bus)
@@ -92,6 +99,7 @@ class Runtime:
     async def shutdown(self) -> None:
         """Graceful stop of every live agent, then release resources."""
         await self.supervisor.stop_all()
+        await self.mcp.close()
         self.close()
 
     def close(self) -> None:

@@ -1,0 +1,287 @@
+"""World-facing actions: fetch_web, call_api, call_mcp, answer_engine,
+generate_images — through live agents with fake transports, plus a REAL
+stdio MCP server subprocess (the reference tests these with req_cassette
+record/replay and Hammox transport mocks; our seam is the injectable
+HttpFn / MCPManager)."""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from quoracle_tpu.agent import AgentConfig, AgentDeps, AgentSupervisor
+from quoracle_tpu.context.history import RESULT
+from quoracle_tpu.infra.http import FakeHttp, HttpResponse, check_ssrf, SSRFError
+from quoracle_tpu.infra.mcp import MCPManager
+from quoracle_tpu.models.images import ProceduralImageBackend
+from quoracle_tpu.models.runtime import MockBackend
+from quoracle_tpu.utils.html_md import html_to_markdown
+
+POOL = MockBackend.DEFAULT_POOL
+
+
+def j(action, params=None, wait=False):
+    return json.dumps({"action": action, "params": params or {},
+                       "reasoning": "t", "wait": wait})
+
+
+def scripted(*entries):
+    return MockBackend(scripts={m: list(entries) for m in POOL},
+                       respond=lambda r: j("wait", {}))
+
+
+async def until(cond, timeout=15.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return
+        await asyncio.sleep(0.01)
+    raise AssertionError("condition not met")
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def first_result(core):
+    return next(e for e in core.ctx.history(POOL[0]) if e.kind == RESULT)
+
+
+async def run_one_action(backend, **deps_over):
+    deps = AgentDeps.for_tests(backend, ssrf_check=False, **deps_over)
+    sup = AgentSupervisor(deps)
+    core = await sup.start_agent(AgentConfig(
+        agent_id="agent-w", task_id="t1", model_pool=list(POOL)))
+    core.post({"type": "user_message", "content": "go", "from": "user"})
+    await until(lambda: any(e.kind == RESULT
+                            for e in core.ctx.history(POOL[0])))
+    result = first_result(core)
+    await sup.terminate_agent("agent-w")
+    return core, result.as_text()
+
+
+# ---------------------------------------------------------------------------
+# html → markdown
+# ---------------------------------------------------------------------------
+
+def test_html_to_markdown():
+    html = """<html><head><title>x</title><script>evil()</script></head>
+    <body><h1>Title</h1><p>Hello <b>world</b>, see
+    <a href="https://x.example/doc">the doc</a>.</p>
+    <ul><li>alpha</li><li>beta</li></ul>
+    <pre><code>x = 1</code></pre></body></html>"""
+    md = html_to_markdown(html)
+    assert "# Title" in md
+    assert "**world**" in md
+    assert "[the doc](https://x.example/doc)" in md
+    assert "- alpha" in md and "- beta" in md
+    assert "x = 1" in md
+    assert "evil" not in md
+
+
+def test_ssrf_check_blocks_private():
+    with pytest.raises(SSRFError):
+        check_ssrf("http://127.0.0.1/admin")
+    with pytest.raises(SSRFError):
+        check_ssrf("ftp://example.com/x")
+
+
+# ---------------------------------------------------------------------------
+# fetch_web / call_api through a live agent
+# ---------------------------------------------------------------------------
+
+def test_fetch_web_converts_html_and_fences_output():
+    async def main():
+        http = FakeHttp({"https://site.example": (
+            200, "text/html",
+            "<h1>Doc</h1><p>body text <script>ignore()</script></p>")})
+        backend = scripted(
+            j("fetch_web", {"url": "https://site.example/page"}),
+            j("wait", {}))
+        core, text = await run_one_action(backend, http=http)
+        assert "# Doc" in text and "body text" in text
+        assert "ignore()" not in text
+        assert "NO_EXECUTE" in text            # untrusted output is fenced
+        assert http.requests[0]["url"] == "https://site.example/page"
+    run(main())
+
+
+def test_fetch_web_image_returns_base64():
+    async def main():
+        http = FakeHttp({"https://img.example": (
+            200, "image/png", b"\x89PNG fakebytes")})
+        backend = scripted(
+            j("fetch_web", {"url": "https://img.example/x.png"}),
+            j("wait", {}))
+        core, text = await run_one_action(backend, http=http)
+        assert "image_base64" in text
+        assert "image/png" in text
+    run(main())
+
+
+def test_call_api_jsonrpc_and_graphql():
+    async def main():
+        def rpc(url, method, headers, body):
+            req = json.loads(body)
+            assert req["jsonrpc"] == "2.0"
+            return (200, "application/json",
+                    json.dumps({"jsonrpc": "2.0", "id": req["id"],
+                                "result": {"sum": 42}}))
+        def gql(url, method, headers, body):
+            req = json.loads(body)
+            assert "query" in req
+            return (200, "application/json",
+                    json.dumps({"data": {"user": {"name": "ada"}}}))
+        http = FakeHttp({"https://rpc.example": rpc,
+                         "https://gql.example": gql})
+        backend = scripted(
+            j("call_api", {"url": "https://rpc.example", "method": "POST",
+                           "protocol": "jsonrpc",
+                           "body": {"method": "add", "params": [40, 2]}}),
+            j("call_api", {"url": "https://gql.example", "method": "POST",
+                           "protocol": "graphql",
+                           "body": {"query": "{user{name}}"},
+                           "auth": {"type": "bearer", "token": "tkn"}}),
+            j("wait", {}))
+        deps = AgentDeps.for_tests(backend, http=http, ssrf_check=False)
+        sup = AgentSupervisor(deps)
+        core = await sup.start_agent(AgentConfig(
+            agent_id="agent-w", task_id="t1", model_pool=list(POOL)))
+        core.post({"type": "user_message", "content": "go", "from": "user"})
+        await until(lambda: len([e for e in core.ctx.history(POOL[0])
+                                 if e.kind == RESULT]) >= 2)
+        texts = [e.as_text() for e in core.ctx.history(POOL[0])
+                 if e.kind == RESULT]
+        assert any('"sum": 42' in t for t in texts)
+        assert any('"name": "ada"' in t for t in texts)
+        # bearer auth header was built
+        assert any(r["headers"].get("Authorization") == "Bearer tkn"
+                   for r in http.requests)
+        await sup.terminate_agent("agent-w")
+    run(main())
+
+
+def test_call_api_http_error_status():
+    async def main():
+        http = FakeHttp({"https://api.example": (500, "text/plain", "boom")})
+        backend = scripted(
+            j("call_api", {"url": "https://api.example", "method": "GET"}),
+            j("wait", {}))
+        core, text = await run_one_action(backend, http=http)
+        assert '"status": "error"' in text and "HTTP 500" in text
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# call_mcp against a REAL stdio MCP server subprocess
+# ---------------------------------------------------------------------------
+
+MCP_SERVER = r'''
+import json, sys
+tools = [{"name": "adder", "description": "adds a and b",
+          "inputSchema": {"type": "object"}}]
+for line in sys.stdin:
+    msg = json.loads(line)
+    mid = msg.get("id")
+    method = msg.get("method")
+    if mid is None:
+        continue  # notification
+    if method == "initialize":
+        result = {"protocolVersion": msg["params"]["protocolVersion"],
+                  "capabilities": {"tools": {}},
+                  "serverInfo": {"name": "testsrv", "version": "0"}}
+    elif method == "tools/list":
+        result = {"tools": tools}
+    elif method == "tools/call":
+        args = msg["params"]["arguments"]
+        result = {"content": [{"type": "text",
+                               "text": str(args["a"] + args["b"])}]}
+    else:
+        result = {}
+    sys.stdout.write(json.dumps({"jsonrpc": "2.0", "id": mid,
+                                 "result": result}) + "\n")
+    sys.stdout.flush()
+'''
+
+
+def test_call_mcp_stdio_end_to_end(tmp_path):
+    async def main():
+        server_py = tmp_path / "mcp_server.py"
+        server_py.write_text(MCP_SERVER)
+        mcp = MCPManager({"calc": {"transport": "stdio",
+                                   "command": [sys.executable,
+                                               str(server_py)]}})
+        tools = await mcp.list_tools("calc")
+        assert tools[0]["name"] == "adder"
+        backend = scripted(
+            j("call_mcp", {"server": "calc", "tool": "adder",
+                           "arguments": {"a": 19, "b": 23}}),
+            j("wait", {}))
+        core, text = await run_one_action(backend, mcp=mcp)
+        assert '"content": "42"' in text
+        assert "NO_EXECUTE" in text
+        # unknown server surfaces as an action error
+        backend2 = scripted(
+            j("call_mcp", {"server": "nope", "tool": "x"}), j("wait", {}))
+        core2, text2 = await run_one_action(backend2, mcp=mcp)
+        assert "unknown MCP server" in text2
+        await mcp.close()
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# answer_engine / generate_images
+# ---------------------------------------------------------------------------
+
+def test_answer_engine_uses_designated_model():
+    async def main():
+        def respond(r):
+            joined = "\n".join(str(m.get("content", ""))
+                               for m in r.messages)
+            if "Answer the question" in joined:     # the grounding query
+                return "The answer is 4."
+            if '"answer"' in joined:                # result seen: idle
+                return j("wait", {})
+            return j("answer_engine", {"query": "what is 2+2?"})
+        backend = MockBackend(respond=respond)
+        core, text = await run_one_action(backend)
+        assert "The answer is 4." in text
+        assert "NO_EXECUTE" in text            # grounded answers are fenced
+    run(main())
+
+
+def test_generate_images_procedural(tmp_path):
+    async def main():
+        backend = scripted(
+            j("generate_images", {"prompt": "a red square", "count": 2,
+                                  "size": "32x32"}),
+            j("wait", {}))
+        deps = AgentDeps.for_tests(backend, images=ProceduralImageBackend())
+        sup = AgentSupervisor(deps)
+        core = await sup.start_agent(AgentConfig(
+            agent_id="agent-w", task_id="t1", model_pool=list(POOL),
+            working_dir=str(tmp_path)))
+        core.post({"type": "user_message", "content": "go", "from": "user"})
+        await until(lambda: any(e.kind == RESULT
+                                for e in core.ctx.history(POOL[0])))
+        result = first_result(core).content["result"]
+        assert result["status"] == "ok"
+        assert len(result["images"]) == 2
+        for img in result["images"]:
+            assert os.path.isfile(img["path"])
+            with open(img["path"], "rb") as f:
+                assert f.read(8) == b"\x89PNG\r\n\x1a\n"
+        await sup.terminate_agent("agent-w")
+    run(main())
+
+
+def test_zero_egress_mode_fails_cleanly():
+    async def main():
+        backend = scripted(
+            j("fetch_web", {"url": "https://x.example"}), j("wait", {}))
+        core, text = await run_one_action(backend, http=None)
+        assert "zero-egress" in text
+    run(main())
