@@ -68,8 +68,11 @@ def _default_transport():
 async def fetch_web_action(core, router, params: dict) -> dict:
     url = params["url"]
     if core.deps.ssrf_check:
+        # Off-loop: the guard resolves DNS, which must never block the
+        # runtime loop. The default transport re-checks redirect hops.
         try:
-            check_ssrf(url)
+            await asyncio.get_running_loop().run_in_executor(
+                None, check_ssrf, url)
         except SSRFError as e:
             raise ActionError(f"fetch_web blocked: {e}")
     resp = await _http(core, url,

@@ -57,13 +57,7 @@ async def cmd_run(args: argparse.Namespace) -> int:
                                model_pool=pool))
     _attach_printer(rt)
     if pool is None and args.profile is None:
-        # default pools per backend when neither --pool nor --profile names one
-        if args.backend == "tpu":
-            from quoracle_tpu.models.config import BENCH_POOL
-            pool = list(BENCH_POOL)
-        else:
-            from quoracle_tpu.models.runtime import MockBackend
-            pool = list(MockBackend.DEFAULT_POOL)
+        pool = rt.default_pool()
     task_id, root = await rt.tasks.create_task(
         args.description, model_pool=pool, profile=args.profile,
         budget=args.budget)
@@ -93,6 +87,29 @@ async def cmd_resume(args: argparse.Namespace) -> int:
     return 0
 
 
+async def cmd_serve(args: argparse.Namespace) -> int:
+    from quoracle_tpu.web import DashboardServer
+    rt = Runtime(RuntimeConfig(
+        db_path=args.db, backend=args.backend,
+        model_pool=args.pool.split(",") if args.pool else None))
+    _attach_printer(rt)
+    result = await rt.boot()
+    if result["revived"]:
+        print(f"revived tasks: {result['revived']}", flush=True)
+    server = await DashboardServer(rt, host=args.host,
+                                   port=args.port).start()
+    print(f"dashboard at {server.url}", flush=True)
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await server.stop()
+        await rt.shutdown()
+    return 0
+
+
 async def cmd_status(args: argparse.Namespace) -> int:
     rt = Runtime(RuntimeConfig(db_path=args.db))
     print(json.dumps(rt.status(), indent=2))
@@ -119,6 +136,12 @@ def build_parser() -> argparse.ArgumentParser:
     resp = sub.add_parser("resume", help="boot revival of persisted tasks")
     common(resp)
 
+    servep = sub.add_parser("serve", help="run the web dashboard")
+    servep.add_argument("--host", default="127.0.0.1")
+    servep.add_argument("--port", type=int, default=8400)
+    servep.add_argument("--pool", help="comma-separated model specs")
+    common(servep)
+
     statp = sub.add_parser("status", help="show tasks + agents")
     statp.add_argument("--db", default=":memory:")
     return p
@@ -127,7 +150,7 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
     handler = {"run": cmd_run, "resume": cmd_resume,
-               "status": cmd_status}[args.cmd]
+               "serve": cmd_serve, "status": cmd_status}[args.cmd]
     return asyncio.run(handler(args))
 
 

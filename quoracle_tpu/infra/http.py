@@ -83,9 +83,11 @@ def urllib_http(url: str, method: str = "GET",
                 timeout_s: float = DEFAULT_TIMEOUT_S,
                 verify_url: Optional[Callable[[str], None]] = None) -> HttpResponse:
     """Default transport. ``verify_url`` (e.g. check_ssrf) is applied to
-    every redirect target. Residual risk: DNS rebinding between the check's
-    resolution and urlopen's — acceptable for the reference-parity
-    'optional SSRF check' posture (reference web.ex:12-36)."""
+    the INITIAL url and to every redirect target. Residual risk: DNS
+    rebinding between the check's resolution and urlopen's — acceptable for
+    the reference-parity 'optional SSRF check' posture (web.ex:12-36)."""
+    if verify_url is not None:
+        verify_url(url)
     req = urllib.request.Request(url, data=body, method=method.upper())
     for k, v in (headers or {}).items():
         req.add_header(k, v)

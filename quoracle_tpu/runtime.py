@@ -112,6 +112,13 @@ class Runtime:
     def live_agents(self) -> list[str]:
         return self.supervisor.live_agents()
 
+    def default_pool(self) -> list[str]:
+        """The pool used when a task names neither pool nor profile: every
+        model the backend actually serves."""
+        if isinstance(self.backend, TPUBackend):
+            return list(self.backend.engines)
+        return list(MockBackend.DEFAULT_POOL)
+
     def list_groves(self) -> list:
         from quoracle_tpu.governance.grove import list_groves
         groves_dir = (self.config.groves_dir

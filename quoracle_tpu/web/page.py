@@ -1,0 +1,191 @@
+"""The dashboard single page: 3-panel layout parity with the reference
+(reference lib/quoracle_web/live/dashboard_live.ex + README.md:624 — task
+tree left, log viewer middle, mailbox right), rendered client-side from the
+JSON API and kept live by the /events SSE stream."""
+
+DASHBOARD_HTML = r"""<!doctype html>
+<html lang="en">
+<head>
+<meta charset="utf-8">
+<title>quoracle-tpu</title>
+<style>
+  :root { color-scheme: dark; }
+  * { box-sizing: border-box; }
+  body { margin: 0; font: 13px/1.5 ui-monospace, SFMono-Regular, Menlo,
+         monospace; background: #14161a; color: #d6d8dd; }
+  header { display: flex; align-items: center; gap: 16px;
+           padding: 10px 16px; border-bottom: 1px solid #2a2d33; }
+  header h1 { font-size: 14px; margin: 0; color: #fff; font-weight: 600; }
+  header .status { color: #8b8f98; }
+  main { display: grid; grid-template-columns: 300px 1fr 340px;
+         height: calc(100vh - 45px); }
+  section { overflow-y: auto; padding: 12px; border-right: 1px solid #2a2d33; }
+  section h2 { font-size: 11px; text-transform: uppercase; letter-spacing:
+               .08em; color: #8b8f98; margin: 0 0 8px; }
+  .task { padding: 6px 8px; border-radius: 6px; cursor: pointer;
+          margin-bottom: 4px; }
+  .task:hover, .task.sel { background: #20242b; }
+  .task .tid { color: #9ecbff; }
+  .task .st { float: right; color: #8b8f98; }
+  .agent { padding: 4px 8px; cursor: pointer; border-radius: 4px;
+           white-space: nowrap; overflow: hidden; text-overflow: ellipsis; }
+  .agent:hover, .agent.sel { background: #20242b; }
+  .agent .aid { color: #b7e3a8; }
+  .agent .meta { color: #8b8f98; }
+  .log { padding: 3px 0; border-bottom: 1px solid #1c1f24;
+         word-break: break-word; white-space: pre-wrap; }
+  .log .lvl-error { color: #ff9a9a; }
+  .log .lvl-warning { color: #ffd28a; }
+  .log .lvl-decision { color: #9ecbff; }
+  .log .ts { color: #5c6068; margin-right: 6px; }
+  .msg { padding: 6px 8px; margin-bottom: 6px; background: #1a1d22;
+         border-radius: 6px; }
+  .msg .from { color: #d9b8ff; }
+  form { display: flex; gap: 6px; margin-top: 10px; }
+  input, button, select { font: inherit; background: #1a1d22; color: #d6d8dd;
+          border: 1px solid #2a2d33; border-radius: 6px; padding: 6px 8px; }
+  input { flex: 1; }
+  button { cursor: pointer; }
+  button:hover { background: #242830; }
+  #newtask { margin-bottom: 12px; display: flex; flex-direction: column;
+             gap: 6px; }
+  #newtask input { width: 100%; }
+  .row { display: flex; gap: 6px; }
+</style>
+</head>
+<body>
+<header>
+  <h1>quoracle-tpu</h1>
+  <span class="status" id="status">connecting…</span>
+</header>
+<main>
+  <section id="left">
+    <div id="newtask">
+      <input id="nt-desc" placeholder="new task description">
+      <div class="row">
+        <input id="nt-budget" placeholder="budget (optional)" style="width:120px">
+        <button onclick="createTask()">create task</button>
+      </div>
+    </div>
+    <h2>Tasks</h2><div id="tasks"></div>
+    <h2 style="margin-top:14px">Agents</h2><div id="agents"></div>
+  </section>
+  <section id="mid">
+    <h2>Logs <span id="log-scope" class="meta"></span></h2>
+    <div id="logs"></div>
+  </section>
+  <section id="right" style="border-right:none">
+    <h2>Mailbox</h2>
+    <div id="messages"></div>
+    <form onsubmit="sendMessage(event)">
+      <input id="msg-input" placeholder="message selected agent…">
+      <button>send</button>
+    </form>
+  </section>
+</main>
+<script>
+let selTask = null, selAgent = null;
+const $ = id => document.getElementById(id);
+const esc = s => String(s ?? "").replace(/[&<>]/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
+
+async function api(path, opts) {
+  const r = await fetch(path, opts);
+  return r.json();
+}
+
+async function refreshTasks() {
+  const tasks = await api("/api/tasks");
+  $("tasks").innerHTML = tasks.map(t => `
+    <div class="task ${t.id===selTask?"sel":""}" onclick="selectTask('${t.id}')">
+      <span class="tid">${esc(t.id)}</span>
+      <span class="st">${esc(t.status)} · ${t.live_agents} live · $${esc(t.cost)}</span>
+      <div class="meta">${esc((t.task_fields||{}).description||"").slice(0,60)}</div>
+      <div class="row" style="margin-top:4px">
+        <button onclick="event.stopPropagation();taskOp('${t.id}','pause')">pause</button>
+        <button onclick="event.stopPropagation();taskOp('${t.id}','resume')">resume</button>
+      </div>
+    </div>`).join("");
+}
+
+async function refreshAgents() {
+  const qs = selTask ? "?task_id=" + selTask : "";
+  const agents = await api("/api/agents" + qs);
+  const byParent = {};
+  agents.forEach(a => (byParent[a.parent_id ?? ""] ??= []).push(a));
+  const render = (pid, depth) => (byParent[pid ?? ""] || []).map(a => `
+    <div class="agent ${a.agent_id===selAgent?"sel":""}"
+         style="padding-left:${8+depth*14}px"
+         onclick="selectAgent('${a.agent_id}')">
+      <span class="aid">${esc(a.agent_id)}</span>
+      <span class="meta"> ${esc(a.grove_node||a.profile||"")}
+        ${a.pending_actions ? "⚙" : ""} $${esc(a.cost)}</span>
+    </div>` + render(a.agent_id, depth + 1)).join("");
+  $("agents").innerHTML = render("", 0);
+}
+
+async function refreshLogs() {
+  const qs = selAgent ? "?agent_id=" + selAgent : "";
+  const logs = await api("/api/logs" + qs);
+  $("log-scope").textContent = selAgent || "(all)";
+  $("logs").innerHTML = logs.map(l => `
+    <div class="log"><span class="ts">${new Date(l.ts*1000)
+      .toLocaleTimeString()}</span><span class="lvl-${esc(l.level)}">
+      [${esc(l.level)}]</span> ${esc(l.agent_id)}: ${esc(l.message)}
+      ${l.data && l.data !== "{}" ? esc(l.data).slice(0, 400) : ""}</div>`)
+    .join("");
+  $("logs").scrollTop = $("logs").scrollHeight;
+}
+
+async function refreshMessages() {
+  const qs = selTask ? "?task_id=" + selTask : "";
+  const msgs = await api("/api/messages" + qs);
+  $("messages").innerHTML = msgs.map(m => `
+    <div class="msg"><span class="from">${esc(m.sender)}</span>
+      <span class="meta">→ ${esc(m.targets)}</span>
+      <div>${esc(m.content).slice(0, 500)}</div></div>`).join("");
+}
+
+function selectTask(id) { selTask = id; refreshAll(); }
+function selectAgent(id) { selAgent = id; refreshLogs(); }
+
+async function taskOp(id, op) { await api(`/api/tasks/${id}/${op}`,
+  {method: "POST"}); refreshAll(); }
+
+async function createTask() {
+  const body = {description: $("nt-desc").value};
+  const budget = $("nt-budget").value;
+  if (budget) body.budget = budget;
+  await api("/api/tasks", {method: "POST",
+    headers: {"content-type": "application/json"},
+    body: JSON.stringify(body)});
+  $("nt-desc").value = "";
+  refreshAll();
+}
+
+async function sendMessage(ev) {
+  ev.preventDefault();
+  if (!selAgent) return alert("select an agent first");
+  await api("/api/messages", {method: "POST",
+    headers: {"content-type": "application/json"},
+    body: JSON.stringify({agent_id: selAgent,
+                          content: $("msg-input").value})});
+  $("msg-input").value = "";
+}
+
+function refreshAll() { refreshTasks(); refreshAgents(); refreshLogs();
+                        refreshMessages(); }
+
+const es = new EventSource("/events");
+es.onopen = () => $("status").textContent = "live";
+es.onerror = () => $("status").textContent = "reconnecting…";
+let pending = null;
+es.onmessage = () => {        // debounce bursts into one refresh
+  if (pending) return;
+  pending = setTimeout(() => { pending = null; refreshAll(); }, 250);
+};
+refreshAll();
+</script>
+</body>
+</html>
+"""

@@ -1,0 +1,272 @@
+"""Dashboard HTTP server: JSON API + SSE event stream + the SPA page.
+
+Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
+  GET  /                    dashboard page (3-panel parity)
+  GET  /healthz             health check (reference HealthController)
+  GET  /events              SSE: every bus broadcast as one JSON event
+  GET  /api/status          runtime summary
+  GET  /api/tasks           tasks + live agent counts
+  GET  /api/agents?task_id  agent tree with budget/cost/todo state
+  GET  /api/logs?agent_id   durable logs (newest last)
+  GET  /api/messages?task_id  task mailbox
+  POST /api/tasks           {description?, model_pool?, profile?, budget?, grove?}
+  POST /api/tasks/<id>/pause | /resume
+  POST /api/messages        {agent_id, content} → user message to an agent
+
+The server runs in its own thread (stdlib ThreadingHTTPServer); mutating
+calls bridge into the runtime's asyncio loop with run_coroutine_threadsafe —
+the dashboard never touches agent state off-loop.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import queue
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Optional
+
+from quoracle_tpu.web.page import DASHBOARD_HTML
+
+logger = logging.getLogger(__name__)
+
+API_CALL_TIMEOUT_S = 60.0
+
+
+class DashboardServer:
+    def __init__(self, runtime: Any, host: str = "127.0.0.1",
+                 port: int = 8400):
+        self.runtime = runtime
+        self.host = host
+        self.port = port
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+
+    # ------------------------------------------------------------------
+
+    async def start(self) -> "DashboardServer":
+        self._loop = asyncio.get_running_loop()
+        server = self
+
+        class Handler(_Handler):
+            dashboard = server
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]   # resolve port 0
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        name="dashboard-http", daemon=True)
+        self._thread.start()
+        return self
+
+    async def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    # -- bridged runtime calls (run on the asyncio loop) ----------------
+
+    def call_async(self, coro) -> Any:
+        assert self._loop is not None
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        return fut.result(timeout=API_CALL_TIMEOUT_S)
+
+    def post_to_agent(self, agent_id: str, msg: dict) -> bool:
+        reg = self.runtime.registry.lookup(agent_id)
+        if reg is None:
+            return False
+        reg.core.post(msg)
+        return True
+
+    # -- read-model builders (thread-safe reads) ------------------------
+
+    def tasks_payload(self) -> list[dict]:
+        out = []
+        for t in self.runtime.store.list_tasks():
+            live = self.runtime.registry.agents_for_task(t["id"])
+            out.append({**t, "live_agents": len(live),
+                        "cost": str(self.runtime.store.costs_for_task(t["id"]))})
+        return out
+
+    def agents_payload(self, task_id: Optional[str]) -> list[dict]:
+        regs = (self.runtime.registry.agents_for_task(task_id)
+                if task_id else self.runtime.registry.all())
+        out = []
+        for reg in regs:
+            core = reg.core
+            try:
+                budget = self.runtime.escrow.get(reg.agent_id).snapshot()
+            except KeyError:
+                budget = None
+            out.append({
+                "agent_id": reg.agent_id,
+                "parent_id": reg.parent_id,
+                "task_id": reg.task_id,
+                "profile": core.config.profile,
+                "grove_node": core.config.grove_node,
+                "dismissing": reg.dismissing,
+                "children": [c["agent_id"] for c in core.children],
+                "todos": core.ctx.todos,
+                "active_skills": list(core.active_skills),
+                "pending_actions": len(core.pending_actions),
+                "budget": budget,
+                "cost": str(self.runtime.costs.total_for(reg.agent_id)),
+            })
+        return out
+
+    def logs_payload(self, agent_id: Optional[str], limit: int = 200) -> list[dict]:
+        rows = self.runtime.db.query(
+            "SELECT * FROM logs WHERE (?1 IS NULL OR agent_id=?1) "
+            "ORDER BY id DESC LIMIT ?2", (agent_id, limit))
+        return [dict(r) for r in reversed(rows)]
+
+    def messages_payload(self, task_id: Optional[str],
+                         limit: int = 100) -> list[dict]:
+        rows = self.runtime.db.query(
+            "SELECT * FROM messages WHERE (?1 IS NULL OR task_id=?1) "
+            "ORDER BY id DESC LIMIT ?2", (task_id, limit))
+        return [dict(r) for r in reversed(rows)]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    dashboard: DashboardServer = None  # bound by DashboardServer.start
+
+    # -- plumbing -------------------------------------------------------
+
+    def log_message(self, fmt, *args):          # quiet access log
+        logger.debug("dashboard: " + fmt, *args)
+
+    def _send_json(self, payload: Any, status: int = 200) -> None:
+        body = json.dumps(payload, default=str).encode()
+        self.send_response(status)
+        self.send_header("content-type", "application/json")
+        self.send_header("content-length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _read_body(self) -> dict:
+        length = int(self.headers.get("content-length") or 0)
+        if not length:
+            return {}
+        try:
+            return json.loads(self.rfile.read(length))
+        except json.JSONDecodeError:
+            return {}
+
+    # -- GET ------------------------------------------------------------
+
+    def do_GET(self) -> None:       # noqa: N802 (stdlib API)
+        parsed = urllib.parse.urlparse(self.path)
+        q = urllib.parse.parse_qs(parsed.query)
+        one = lambda k: (q.get(k) or [None])[0]
+        d = self.dashboard
+        try:
+            if parsed.path == "/":
+                body = DASHBOARD_HTML.encode()
+                self.send_response(200)
+                self.send_header("content-type", "text/html; charset=utf-8")
+                self.send_header("content-length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif parsed.path == "/healthz":
+                self._send_json({"status": "ok"})
+            elif parsed.path == "/api/status":
+                self._send_json(d.runtime.status())
+            elif parsed.path == "/api/tasks":
+                self._send_json(d.tasks_payload())
+            elif parsed.path == "/api/agents":
+                self._send_json(d.agents_payload(one("task_id")))
+            elif parsed.path == "/api/logs":
+                self._send_json(d.logs_payload(one("agent_id")))
+            elif parsed.path == "/api/messages":
+                self._send_json(d.messages_payload(one("task_id")))
+            elif parsed.path == "/events":
+                self._stream_events()
+            else:
+                self._send_json({"error": "not found"}, 404)
+        except BrokenPipeError:
+            pass
+        except Exception as e:
+            logger.exception("dashboard GET %s failed", self.path)
+            try:
+                self._send_json({"error": str(e)}, 500)
+            except Exception:
+                pass
+
+    def _stream_events(self) -> None:
+        """SSE: a plain thread-safe queue subscribed to every bus topic —
+        broadcasts arrive from the runtime loop or executor threads alike."""
+        d = self.dashboard
+        events: queue.Queue = queue.Queue(maxsize=1000)
+
+        def push(topic: str, event: dict) -> None:
+            try:
+                events.put_nowait({"topic": topic, **event})
+            except queue.Full:
+                pass                      # slow browser: drop, don't block
+
+        sub = d.runtime.bus.subscribe("*", push)
+        try:
+            self.send_response(200)
+            self.send_header("content-type", "text/event-stream")
+            self.send_header("cache-control", "no-cache")
+            self.end_headers()
+            while True:
+                try:
+                    event = events.get(timeout=15.0)
+                    data = json.dumps(event, default=str)
+                    self.wfile.write(f"data: {data}\n\n".encode())
+                except queue.Empty:
+                    self.wfile.write(b": heartbeat\n\n")
+                self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            sub.unsubscribe()
+
+    # -- POST -----------------------------------------------------------
+
+    def do_POST(self) -> None:      # noqa: N802 (stdlib API)
+        d = self.dashboard
+        body = self._read_body()
+        try:
+            if self.path == "/api/tasks":
+                pool = body.get("model_pool")
+                if pool is None and body.get("profile") is None:
+                    pool = d.runtime.default_pool()   # UI sends only text
+                task_id, root = d.call_async(d.runtime.tasks.create_task(
+                    body.get("description"),
+                    model_pool=pool,
+                    profile=body.get("profile"),
+                    budget=body.get("budget"),
+                    grove=body.get("grove")))
+                self._send_json({"task_id": task_id,
+                                 "root_agent": root.agent_id}, 201)
+            elif self.path.startswith("/api/tasks/") \
+                    and self.path.endswith("/pause"):
+                task_id = self.path.split("/")[3]
+                stopped = d.call_async(d.runtime.tasks.pause_task(task_id))
+                self._send_json({"task_id": task_id, "stopped": stopped})
+            elif self.path.startswith("/api/tasks/") \
+                    and self.path.endswith("/resume"):
+                task_id = self.path.split("/")[3]
+                restored = d.call_async(d.runtime.tasks.restore_task(task_id))
+                self._send_json({"task_id": task_id, "restored": restored})
+            elif self.path == "/api/messages":
+                ok = d.post_to_agent(body.get("agent_id", ""), {
+                    "type": "user_message",
+                    "content": body.get("content", ""), "from": "user"})
+                self._send_json({"delivered": ok}, 200 if ok else 404)
+            else:
+                self._send_json({"error": "not found"}, 404)
+        except Exception as e:
+            logger.exception("dashboard POST %s failed", self.path)
+            self._send_json({"error": str(e)}, 500)

@@ -1,0 +1,162 @@
+"""Dashboard server: JSON API, SSE stream, mutations through the bridge.
+
+The reference tests LiveView with Phoenix.LiveViewTest; here the dashboard
+is plain HTTP, so the tests drive it with urllib from executor threads
+against a live Runtime — covering exactly what a browser would do."""
+
+import asyncio
+import json
+import time
+import urllib.request
+
+from quoracle_tpu.models.runtime import MockBackend
+from quoracle_tpu.runtime import Runtime, RuntimeConfig
+from quoracle_tpu.web import DashboardServer
+
+POOL = MockBackend.DEFAULT_POOL
+
+
+def j(action, params=None, wait=False):
+    return json.dumps({"action": action, "params": params or {},
+                       "reasoning": "t", "wait": wait})
+
+
+async def http_json(url, method="GET", body=None):
+    def call():
+        req = urllib.request.Request(
+            url, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"content-type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return resp.status, json.loads(resp.read())
+    return await asyncio.get_running_loop().run_in_executor(None, call)
+
+
+async def until(cond, timeout=15.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError("condition not met")
+
+
+def test_dashboard_full_api_flow():
+    async def main():
+        def respond(r):
+            joined = "\n".join(str(m.get("content", "")) for m in r.messages)
+            if "poke-from-ui" in joined:
+                return j("todo", {"items": [{"task": "ui-poked"}]})
+            return j("wait", {})
+        rt = Runtime(RuntimeConfig(), backend=MockBackend(respond=respond))
+        server = await DashboardServer(rt, port=0).start()
+        base = server.url
+        try:
+            # health + page + empty status
+            status, health = await http_json(base + "/healthz")
+            assert health == {"status": "ok"}
+            page = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: urllib.request.urlopen(base + "/",
+                                                     timeout=10).read())
+            assert b"quoracle-tpu" in page and b"EventSource" in page
+
+            # create a task through the API
+            status, created = await http_json(
+                base + "/api/tasks", "POST",
+                {"description": "dashboard driven task",
+                 "model_pool": list(POOL)})
+            assert status == 201
+            task_id, root_id = created["task_id"], created["root_agent"]
+
+            # tasks + agents read models reflect it
+            _, tasks = await http_json(base + "/api/tasks")
+            assert tasks[0]["id"] == task_id
+            assert tasks[0]["status"] == "running"
+            _, agents = await http_json(
+                base + f"/api/agents?task_id={task_id}")
+            assert agents[0]["agent_id"] == root_id
+
+            # message an agent from the mailbox form
+            status, sent = await http_json(
+                base + "/api/messages", "POST",
+                {"agent_id": root_id, "content": "poke-from-ui"})
+            assert sent["delivered"]
+            root = rt.registry.lookup(root_id).core
+            await until(lambda: root.ctx.todos == [{"task": "ui-poked"}])
+
+            # durable logs are served
+            _, logs = await http_json(base + f"/api/logs?agent_id={root_id}")
+            assert logs
+
+            # pause via the API
+            status, paused = await http_json(
+                base + f"/api/tasks/{task_id}/pause", "POST")
+            assert paused["stopped"] >= 1
+            _, tasks = await http_json(base + "/api/tasks")
+            assert tasks[0]["status"] == "paused"
+            assert tasks[0]["live_agents"] == 0
+
+            # resume via the API
+            status, resumed = await http_json(
+                base + f"/api/tasks/{task_id}/resume", "POST")
+            assert resumed["restored"] == 1
+            _, agents = await http_json(
+                base + f"/api/agents?task_id={task_id}")
+            assert agents and agents[0]["agent_id"] == root_id
+            await rt.tasks.pause_task(task_id)
+        finally:
+            await server.stop()
+            rt.close()
+    asyncio.run(asyncio.wait_for(main(), 90))
+
+
+def test_dashboard_create_task_without_pool_uses_backend_default():
+    async def main():
+        rt = Runtime(RuntimeConfig(),
+                     backend=MockBackend(respond=lambda r: j("wait", {})))
+        server = await DashboardServer(rt, port=0).start()
+        try:
+            # exactly what the SPA form sends: description only
+            status, created = await http_json(
+                server.url + "/api/tasks", "POST",
+                {"description": "ui minimal task"})
+            assert status == 201
+            root = rt.registry.lookup(created["root_agent"]).core
+            assert root.config.model_pool == list(POOL)
+            await rt.tasks.pause_task(created["task_id"])
+        finally:
+            await server.stop()
+            rt.close()
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_dashboard_sse_stream_delivers_events():
+    async def main():
+        rt = Runtime(RuntimeConfig(),
+                     backend=MockBackend(respond=lambda r: j("wait", {})))
+        server = await DashboardServer(rt, port=0).start()
+        try:
+            chunks: list[bytes] = []
+
+            def read_sse():
+                req = urllib.request.Request(server.url + "/events")
+                with urllib.request.urlopen(req, timeout=20) as resp:
+                    # read a handful of lines then disconnect
+                    for _ in range(6):
+                        line = resp.readline()
+                        if line:
+                            chunks.append(line)
+
+            reader = asyncio.get_running_loop().run_in_executor(None, read_sse)
+            await asyncio.sleep(0.2)       # let the subscription attach
+            task_id, root = await rt.tasks.create_task(
+                "sse probe", model_pool=list(POOL))
+            await asyncio.wait_for(reader, 20)
+            payloads = [json.loads(c[6:]) for c in chunks
+                        if c.startswith(b"data: ")]
+            assert any(p.get("event") == "agent_spawned" for p in payloads)
+            await rt.tasks.pause_task(task_id)
+        finally:
+            await server.stop()
+            rt.close()
+    asyncio.run(asyncio.wait_for(main(), 60))
