@@ -124,15 +124,23 @@ def get_tokenizer(model_name: str, tokenizer_path: str | None = None) -> Tokeniz
     from quoracle_tpu.models.config import get_model_config
     try:
         cfg = get_model_config(model_name)
-        bos, eos = cfg.bos_token_id, cfg.eos_token_id
+        bos, eos, vocab = cfg.bos_token_id, cfg.eos_token_id, cfg.vocab_size
     except KeyError:
-        bos, eos = BOS_ID, EOS_ID
+        bos, eos, vocab = BOS_ID, EOS_ID, 32768
     if tokenizer_path:
         return HFTokenizer(tokenizer_path, bos_id=bos, eos_id=eos)
     tok: Tokenizer
     try:
-        from quoracle_tpu.native.tokenizer import NativeBPETokenizer, native_available
-        tok = NativeBPETokenizer.byte_level() if native_available() else ByteTokenizer()
+        # Learned byte-level BPE sized to the model's vocab (tiny test
+        # models get the byte-only prefix). Both the C++ and the Python
+        # implementation read the same committed merges artifact.
+        from quoracle_tpu.native.tokenizer import NativeBPETokenizer
+        import os
+        from quoracle_tpu.native.tokenizer import MERGES_PATH
+        if os.path.isfile(MERGES_PATH):
+            tok = NativeBPETokenizer.for_vocab(vocab)
+        else:
+            tok = ByteTokenizer()
     except ImportError:
         tok = ByteTokenizer()
     tok.bos_id, tok.eos_id = bos, eos

@@ -1,0 +1,53 @@
+"""Native (C++) components + their pure-Python fallbacks.
+
+The reference's native deps are a Rust tiktoken NIF for token counting and
+libvips for image preprocessing (reference SURVEY.md §2.8,
+lib/quoracle/agent/token_manager.ex:19-24, utils/image_compressor.ex). Here:
+
+* bpe.cpp          — byte-level BPE encoder/decoder/counter (C API, built
+                     on demand with g++ into a cached shared object)
+* tokenizer.py     — ctypes binding + identical pure-Python fallback
+* train_bpe.py     — deterministic BPE training on the repo's own text
+* bpe_merges.txt   — the committed merges artifact (one file; models with
+                     smaller vocabs use a rank-prefix of it)
+* image.cpp/image.py — image decode/resize preprocessing (vision inputs)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence
+
+logger = logging.getLogger(__name__)
+
+_build_lock = threading.Lock()
+
+
+def build_and_load(src_path: str, so_path: str,
+                   extra_flags: Sequence[str] = ()) -> Optional[ctypes.CDLL]:
+    """Compile a single-file C++ shared object on demand (mtime-cached) and
+    dlopen it. Returns None when no compiler is available — callers fall
+    back to their pure-Python implementation."""
+    with _build_lock:
+        fresh = (os.path.isfile(so_path) and
+                 os.path.getmtime(so_path) >= os.path.getmtime(src_path))
+        if not fresh:
+            try:
+                subprocess.run(
+                    ["g++", "-O2", "-shared", "-fPIC", "-o",
+                     so_path + ".tmp", src_path, *extra_flags],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(so_path + ".tmp", so_path)
+            except (OSError, subprocess.SubprocessError) as e:
+                logger.warning("native build of %s failed (%s); using the "
+                               "Python fallback", os.path.basename(src_path),
+                               e)
+                return None
+    try:
+        return ctypes.CDLL(so_path)
+    except OSError:
+        return None

@@ -1,0 +1,173 @@
+"""Deterministic byte-level BPE training.
+
+Replaces the reference's dependence on a fixed external vocabulary
+(tiktoken cl100k, reference token_manager.ex:19-24) with merges learned
+from the text this framework actually tokenizes: its own documentation,
+source, system prompts, and action JSON. Training is deterministic (stable
+tie-breaks), runs once at build time, and commits its artifact
+(bpe_merges.txt); every served model uses a rank-prefix of the same merge
+list sized to its vocab (a BPE merge list is prefix-coherent: the first N
+merges are themselves a valid smaller vocabulary).
+
+Run:  python -m quoracle_tpu.native.train_bpe [--merges 16000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import os
+
+N_SPECIALS = 3          # PAD/BOS/EOS — must match models/tokenizer.py
+BYTE_BASE = N_SPECIALS  # byte b → id b + BYTE_BASE
+FIRST_MERGE_ID = BYTE_BASE + 256
+MAX_WORD_LEN = 128
+
+
+def pre_split(text: str) -> list[bytes]:
+    """Split text into merge units: a run of whitespace binds to the word
+    that follows it (GPT-2 style ' word' units) so merges never cross word
+    boundaries. Long runs are capped so pathological inputs stay O(n)."""
+    words: list[bytes] = []
+    data = text.encode("utf-8")
+    start = 0
+    in_space = True
+    for i, b in enumerate(data):
+        is_space = b in (0x20, 0x09, 0x0A, 0x0D)
+        if is_space and not in_space:
+            words.append(data[start:i])
+            start = i
+        elif b == 0x0A:                      # newline always closes a unit
+            words.append(data[start:i + 1])
+            start = i + 1
+            in_space = True
+            continue
+        if i - start >= MAX_WORD_LEN:
+            words.append(data[start:i])
+            start = i
+        in_space = is_space
+    if start < len(data):
+        words.append(data[start:])
+    return [w for w in words if w]
+
+
+def train(corpus: str, n_merges: int) -> list[tuple[int, int]]:
+    """Classic BPE on a word histogram with incremental pair-count updates
+    (re-counting every pair per merge is O(corpus × merges) — minutes at
+    16k merges; touching only words containing the merged pair is seconds).
+    Ties break on (count desc, pair asc) for determinism."""
+    word_freq = collections.Counter(pre_split(corpus))
+    seqs = [[b + BYTE_BASE for b in w] for w in word_freq]
+    freqs = list(word_freq.values())
+
+    pair_counts: collections.Counter = collections.Counter()
+    where: dict[tuple[int, int], set[int]] = collections.defaultdict(set)
+    for wi, seq in enumerate(seqs):
+        for pair in zip(seq, seq[1:]):
+            pair_counts[pair] += freqs[wi]
+            where[pair].add(wi)
+
+    merges: list[tuple[int, int]] = []
+    next_id = FIRST_MERGE_ID
+    for _ in range(n_merges):
+        if not pair_counts:
+            break
+        best = min(pair_counts.items(), key=lambda kv: (-kv[1], kv[0]))[0]
+        if pair_counts[best] < 2:
+            break
+        merges.append(best)
+        a, b = best
+        for wi in list(where.get(best, ())):
+            seq, freq = seqs[wi], freqs[wi]
+            # remove this word's old pair contributions
+            for pair in zip(seq, seq[1:]):
+                pair_counts[pair] -= freq
+                if pair_counts[pair] <= 0:
+                    del pair_counts[pair]
+                s = where.get(pair)
+                if s is not None:
+                    s.discard(wi)
+            out = []
+            i = 0
+            while i < len(seq):
+                if i + 1 < len(seq) and seq[i] == a and seq[i + 1] == b:
+                    out.append(next_id)
+                    i += 2
+                else:
+                    out.append(seq[i])
+                    i += 1
+            seqs[wi] = out
+            # add the new contributions back
+            for pair in zip(out, out[1:]):
+                pair_counts[pair] += freq
+                where[pair].add(wi)
+        next_id += 1
+    return merges
+
+
+def build_corpus(repo_root: str) -> str:
+    """The text this framework tokenizes in production: docs (markdown +
+    English), source (python), prompts, and action JSON."""
+    parts: list[str] = []
+    for name in ("SURVEY.md", "README.md", "PAPERS.md", "BASELINE.md"):
+        p = os.path.join(repo_root, name)
+        if os.path.isfile(p):
+            with open(p, errors="replace") as f:
+                parts.append(f.read())
+    for dirpath, _dirs, files in os.walk(
+            os.path.join(repo_root, "quoracle_tpu")):
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                with open(os.path.join(dirpath, fn), errors="replace") as f:
+                    parts.append(f.read())
+    # runtime-shaped text: the full system prompt + example action JSON
+    from quoracle_tpu.consensus.prompt_builder import build_system_prompt
+    parts.append(build_system_prompt() * 3)      # weight the hottest text
+    import json
+    from quoracle_tpu.actions.schema import ACTIONS
+    for schema in ACTIONS.values():
+        parts.append(json.dumps({
+            "action": schema.name,
+            "params": {p: f"example {p}" for p in schema.params},
+            "reasoning": "example reasoning for this decision",
+            "wait": False}))
+    return "\n".join(parts)
+
+
+def save_merges(merges: list[tuple[int, int]], path: str) -> None:
+    with open(path, "w") as f:
+        f.write("# quoracle-tpu byte-level BPE merges "
+                "(rank = line order; id = 259 + rank)\n")
+        for a, b in merges:
+            f.write(f"{a} {b}\n")
+
+
+def load_merges(path: str) -> list[tuple[int, int]]:
+    merges = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            a, b = line.split()
+            merges.append((int(a), int(b)))
+    return merges
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--merges", type=int, default=16000)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(__file__), "bpe_merges.txt"))
+    args = ap.parse_args()
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    corpus = build_corpus(repo_root)
+    print(f"corpus: {len(corpus):,} chars")
+    merges = train(corpus, args.merges)
+    save_merges(merges, args.out)
+    print(f"trained {len(merges):,} merges → {args.out}")
+
+
+if __name__ == "__main__":
+    main()
