@@ -155,9 +155,12 @@ def forward_hidden(
         k_buf = jax.vmap(write_row)(k_buf, k, write_offset)
         v_buf = jax.vmap(write_row)(v_buf, v, write_offset)
 
-        attn = attend(q, k_buf, v_buf, positions,
-                      kv_len=kv_lens,
-                      sliding_window=cfg.sliding_window)
+        # attend_auto: pallas flash kernel for long prefill chunks on TPU,
+        # dense fused XLA otherwise (decode steps, CPU tests).
+        from quoracle_tpu.ops.flash_attention import attend_auto
+        attn = attend_auto(q, k_buf, v_buf, positions,
+                           kv_len=kv_lens,
+                           sliding_window=cfg.sliding_window)
         x = x + jnp.einsum("bthd,hdD->btD", attn,
                            p["wo"].reshape(cfg.n_heads, cfg.head_dim, cfg.dim))
 

@@ -1,0 +1,166 @@
+"""Pallas flash attention (blockwise online-softmax) for TPU prefill.
+
+The XLA `attend` path materializes [B, H, T, S] scores in HBM; this kernel
+streams KV blocks through VMEM with running (max, denom, acc) statistics so
+the memory high-water is O(TQ x TK) per core — the standard flash recipe
+mapped to the TPU constraints of /opt/skills/guides/pallas_guide.md (grid
+over (batch, head, q-block), MXU contractions with
+preferred_element_type=f32, VPU mask/softmax chain, lane dim 128).
+
+Semantics match ops/attention.attend exactly (same masking: validity by
+kv_len, causality by absolute position, optional sliding window) and the
+tests assert numerical agreement. Off-TPU the kernel runs in interpreter
+mode — correct but slow — so production callers gate on platform
+(attend_auto below).
+
+No reference counterpart: the reference never executes attention
+(SURVEY.md §2.8 — all inference was remote HTTPS).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from quoracle_tpu.ops.attention import attend
+
+DEFAULT_TQ = 128
+DEFAULT_TK = 128
+NEG_INF = -1e30
+
+
+def _flash_kernel(kv_len_ref, qpos_ref, q_ref, k_ref, v_ref, o_ref, *,
+                  tk: int, scale: float, sliding_window: Optional[int]):
+    """One (batch, head, q-block) program: stream KV in tk-sized blocks.
+
+    Block shapes (leading singleton dims dropped by indexing):
+      q_ref [1, 1, TQ, hd]   k_ref/v_ref [1, 1, S, hd]
+      qpos_ref [1, TQ] (VMEM) kv_len_ref [1] (SMEM)  o_ref [1, 1, TQ, hd]
+    """
+    q = q_ref[0, 0].astype(jnp.float32) * scale          # [TQ, hd]
+    tq, hd = q.shape
+    s = k_ref.shape[2]
+    kv_len = kv_len_ref[pl.program_id(0)]                 # this batch row
+    q_pos = qpos_ref[0].astype(jnp.int32)                 # [TQ]
+
+    def body(i, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, 0, pl.ds(i * tk, tk), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(i * tk, tk), :].astype(jnp.float32)
+        scores = jax.lax.dot_general(                     # [TQ, tk] on MXU
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        kv_idx = i * tk + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        qp = q_pos[:, None]
+        mask = (kv_idx < kv_len) & (kv_idx <= qp)
+        if sliding_window is not None:
+            mask &= qp - kv_idx < sliding_window
+        scores = jnp.where(mask, scores, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=1, keepdims=True))
+        p = jnp.exp(scores - m_new)                       # [TQ, tk]
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = acc * correction + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((tq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((tq, 1), jnp.float32)
+    acc0 = jnp.zeros((tq, hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, s // tk, body, (m0, l0, acc0))
+    # fully-masked rows (query padding) produce l == 0 → emit zeros
+    out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+def _pad_to(x: jax.Array, axis: int, multiple: int,
+            value: float = 0.0) -> jax.Array:
+    size = x.shape[axis]
+    target = ((size + multiple - 1) // multiple) * multiple
+    if target == size:
+        return x
+    pad = [(0, 0)] * x.ndim
+    pad[axis] = (0, target - size)
+    return jnp.pad(x, pad, constant_values=value)
+
+
+@functools.partial(jax.jit, static_argnames=("sliding_window", "tq", "tk",
+                                             "interpret"))
+def flash_attend(
+    q: jax.Array,            # [B, T, n_heads, hd]
+    k: jax.Array,            # [B, S, n_kv, hd]
+    v: jax.Array,            # [B, S, n_kv, hd]
+    q_positions: jax.Array,  # [B, T] int32
+    kv_len: jax.Array,       # [B] int32
+    sliding_window: Optional[int] = None,
+    tq: int = DEFAULT_TQ,
+    tk: int = DEFAULT_TK,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in for attend() with flash memory behavior. GQA is handled by
+    head-index mapping (kv never materializes repeated)."""
+    b, t, n_heads, hd = q.shape
+    s, n_kv = k.shape[1], k.shape[2]
+    q_per_kv = n_heads // n_kv
+    scale = hd ** -0.5
+
+    # Lane/tile alignment: hd → 128-multiple, T → tq-multiple, S → tk-mult.
+    hd_p = max(128, ((hd + 127) // 128) * 128)
+    q2 = _pad_to(_pad_to(q, 3, hd_p), 1, tq)
+    k2 = _pad_to(_pad_to(k, 3, hd_p), 1, tk)
+    v2 = _pad_to(_pad_to(v, 3, hd_p), 1, tk)
+    # padded queries get position -1: masked against every kv index
+    qpos2 = _pad_to(q_positions.astype(jnp.int32), 1, tq, value=-1)
+    t_p, s_p = q2.shape[1], k2.shape[1]
+
+    q2 = q2.transpose(0, 2, 1, 3)        # [B, H, T, hd]
+    k2 = k2.transpose(0, 2, 1, 3)        # [B, KVH, S, hd]
+    v2 = v2.transpose(0, 2, 1, 3)
+
+    grid = (b, n_heads, t_p // tq)
+    kernel = functools.partial(_flash_kernel, tk=tk, scale=scale,
+                               sliding_window=sliding_window)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,            # kv_len rides SMEM
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, tq), lambda bb, h, qi, kvl: (bb, qi)),
+                pl.BlockSpec((1, 1, tq, hd_p),
+                             lambda bb, h, qi, kvl: (bb, h, qi, 0)),
+                pl.BlockSpec((1, 1, s_p, hd_p),
+                             lambda bb, h, qi, kvl, _q=q_per_kv:
+                             (bb, h // _q, 0, 0)),
+                pl.BlockSpec((1, 1, s_p, hd_p),
+                             lambda bb, h, qi, kvl, _q=q_per_kv:
+                             (bb, h // _q, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, tq, hd_p),
+                                   lambda bb, h, qi, kvl: (bb, h, qi, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, n_heads, t_p, hd_p), q.dtype),
+        interpret=interpret,
+    )(kv_len.astype(jnp.int32), qpos2, q2, k2, v2)
+
+    return out.transpose(0, 2, 1, 3)[:, :t, :, :hd]
+
+
+def attend_auto(q, k, v, q_positions, kv_len,
+                sliding_window: Optional[int] = None,
+                min_flash_len: int = 256) -> jax.Array:
+    """Pick the attention path: flash on TPU for long prefill chunks, dense
+    XLA otherwise (decode steps and CPU tests). Same signature/semantics as
+    attend()."""
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu and q.shape[1] >= min_flash_len:
+        return flash_attend(q, k, v, q_positions, kv_len,
+                            sliding_window=sliding_window)
+    return attend(q, k, v, q_positions, kv_len,
+                  sliding_window=sliding_window)

@@ -1,0 +1,112 @@
+"""Ring attention: sequence-parallel causal attention over a mesh axis.
+
+Long-context capability with no reference counterpart (the reference never
+executes attention; SURVEY.md §5 calls this out as a NEW capability): when a
+sequence outgrows one chip's HBM, shard it over the ``sp`` mesh axis. Each
+device keeps its Q shard resident and the K/V shards rotate around the ring
+with ``lax.ppermute`` (ICI neighbor exchange), one hop per step; partial
+attention accumulates with online-softmax statistics so the result is
+bit-comparable to single-device attention. This is the blockwise/ring
+formulation (PAPERS.md: Ring Attention, blockwise transformers) expressed
+at the XLA collective level per the scaling-book recipe — shard_map +
+ppermute, letting XLA schedule compute/communication overlap.
+
+The per-step local block math reuses the same masking semantics as
+ops/attention.attend; tests assert exact agreement with the dense path on a
+virtual 8-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from quoracle_tpu.ops.attention import repeat_kv
+
+NEG_INF = -1e30
+
+
+def _block_scores(q, k, scale):
+    # [B, Sq, H, hd] x [B, Sk, H, hd] -> [B, H, Sq, Sk] (MXU contraction)
+    return jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32) * scale,
+                      k.astype(jnp.float32))
+
+
+def _ring_shard(q, k, v, kv_len, *, axis_name: str, n_shards: int,
+                sliding_window: Optional[int]):
+    """Runs inside shard_map. q/k/v: [B, S_loc, H|KVH, hd] local shards;
+    kv_len [B] replicated. Returns the local output shard."""
+    b, s_loc, n_heads, hd = q.shape
+    q_per_kv = n_heads // k.shape[2]
+    scale = hd ** -0.5
+    my = jax.lax.axis_index(axis_name)
+    q_pos = (my * s_loc
+             + jnp.arange(s_loc, dtype=jnp.int32))[None, :, None]  # [1,Sq,1]
+
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+
+    def step(i, carry):
+        k_cur, v_cur, m, l, acc = carry
+        # the shard currently held arrived from (my - i) around the ring
+        owner = (my - i) % n_shards
+        kv_pos = (owner * s_loc
+                  + jnp.arange(s_loc, dtype=jnp.int32))[None, None, :]
+        scores = _block_scores(q, repeat_kv(k_cur, q_per_kv), scale)
+        mask = (kv_pos < kv_len[:, None, None]) & (kv_pos <= q_pos)
+        if sliding_window is not None:
+            mask &= q_pos - kv_pos < sliding_window
+        scores = jnp.where(mask[:, None, :, :], scores, NEG_INF)
+
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.exp(scores - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum(
+            "bhts,bshd->bthd", p,
+            repeat_kv(v_cur, q_per_kv).astype(jnp.float32)
+        ).transpose(0, 2, 1, 3)
+        # rotate K/V to the next neighbor (one ICI hop per step); the last
+        # iteration's permute returns the shards home, keeping the loop
+        # carry shape-uniform — XLA overlaps it with the block math above.
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, m_new, l_new, acc_new
+
+    m0 = jnp.full((b, n_heads, s_loc, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, n_heads, s_loc, 1), jnp.float32)
+    acc0 = jnp.zeros((b, n_heads, s_loc, hd), jnp.float32)
+    *_kv, m, l, acc = jax.lax.fori_loop(
+        0, n_shards, step, (k, v, m0, l0, acc0))
+    out = jnp.where(l > 0, acc / jnp.where(l > 0, l, 1.0), 0.0)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)   # [B, S_loc, H, hd]
+
+
+def ring_attend(
+    mesh: Mesh,
+    q: jax.Array,            # [B, S, n_heads, hd], S sharded on axis_name
+    k: jax.Array,            # [B, S, n_kv, hd]
+    v: jax.Array,
+    kv_len: jax.Array,       # [B] int32 (valid prefix of the GLOBAL seq)
+    axis_name: str = "sp",
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Causal attention over a sequence sharded on ``axis_name``. The
+    global sequence length must divide the axis size."""
+    n_shards = int(mesh.shape[axis_name])
+    if q.shape[1] % n_shards:
+        raise ValueError(f"sequence {q.shape[1]} not divisible by "
+                         f"{axis_name}={n_shards}")
+    seq_spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        functools.partial(_ring_shard, axis_name=axis_name,
+                          n_shards=n_shards, sliding_window=sliding_window),
+        mesh=mesh,
+        in_specs=(seq_spec, seq_spec, seq_spec, P(None)),
+        out_specs=seq_spec,
+        check_vma=False,
+    )
+    return fn(q, k, v, kv_len.astype(jnp.int32))

@@ -28,20 +28,31 @@ from quoracle_tpu.models.config import ModelConfig
 def make_mesh(
     n_devices: Optional[int] = None,
     tp: Optional[int] = None,
-    axis_names: Sequence[str] = ("dp", "tp"),
+    axis_names: Optional[Sequence[str]] = None,
     devices: Optional[Sequence] = None,
+    sp: int = 1,
 ) -> Mesh:
-    """Build a dp×tp mesh over the first n_devices devices.
+    """Build a dp×tp mesh — or dp×sp×tp when sp > 1 (sequence-parallel
+    ring attention over the middle axis: ppermute hops ride neighboring
+    ICI links).
 
-    tp defaults to all devices (dp=1): latency-optimal for a single agent's
-    consensus round; callers raise dp when many agents decode concurrently.
+    tp defaults to all remaining devices (dp=1): latency-optimal for a
+    single agent's consensus round; callers raise dp when many agents
+    decode concurrently.
     """
     devs = list(devices) if devices is not None else jax.devices()
     n = n_devices or len(devs)
     devs = devs[:n]
-    tp = tp or n
-    assert n % tp == 0, f"{n} devices not divisible by tp={tp}"
-    arr = np.array(devs).reshape(n // tp, tp)
+    assert n % sp == 0, f"{n} devices not divisible by sp={sp}"
+    tp = tp or n // sp
+    assert n % (sp * tp) == 0, \
+        f"{n} devices not divisible by sp*tp={sp * tp}"
+    if axis_names is None:
+        axis_names = ("dp", "sp", "tp") if sp > 1 else ("dp", "tp")
+    if sp > 1:
+        arr = np.array(devs).reshape(n // (sp * tp), sp, tp)
+    else:
+        arr = np.array(devs).reshape(n // tp, tp)
     return Mesh(arr, axis_names=tuple(axis_names))
 
 

@@ -1,0 +1,125 @@
+"""Long-context attention: pallas flash kernel + sequence-parallel ring.
+
+Both must agree numerically with the dense XLA attend() reference on valid
+(non-padded) rows; the flash kernel runs in pallas interpreter mode on the
+CPU test mesh, the ring runs over the 8-virtual-device mesh from conftest.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quoracle_tpu.ops.attention import attend
+from quoracle_tpu.ops.flash_attention import attend_auto, flash_attend
+from quoracle_tpu.ops.ring_attention import ring_attend
+from quoracle_tpu.parallel.mesh import make_mesh
+
+
+def make_qkv(b, t, s, h, kvh, hd, seed=0):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, t, h, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (b, s, kvh, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (b, s, kvh, hd), jnp.float32)
+    return q, k, v
+
+
+def valid_close(out, ref, kv_len, q_positions, atol=2e-3):
+    """Compare only rows whose query position is inside the valid prefix
+    (fully-masked padding rows are implementation-defined)."""
+    for bi in range(out.shape[0]):
+        rows = np.asarray(q_positions[bi]) < int(kv_len[bi])
+        np.testing.assert_allclose(np.asarray(out[bi][rows]),
+                                   np.asarray(ref[bi][rows]), atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# Flash kernel (interpret mode on CPU)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("case", [
+    dict(b=2, t=128, s=128, h=4, kvh=4, hd=128),            # MHA aligned
+    dict(b=1, t=256, s=256, h=8, kvh=2, hd=128),            # GQA 4:1
+    dict(b=2, t=100, s=160, h=4, kvh=2, hd=64),             # unaligned + pad
+])
+def test_flash_matches_dense(case):
+    b, t, s, h, kvh, hd = (case[k] for k in "btshkvh hd".split()) \
+        if False else (case["b"], case["t"], case["s"], case["h"],
+                       case["kvh"], case["hd"])
+    q, k, v = make_qkv(b, t, s, h, kvh, hd)
+    q_pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+    kv_len = jnp.array([s, max(1, s - 37)][:b], jnp.int32)
+    ref = attend(q, k, v, q_pos, kv_len)
+    out = flash_attend(q, k, v, q_pos, kv_len, interpret=True,
+                       tq=64, tk=64)
+    valid_close(out, ref, kv_len, q_pos)
+
+
+def test_flash_sliding_window():
+    q, k, v = make_qkv(1, 128, 128, 4, 4, 128)
+    q_pos = jnp.arange(128, dtype=jnp.int32)[None]
+    kv_len = jnp.array([128], jnp.int32)
+    ref = attend(q, k, v, q_pos, kv_len, sliding_window=32)
+    out = flash_attend(q, k, v, q_pos, kv_len, sliding_window=32,
+                       interpret=True, tq=64, tk=64)
+    valid_close(out, ref, kv_len, q_pos)
+
+
+def test_flash_decode_chunk_against_prefix():
+    # query chunk mid-sequence (prefill continuation): absolute positions
+    q, k, v = make_qkv(1, 64, 256, 4, 2, 128)
+    q_pos = (128 + jnp.arange(64, dtype=jnp.int32))[None]
+    kv_len = jnp.array([192], jnp.int32)
+    ref = attend(q, k, v, q_pos, kv_len)
+    out = flash_attend(q, k, v, q_pos, kv_len, interpret=True,
+                       tq=64, tk=64)
+    valid_close(out, ref, kv_len, q_pos)
+
+
+def test_attend_auto_dispatches_dense_off_tpu():
+    q, k, v = make_qkv(1, 512, 512, 4, 4, 128)
+    q_pos = jnp.arange(512, dtype=jnp.int32)[None]
+    kv_len = jnp.array([512], jnp.int32)
+    out = attend_auto(q, k, v, q_pos, kv_len)     # CPU → dense path
+    ref = attend(q, k, v, q_pos, kv_len)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# Ring attention over the 8-device mesh
+# ---------------------------------------------------------------------------
+
+def test_ring_matches_dense_full_sequence(eight_devices):
+    mesh = make_mesh(8, sp=8, tp=1)
+    b, s, h, kvh, hd = 2, 256, 4, 2, 64
+    q, k, v = make_qkv(b, s, s, h, kvh, hd, seed=1)
+    q_pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+    kv_len = jnp.array([s, s - 50], jnp.int32)
+    ref = attend(q, k, v, q_pos, kv_len)
+    out = ring_attend(mesh, q, k, v, kv_len)
+    valid_close(out, ref, kv_len, q_pos, atol=1e-3)
+
+
+def test_ring_sliding_window(eight_devices):
+    mesh = make_mesh(8, sp=4, tp=2)
+    b, s, h, kvh, hd = 1, 128, 4, 4, 64
+    q, k, v = make_qkv(b, s, s, h, kvh, hd, seed=2)
+    q_pos = jnp.arange(s, dtype=jnp.int32)[None]
+    kv_len = jnp.array([s], jnp.int32)
+    ref = attend(q, k, v, q_pos, kv_len, sliding_window=48)
+    out = ring_attend(mesh, q, k, v, kv_len, sliding_window=48)
+    valid_close(out, ref, kv_len, q_pos, atol=1e-3)
+
+
+def test_ring_rejects_indivisible_sequence(eight_devices):
+    mesh = make_mesh(8, sp=8, tp=1)
+    q, k, v = make_qkv(1, 100, 100, 2, 2, 64)
+    with pytest.raises(ValueError):
+        ring_attend(mesh, q, k, v, jnp.array([100], jnp.int32))
+
+
+def test_make_mesh_sp_axis(eight_devices):
+    mesh = make_mesh(8, sp=4, tp=2)
+    assert dict(mesh.shape) == {"dp": 1, "sp": 4, "tp": 2}
+    mesh2 = make_mesh(8, tp=4)
+    assert dict(mesh2.shape) == {"dp": 2, "tp": 4}
