@@ -92,12 +92,20 @@ async def cmd_serve(args: argparse.Namespace) -> int:
     rt = Runtime(RuntimeConfig(
         db_path=args.db, backend=args.backend,
         model_pool=args.pool.split(",") if args.pool else None))
+    # Validate host/token BEFORE boot so a refused bind exits with a clean
+    # message instead of a traceback over a half-started runtime.
+    try:
+        server = DashboardServer(rt, host=args.host, port=args.port,
+                                 auth_token=args.token)
+    except ValueError as e:
+        print(f"error: {e}", flush=True)
+        rt.close()
+        return 2
     _attach_printer(rt)
     result = await rt.boot()
     if result["revived"]:
         print(f"revived tasks: {result['revived']}", flush=True)
-    server = await DashboardServer(rt, host=args.host,
-                                   port=args.port).start()
+    server = await server.start()
     print(f"dashboard at {server.url}", flush=True)
     try:
         while True:
@@ -140,6 +148,10 @@ def build_parser() -> argparse.ArgumentParser:
     servep.add_argument("--host", default="127.0.0.1")
     servep.add_argument("--port", type=int, default=8400)
     servep.add_argument("--pool", help="comma-separated model specs")
+    servep.add_argument("--token", default=None,
+                        help="dashboard auth token (default: env "
+                             "QUORACLE_DASHBOARD_TOKEN); required for "
+                             "non-loopback --host")
     common(servep)
 
     statp = sub.add_parser("status", help="show tasks + agents")

@@ -190,6 +190,11 @@ def _glob_match(path: str, pattern: str,
         if pattern.startswith("/**", i) and i + 3 == len(pattern):
             regex += "(/.*)?"
             i += 3
+        elif pattern.startswith("/**/", i):
+            # Interior /**/ matches zero or more intermediate directories:
+            # a/**/b matches both a/b and a/x/y/b (standard glob semantics).
+            regex += "/(.*/)?"
+            i += 4
         elif pattern.startswith("**", i):
             regex += ".*"
             i += 2

@@ -36,16 +36,24 @@ def build_and_load(src_path: str, so_path: str,
         fresh = (os.path.isfile(so_path) and
                  os.path.getmtime(so_path) >= os.path.getmtime(src_path))
         if not fresh:
+            # Per-pid temp name: the lock only serializes threads in THIS
+            # process; two processes building concurrently must not
+            # interleave writes into one temp file before the atomic rename.
+            tmp_path = f"{so_path}.{os.getpid()}.tmp"
             try:
                 subprocess.run(
                     ["g++", "-O2", "-shared", "-fPIC", "-o",
-                     so_path + ".tmp", src_path, *extra_flags],
+                     tmp_path, src_path, *extra_flags],
                     check=True, capture_output=True, timeout=120)
-                os.replace(so_path + ".tmp", so_path)
+                os.replace(tmp_path, so_path)
             except (OSError, subprocess.SubprocessError) as e:
                 logger.warning("native build of %s failed (%s); using the "
                                "Python fallback", os.path.basename(src_path),
                                e)
+                try:
+                    os.unlink(tmp_path)   # don't leak per-pid orphans
+                except OSError:
+                    pass
                 return None
     try:
         return ctypes.CDLL(so_path)

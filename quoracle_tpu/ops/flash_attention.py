@@ -62,7 +62,10 @@ def _flash_kernel(kv_len_ref, qpos_ref, q_ref, k_ref, v_ref, o_ref, *,
             mask &= qp - kv_idx < sliding_window
         scores = jnp.where(mask, scores, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(scores, axis=1, keepdims=True))
-        p = jnp.exp(scores - m_new)                       # [TQ, tk]
+        # NEG_INF is finite, so a fully-masked block would give
+        # exp(NEG_INF - NEG_INF) = 1 per position; re-mask p so masked
+        # positions contribute 0 and fully-masked rows keep l == 0.
+        p = jnp.where(mask, jnp.exp(scores - m_new), 0.0)  # [TQ, tk]
         correction = jnp.exp(m - m_new)
         l_new = l * correction + jnp.sum(p, axis=1, keepdims=True)
         acc_new = acc * correction + jax.lax.dot_general(

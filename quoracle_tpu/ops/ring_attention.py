@@ -62,7 +62,9 @@ def _ring_shard(q, k, v, kv_len, *, axis_name: str, n_shards: int,
         scores = jnp.where(mask[:, None, :, :], scores, NEG_INF)
 
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
-        p = jnp.exp(scores - m_new)
+        # NEG_INF is finite: re-mask p so steps whose block is fully masked
+        # contribute 0 (not a uniform 1) and kv_len==0 rows keep l == 0.
+        p = jnp.where(mask[:, None, :, :], jnp.exp(scores - m_new), 0.0)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * corr + jnp.einsum(

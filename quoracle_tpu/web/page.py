@@ -89,7 +89,24 @@ const $ = id => document.getElementById(id);
 const esc = s => String(s ?? "").replace(/[&<>]/g,
   c => ({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
 
+// Token-mode support: ?token=… (or #token=…) is remembered in
+// sessionStorage and attached to every request; EventSource can't set
+// headers, so the SSE URL carries it as a query param too.
+const urlTok = new URLSearchParams(location.search).get("token")
+  || new URLSearchParams(location.hash.slice(1)).get("token");
+if (urlTok) {
+  sessionStorage.setItem("qt_token", urlTok);
+  history.replaceState(null, "", location.pathname);   // scrub from URL bar
+}
+const TOKEN = sessionStorage.getItem("qt_token");
+const withTok = path => !TOKEN ? path
+  : path + (path.includes("?") ? "&" : "?") + "token="
+    + encodeURIComponent(TOKEN);
+
 async function api(path, opts) {
+  opts = opts || {};
+  if (TOKEN) opts.headers = {...(opts.headers || {}),
+                             "authorization": "Bearer " + TOKEN};
   const r = await fetch(path, opts);
   return r.json();
 }
@@ -176,7 +193,7 @@ async function sendMessage(ev) {
 function refreshAll() { refreshTasks(); refreshAgents(); refreshLogs();
                         refreshMessages(); }
 
-const es = new EventSource("/events");
+const es = new EventSource(withTok("/events"));
 es.onopen = () => $("status").textContent = "live";
 es.onerror = () => $("status").textContent = "reconnecting…";
 let pending = null;

@@ -37,11 +37,26 @@ API_CALL_TIMEOUT_S = 60.0
 
 
 class DashboardServer:
+    """``auth_token`` (default: env QUORACLE_DASHBOARD_TOKEN) gates the
+    mutating endpoints — POST /api/tasks spawns agents that can run shell
+    commands, so binding a non-loopback host without a token is refused
+    outright rather than exposing unauthenticated RCE."""
+
     def __init__(self, runtime: Any, host: str = "127.0.0.1",
-                 port: int = 8400):
+                 port: int = 8400, auth_token: Optional[str] = None):
+        import os
         self.runtime = runtime
         self.host = host
         self.port = port
+        self.auth_token = auth_token or os.environ.get(
+            "QUORACLE_DASHBOARD_TOKEN") or None
+        # NB: "" is NOT loopback — ThreadingHTTPServer binds INADDR_ANY for it.
+        if self.auth_token is None and host not in (
+                "127.0.0.1", "localhost", "::1"):
+            raise ValueError(
+                f"refusing to bind dashboard to non-loopback host {host!r} "
+                "without an auth token (pass auth_token= or set "
+                "QUORACLE_DASHBOARD_TOKEN)")
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
@@ -142,6 +157,11 @@ class _Handler(BaseHTTPRequestHandler):
     # -- plumbing -------------------------------------------------------
 
     def log_message(self, fmt, *args):          # quiet access log
+        # redact ?token=… — GET /events carries the bearer token as a query
+        # param (EventSource can't set headers); it must not reach logs.
+        import re
+        args = tuple(re.sub(r"([?&]token=)[^& ]*", r"\1[REDACTED]", a)
+                     if isinstance(a, str) else a for a in args)
         logger.debug("dashboard: " + fmt, *args)
 
     def _send_json(self, payload: Any, status: int = 200) -> None:
@@ -168,6 +188,16 @@ class _Handler(BaseHTTPRequestHandler):
         q = urllib.parse.parse_qs(parsed.query)
         one = lambda k: (q.get(k) or [None])[0]
         d = self.dashboard
+        # When a token is configured (the non-loopback deployment mode) the
+        # read endpoints are gated too: logs/messages/SSE carry full agent
+        # transcripts, which routinely include repo contents and secrets.
+        # Only the static page and the health probe stay open. GETs may
+        # carry the token as ?token= because EventSource can't set headers
+        # (the SPA attaches it; see page.py).
+        if parsed.path not in ("/", "/healthz") and not self._authorized(
+                query_token=one("token")):
+            self._send_json({"error": "unauthorized"}, 401)
+            return
         try:
             if parsed.path == "/":
                 body = DASHBOARD_HTML.encode()
@@ -234,8 +264,23 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- POST -----------------------------------------------------------
 
+    def _authorized(self, query_token: Optional[str] = None) -> bool:
+        token = self.dashboard.auth_token
+        if token is None:
+            return True             # loopback-only bind (enforced at init)
+        import hmac
+        got = query_token if query_token is not None else \
+            (self.headers.get("authorization") or "").removeprefix("Bearer ")
+        # bytes on both sides: compare_digest raises TypeError on non-ASCII
+        # str, and headers are latin-1 decoded so that's remotely reachable.
+        return hmac.compare_digest(got.encode("utf-8", "surrogateescape"),
+                                   token.encode("utf-8", "surrogateescape"))
+
     def do_POST(self) -> None:      # noqa: N802 (stdlib API)
         d = self.dashboard
+        if not self._authorized():
+            self._send_json({"error": "unauthorized"}, 401)
+            return
         body = self._read_body()
         try:
             if self.path == "/api/tasks":

@@ -160,3 +160,56 @@ def test_dashboard_sse_stream_delivers_events():
             await server.stop()
             rt.close()
     asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_dashboard_auth_token_gates_mutations(monkeypatch):
+    """ADVICE r1: with a token set, mutating AND read endpoints require the
+    bearer token (only / and /healthz stay open), and non-loopback binds
+    without a token are refused outright."""
+    import pytest
+    import urllib.error
+
+    monkeypatch.delenv("QUORACLE_DASHBOARD_TOKEN", raising=False)
+
+    async def main():
+        rt = Runtime(RuntimeConfig(), backend=MockBackend())
+        server = await DashboardServer(rt, port=0, auth_token="s3cret").start()
+        base = server.url
+        try:
+            # health stays open; API reads are gated when a token is set
+            status, _ = await http_json(base + "/healthz")
+            assert status == 200
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                await http_json(base + "/api/status")
+            assert ei.value.code == 401
+            # POST without token → 401
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                await http_json(base + "/api/messages", method="POST",
+                                body={"agent_id": "x", "content": "hi"})
+            assert ei.value.code == 401
+            # POST with the token passes auth (404: no such agent)
+
+            def call_with_token():
+                req = urllib.request.Request(
+                    base + "/api/messages", method="POST",
+                    data=json.dumps({"agent_id": "x", "content": "hi"}).encode(),
+                    headers={"content-type": "application/json",
+                             "authorization": "Bearer s3cret"})
+                try:
+                    with urllib.request.urlopen(req, timeout=10) as resp:
+                        return resp.status
+                except urllib.error.HTTPError as e:
+                    return e.code
+            code = await asyncio.get_running_loop().run_in_executor(
+                None, call_with_token)
+            assert code == 404
+        finally:
+            await server.stop()
+            await rt.shutdown()
+
+    asyncio.run(main())
+    # non-loopback binds (incl. "" = INADDR_ANY) refuse without a token
+    with pytest.raises(ValueError):
+        DashboardServer(object(), host="0.0.0.0", port=0)
+    with pytest.raises(ValueError):
+        DashboardServer(object(), host="", port=0)

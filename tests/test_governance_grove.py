@@ -392,3 +392,14 @@ def test_grove_system_prompt_carries_skills(tmp_path):
         assert "Never fabricate results." in sys_prompt
         await tm.pause_task(task_id)
     asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_glob_interior_doublestar_matches_zero_dirs():
+    """ADVICE r1: a/**/b must match a/b (zero intermediate dirs) as well as
+    any depth, per standard glob semantics."""
+    from quoracle_tpu.governance.grove import _glob_match
+    assert _glob_match("/a/b", "/a/**/b")
+    assert _glob_match("/a/x/b", "/a/**/b")
+    assert _glob_match("/a/x/y/z/b", "/a/**/b")
+    assert not _glob_match("/a/xb", "/a/**/b")
+    assert not _glob_match("/ab", "/a/**/b")

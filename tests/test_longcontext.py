@@ -123,3 +123,31 @@ def test_make_mesh_sp_axis(eight_devices):
     assert dict(mesh.shape) == {"dp": 1, "sp": 4, "tp": 2}
     mesh2 = make_mesh(8, tp=4)
     assert dict(mesh2.shape) == {"dp": 2, "tp": 4}
+
+
+# ---------------------------------------------------------------------------
+# Fully-masked rows (ADVICE r1): kv_len == 0 must emit exact zeros, not an
+# average of V — NEG_INF is finite, so the kernels re-mask p explicitly.
+# ---------------------------------------------------------------------------
+
+def test_flash_fully_masked_rows_emit_zeros():
+    q, k, v = make_qkv(2, 64, 64, 4, 2, 64)
+    q_pos = jnp.broadcast_to(jnp.arange(64, dtype=jnp.int32)[None], (2, 64))
+    kv_len = jnp.array([0, 64], jnp.int32)     # row 0 has no valid kv at all
+    out = np.asarray(flash_attend(q, k, v, q_pos, kv_len, interpret=True,
+                                  tq=64, tk=64))
+    assert np.all(out[0] == 0.0)
+    ref = attend(q, k, v, q_pos, kv_len)
+    valid_close(out, ref, kv_len, q_pos)       # row 1 unaffected
+
+
+def test_ring_fully_masked_rows_emit_zeros(eight_devices):
+    mesh = make_mesh(8, sp=4, tp=2)
+    b, s, h, kvh, hd = 2, 128, 4, 4, 64
+    q, k, v = make_qkv(b, s, s, h, kvh, hd, seed=3)
+    kv_len = jnp.array([0, s], jnp.int32)
+    out = np.asarray(ring_attend(mesh, q, k, v, kv_len))
+    assert np.all(out[0] == 0.0)
+    q_pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+    ref = attend(q, k, v, q_pos, kv_len)
+    valid_close(out, ref, kv_len, q_pos, atol=1e-3)
