@@ -51,6 +51,12 @@ class ModelConfig:
     sliding_window: Optional[int] = None
     # Optional logit soft-capping (Gemma-2 style); None = off.
     final_logit_softcap: Optional[float] = None
+    # QKV projection biases (Qwen2-style).
+    attn_bias: bool = False
+    # RoPE frequency scaling, hashable: ("linear", factor) or
+    # ("llama3", factor, low_freq_factor, high_freq_factor, original_max_pos).
+    # None = unscaled. (Kept a tuple so ModelConfig stays hashable for jit.)
+    rope_scaling: Optional[tuple] = None
 
     # --- serving metadata (what the reference pulled from LLMDB) ---
     context_window: int = 8192
@@ -62,6 +68,13 @@ class ModelConfig:
     output_cost_per_mtok: float = 0.15
     eos_token_id: int = 2
     bos_token_id: int = 1
+    # Additional stop ids beyond eos_token_id — llama-3-instruct style
+    # checkpoints end chat turns with <|eot_id|> while config.eos lists
+    # several ids; decode stops on ANY of {eos_token_id} | stop_token_ids.
+    stop_token_ids: tuple = ()
+    # HF checkpoint directory for real weights (models/loader.py); None =
+    # random-init (tests/bench). The directory's tokenizer files are used too.
+    checkpoint_path: Optional[str] = None
 
     def __post_init__(self):
         if self.head_dim is None:

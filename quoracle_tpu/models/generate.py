@@ -62,6 +62,7 @@ def decode(
     active: jax.Array,         # [B] bool — False for batch-bucket padding rows
     row_limit: jax.Array,      # [B] int32 per-row generation budget (<= max_new)
     pad_id: int = 0,
+    stop_ids: tuple = (),      # extra stop ids (llama-3 <|eot_id|> style)
 ) -> tuple[jax.Array, jax.Array]:
     """Autoregressive decode.
 
@@ -78,11 +79,15 @@ def decode(
     done, so the early-exit fires when every REAL row has finished.
     """
     B = first_logits.shape[0]
+    stops = jnp.asarray((eos_id,) + tuple(stop_ids), jnp.int32)
+
+    def is_stop(tok):
+        return jnp.any(tok[:, None] == stops[None, :], axis=1)
 
     rng, k0 = jax.random.split(rng)
     tok0 = sample_tokens(first_logits, k0, temperature, top_p)
     n0 = jnp.where(active, 1, 0).astype(jnp.int32)
-    done0 = ~active | (tok0 == eos_id) | (n0 >= row_limit)
+    done0 = ~active | is_stop(tok0) | (n0 >= row_limit)
     out0 = jnp.full((B, max_new), pad_id, jnp.int32).at[:, 0].set(tok0)
 
     def cond(carry):
@@ -103,7 +108,7 @@ def decode(
         out = jax.lax.dynamic_update_slice_in_dim(out, nxt[:, None], i, axis=1)
         n_emitted = n_emitted + jnp.where(done, 0, 1).astype(jnp.int32)
         cache = cache._replace(lens=cache.lens + jnp.where(done, 0, 1))
-        done = done | (nxt == eos_id) | (n_emitted >= row_limit)
+        done = done | is_stop(nxt) | (n_emitted >= row_limit)
         return (i + 1, done, nxt, out, n_emitted, cache, rng)
 
     # Feed the first sampled token through the loop starting at step 1.
@@ -141,41 +146,76 @@ class GenerateEngine:
     Holds params (device-resident), compiles (prefill+decode) per shape
     bucket, and exposes a list-in/list-out generate(). The pool runtime
     (models/runtime.py) owns one Engine per pool member.
+
+    With ``mesh`` set, the engine serves SHARDED: params placed per
+    parallel/mesh.param_specs (Megatron-style tp), the KV cache constrained
+    to cache_spec, and inputs laid out on the dp axis — GSPMD inserts the
+    psums, which ride ICI (SURVEY.md §2.9 tp-sharded serving). A pool on a
+    multi-chip slice gives each member its own sub-mesh
+    (parallel.mesh.pool_submeshes) and the host scheduler overlaps members
+    (models/runtime.py). mesh=None is the single-chip degenerate case.
+
+    generate() is thread-safe: the host-side RNG draw is locked; everything
+    else is functional.
     """
 
     BATCH_BUCKETS = (1, 2, 4, 8, 16, 32)
 
     def __init__(self, cfg: ModelConfig, params: dict, tokenizer,
                  max_seq: Optional[int] = None, seed: int = 0,
-                 prompt_buckets: Sequence[int] = (128, 256, 512, 1024, 2048, 4096, 8192)):
+                 prompt_buckets: Sequence[int] = (128, 256, 512, 1024, 2048, 4096, 8192),
+                 mesh=None):
+        import threading
         self.cfg = cfg
+        self.mesh = mesh
+        if mesh is not None:
+            from quoracle_tpu.parallel.mesh import shard_params
+            params = shard_params(params, mesh, cfg)
         self.params = params
         self.tokenizer = tokenizer
         self.max_seq = max_seq or cfg.context_window
         self.prompt_buckets = tuple(b for b in prompt_buckets if b <= self.max_seq)
         self._rng = jax.random.PRNGKey(seed)
+        self._rng_lock = threading.Lock()
+        # KV cache dtype follows the params (bf16 serving, fp32 parity tests)
+        # — mixing dtypes would fail the in-place cache scatter.
+        self.cache_dtype = jax.tree.leaves(params)[0].dtype
         self._step = self._build_step()
 
     def _build_step(self):
         cfg = self.cfg
+        mesh = self.mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from quoracle_tpu.parallel.mesh import cache_spec
+            kv_sharding = NamedSharding(mesh, cache_spec(cfg, mesh))
 
         @functools.partial(jax.jit, static_argnames=("max_new", "cache_len"))
         def step(params, tokens, prompt_lens, rng, temperature, top_p, active,
                  row_limit, max_new: int, cache_len: int):
             B = tokens.shape[0]
-            cache = init_cache(cfg, B, cache_len)
+            cache = init_cache(cfg, B, cache_len, dtype=self.cache_dtype)
+            if mesh is not None:
+                # Pin the cache layout (kv heads on tp, batch on dp) so the
+                # decode loop carries a stable sharding instead of whatever
+                # GSPMD back-propagates from the first write.
+                cache = cache._replace(
+                    k=jax.lax.with_sharding_constraint(cache.k, kv_sharding),
+                    v=jax.lax.with_sharding_constraint(cache.v, kv_sharding))
             last_logits, cache = prefill(params, cfg, tokens, prompt_lens, cache)
             out, n_emitted = decode(params, cfg, cache, last_logits, rng,
                                     temperature, top_p, max_new, cfg.eos_token_id,
                                     active=active, row_limit=row_limit,
-                                    pad_id=self.tokenizer.pad_id)
+                                    pad_id=self.tokenizer.pad_id,
+                                    stop_ids=cfg.stop_token_ids)
             return out, n_emitted
 
         return step
 
     def next_rng(self) -> jax.Array:
-        self._rng, k = jax.random.split(self._rng)
-        return k
+        with self._rng_lock:
+            self._rng, k = jax.random.split(self._rng)
+            return k
 
     def generate(
         self,
@@ -210,6 +250,10 @@ class GenerateEngine:
                 f"for model {self.cfg.name}")
         T = _round_up(max_prompt, self.prompt_buckets)
         B = _round_up(n, self.BATCH_BUCKETS)
+        if self.mesh is not None:
+            # batch rows ride the dp axis — pad the bucket to a multiple
+            dp = int(self.mesh.shape.get("dp", 1))
+            B = ((B + dp - 1) // dp) * dp
         # Bucket the decode bound too: consensus computes a DYNAMIC max_tokens
         # per round (reference per_model_query.ex:136-145), which would
         # otherwise trigger one XLA compile per unique value. Per-row TRACED
@@ -231,11 +275,22 @@ class GenerateEngine:
         active = np.zeros((B,), bool)
         active[:n] = True
 
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            row = NamedSharding(self.mesh, P("dp"))
+            mat = NamedSharding(self.mesh, P("dp", None))
+            put = jax.device_put
+            args = (put(tokens, mat), put(lens, row))
+            samp = (put(temp_arr, row), put(top_arr, row),
+                    put(active, row), put(limits, row))
+        else:
+            args = (jnp.asarray(tokens), jnp.asarray(lens))
+            samp = (jnp.asarray(temp_arr), jnp.asarray(top_arr),
+                    jnp.asarray(active), jnp.asarray(limits))
         out, n_emitted = self._step(
-            self.params, jnp.asarray(tokens), jnp.asarray(lens),
+            self.params, *args,
             rng if rng is not None else self.next_rng(),
-            jnp.asarray(temp_arr), jnp.asarray(top_arr), jnp.asarray(active),
-            jnp.asarray(limits),
+            *samp,
             max_new=max_new, cache_len=T + max_new,
         )
         out = np.asarray(out)
@@ -249,7 +304,8 @@ class GenerateEngine:
             k = min(int(n_emitted[i]), row_budgets[i])
             ids = [int(t) for t in out[i, :k]]
             finish = "length"
-            if ids and ids[-1] == self.cfg.eos_token_id:
+            stop_set = {self.cfg.eos_token_id, *self.cfg.stop_token_ids}
+            if ids and ids[-1] in stop_set:
                 ids.pop()
                 finish = "stop"
             results.append(GenResult(

@@ -108,21 +108,42 @@ class TPUBackend(ModelBackend):
     def __init__(self, pool: Sequence[str], *, seed: int = 0,
                  embed_model: Optional[str] = None,
                  engines: Optional[dict[str, GenerateEngine]] = None,
-                 embedder=None, init_params_fn=None):
+                 embedder=None, init_params_fn=None,
+                 submeshes: Optional[Sequence] = None,
+                 overlap: bool = True):
+        """``submeshes``: one jax Mesh per pool member (parallel.mesh.
+        pool_submeshes) — each member's engine serves tp-sharded on its own
+        chips, and ``overlap`` runs members concurrently from host threads
+        instead of the sequential loop (SURVEY §7 hard part 1). None =
+        single-device engines."""
         import jax
         from quoracle_tpu.models.embeddings import EmbeddingEncoder
         from quoracle_tpu.models.transformer import init_params
 
         self.pool = list(pool)
         self.engines: dict[str, GenerateEngine] = dict(engines or {})
+        self.overlap = overlap
         init_fn = init_params_fn or init_params
         for i, spec in enumerate(self.pool):
             if spec in self.engines:
                 continue
             cfg = get_model_config(spec)
-            params = init_fn(cfg, jax.random.PRNGKey(seed + i))
+            mesh = submeshes[i % len(submeshes)] if submeshes else None
+            if cfg.checkpoint_path:
+                # Real weights: HF safetensors → stacked pytree
+                # (models/loader.py); the catalog entry carries the path
+                # (register_hf_checkpoint). With a mesh, leave params as
+                # host numpy — the engine's shard_params places them
+                # directly; going through to_device first would park a
+                # whole replicated copy on one chip.
+                from quoracle_tpu.models.loader import load_params, to_device
+                params = load_params(cfg.checkpoint_path, cfg)
+                if mesh is None:
+                    params = to_device(params)
+            else:
+                params = init_fn(cfg, jax.random.PRNGKey(seed + i))
             self.engines[spec] = GenerateEngine(
-                cfg, params, get_tokenizer(spec), seed=seed + i)
+                cfg, params, get_tokenizer(spec), seed=seed + i, mesh=mesh)
 
         if embedder is not None:
             self.embedder = embedder
@@ -142,66 +163,85 @@ class TPUBackend(ModelBackend):
     def query(self, requests: Sequence[QueryRequest]) -> list[QueryResult]:
         """Group rows by pool member; one batched generate per member.
 
-        Members run sequentially on a single chip; on a multi-chip mesh each
-        member owns a sub-mesh and the host scheduler overlaps them
-        (SURVEY.md §7 hard part 1)."""
+        Members OVERLAP: each member's generate is dispatched from its own
+        host thread, so on sub-meshed slices the three models decode
+        concurrently on their own chips (SURVEY.md §7 hard part 1; replaces
+        the reference's Task.async-per-model HTTPS fan-out,
+        per_model_query.ex:312-342). On a single chip the dispatches
+        serialize on the device queue — same latency as the sequential loop.
+        """
         by_model: dict[str, list[int]] = {}
         for i, r in enumerate(requests):
             by_model.setdefault(r.model_spec, []).append(i)
 
         results: list[Optional[QueryResult]] = [None] * len(requests)
-        for spec, idxs in by_model.items():
-            engine = self.engines.get(spec)
-            if engine is None:
-                for i in idxs:
-                    results[i] = QueryResult(
-                        model_spec=spec, error=f"unknown model {spec!r}",
-                        permanent_error=True)
-                continue
-            t0 = time.monotonic()
-            prompts, temps, tops, budgets, live_idxs = [], [], [], [], []
-            max_seq = engine.max_seq
-            for i in idxs:
-                r = requests[i]
-                ids = engine.tokenizer.encode_chat(r.messages)
-                if len(ids) >= max_seq:
-                    # Per-ROW overflow: only the oversized row errors; the
-                    # rest of the group still runs (the condensation layer
-                    # retries this model after condensing).
-                    results[i] = QueryResult(
-                        model_spec=spec,
-                        error=f"context_overflow: prompt {len(ids)} tokens "
-                              f">= window {max_seq}")
-                    continue
-                prompts.append(ids)
-                temps.append(r.temperature)
-                tops.append(r.top_p)
-                window, out_lim = engine.cfg.context_window, engine.cfg.output_limit
-                floor = min(OUTPUT_FLOOR, out_lim)
-                budget = min(out_lim, max(floor, window - len(ids)))
-                budgets.append(min(r.max_tokens, budget) if r.max_tokens else budget)
-                live_idxs.append(i)
-            if not live_idxs:
-                continue
-            try:
-                gens = engine.generate(
-                    prompts, temperature=temps, top_p=tops,
-                    max_new_tokens=budgets)
-            except ContextOverflowError as e:
-                for i in live_idxs:
-                    results[i] = QueryResult(model_spec=spec,
-                                             error=f"context_overflow: {e}")
-                continue
-            latency_ms = (time.monotonic() - t0) * 1000
-            cfg = engine.cfg
-            for i, g in zip(live_idxs, gens):
-                cost = (g.n_prompt_tokens * cfg.input_cost_per_mtok
-                        + g.n_gen_tokens * cfg.output_cost_per_mtok) / 1e6
-                results[i] = QueryResult(
-                    model_spec=spec, text=g.text,
-                    usage=Usage(g.n_prompt_tokens, g.n_gen_tokens, cost),
-                    latency_ms=latency_ms)
+        groups = list(by_model.items())
+        if self.overlap and len(groups) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(max_workers=len(groups),
+                                    thread_name_prefix="pool-member") as ex:
+                list(ex.map(lambda g: self._query_member(
+                    g[0], g[1], requests, results), groups))
+        else:
+            for spec, idxs in groups:
+                self._query_member(spec, idxs, requests, results)
         return [r for r in results if r is not None]
+
+    def _query_member(self, spec: str, idxs: list[int],
+                      requests: Sequence[QueryRequest],
+                      results: list[Optional[QueryResult]]) -> None:
+        """One pool member's slice of the round. Writes into disjoint
+        ``results`` positions — safe from concurrent member threads."""
+        engine = self.engines.get(spec)
+        if engine is None:
+            for i in idxs:
+                results[i] = QueryResult(
+                    model_spec=spec, error=f"unknown model {spec!r}",
+                    permanent_error=True)
+            return
+        t0 = time.monotonic()
+        prompts, temps, tops, budgets, live_idxs = [], [], [], [], []
+        max_seq = engine.max_seq
+        for i in idxs:
+            r = requests[i]
+            ids = engine.tokenizer.encode_chat(r.messages)
+            if len(ids) >= max_seq:
+                # Per-ROW overflow: only the oversized row errors; the
+                # rest of the group still runs (the condensation layer
+                # retries this model after condensing).
+                results[i] = QueryResult(
+                    model_spec=spec,
+                    error=f"context_overflow: prompt {len(ids)} tokens "
+                          f">= window {max_seq}")
+                continue
+            prompts.append(ids)
+            temps.append(r.temperature)
+            tops.append(r.top_p)
+            window, out_lim = engine.cfg.context_window, engine.cfg.output_limit
+            floor = min(OUTPUT_FLOOR, out_lim)
+            budget = min(out_lim, max(floor, window - len(ids)))
+            budgets.append(min(r.max_tokens, budget) if r.max_tokens else budget)
+            live_idxs.append(i)
+        if not live_idxs:
+            return
+        try:
+            gens = engine.generate(
+                prompts, temperature=temps, top_p=tops,
+                max_new_tokens=budgets)
+        except ContextOverflowError as e:
+            for i in live_idxs:
+                results[i] = QueryResult(model_spec=spec,
+                                         error=f"context_overflow: {e}")
+            return
+        latency_ms = (time.monotonic() - t0) * 1000
+        cfg = engine.cfg
+        for i, g in zip(live_idxs, gens):
+            cost = (g.n_prompt_tokens * cfg.input_cost_per_mtok
+                    + g.n_gen_tokens * cfg.output_cost_per_mtok) / 1e6
+            results[i] = QueryResult(
+                model_spec=spec, text=g.text,
+                usage=Usage(g.n_prompt_tokens, g.n_gen_tokens, cost),
+                latency_ms=latency_ms)
 
     def embed(self, texts: Sequence[str]) -> list[np.ndarray]:
         return self.embedder.embed(texts)

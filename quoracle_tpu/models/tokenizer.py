@@ -18,7 +18,6 @@ All are stateless after construction and safe to share across threads.
 from __future__ import annotations
 
 import abc
-from functools import lru_cache
 from typing import Sequence
 
 PAD_ID = 0
@@ -92,6 +91,65 @@ class ByteTokenizer(Tokenizer):
         return 256 + _N_SPECIALS
 
 
+class HFAutoTokenizer(Tokenizer):
+    """The REAL tokenizer of a served checkpoint: transformers AutoTokenizer
+    loaded from the checkpoint directory (local files only — this runtime
+    never fetches). Uses the model's own chat template when the checkpoint
+    ships one, so served prompts are formatted exactly as the model was
+    trained; falls back to the neutral template otherwise.
+
+    Replaces the reference's per-provider formatting + tiktoken estimate
+    (reference token_manager.ex:19-24) with exact counts from the model's
+    own vocab — the SURVEY §2.8 requirement.
+    """
+
+    def __init__(self, path: str):
+        import os
+        from transformers import AutoTokenizer
+        if not any(os.path.isfile(os.path.join(path, f)) for f in
+                   ("tokenizer.json", "vocab.json", "tokenizer_config.json")):
+            # AutoTokenizer's own failure here is an obscure conversion
+            # crash; fail with an actionable message instead.
+            raise ValueError(
+                f"checkpoint dir {path!r} has no tokenizer files "
+                "(tokenizer.json / vocab.json) — a served checkpoint must "
+                "ship its own tokenizer")
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        # No invented specials: when the checkpoint's tokenizer defines no
+        # bos, prepending the in-tree default id would inject an arbitrary
+        # vocab token into every prompt.
+        self._has_bos = self._tok.bos_token_id is not None
+        self.bos_id = self._tok.bos_token_id \
+            if self._has_bos else BOS_ID
+        self.eos_id = self._tok.eos_token_id \
+            if self._tok.eos_token_id is not None else EOS_ID
+        self.pad_id = self._tok.pad_token_id \
+            if self._tok.pad_token_id is not None else self.eos_id
+        self._has_template = bool(getattr(self._tok, "chat_template", None))
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        return [self.bos_id] + ids if add_bos and self._has_bos else ids
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._tok)
+
+    def encode_chat(self, messages: Sequence[dict]) -> list[int]:
+        msgs = [{"role": m.get("role", "user"),
+                 "content": m.get("content", "")
+                 if isinstance(m.get("content", ""), str)
+                 else _stringify_content(m.get("content"))}
+                for m in messages]
+        if self._has_template:
+            return list(self._tok.apply_chat_template(
+                msgs, add_generation_prompt=True, tokenize=True))
+        return self.encode(self.render_chat(msgs), add_bos=True)
+
+
 class HFTokenizer(Tokenizer):
     """Binding over a HuggingFace ``tokenizers`` file (tokenizer.json)."""
 
@@ -113,22 +171,38 @@ class HFTokenizer(Tokenizer):
         return self._tok.get_vocab_size()
 
 
-@lru_cache(maxsize=None)
+_TOK_CACHE: dict[tuple, Tokenizer] = {}
+
+
 def get_tokenizer(model_name: str, tokenizer_path: str | None = None) -> Tokenizer:
     """Tokenizer for a catalog model. Tiny/bench models use bytes; real
-    checkpoints pass an explicit tokenizer.json path.
+    checkpoints use their own tokenizer files (HFAutoTokenizer).
 
     bos/eos ids come from the model's catalog entry so the tokenizer and the
     engine's stop condition always agree (the engine stops on
-    ``ModelConfig.eos_token_id``)."""
+    ``ModelConfig.eos_token_id``). The cache key includes the resolved
+    checkpoint path — re-registering a name with different weights (or
+    registering AFTER a first lookup) must not pin a stale tokenizer."""
     from quoracle_tpu.models.config import get_model_config
+    ckpt = None
     try:
         cfg = get_model_config(model_name)
         bos, eos, vocab = cfg.bos_token_id, cfg.eos_token_id, cfg.vocab_size
+        ckpt = cfg.checkpoint_path
     except KeyError:
         bos, eos, vocab = BOS_ID, EOS_ID, 32768
+    key = (model_name, tokenizer_path, ckpt, bos, eos, vocab)
+    cached = _TOK_CACHE.get(key)
+    if cached is not None:
+        return cached
+    if ckpt:                         # real checkpoint → its real tokenizer
+        tok = HFAutoTokenizer(ckpt)
+        _TOK_CACHE[key] = tok
+        return tok
     if tokenizer_path:
-        return HFTokenizer(tokenizer_path, bos_id=bos, eos_id=eos)
+        tok = HFTokenizer(tokenizer_path, bos_id=bos, eos_id=eos)
+        _TOK_CACHE[key] = tok
+        return tok
     tok: Tokenizer
     try:
         # Learned byte-level BPE sized to the model's vocab (tiny test
@@ -144,4 +218,9 @@ def get_tokenizer(model_name: str, tokenizer_path: str | None = None) -> Tokeniz
     except ImportError:
         tok = ByteTokenizer()
     tok.bos_id, tok.eos_id = bos, eos
+    _TOK_CACHE[key] = tok
     return tok
+
+
+# lru_cache-compatible reset hook (tests and hot-reload paths use it)
+get_tokenizer.cache_clear = _TOK_CACHE.clear

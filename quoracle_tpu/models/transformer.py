@@ -48,8 +48,9 @@ def init_cache(cfg: ModelConfig, batch: int, max_len: int,
 
 
 def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
-    """Random-init params pytree (normal/sqrt(dim) — used for tests and bench;
-    real checkpoints come through models/loader.py)."""
+    """Random-init params pytree (normal/sqrt(dim)) — tests and bench only.
+    Real checkpoints load through models/loader.py (same structure, weights
+    from safetensors)."""
     k_embed, k_layers, k_head = jax.random.split(key, 3)
     L, D, F = cfg.n_layers, cfg.dim, cfg.ffn_dim
     H, KV, HD = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -73,6 +74,10 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
         },
         "final_norm": jnp.ones((D,), dtype),
     }
+    if cfg.attn_bias:
+        params["layers"]["bq"] = jnp.zeros((L, H * HD), dtype)
+        params["layers"]["bk"] = jnp.zeros((L, KV * HD), dtype)
+        params["layers"]["bv"] = jnp.zeros((L, KV * HD), dtype)
     if cfg.rmsnorm_plus_one:
         # Gemma norm weights are a delta around 1; zero-init matches identity.
         params["layers"]["attn_norm"] = jnp.zeros((L, D), dtype)
@@ -92,11 +97,39 @@ def rmsnorm(x: jax.Array, w: jax.Array, eps: float, plus_one: bool) -> jax.Array
     return (normed * wf).astype(x.dtype)
 
 
-def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def _scale_rope_freqs(freqs: jax.Array, scaling: Optional[tuple]) -> jax.Array:
+    """Apply HF-style rope_scaling to inverse frequencies.
+
+    ("linear", factor): freqs / factor.
+    ("llama3", factor, low_ff, high_ff, orig_max): long wavelengths divided
+    by factor, short kept, smooth ramp between — matching the llama-3.1
+    frequency-scaling scheme every 3.1/3.2 checkpoint ships in config.json.
+    """
+    if scaling is None:
+        return freqs
+    kind = scaling[0]
+    if kind == "linear":
+        return freqs / scaling[1]
+    if kind == "llama3":
+        _, factor, low_ff, high_ff, orig_max = scaling
+        wavelen = 2.0 * jnp.pi / freqs
+        low_wl = orig_max / low_ff
+        high_wl = orig_max / high_ff
+        smooth = (orig_max / wavelen - low_ff) / (high_ff - low_ff)
+        interp = (1.0 - smooth) * freqs / factor + smooth * freqs
+        out = jnp.where(wavelen > low_wl, freqs / factor,
+                        jnp.where(wavelen < high_wl, freqs, interp))
+        return out
+    raise ValueError(f"unsupported rope scaling {kind!r}")
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float,
+         scaling: Optional[tuple] = None) -> jax.Array:
     """Rotary embedding. x: [B, T, heads, hd]; positions: [B, T]."""
     hd = x.shape[-1]
     half = hd // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    freqs = _scale_rope_freqs(freqs, scaling)
     angles = positions.astype(jnp.float32)[:, :, None, None] * freqs  # [B,T,1,half]
     cos, sin = jnp.cos(angles), jnp.sin(angles)
     x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
@@ -146,11 +179,16 @@ def forward_hidden(
     def layer_body(x, scanned):
         p, k_buf, v_buf = scanned  # p: one layer's params; bufs: [B, S, kv, hd]
         h = rmsnorm(x, p["attn_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
-        q = jnp.einsum("btd,dh->bth", h, p["wq"]).reshape(B, T, cfg.n_heads, cfg.head_dim)
-        k = jnp.einsum("btd,dh->bth", h, p["wk"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-        v = jnp.einsum("btd,dh->bth", h, p["wv"]).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-        q = rope(q, positions, cfg.rope_theta)
-        k = rope(k, positions, cfg.rope_theta)
+        q = jnp.einsum("btd,dh->bth", h, p["wq"])
+        k = jnp.einsum("btd,dh->bth", h, p["wk"])
+        v = jnp.einsum("btd,dh->bth", h, p["wv"])
+        if cfg.attn_bias:               # Qwen2-style QKV biases
+            q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+        q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
         k_buf = jax.vmap(write_row)(k_buf, k, write_offset)
         v_buf = jax.vmap(write_row)(v_buf, v, write_offset)

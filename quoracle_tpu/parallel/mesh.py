@@ -56,6 +56,32 @@ def make_mesh(
     return Mesh(arr, axis_names=tuple(axis_names))
 
 
+def pool_submeshes(
+    n_members: int,
+    devices: Optional[Sequence] = None,
+    tp: Optional[int] = None,
+) -> list[Mesh]:
+    """Static partition of the slice into one sub-mesh per pool member —
+    the SURVEY §7 hard-part-1 design: each member's generate runs on its own
+    chips and the host scheduler overlaps members (models/runtime.py).
+
+    Contiguous device ranges keep each member's tp collectives on
+    neighboring ICI links. With fewer devices than members, members share
+    meshes round-robin (degenerates to the single-chip case at n=1).
+    """
+    devs = list(devices) if devices is not None else jax.devices()
+    per = max(1, len(devs) // n_members)
+    meshes = []
+    for i in range(n_members):
+        lo = (i * per) % len(devs)
+        sub = devs[lo:lo + per] or devs[:per]
+        t = tp or len(sub)
+        t = _largest_tp_divisor(len(sub), t)
+        arr = np.array(sub).reshape(len(sub) // t, t)
+        meshes.append(Mesh(arr, axis_names=("dp", "tp")))
+    return meshes
+
+
 def _largest_tp_divisor(n_kv_heads: int, tp_size: int) -> int:
     d = min(n_kv_heads, tp_size)
     while n_kv_heads % d or tp_size % d:
@@ -86,6 +112,11 @@ def param_specs(cfg: ModelConfig) -> dict:
         },
         "final_norm": P(None),
     }
+    if cfg.attn_bias:
+        # biases follow their projection's output sharding
+        specs["layers"]["bq"] = P(None, "tp")
+        specs["layers"]["bk"] = P(None, "tp")
+        specs["layers"]["bv"] = P(None, "tp")
     if not cfg.tie_embeddings:
         specs["lm_head"] = P(None, "tp")
     return specs
