@@ -136,6 +136,7 @@ class AgentCore:
                 force_reflection=config.force_reflection,
                 allowed_actions=set(allowed),
                 profile_optional_spawn=self.grove is not None,
+                session_key=self.agent_id,   # KV residency per agent×model
             ),
             log=lambda event, data: deps.events.log(
                 self.agent_id, "debug", event, **data))

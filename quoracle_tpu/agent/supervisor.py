@@ -82,6 +82,11 @@ class AgentSupervisor:
                 pass
         except Exception:
             pass  # crash already logged by _on_agent_done
+        # Free the agent's resident KV sessions — dead agents must not pin
+        # HBM until LRU pressure happens to evict them.
+        drop = getattr(self.deps.backend, "drop_session", None)
+        if drop is not None:
+            drop(agent_id)
         return True
 
     # -- tree termination (reference tree_terminator.ex) -------------------

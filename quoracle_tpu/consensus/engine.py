@@ -45,6 +45,9 @@ class ConsensusConfig:
     allowed_actions: Optional[set[str]] = None
     profile_optional_spawn: bool = False
     max_tokens: Optional[int] = None
+    # KV-residency key (the agent id): refinement rounds and later cycles
+    # reuse the resident prompt prefix on the TPU backend.
+    session_key: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -190,6 +193,7 @@ class ConsensusEngine:
                 temperature=temperature_for_round(
                     m, round_num, cfg.max_refinement_rounds),
                 max_tokens=cfg.max_tokens,
+                session_id=cfg.session_key,
             )
             for m in pool
         ]

@@ -28,25 +28,38 @@ from quoracle_tpu.models.transformer import (
 )
 
 
-def prefill(params: dict, cfg: ModelConfig, tokens: jax.Array,
-            prompt_lens: jax.Array, cache: KVCache) -> tuple[jax.Array, KVCache]:
-    """Fill the cache from right-padded prompts. Returns (last-token logits
-    [B, V], cache with lens = prompt_lens).
+def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jax.Array,
+                  prefix_lens: jax.Array, chunk_lens: jax.Array,
+                  cache: KVCache) -> tuple[jax.Array, KVCache]:
+    """Fill the cache from a right-padded token CHUNK starting at per-row
+    absolute position ``prefix_lens`` (0 = fresh prefill; >0 = resume on top
+    of a KV prefix already in the buffer — the prefix-reuse path). Returns
+    (last-token logits [B, V], cache with lens = prefix + chunk).
 
     The head projection happens AFTER gathering each row's last hidden state —
     projecting the full [B, T, vocab] tensor first would cost ~4 GB/row fp32
     at llama-3-8b scale for values that are immediately discarded."""
     B, T = tokens.shape
-    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+    positions = (prefix_lens[:, None]
+                 + jnp.arange(T, dtype=jnp.int32)[None, :])
+    total = (prefix_lens + chunk_lens).astype(jnp.int32)
     hidden, cache = forward_hidden(
         params, cfg, tokens, positions, cache,
-        write_offset=jnp.zeros((B,), jnp.int32),
-        kv_lens=prompt_lens,
+        write_offset=prefix_lens.astype(jnp.int32),
+        kv_lens=total,
     )
     last_h = jnp.take_along_axis(
-        hidden, (prompt_lens - 1)[:, None, None].astype(jnp.int32), axis=1)
+        hidden, (chunk_lens - 1)[:, None, None].astype(jnp.int32), axis=1)
     last = project_logits(params, cfg, last_h)[:, 0, :]
-    return last, cache._replace(lens=prompt_lens.astype(jnp.int32))
+    return last, cache._replace(lens=total)
+
+
+def prefill(params: dict, cfg: ModelConfig, tokens: jax.Array,
+            prompt_lens: jax.Array, cache: KVCache) -> tuple[jax.Array, KVCache]:
+    """Fresh prefill = prefill_chunk from position 0."""
+    B = tokens.shape[0]
+    return prefill_chunk(params, cfg, tokens,
+                         jnp.zeros((B,), jnp.int32), prompt_lens, cache)
 
 
 def decode(
@@ -138,6 +151,68 @@ class GenResult:
     n_gen_tokens: int
     latency_s: float
     finish_reason: str  # "stop" | "length"
+    n_cached_tokens: int = 0   # prompt prefix served from a resident KV session
+
+
+@dataclasses.dataclass
+class _Session:
+    """Resident KV state for one conversation (agent × model).
+
+    ``tokens`` are exactly the ids whose K/V live in ``k``/``v``
+    ([L, len(tokens), n_kv, hd] device arrays, no padding). The next round's
+    prompt reuses the longest common prefix — refinement rounds extend the
+    prior prompt, so the whole previous conversation prefills for free; after
+    condensation the prefix shrinks to the still-shared system prompt
+    (reference analog: cached system prompt, consensus_handler.ex:126-152).
+    """
+    tokens: list[int]
+    k: jax.Array
+    v: jax.Array
+    last_used: float = 0.0
+
+
+class SessionStore:
+    """LRU-bounded session cache; thread-safe (engines serve concurrent
+    agent rounds from executor threads)."""
+
+    def __init__(self, max_tokens: int = 262_144):
+        import threading
+        self.max_tokens = max_tokens
+        self._lock = threading.Lock()
+        self._sessions: dict[str, _Session] = {}
+
+    def get(self, key: str) -> Optional[_Session]:
+        with self._lock:
+            return self._sessions.get(key)
+
+    def put(self, key: str, sess: _Session) -> None:
+        sess.last_used = time.monotonic()
+        with self._lock:
+            self._sessions[key] = sess
+            total = sum(len(s.tokens) for s in self._sessions.values())
+            while total > self.max_tokens and len(self._sessions) > 1:
+                lru = min(self._sessions, key=lambda k:
+                          self._sessions[k].last_used)
+                if lru == key:
+                    break
+                total -= len(self._sessions[lru].tokens)
+                del self._sessions[lru]
+
+    def drop(self, key: str) -> None:
+        with self._lock:
+            self._sessions.pop(key, None)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._sessions)
+
+
+def _lcp(a: Sequence[int], b: Sequence[int]) -> int:
+    n = min(len(a), len(b))
+    i = 0
+    while i < n and a[i] == b[i]:
+        i += 1
+    return i
 
 
 class GenerateEngine:
@@ -164,10 +239,11 @@ class GenerateEngine:
     def __init__(self, cfg: ModelConfig, params: dict, tokenizer,
                  max_seq: Optional[int] = None, seed: int = 0,
                  prompt_buckets: Sequence[int] = (128, 256, 512, 1024, 2048, 4096, 8192),
-                 mesh=None):
+                 mesh=None, session_max_bytes: int = 2 << 30):
         import threading
         self.cfg = cfg
         self.mesh = mesh
+        self.last_prefill_tokens = 0   # diagnostics: suffix actually computed
         if mesh is not None:
             from quoracle_tpu.parallel.mesh import shard_params
             params = shard_params(params, mesh, cfg)
@@ -180,6 +256,14 @@ class GenerateEngine:
         # KV cache dtype follows the params (bf16 serving, fp32 parity tests)
         # — mixing dtypes would fail the in-place cache scatter.
         self.cache_dtype = jax.tree.leaves(params)[0].dtype
+        # Session budget in BYTES, converted to tokens for the store: per
+        # cached token K+V cost 2 · L · n_kv · hd · itemsize — at 8B scale
+        # that's ~128 KiB/token, so a token-denominated default would permit
+        # tens of GiB of HBM before "bounding" anything.
+        token_bytes = (2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
+                       * jnp.dtype(self.cache_dtype).itemsize)
+        self.sessions = SessionStore(
+            max_tokens=max(1, session_max_bytes // token_bytes))
         self._step = self._build_step()
 
     def _build_step(self):
@@ -190,26 +274,54 @@ class GenerateEngine:
             from quoracle_tpu.parallel.mesh import cache_spec
             kv_sharding = NamedSharding(mesh, cache_spec(cfg, mesh))
 
+        def _constrain(cache: KVCache) -> KVCache:
+            if mesh is None:
+                return cache
+            # Pin the cache layout (kv heads on tp, batch on dp) so the
+            # decode loop carries a stable sharding instead of whatever
+            # GSPMD back-propagates from the first write.
+            return cache._replace(
+                k=jax.lax.with_sharding_constraint(cache.k, kv_sharding),
+                v=jax.lax.with_sharding_constraint(cache.v, kv_sharding))
+
+        def _finish(params, cache, last_logits, rng, temperature, top_p,
+                    active, row_limit, max_new):
+            out, n_emitted = decode(params, cfg, cache, last_logits, rng,
+                                    temperature, top_p, max_new,
+                                    cfg.eos_token_id,
+                                    active=active, row_limit=row_limit,
+                                    pad_id=self.tokenizer.pad_id,
+                                    stop_ids=cfg.stop_token_ids)
+            return out, n_emitted, cache
+
         @functools.partial(jax.jit, static_argnames=("max_new", "cache_len"))
         def step(params, tokens, prompt_lens, rng, temperature, top_p, active,
                  row_limit, max_new: int, cache_len: int):
             B = tokens.shape[0]
-            cache = init_cache(cfg, B, cache_len, dtype=self.cache_dtype)
-            if mesh is not None:
-                # Pin the cache layout (kv heads on tp, batch on dp) so the
-                # decode loop carries a stable sharding instead of whatever
-                # GSPMD back-propagates from the first write.
-                cache = cache._replace(
-                    k=jax.lax.with_sharding_constraint(cache.k, kv_sharding),
-                    v=jax.lax.with_sharding_constraint(cache.v, kv_sharding))
-            last_logits, cache = prefill(params, cfg, tokens, prompt_lens, cache)
-            out, n_emitted = decode(params, cfg, cache, last_logits, rng,
-                                    temperature, top_p, max_new, cfg.eos_token_id,
-                                    active=active, row_limit=row_limit,
-                                    pad_id=self.tokenizer.pad_id,
-                                    stop_ids=cfg.stop_token_ids)
-            return out, n_emitted
+            cache = _constrain(init_cache(cfg, B, cache_len,
+                                          dtype=self.cache_dtype))
+            last_logits, cache = prefill(params, cfg, tokens, prompt_lens,
+                                         cache)
+            return _finish(params, cache, last_logits, rng, temperature,
+                           top_p, active, row_limit, max_new)
 
+        @functools.partial(jax.jit, static_argnames=("max_new", "cache_len"),
+                           donate_argnums=(1, 2))   # buffers update in place
+        def step_resume(params, k_buf, v_buf, tokens, prefix_lens, chunk_lens,
+                        rng, temperature, top_p, active, row_limit,
+                        max_new: int, cache_len: int):
+            # KV prefix already in the buffers (session reuse); only the
+            # suffix chunk runs through the stack.
+            del cache_len
+            B = tokens.shape[0]
+            cache = _constrain(KVCache(k=k_buf, v=v_buf,
+                                       lens=jnp.zeros((B,), jnp.int32)))
+            last_logits, cache = prefill_chunk(params, cfg, tokens,
+                                               prefix_lens, chunk_lens, cache)
+            return _finish(params, cache, last_logits, rng, temperature,
+                           top_p, active, row_limit, max_new)
+
+        self._step_resume = step_resume
         return step
 
     def next_rng(self) -> jax.Array:
@@ -224,7 +336,14 @@ class GenerateEngine:
         top_p: Sequence[float] | float = 1.0,
         max_new_tokens: Sequence[int] | int = 256,
         rng: Optional[jax.Array] = None,
+        session_ids: Optional[Sequence[Optional[str]]] = None,
     ) -> list[GenResult]:
+        """``session_ids`` (aligned with prompts; None entries opt out)
+        enables KV residency: each row reuses the longest token prefix it
+        shares with its session's resident cache and prefills only the
+        suffix; the prompt KV is stored back for the next round. Consensus
+        refinement rounds extend the previous prompt, so rounds 2+ skip
+        re-prefilling the whole conversation (SURVEY §7 hard part 2)."""
         t0 = time.monotonic()
         n = len(prompts)
         if n == 0:
@@ -248,7 +367,26 @@ class GenerateEngine:
             raise ContextOverflowError(
                 f"prompt of {max_prompt} tokens >= max_seq {self.max_seq} "
                 f"for model {self.cfg.name}")
-        T = _round_up(max_prompt, self.prompt_buckets)
+
+        # Session prefix lookup: how much of each prompt is already resident.
+        sess_rows: list[Optional[_Session]] = [None] * n
+        prefixes = [0] * n
+        if session_ids is not None:
+            for i, sid in enumerate(session_ids):
+                if not sid:
+                    continue
+                s = self.sessions.get(sid)
+                if s is None:
+                    continue
+                # ≥1 suffix token must run to produce last-position logits
+                p = min(_lcp(s.tokens, prompts[i]), len(prompts[i]) - 1)
+                if p > 0:
+                    sess_rows[i], prefixes[i] = s, p
+        resume = any(p > 0 for p in prefixes)
+
+        suffixes = [list(p[pre:]) for p, pre in zip(prompts, prefixes)]
+        max_chunk = max(len(s) for s in suffixes)
+        T = _round_up(max_chunk, self.prompt_buckets)
         B = _round_up(n, self.BATCH_BUCKETS)
         if self.mesh is not None:
             # batch rows ride the dp axis — pad the bucket to a multiple
@@ -260,14 +398,27 @@ class GenerateEngine:
         # limits stop each row at its own budget, so bucketing costs nothing.
         max_new = _round_up(min(max(row_budgets), self.max_seq - 1),
                             (64, 128, 256, 512, 1024, 2048, 4096))
+        if resume:
+            # The padded chunk is written at write_offset=prefix_i, so the
+            # buffer must cover max(prefix) + T (the full padded extent, NOT
+            # just max prompt length): dynamic_update_slice CLAMPS start
+            # indices, and an under-sized buffer would silently scribble the
+            # pad region over valid prefix KV.
+            max_prefix = max(prefixes)
+            cache_len = _round_up(max_prefix + T, self.prompt_buckets) + max_new
+        else:
+            cache_len = T + max_new
 
         tokens = np.full((B, T), self.tokenizer.pad_id, np.int32)
-        lens = np.ones((B,), np.int32)  # padded rows get length 1 (harmless)
+        pre_arr = np.zeros((B,), np.int32)
+        chunk_arr = np.ones((B,), np.int32)  # padded rows: 1 (harmless)
         limits = np.ones((B,), np.int32)
-        for i, p in enumerate(prompts):
-            tokens[i, :len(p)] = p
-            lens[i] = max(1, len(p))
-            limits[i] = max(1, min(row_budgets[i], self.max_seq - lens[i]))
+        for i, s in enumerate(suffixes):
+            tokens[i, :len(s)] = s
+            pre_arr[i] = prefixes[i]
+            chunk_arr[i] = max(1, len(s))
+            total = max(1, len(prompts[i]))
+            limits[i] = max(1, min(row_budgets[i], self.max_seq - total))
         temp_arr = np.zeros((B,), np.float32)
         temp_arr[:n] = temps
         top_arr = np.ones((B,), np.float32)
@@ -279,20 +430,36 @@ class GenerateEngine:
             from jax.sharding import NamedSharding, PartitionSpec as P
             row = NamedSharding(self.mesh, P("dp"))
             mat = NamedSharding(self.mesh, P("dp", None))
-            put = jax.device_put
-            args = (put(tokens, mat), put(lens, row))
-            samp = (put(temp_arr, row), put(top_arr, row),
-                    put(active, row), put(limits, row))
+            put = lambda a, s: jax.device_put(a, s)
         else:
-            args = (jnp.asarray(tokens), jnp.asarray(lens))
-            samp = (jnp.asarray(temp_arr), jnp.asarray(top_arr),
-                    jnp.asarray(active), jnp.asarray(limits))
-        out, n_emitted = self._step(
-            self.params, *args,
-            rng if rng is not None else self.next_rng(),
-            *samp,
-            max_new=max_new, cache_len=T + max_new,
-        )
+            row = mat = None
+            put = lambda a, s: jnp.asarray(a)
+        rng_key = rng if rng is not None else self.next_rng()
+        samp = (put(temp_arr, row), put(top_arr, row),
+                put(active, row), put(limits, row))
+
+        if resume:
+            kb, vb = self._assemble_kv(sess_rows, prefixes, B, cache_len)
+            out, n_emitted, cache = self._step_resume(
+                self.params, kb, vb, put(tokens, mat), put(pre_arr, row),
+                put(chunk_arr, row), rng_key, *samp,
+                max_new=max_new, cache_len=cache_len)
+        else:
+            out, n_emitted, cache = self._step(
+                self.params, put(tokens, mat), put(chunk_arr, row), rng_key,
+                *samp, max_new=max_new, cache_len=cache_len)
+        self.last_prefill_tokens = sum(len(s) for s in suffixes)
+
+        # Store prompt-level KV back into sessions for the next round.
+        if session_ids is not None:
+            for i, sid in enumerate(session_ids):
+                if not sid:
+                    continue
+                plen = len(prompts[i])
+                self.sessions.put(sid, _Session(
+                    tokens=list(prompts[i]),
+                    k=cache.k[:, i, :plen], v=cache.v[:, i, :plen]))
+
         out = np.asarray(out)
         n_emitted = np.asarray(n_emitted)
         latency = time.monotonic() - t0
@@ -315,5 +482,39 @@ class GenerateEngine:
                 n_gen_tokens=len(ids),
                 latency_s=latency,
                 finish_reason=finish,
+                n_cached_tokens=prefixes[i],
             ))
         return results
+
+    def _assemble_kv(self, sess_rows: list, prefixes: list[int], B: int,
+                     cache_len: int):
+        """Build the batch KV buffers with each row's resident prefix
+        written in. Rows without a session stay zero (their prefix is 0, so
+        the validity mask never reads them).
+
+        One stack per buffer instead of per-row .at[].set chains: each
+        out-of-jit .set copies the WHOLE buffer, so n session rows would
+        move n× the buffer size; pad-and-stack moves ~2×, and step_resume
+        donates the buffers so no further copy happens inside the jit."""
+        L, KV, HD = self.cfg.n_layers, self.cfg.n_kv_heads, self.cfg.head_dim
+        zero_row = jnp.zeros((L, cache_len, KV, HD), self.cache_dtype)
+
+        def row(side: str, s, p: int):
+            if s is None or p == 0:
+                return zero_row
+            arr = (s.k if side == "k" else s.v)[:, :p].astype(self.cache_dtype)
+            return jnp.pad(arr, ((0, 0), (0, cache_len - p), (0, 0), (0, 0)))
+
+        kb = jnp.stack([row("k", s, p)
+                        for s, p in zip(sess_rows, prefixes)]
+                       + [zero_row] * (B - len(sess_rows)), axis=1)
+        vb = jnp.stack([row("v", s, p)
+                        for s, p in zip(sess_rows, prefixes)]
+                       + [zero_row] * (B - len(sess_rows)), axis=1)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+            from quoracle_tpu.parallel.mesh import cache_spec
+            sharding = NamedSharding(self.mesh, cache_spec(self.cfg, self.mesh))
+            kb = jax.device_put(kb, sharding)
+            vb = jax.device_put(vb, sharding)
+        return kb, vb

@@ -40,6 +40,10 @@ class QueryRequest:
     temperature: float = 1.0
     top_p: float = 1.0
     max_tokens: Optional[int] = None   # None = dynamic (window - input, capped)
+    # KV residency key (normally the agent id): rows with a session reuse
+    # the prompt prefix already resident in that session's cache and refill
+    # only the suffix (GenerateEngine sessions; SURVEY §7 hard part 2).
+    session_id: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -92,6 +96,10 @@ class ModelBackend(abc.ABC):
 
     @abc.abstractmethod
     def output_limit(self, model_spec: str) -> int: ...
+
+    def drop_session(self, session_id: str) -> None:
+        """Release any resident KV state for a conversation (called on agent
+        termination). No-op for backends without KV residency."""
 
 
 # ---------------------------------------------------------------------------
@@ -200,7 +208,7 @@ class TPUBackend(ModelBackend):
                     permanent_error=True)
             return
         t0 = time.monotonic()
-        prompts, temps, tops, budgets, live_idxs = [], [], [], [], []
+        prompts, temps, tops, budgets, live_idxs, sess = [], [], [], [], [], []
         max_seq = engine.max_seq
         for i in idxs:
             r = requests[i]
@@ -217,6 +225,7 @@ class TPUBackend(ModelBackend):
             prompts.append(ids)
             temps.append(r.temperature)
             tops.append(r.top_p)
+            sess.append(r.session_id)
             window, out_lim = engine.cfg.context_window, engine.cfg.output_limit
             floor = min(OUTPUT_FLOOR, out_lim)
             budget = min(out_lim, max(floor, window - len(ids)))
@@ -227,7 +236,8 @@ class TPUBackend(ModelBackend):
         try:
             gens = engine.generate(
                 prompts, temperature=temps, top_p=tops,
-                max_new_tokens=budgets)
+                max_new_tokens=budgets,
+                session_ids=sess if any(sess) else None)
         except ContextOverflowError as e:
             for i in live_idxs:
                 results[i] = QueryResult(model_spec=spec,
@@ -245,6 +255,10 @@ class TPUBackend(ModelBackend):
 
     def embed(self, texts: Sequence[str]) -> list[np.ndarray]:
         return self.embedder.embed(texts)
+
+    def drop_session(self, session_id: str) -> None:
+        for engine in self.engines.values():
+            engine.sessions.drop(session_id)
 
     def count_tokens(self, model_spec: str, text: str) -> int:
         return self.engines[model_spec].tokenizer.count(text)
