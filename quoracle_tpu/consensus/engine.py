@@ -48,6 +48,10 @@ class ConsensusConfig:
     # KV-residency key (the agent id): refinement rounds and later cycles
     # reuse the resident prompt prefix on the TPU backend.
     session_key: Optional[str] = None
+    # Grammar-masked decoding: proposals are valid JSON by construction on
+    # backends that support it (TPU); mock/HTTP backends ignore the flag and
+    # the parser's markdown-unwrap recovery still applies.
+    constrained_json: bool = True
 
 
 @dataclasses.dataclass
@@ -194,6 +198,7 @@ class ConsensusEngine:
                     m, round_num, cfg.max_refinement_rounds),
                 max_tokens=cfg.max_tokens,
                 session_id=cfg.session_key,
+                constrain_json=cfg.constrained_json,
             )
             for m in pool
         ]

@@ -27,6 +27,10 @@ from quoracle_tpu.models.transformer import (
     KVCache, forward_hidden, init_cache, project_logits,
 )
 
+# Finite mask value: a whole-row -inf would NaN the sampling softmax; the
+# grammar layer guarantees >= 1 allowed token, this is defense in depth.
+NEG_INF_LOGITS = -1e30
+
 
 def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jax.Array,
                   prefix_lens: jax.Array, chunk_lens: jax.Array,
@@ -76,6 +80,8 @@ def decode(
     row_limit: jax.Array,      # [B] int32 per-row generation budget (<= max_new)
     pad_id: int = 0,
     stop_ids: tuple = (),      # extra stop ids (llama-3 <|eot_id|> style)
+    json_table: Optional[jax.Array] = None,   # [S, V] grammar transitions
+    json_state: Optional[jax.Array] = None,   # [B] int32; -1 = unconstrained
 ) -> tuple[jax.Array, jax.Array]:
     """Autoregressive decode.
 
@@ -90,17 +96,47 @@ def decode(
     its limit, so bucketing never costs extra forward steps and no row's
     positions run past the context window. Padding rows (``~active``) start
     done, so the early-exit fires when every REAL row has finished.
+
+    With ``json_table``/``json_state`` set, rows whose state is >= 0 sample
+    under the JSON grammar mask (models/constrained.py): each step is one
+    row gather (allowed = table[state] >= 0) + where() before sampling, and
+    a scalar gather to advance the state — output is valid JSON by
+    construction (SURVEY §7 hard part 4).
     """
     B = first_logits.shape[0]
     stops = jnp.asarray((eos_id,) + tuple(stop_ids), jnp.int32)
+    constrained = json_table is not None
 
     def is_stop(tok):
         return jnp.any(tok[:, None] == stops[None, :], axis=1)
 
+    def mask_logits(logits, jstate):
+        if not constrained:
+            return logits
+        allowed = json_table[jnp.clip(jstate, 0, None)] >= 0   # [B, V]
+        # dead-end safety: if no token is allowed (vocab gap), permit eos so
+        # the row stops instead of sampling from an all -inf row
+        none_ok = ~jnp.any(allowed, axis=-1, keepdims=True)
+        eos_hot = (jnp.arange(logits.shape[-1]) == eos_id)[None, :]
+        allowed = allowed | (none_ok & eos_hot) | (jstate < 0)[:, None]
+        return jnp.where(allowed, logits, NEG_INF_LOGITS)
+
+    def advance(jstate, tok, done):
+        if not constrained:
+            return jstate
+        nxt = json_table[jnp.clip(jstate, 0, None),
+                         tok].astype(jnp.int32)
+        return jnp.where((jstate >= 0) & ~done, nxt, jstate)
+
+    jstate0 = json_state if constrained else jnp.zeros((B,), jnp.int32)
+
     rng, k0 = jax.random.split(rng)
-    tok0 = sample_tokens(first_logits, k0, temperature, top_p)
+    tok0 = sample_tokens(mask_logits(first_logits, jstate0), k0,
+                         temperature, top_p)
     n0 = jnp.where(active, 1, 0).astype(jnp.int32)
     done0 = ~active | is_stop(tok0) | (n0 >= row_limit)
+    # advance on tok0 for every active row (eos self-loops in accept states)
+    jstate0 = advance(jstate0, tok0, ~active)
     out0 = jnp.full((B, max_new), pad_id, jnp.int32).at[:, 0].set(tok0)
 
     def cond(carry):
@@ -108,7 +144,7 @@ def decode(
         return (i < max_new) & ~jnp.all(done)
 
     def body(carry):
-        i, done, cur, out, n_emitted, cache, rng = carry
+        i, done, cur, out, n_emitted, cache, rng, jstate = carry
         positions = cache.lens[:, None]
         hidden, cache = forward_hidden(
             params, cfg, cur[:, None], positions, cache,
@@ -116,17 +152,21 @@ def decode(
         )
         logits = project_logits(params, cfg, hidden)
         rng, k = jax.random.split(rng)
-        nxt = sample_tokens(logits[:, 0, :], k, temperature, top_p)
+        nxt = sample_tokens(mask_logits(logits[:, 0, :], jstate), k,
+                            temperature, top_p)
         nxt = jnp.where(done, pad_id, nxt)
         out = jax.lax.dynamic_update_slice_in_dim(out, nxt[:, None], i, axis=1)
         n_emitted = n_emitted + jnp.where(done, 0, 1).astype(jnp.int32)
         cache = cache._replace(lens=cache.lens + jnp.where(done, 0, 1))
+        jstate = advance(jstate, nxt, done)
         done = done | is_stop(nxt) | (n_emitted >= row_limit)
-        return (i + 1, done, nxt, out, n_emitted, cache, rng)
+        return (i + 1, done, nxt, out, n_emitted, cache, rng, jstate)
 
     # Feed the first sampled token through the loop starting at step 1.
-    init = (jnp.asarray(1, jnp.int32), done0, tok0, out0, n0, cache, rng)
-    _, done, _, out, n_emitted, cache, _ = jax.lax.while_loop(cond, body, init)
+    init = (jnp.asarray(1, jnp.int32), done0, tok0, out0, n0, cache, rng,
+            jstate0)
+    _, done, _, out, n_emitted, cache, _, _ = \
+        jax.lax.while_loop(cond, body, init)
     return out, n_emitted
 
 
@@ -285,30 +325,35 @@ class GenerateEngine:
                 v=jax.lax.with_sharding_constraint(cache.v, kv_sharding))
 
         def _finish(params, cache, last_logits, rng, temperature, top_p,
-                    active, row_limit, max_new):
+                    active, row_limit, max_new, json_table, json_state):
             out, n_emitted = decode(params, cfg, cache, last_logits, rng,
                                     temperature, top_p, max_new,
                                     cfg.eos_token_id,
                                     active=active, row_limit=row_limit,
                                     pad_id=self.tokenizer.pad_id,
-                                    stop_ids=cfg.stop_token_ids)
+                                    stop_ids=cfg.stop_token_ids,
+                                    json_table=json_table,
+                                    json_state=json_state)
             return out, n_emitted, cache
 
         @functools.partial(jax.jit, static_argnames=("max_new", "cache_len"))
         def step(params, tokens, prompt_lens, rng, temperature, top_p, active,
-                 row_limit, max_new: int, cache_len: int):
+                 row_limit, json_table, json_state,
+                 max_new: int, cache_len: int):
             B = tokens.shape[0]
             cache = _constrain(init_cache(cfg, B, cache_len,
                                           dtype=self.cache_dtype))
             last_logits, cache = prefill(params, cfg, tokens, prompt_lens,
                                          cache)
             return _finish(params, cache, last_logits, rng, temperature,
-                           top_p, active, row_limit, max_new)
+                           top_p, active, row_limit, max_new,
+                           json_table, json_state)
 
         @functools.partial(jax.jit, static_argnames=("max_new", "cache_len"),
                            donate_argnums=(1, 2))   # buffers update in place
         def step_resume(params, k_buf, v_buf, tokens, prefix_lens, chunk_lens,
                         rng, temperature, top_p, active, row_limit,
+                        json_table, json_state,
                         max_new: int, cache_len: int):
             # KV prefix already in the buffers (session reuse); only the
             # suffix chunk runs through the stack.
@@ -319,7 +364,8 @@ class GenerateEngine:
             last_logits, cache = prefill_chunk(params, cfg, tokens,
                                                prefix_lens, chunk_lens, cache)
             return _finish(params, cache, last_logits, rng, temperature,
-                           top_p, active, row_limit, max_new)
+                           top_p, active, row_limit, max_new,
+                           json_table, json_state)
 
         self._step_resume = step_resume
         return step
@@ -337,6 +383,7 @@ class GenerateEngine:
         max_new_tokens: Sequence[int] | int = 256,
         rng: Optional[jax.Array] = None,
         session_ids: Optional[Sequence[Optional[str]]] = None,
+        constrain_json: Optional[Sequence[bool]] = None,
     ) -> list[GenResult]:
         """``session_ids`` (aligned with prompts; None entries opt out)
         enables KV residency: each row reuses the longest token prefix it
@@ -438,16 +485,28 @@ class GenerateEngine:
         samp = (put(temp_arr, row), put(top_arr, row),
                 put(active, row), put(limits, row))
 
+        # JSON grammar constraint: rows flagged True start in the grammar's
+        # start state; -1 rows sample unconstrained.
+        if constrain_json is not None and any(constrain_json):
+            table = self._json_table_device()
+            jstate = np.full((B,), -1, np.int32)
+            for i, flag in enumerate(constrain_json):
+                if flag:
+                    jstate[i] = self._json_start
+            json_args = (table, put(jstate, row))
+        else:
+            json_args = (None, None)
+
         if resume:
             kb, vb = self._assemble_kv(sess_rows, prefixes, B, cache_len)
             out, n_emitted, cache = self._step_resume(
                 self.params, kb, vb, put(tokens, mat), put(pre_arr, row),
-                put(chunk_arr, row), rng_key, *samp,
+                put(chunk_arr, row), rng_key, *samp, *json_args,
                 max_new=max_new, cache_len=cache_len)
         else:
             out, n_emitted, cache = self._step(
                 self.params, put(tokens, mat), put(chunk_arr, row), rng_key,
-                *samp, max_new=max_new, cache_len=cache_len)
+                *samp, *json_args, max_new=max_new, cache_len=cache_len)
         self.last_prefill_tokens = sum(len(s) for s in suffixes)
 
         # Store prompt-level KV back into sessions for the next round.
@@ -485,6 +544,21 @@ class GenerateEngine:
                 n_cached_tokens=prefixes[i],
             ))
         return results
+
+    def _json_table_device(self):
+        """Lazily build + cache the JSON grammar table for this tokenizer
+        (one vocab walk, a few hundred ms; then device-resident int16)."""
+        if getattr(self, "_json_table", None) is None:
+            from quoracle_tpu.models.constrained import JsonTokenTable
+            tt = JsonTokenTable.for_tokenizer(
+                self.tokenizer,
+                # vocab per the MODEL (logit width), padding beyond the
+                # tokenizer's ids stays rejected
+                self.cfg.vocab_size, self.cfg.eos_token_id,
+                extra_stop_ids=tuple(self.cfg.stop_token_ids))
+            self._json_table = jnp.asarray(tt.table)
+            self._json_start = tt.start_state
+        return self._json_table
 
     def _assemble_kv(self, sess_rows: list, prefixes: list[int], B: int,
                      cache_len: int):

@@ -44,6 +44,9 @@ class QueryRequest:
     # the prompt prefix already resident in that session's cache and refill
     # only the suffix (GenerateEngine sessions; SURVEY §7 hard part 2).
     session_id: Optional[str] = None
+    # Grammar-masked sampling: the response is a syntactically valid JSON
+    # object by construction (models/constrained.py; SURVEY §7 hard part 4).
+    constrain_json: bool = False
 
 
 @dataclasses.dataclass
@@ -209,6 +212,7 @@ class TPUBackend(ModelBackend):
             return
         t0 = time.monotonic()
         prompts, temps, tops, budgets, live_idxs, sess = [], [], [], [], [], []
+        cjson = []
         max_seq = engine.max_seq
         for i in idxs:
             r = requests[i]
@@ -226,6 +230,7 @@ class TPUBackend(ModelBackend):
             temps.append(r.temperature)
             tops.append(r.top_p)
             sess.append(r.session_id)
+            cjson.append(r.constrain_json)
             window, out_lim = engine.cfg.context_window, engine.cfg.output_limit
             floor = min(OUTPUT_FLOOR, out_lim)
             budget = min(out_lim, max(floor, window - len(ids)))
@@ -237,7 +242,8 @@ class TPUBackend(ModelBackend):
             gens = engine.generate(
                 prompts, temperature=temps, top_p=tops,
                 max_new_tokens=budgets,
-                session_ids=sess if any(sess) else None)
+                session_ids=sess if any(sess) else None,
+                constrain_json=cjson if any(cjson) else None)
         except ContextOverflowError as e:
             for i in live_idxs:
                 results[i] = QueryResult(model_spec=spec,
