@@ -126,9 +126,15 @@ class AgentCore:
             self.skills_loader = deps.skills
         self.active_skills: list[str] = list(config.active_skills)
 
+        self.engine = self._build_engine()
+
+    def _build_engine(self) -> ConsensusEngine:
+        """Consensus engine for the CURRENT model pool — rebuilt on
+        switch_model_pool (reference core.ex:115-127)."""
+        config, deps = self.config, self.deps
         allowed = filter_actions(list(ACTIONS), config.capability_groups,
                                  config.forbidden_actions)
-        self.engine = ConsensusEngine(
+        return ConsensusEngine(
             deps.backend,
             ConsensusConfig(
                 model_pool=list(config.model_pool),
@@ -259,6 +265,8 @@ class AgentCore:
                 "content": "Your wait period elapsed with no new events.",
             })
             self._maybe_schedule_consensus()
+        elif t == "switch_model_pool":
+            await self._switch_model_pool(list(msg["model_pool"]))
         elif t == "stop_requested":
             # Graceful: finish the mailbox up to here, skip new consensus
             # (reference core.ex:425-429 drains triggers and stops normally).
@@ -366,6 +374,54 @@ class AgentCore:
             inline_condense(self.ctx, m, n, self._reflect_fn,
                             embedder=deps.backend)
         return outcome
+
+    # -- model-pool switching ----------------------------------------------
+
+    async def _switch_model_pool(self, new_pool: list[str]) -> None:
+        """HistoryTransfer (reference core.ex:115-127, history_transfer.ex):
+        re-key histories + ACE onto the new pool, drop the old pool's KV
+        sessions, rebuild the consensus engine. Condensation may reflect via
+        the backend, so the transfer runs off-loop like consensus does."""
+        deps = self.deps
+        old_pool = list(self.config.model_pool)
+        if set(new_pool) == set(old_pool):
+            # Same membership (possibly reordered): nothing to transfer and
+            # every resident KV prefix stays valid.
+            self.config.model_pool = list(new_pool)
+            self.engine = self._build_engine()
+            return
+        loop = asyncio.get_running_loop()
+        report = await loop.run_in_executor(
+            None, self._switch_blocking, old_pool, new_pool)
+        deps.events.log(
+            self.agent_id, "info",
+            f"model pool switched {old_pool} -> {new_pool}",
+            sources=report.source_for, condensed=sorted(report.condensed),
+            dropped=report.dropped_models)
+        if deps.persistence is not None:
+            # persist_agent rewrites the serialized config, so the NEW pool
+            # is what a restore rebuilds with.
+            deps.persistence.persist_agent(self)
+
+    def _switch_blocking(self, old_pool: list[str], new_pool: list[str]):
+        from quoracle_tpu.context.history_transfer import transfer_histories
+        deps = self.deps
+        report = transfer_histories(
+            self.ctx, old_pool, new_pool, deps.token_manager,
+            self._reflect_fn, deps.backend.output_limit,
+            embedder=deps.backend)
+        # Drop KV sessions whose histories changed: removed members and
+        # members that just inherited a transferred history. Unchanged
+        # members keep their still-valid resident prefixes.
+        stale = set(report.dropped_models) | set(report.source_for)
+        if stale:
+            deps.backend.drop_session(self.agent_id, model_specs=sorted(stale))
+        # A pending reactive-condensation flag for a dropped model would
+        # re-create its history key via ctx.history() next cycle.
+        self._overflow_models &= set(new_pool)
+        self.config.model_pool = list(new_pool)
+        self.engine = self._build_engine()
+        return report
 
     def _process_outcome(self, outcome: ConsensusOutcome) -> None:
         deps, cfg = self.deps, self.config

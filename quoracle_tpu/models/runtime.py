@@ -100,9 +100,12 @@ class ModelBackend(abc.ABC):
     @abc.abstractmethod
     def output_limit(self, model_spec: str) -> int: ...
 
-    def drop_session(self, session_id: str) -> None:
-        """Release any resident KV state for a conversation (called on agent
-        termination). No-op for backends without KV residency."""
+    def drop_session(self, session_id: str,
+                     model_specs: Optional[Sequence[str]] = None) -> None:
+        """Release resident KV state for a conversation (called on agent
+        termination / pool switch). ``model_specs`` limits the drop to those
+        members' engines — a pool switch keeps unchanged members' still-valid
+        prefixes resident. No-op for backends without KV residency."""
 
 
 # ---------------------------------------------------------------------------
@@ -262,9 +265,12 @@ class TPUBackend(ModelBackend):
     def embed(self, texts: Sequence[str]) -> list[np.ndarray]:
         return self.embedder.embed(texts)
 
-    def drop_session(self, session_id: str) -> None:
-        for engine in self.engines.values():
-            engine.sessions.drop(session_id)
+    def drop_session(self, session_id: str,
+                     model_specs: Optional[Sequence[str]] = None) -> None:
+        keep = None if model_specs is None else set(model_specs)
+        for spec, engine in self.engines.items():
+            if keep is None or spec in keep:
+                engine.sessions.drop(session_id)
 
     def count_tokens(self, model_spec: str, text: str) -> int:
         return self.engines[model_spec].tokenizer.count(text)

@@ -1,0 +1,216 @@
+"""HistoryTransfer: runtime model-pool switching.
+
+Parity with the reference's HistoryTransfer + Core.switch_model_pool
+(reference lib/quoracle/agent/history_transfer.ex, core.ex:115-127,257-263):
+new pool members inherit the largest fitting old history (condensed if
+nothing fits), ACE is re-keyed, old sessions drop, and the switch survives a
+persistence restore.
+"""
+
+import asyncio
+import json
+import time
+
+from quoracle_tpu.agent import AgentConfig, AgentDeps, AgentSupervisor
+from quoracle_tpu.context.history import (
+    DECISION, USER, AgentContext, HistoryEntry, Lesson,
+)
+from quoracle_tpu.context.history_transfer import transfer_histories
+from quoracle_tpu.context.reflector import Reflection
+from quoracle_tpu.context.token_manager import TokenManager
+from quoracle_tpu.models.runtime import MockBackend
+from quoracle_tpu.persistence import Database, Persistence, TaskManager
+from quoracle_tpu.persistence.store import PersistentSecretStore
+
+POOL = MockBackend.DEFAULT_POOL
+NEW_POOL = ["mock:new-model-a", "mock:new-model-b"]
+
+
+def j(action, params=None, wait=False):
+    return json.dumps({"action": action, "params": params or {},
+                       "reasoning": "test", "wait": wait})
+
+
+def reflect_stub(model_spec, entries):
+    return Reflection(summary_text=f"[summary of {len(entries)} entries]",
+                      lessons=[], state=[])
+
+
+def char_tm(limits):
+    """1 token per 4 chars; per-model windows from ``limits``."""
+    return TokenManager(lambda spec, text: max(1, len(text) // 4),
+                        context_limit_fn=lambda spec: limits[spec])
+
+
+async def until(cond, timeout=10.0, interval=0.01):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return
+        await asyncio.sleep(interval)
+    raise AssertionError("condition not met within timeout")
+
+
+def run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+# ---------------------------------------------------------------------------
+# Pure transfer semantics
+# ---------------------------------------------------------------------------
+
+def test_largest_fitting_history_is_chosen():
+    ctx = AgentContext()
+    # old-a: large history; old-b: small one
+    ctx.model_histories["old-a"] = [
+        HistoryEntry(kind=USER, content="x" * 400) for _ in range(5)]
+    ctx.model_histories["old-b"] = [HistoryEntry(kind=USER, content="short")]
+    ctx.context_lessons["old-a"] = [Lesson(type="factual", content="A fact")]
+    ctx.model_states["old-a"] = ["state summary"]
+
+    limits = {"old-a": 100_000, "old-b": 100_000, "new-1": 100_000}
+    tm = char_tm(limits)
+    report = transfer_histories(
+        ctx, ["old-a", "old-b"], ["new-1"], tm, reflect_stub,
+        output_limit_fn=lambda spec: 4096)
+
+    assert report.source_for["new-1"] == "old-a"
+    assert len(ctx.model_histories["new-1"]) == 5
+    # ACE re-keyed from the same source
+    assert ctx.context_lessons["new-1"][0].content == "A fact"
+    assert ctx.model_states["new-1"] == ["state summary"]
+    # old keys dropped
+    assert set(ctx.model_histories) == {"new-1"}
+    assert sorted(report.dropped_models) == ["old-a", "old-b"]
+
+
+def test_nonfitting_history_condenses_until_fits():
+    ctx = AgentContext()
+    # 30 entries x 100 tokens = 3000 tokens; new model window 2000 with
+    # output_limit 500 -> floor 500 -> fits only below ~1470 tokens.
+    ctx.model_histories["old-a"] = [
+        HistoryEntry(kind=USER, content="y" * 400) for _ in range(30)]
+    limits = {"old-a": 100_000, "new-1": 2000}
+    tm = char_tm(limits)
+    report = transfer_histories(
+        ctx, ["old-a"], ["new-1"], tm, reflect_stub,
+        output_limit_fn=lambda spec: 500)
+
+    assert report.condensed.get("new-1")
+    tokens = tm.history_tokens("new-1", ctx.model_histories["new-1"])
+    assert tm.dynamic_max_tokens("new-1", tokens, 500) is not None
+    # condensation left a summary entry at the head
+    assert ctx.model_histories["new-1"][0].kind == "summary"
+
+
+def test_kept_model_retains_its_own_history():
+    ctx = AgentContext()
+    ctx.model_histories["shared"] = [HistoryEntry(kind=USER, content="mine")]
+    ctx.model_histories["old-b"] = [
+        HistoryEntry(kind=USER, content="w" * 4000)]
+    limits = {"shared": 100_000, "old-b": 100_000, "new-1": 100_000}
+    tm = char_tm(limits)
+    transfer_histories(
+        ctx, ["shared", "old-b"], ["shared", "new-1"], tm, reflect_stub,
+        output_limit_fn=lambda spec: 4096)
+    # the kept model keeps its own (small) history, not the largest
+    assert ctx.model_histories["shared"][0].content == "mine"
+    # the new model inherits the largest
+    assert ctx.model_histories["new-1"][0].content == "w" * 4000
+    assert "old-b" not in ctx.model_histories
+
+
+# ---------------------------------------------------------------------------
+# Agent-level switch
+# ---------------------------------------------------------------------------
+
+class DropRecordingBackend(MockBackend):
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.dropped_sessions = []
+
+    def drop_session(self, session_id, model_specs=None):
+        self.dropped_sessions.append((session_id, model_specs))
+
+
+def test_switch_model_pool_preserves_context_and_drops_sessions():
+    async def main():
+        backend = DropRecordingBackend(
+            scripts={m: [j("todo", {"items": [{"task": "t", "done": False}]})]
+                     for m in POOL + NEW_POOL},
+            respond=lambda r: j("wait", {}))
+        deps = AgentDeps.for_tests(backend)
+        sup = AgentSupervisor(deps)
+        core = await sup.start_agent(AgentConfig(
+            agent_id="agent-switch", task_id="task-1",
+            model_pool=list(POOL)))
+        core.post({"type": "user_message", "content": "do something",
+                   "from": "user"})
+        await until(lambda: any(
+            e.kind == DECISION for e in core.ctx.history(POOL[0])))
+
+        core.post({"type": "switch_model_pool", "model_pool": list(NEW_POOL)})
+        await until(lambda: core.config.model_pool == NEW_POOL)
+
+        # context preserved: the new models carry the old conversation
+        for m in NEW_POOL:
+            kinds = [e.kind for e in core.ctx.history(m)]
+            assert USER in kinds and DECISION in kinds
+        assert set(core.ctx.model_histories) == set(NEW_POOL)
+        # resident KV sessions dropped for exactly the changed members
+        # (old pool removed + new members that inherited a history)
+        assert len(backend.dropped_sessions) == 1
+        sid, specs = backend.dropped_sessions[0]
+        assert sid == "agent-switch"
+        assert set(specs) == set(POOL) | set(NEW_POOL)
+        # consensus engine now queries the new pool
+        assert core.engine.config.model_pool == NEW_POOL
+        n_before = len(backend.calls)
+        core.post({"type": "user_message", "content": "again", "from": "u"})
+        await until(lambda: len(backend.calls) > n_before)
+        # every post-switch query targets the new pool only
+        assert {c.model_spec for c in backend.calls[n_before:]} <= set(NEW_POOL)
+        await sup.terminate_agent("agent-switch")
+    run(main())
+
+
+def test_switch_survives_pause_and_restore():
+    async def main():
+        db = Database(":memory:", encryption_key="k" * 16)
+        store = Persistence(db)
+        backend = MockBackend(
+            scripts={m: [j("todo", {"items": [{"task": "x", "done": False}]})]
+                     for m in POOL + NEW_POOL},
+            respond=lambda r: j("wait", {}))
+        deps = AgentDeps.for_tests(backend,
+                                   secrets=PersistentSecretStore(db))
+        deps.persistence = store
+        sup = AgentSupervisor(deps)
+        tm = TaskManager(deps, store)
+        task_id, root = await tm.create_task(
+            "switch test", model_pool=list(POOL))
+        root.post({"type": "user_message", "content": "go", "from": "user"})
+        await until(lambda: any(
+            e.kind == DECISION for e in root.ctx.history(POOL[0])))
+
+        root.post({"type": "switch_model_pool", "model_pool": list(NEW_POOL)})
+        await until(lambda: root.config.model_pool == NEW_POOL)
+        await tm.pause_task(task_id)
+
+        # restore into a fresh stack sharing the same DB
+        deps2 = AgentDeps.for_tests(backend,
+                                    secrets=PersistentSecretStore(db))
+        deps2.persistence = store
+        sup2 = AgentSupervisor(deps2)
+        tm2 = TaskManager(deps2, store)
+        n = await tm2.restore_task(task_id)
+        assert n >= 1
+        restored = deps2.registry.agents_for_task(task_id)[0].core
+        # the switch persisted: restored agent runs the NEW pool with the
+        # transferred history
+        assert restored.config.model_pool == NEW_POOL
+        for m in NEW_POOL:
+            kinds = [e.kind for e in restored.ctx.history(m)]
+            assert DECISION in kinds
+        await tm2.pause_task(task_id)
+    run(main())
