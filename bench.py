@@ -1,13 +1,28 @@
-"""Driver benchmark: 3-model consensus-round latency + tokens/sec/chip on TPU.
+"""Driver benchmark: consensus-round latency + tokens/sec/chip on TPU,
+measured through the PRODUCTION serving stack.
 
-Measures the framework's headline metric (BASELINE.json): the latency of one
-consensus round — every pool member generates its action proposal for the same
-agent turn — run entirely on-device, zero external LLM calls. The reference
-implements this round as one HTTPS request per model with p50 ≈ the slowest
-provider (reference lib/quoracle/models/model_query.ex:88-131); it publishes
-no numbers (BASELINE.md), so ``vs_baseline`` compares against the documented
-hosted-API estimate: a 3-model round at typical hosted p50s ≈ 7500 ms
-(slowest-of-3 for ~128 output tokens + provider overhead; see BASELINE.md).
+What runs (nothing stubbed — VERDICT r2 item 1):
+  real HF-format checkpoints (generated locally at 1b scale on first run,
+  models/make_checkpoint.py) → models/loader.py → each checkpoint's own
+  trained BPE tokenizer + chat template (HFAutoTokenizer) → TPUBackend
+  (models/runtime.py) with KV session residency ON, grammar-constrained
+  JSON decoding ON, and production overlap semantics.
+
+Each measured cycle simulates one agent turn the way the consensus engine
+drives it (consensus/engine.py): round 1 proposes from the full system
+prompt + task; rounds 2-3 are refinement rounds whose prompts EXTEND the
+prior conversation — with sessions on, only the new suffix prefills
+(SURVEY §7 hard part 2). Three configs from BASELINE.md are measured:
+
+  config 1 — 1-model pool, single agent turn (3 rounds)
+  config 2 — 3-model consensus pool, single agent turn (3 rounds)  [headline]
+  config 3 — 3 agents deciding concurrently, 3-model pool, one round each
+             (rows batch per pool member)
+
+``vs_baseline`` divides the estimated hosted-API 3-model round p50 by the
+measured config-2 p50. The estimate is DERIVED in BASELINE.md (per-call
+latency model: TTFT + tokens/decode-rate, slowest-of-3), not published by
+the reference — it publishes no numbers at all (BASELINE.md).
 
 Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
 """
@@ -15,90 +30,229 @@ Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
 from __future__ import annotations
 
 import json
+import os
 import statistics
 import sys
 import time
 
-HOSTED_BASELINE_MS = 7500.0  # BASELINE.md: estimated hosted-API 3-model round p50
-POOL = ["xla:llama-1b", "xla:mistral-1b", "xla:gemma-1b"]  # bench-scale trio
+# BASELINE.md "Hosted-API comparison point": slowest-of-3 hosted calls for
+# 128 output tokens ≈ TTFT 0.8 s + 128 tok / 32 tok/s = 4.8 s ≈ 5000 ms.
+HOSTED_BASELINE_MS = 5000.0
+SCALE = "1b"
+FAMILIES = ["llama", "mistral", "gemma"]
 MAX_NEW = 128
-N_ROUNDS = 5
+N_CYCLES = 4          # measured agent turns per config (plus 1 warmup)
+ROUNDS_PER_CYCLE = 3  # initial + 2 refinement rounds
 
-PROMPT = (
-    "You are an autonomous agent deciding your next action. Respond with a "
-    "JSON object {\"action\": ..., \"params\": {...}, \"reasoning\": ..., "
-    '"wait": false}. Available actions: send_message, todo, wait, orient, '
-    "spawn_child, execute_shell, file_read, file_write. Current task: survey "
-    "the repository layout and report the three largest source files to your "
-    "parent agent. Conversation so far: the parent asked for a structural "
-    "summary; you have already listed the top-level directories and found "
-    "src/, tests/, docs/. Decide the single next action that makes progress."
-)
+# Public HBM-bandwidth specs per device generation — the decode roofline.
+# Most-specific key first (matched by substring of device_kind).
+PEAK_HBM_GBPS = {"TPU v5 lite": 819.0, "TPU v5e": 819.0, "TPU v5p": 2765.0,
+                 "TPU v6 lite": 1640.0, "TPU v6e": 1640.0, "TPU v4": 1228.0}
+
+TASKS = [
+    "Survey the repository layout and report the three largest source files "
+    "to your parent agent.",
+    "A child agent reported test failures in tests/test_io.py; decide how "
+    "to investigate.",
+    "The budget snapshot shows 80% spent; re-plan the remaining work.",
+    "Summarize progress so far and message your parent with a status update.",
+    "Two children disagree about the deployment order; resolve it.",
+]
+REFINEMENTS = [
+    "Consensus was not reached. Other models proposed different actions. "
+    "Review your proposal as a skeptical reviewer and respond with your "
+    "(possibly revised) complete JSON action.",
+    "Still no consensus after refinement. State your final choice as a "
+    "complete, self-contained JSON action object.",
+]
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def ensure_checkpoints() -> list[str]:
+    from quoracle_tpu.models.make_checkpoint import make_bench_checkpoints
+    root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "checkpoints")
+    t0 = time.monotonic()
+    dirs = make_bench_checkpoints(root, scale=SCALE, families=FAMILIES)
+    log(f"checkpoints ready in {time.monotonic() - t0:.1f}s: {dirs}")
+    return dirs
+
+
+def run_cycle(backend, pool, session_prefix: str, task: str,
+              n_agents: int = 1, rounds: int = ROUNDS_PER_CYCLE):
+    """One simulated agent turn: initial round + refinement rounds that
+    extend each member's own conversation (consensus/engine.py shape).
+    Returns per-round stats dicts."""
+    from quoracle_tpu.consensus.temperature import temperature_for_round
+    from quoracle_tpu.models.runtime import QueryRequest
+
+    system = ("You are an autonomous agent in a recursive agent tree. "
+              "Decide your next action. Respond ONLY with a JSON object "
+              '{"action": ..., "params": {...}, "reasoning": ..., '
+              '"wait": false}. Available actions: send_message, todo, wait, '
+              "orient, spawn_child, execute_shell, file_read, file_write, "
+              "fetch_web, call_api, batch_sync, dismiss_child.")
+    # per (agent, member) conversation, as the consensus engine keeps them
+    convs = {(a, m): [{"role": "system", "content": system},
+                      {"role": "user", "content": task}]
+             for a in range(n_agents) for m in pool}
+    stats = []
+    for rnd in range(1, rounds + 1):
+        reqs, keys = [], []
+        for a in range(n_agents):
+            for m in pool:
+                reqs.append(QueryRequest(
+                    model_spec=m, messages=convs[(a, m)],
+                    temperature=temperature_for_round(m.split(":")[1], rnd),
+                    top_p=0.95, max_tokens=MAX_NEW,
+                    session_id=f"{session_prefix}-a{a}",
+                    constrain_json=True))
+                keys.append((a, m))
+        t0 = time.monotonic()
+        results = backend.query(reqs)
+        wall_ms = (time.monotonic() - t0) * 1000.0
+        gen_tokens = sum(r.usage.completion_tokens for r in results)
+        prompt_tokens = sum(r.usage.prompt_tokens for r in results)
+        engines = [backend.engines[m] for m in pool]   # active members only
+        prefill_tokens = sum(e.last_prefill_tokens for e in engines)
+        prefill_s = sum(e.last_prefill_s for e in engines)
+        decode_s = sum(e.last_decode_s for e in engines)
+        for r in results:
+            assert r.ok, f"round {rnd} failed: {r.error}"
+        stats.append({
+            "round": rnd, "wall_ms": wall_ms, "gen_tokens": gen_tokens,
+            "prompt_tokens": prompt_tokens, "prefill_tokens": prefill_tokens,
+            "prefill_s": prefill_s, "decode_s": decode_s,
+        })
+        for (a, m), r in zip(keys, results):
+            convs[(a, m)] = convs[(a, m)] + [
+                {"role": "assistant", "content": r.text},
+                {"role": "user", "content": REFINEMENTS[min(rnd - 1,
+                                                            len(REFINEMENTS) - 1)]},
+            ]
+    return stats
+
+
+def measure_config(backend, pool, name: str, n_agents: int = 1,
+                   rounds: int = ROUNDS_PER_CYCLE) -> dict:
+    all_rounds = []
+    t_all = time.monotonic()
+    for c in range(N_CYCLES):
+        task = TASKS[c % len(TASKS)]
+        rs = run_cycle(backend, pool, f"{name}-c{c}", task,
+                       n_agents=n_agents, rounds=rounds)
+        all_rounds.extend(rs)
+        log(f"{name} cycle {c}: " + "  ".join(
+            f"r{s['round']} {s['wall_ms']:.0f}ms"
+            f" (prefill {s['prefill_tokens']}tok)" for s in rs))
+    wall = time.monotonic() - t_all
+    lat = [s["wall_ms"] for s in all_rounds]
+    r1 = [s["wall_ms"] for s in all_rounds if s["round"] == 1]
+    rn = [s["wall_ms"] for s in all_rounds if s["round"] > 1]
+    gen = sum(s["gen_tokens"] for s in all_rounds)
+    return {
+        "p50_round_ms": statistics.median(lat),
+        "p50_round1_ms": statistics.median(r1),
+        "p50_refine_ms": statistics.median(rn) if rn else None,
+        "gen_tokens": gen,
+        "wall_s": wall,
+        "tokens_per_sec": gen / wall,
+        "prefill_s": sum(s["prefill_s"] for s in all_rounds),
+        "decode_s": sum(s["decode_s"] for s in all_rounds),
+        "prefill_tokens": sum(s["prefill_tokens"] for s in all_rounds),
+        "prompt_tokens": sum(s["prompt_tokens"] for s in all_rounds),
+    }
+
+
 def main() -> None:
     import jax
 
     from quoracle_tpu.models.config import get_model_config
-    from quoracle_tpu.models.generate import GenerateEngine
-    from quoracle_tpu.models.tokenizer import get_tokenizer
-    from quoracle_tpu.models.transformer import init_params
-    from quoracle_tpu.consensus.temperature import temperature_for_round
+    from quoracle_tpu.models.loader import register_hf_checkpoint
+    from quoracle_tpu.models.runtime import TPUBackend
 
-    n_chips = len(jax.devices())
-    log(f"devices: {jax.devices()}")
+    devs = jax.devices()
+    n_chips = len(devs)
+    kind = getattr(devs[0], "device_kind", "unknown")
+    peak_gbps = next((v for k, v in PEAK_HBM_GBPS.items() if k in kind), None)
+    log(f"devices: {devs} (kind={kind!r})")
 
-    engines = []
-    for i, spec in enumerate(POOL):
-        cfg = get_model_config(spec)
-        t0 = time.monotonic()
-        params = init_params(cfg, jax.random.PRNGKey(i))
-        jax.block_until_ready(params)
-        tok = get_tokenizer(cfg.name)
-        engines.append((spec, cfg, GenerateEngine(cfg, params, tok), tok))
-        log(f"{spec}: params ready in {time.monotonic() - t0:.1f}s")
-
-    def run_round(round_idx: int) -> tuple[float, int]:
-        """One consensus round: each pool member proposes an action."""
-        t0 = time.monotonic()
-        n_tokens = 0
-        for spec, cfg, engine, tok in engines:
-            temp = temperature_for_round(cfg.name, round_idx + 1)
-            ids = tok.encode(PROMPT, add_bos=True)
-            res = engine.generate([ids], temperature=temp, top_p=0.95,
-                                  max_new_tokens=MAX_NEW)
-            n_tokens += res[0].n_gen_tokens
-        return (time.monotonic() - t0) * 1000.0, n_tokens
+    dirs = ensure_checkpoints()
+    pool = []
+    for d in dirs:
+        cfg = register_hf_checkpoint(d)
+        pool.append(f"xla:{cfg.name}")
+    log(f"pool: {pool}")
 
     t0 = time.monotonic()
-    run_round(0)  # warmup: compiles one (batch, prompt, decode) bucket per model
-    log(f"warmup (compile) {time.monotonic() - t0:.1f}s")
+    backend = TPUBackend(pool, overlap=(n_chips > 1))
+    log(f"backend ready (weights loaded) in {time.monotonic() - t0:.1f}s")
 
-    lat_ms, toks = [], 0
-    t_all = time.monotonic()
-    for r in range(N_ROUNDS):
-        ms, n = run_round(0)
-        lat_ms.append(ms)
-        toks += n
-        log(f"round {r}: {ms:.0f} ms, {n} tokens")
-    wall = time.monotonic() - t_all
+    # bf16 bytes the decode loop streams per emitted token, per member
+    param_bytes = {}
+    for spec in pool:
+        e = backend.engines[spec]
+        param_bytes[spec] = sum(
+            int(p.size) * p.dtype.itemsize
+            for p in jax.tree.leaves(e.params))
+    log("param bytes: " + ", ".join(f"{s}: {b / 1e9:.2f} GB"
+                                    for s, b in param_bytes.items()))
 
-    p50 = statistics.median(lat_ms)
-    tps_chip = toks / wall / max(1, n_chips)
+    # warmup: compile each member's (prefill, decode) buckets for every
+    # measured shape — the B=1 rounds (configs 1-2) AND config 3's
+    # batch-of-3 rows per member
+    t0 = time.monotonic()
+    run_cycle(backend, pool, "warmup", TASKS[0])
+    run_cycle(backend, pool, "warmup3", TASKS[0], n_agents=3, rounds=1)
+    log(f"warmup (compiles) {time.monotonic() - t0:.1f}s")
+
+    cfg1 = measure_config(backend, [pool[0]], "config1")
+    cfg2 = measure_config(backend, pool, "config2")
+    cfg3 = measure_config(backend, pool, "config3", n_agents=3, rounds=1)
+
+    # Decode-phase roofline: every decoded token streams the member's full
+    # bf16 weights from HBM (batch 1 per member). Utilization uses summed
+    # per-member device decode time (members serialize on one chip).
+    avg_param_gb = sum(param_bytes.values()) / len(param_bytes) / 1e9
+    per_member_tokens = cfg2["gen_tokens"] / len(pool)
+    decode_gb = sum(per_member_tokens * b for b in param_bytes.values()) / 1e9
+    bw_gbps = decode_gb / max(cfg2["decode_s"], 1e-9)
+    util = bw_gbps / peak_gbps if peak_gbps else None
+
+    p50 = cfg2["p50_round_ms"]
+    tps_chip = cfg2["tokens_per_sec"] / max(1, n_chips)
+    residency_saved = 1.0 - (cfg2["prefill_tokens"]
+                             / max(1, cfg2["prompt_tokens"]))
+    log(json.dumps({"config1": cfg1, "config2": cfg2, "config3": cfg3},
+                   indent=1, default=str))
     print(json.dumps({
         "metric": "consensus_round_p50_latency",
         "value": round(p50, 1),
         "unit": "ms",
         "vs_baseline": round(HOSTED_BASELINE_MS / p50, 2),
         "tokens_per_sec_per_chip": round(tps_chip, 1),
+        "round1_p50_ms": round(cfg2["p50_round1_ms"], 1),
+        "refinement_p50_ms": round(cfg2["p50_refine_ms"], 1),
+        "prefill_s_total": round(cfg2["prefill_s"], 2),
+        "decode_s_total": round(cfg2["decode_s"], 2),
+        "kv_residency_prefill_savings": round(residency_saved, 3),
+        "decode_hbm_gbps": round(bw_gbps, 1),
+        "decode_hbm_utilization": round(util, 3) if util else None,
+        "avg_model_gb": round(avg_param_gb, 2),
+        "config1_p50_ms": round(cfg1["p50_round_ms"], 1),
+        "config3_p50_ms": round(cfg3["p50_round_ms"], 1),
         "n_chips": n_chips,
-        "pool": POOL,
-        "rounds": N_ROUNDS,
+        "device_kind": kind,
+        "pool": pool,
+        "cycles": N_CYCLES,
+        "rounds_per_cycle": ROUNDS_PER_CYCLE,
         "max_new_tokens": MAX_NEW,
+        "constrained_json": True,
+        "sessions": True,
+        "checkpoints": True,
     }))
 
 

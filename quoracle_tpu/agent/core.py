@@ -386,9 +386,15 @@ class AgentCore:
         old_pool = list(self.config.model_pool)
         if set(new_pool) == set(old_pool):
             # Same membership (possibly reordered): nothing to transfer and
-            # every resident KV prefix stays valid.
+            # every resident KV prefix stays valid — but order is
+            # semantically meaningful (pool[0] is the default answer model),
+            # so the reorder still logs and persists.
             self.config.model_pool = list(new_pool)
             self.engine = self._build_engine()
+            deps.events.log(self.agent_id, "info",
+                            f"model pool reordered {old_pool} -> {new_pool}")
+            if deps.persistence is not None:
+                deps.persistence.persist_agent(self)
             return
         loop = asyncio.get_running_loop()
         report = await loop.run_in_executor(

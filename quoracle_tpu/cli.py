@@ -54,7 +54,8 @@ def _attach_printer(rt: Runtime) -> None:
 async def cmd_run(args: argparse.Namespace) -> int:
     pool = args.pool.split(",") if args.pool else None
     rt = Runtime(RuntimeConfig(db_path=args.db, backend=args.backend,
-                               model_pool=pool))
+                               model_pool=pool,
+                               checkpoints=args.checkpoints, tp=args.tp))
     _attach_printer(rt)
     if pool is None and args.profile is None:
         pool = rt.default_pool()
@@ -74,7 +75,8 @@ async def cmd_run(args: argparse.Namespace) -> int:
 
 
 async def cmd_resume(args: argparse.Namespace) -> int:
-    rt = Runtime(RuntimeConfig(db_path=args.db, backend=args.backend))
+    rt = Runtime(RuntimeConfig(db_path=args.db, backend=args.backend,
+                               checkpoints=args.checkpoints, tp=args.tp))
     _attach_printer(rt)
     result = await rt.boot()
     print(json.dumps(result), flush=True)
@@ -91,7 +93,8 @@ async def cmd_serve(args: argparse.Namespace) -> int:
     from quoracle_tpu.web import DashboardServer
     rt = Runtime(RuntimeConfig(
         db_path=args.db, backend=args.backend,
-        model_pool=args.pool.split(",") if args.pool else None))
+        model_pool=args.pool.split(",") if args.pool else None,
+        checkpoints=args.checkpoints, tp=args.tp))
     # Validate host/token BEFORE boot so a refused bind exits with a clean
     # message instead of a traceback over a half-started runtime.
     try:
@@ -133,6 +136,14 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--db", default=":memory:")
         sp.add_argument("--backend", choices=["mock", "tpu"], default="mock")
         sp.add_argument("--watch-seconds", type=float, default=30.0)
+        sp.add_argument("--checkpoint", action="append", dest="checkpoints",
+                        metavar="DIR",
+                        help="HF checkpoint dir to register + serve "
+                             "(repeatable; implies the pool when --pool "
+                             "is unset)")
+        sp.add_argument("--tp", type=int, default=None,
+                        help="tensor-parallel size per pool member on "
+                             "multi-chip slices")
 
     runp = sub.add_parser("run", help="create a task and watch it")
     runp.add_argument("description")
@@ -156,11 +167,23 @@ def build_parser() -> argparse.ArgumentParser:
 
     statp = sub.add_parser("status", help="show tasks + agents")
     statp.add_argument("--db", default=":memory:")
+
+    showp = sub.add_parser(
+        "show-prompts",
+        help="dump verbatim LLM prompts for a named scenario (the "
+             "reference's mix quoracle.show_llm_prompts)")
+    showp.add_argument("scenario", nargs="?", default=None)
+    showp.add_argument("--write-golden", metavar="DIR", default=None)
     return p
 
 
 def main(argv: list[str] | None = None) -> int:
     args = build_parser().parse_args(argv)
+    if args.cmd == "show-prompts":
+        from quoracle_tpu.tools.show_prompts import main as show_main
+        if args.write_golden:
+            return show_main(["--write-golden", args.write_golden])
+        return show_main([args.scenario] if args.scenario else [])
     handler = {"run": cmd_run, "resume": cmd_resume,
                "serve": cmd_serve, "status": cmd_status}[args.cmd]
     return asyncio.run(handler(args))

@@ -199,6 +199,12 @@ class ConsensusEngine:
                 max_tokens=cfg.max_tokens,
                 session_id=cfg.session_key,
                 constrain_json=cfg.constrained_json,
+                # Schema-aware grammar: a constrained row cannot name an
+                # action outside the capability-gated set (VERDICT r2
+                # item 7) — the validator keeps the params check.
+                action_enum=(tuple(sorted(cfg.allowed_actions))
+                             if cfg.constrained_json and cfg.allowed_actions
+                             else None),
             )
             for m in pool
         ]

@@ -22,14 +22,20 @@ the logits, then ``state = table[state, token]``. Fully shape-static, no
 host sync — exactly what the TPU wants. EOS is only sampleable in accept
 states (top-level object closed), so constrained rows terminate cleanly.
 
-This guarantees SYNTACTIC validity; action-schema conformance stays with
-the validator layer (actions/validator.py), which now only ever sees
-parseable JSON.
+This guarantees SYNTACTIC validity. With ``action_enum`` set the grammar is
+also SCHEMA-AWARE for the decision shape (VERDICT r2 item 7): the top-level
+object must open with ``"action": "<name>"`` where the name walks a trie of
+the capability-gated action set, and later top-level keys cannot re-spell
+``action`` (duplicate keys would let json.loads override the constrained
+value). A constrained row therefore cannot propose an unknown action —
+the remaining schema conformance (required params, enums) stays with the
+validator layer (actions/validator.py), which now only ever sees parseable
+JSON naming a real, allowed action.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Sequence
 
 import numpy as np
 
@@ -52,6 +58,7 @@ OBJ_NEXT = "obj_next"        # after a member: expect ',' or '}'
 OBJ_KEY = "obj_key"          # after ',': expect key
 ARR_NEXT = "arr_next"        # after an element: expect ',' or ']'
 NUM_SIGN = "num_sign"        # after '-'
+NUM_ZERO = "num_zero"        # a leading 0: no further int digits (RFC 8259)
 NUM_INT = "num_int"          # integer digits
 NUM_DOT = "num_dot"          # after '.'
 NUM_FRAC = "num_frac"        # fraction digits
@@ -77,12 +84,29 @@ def _kw_states():
     return out
 
 
+ACTION_KEY = "action"
+
+
 class CharDFA:
     """Explicit-state JSON automaton over bytes. Built by BFS from the start
-    state; transitions computed on demand by `step`."""
+    state; transitions computed on demand by `step`.
 
-    def __init__(self, max_depth: int = 5):
+    ``action_enum``: when set, the top-level object is forced to open with
+    ``"action": "<member>"`` (member walked through a prefix trie) and
+    subsequent top-level keys may not spell ``action`` again (escapes are
+    banned in top-level keys so \\u0061-style respellings can't sneak a
+    duplicate in). Nested objects stay fully generic."""
+
+    def __init__(self, max_depth: int = 5,
+                 action_enum: Optional[Sequence[str]] = None):
         self.max_depth = max_depth
+        self.action_enum = (tuple(sorted(set(action_enum)))
+                            if action_enum else None)
+        if self.action_enum:
+            self._enum_prefixes = {w[:i] for w in self.action_enum
+                                   for i in range(len(w) + 1)}
+            self._act_prefixes = {ACTION_KEY[:i]
+                                  for i in range(len(ACTION_KEY) + 1)}
         # top level must be an OBJECT (the action-proposal shape), not any
         # bare JSON value
         self.start = (WS_VALUE + ":obj_only", ())
@@ -110,6 +134,8 @@ class CharDFA:
             return (WS_VALUE + ":arr0", stack + ("A",))
         if ch == "-":
             return (NUM_SIGN, stack)
+        if ch == "0":
+            return (NUM_ZERO, stack)   # leading zero ends the int part
         if ch in _DIGITS:
             return (NUM_INT, stack)
         for w in _KEYWORDS:
@@ -125,6 +151,57 @@ class CharDFA:
 
     def step(self, state: tuple, ch: str) -> Optional[tuple]:
         mode, stack = state
+
+        # ---- action-enum modes (schema-aware top-level object) ----------
+        if self.action_enum is not None:
+            if mode == WS_VALUE + ":obj_only":
+                if ch in _WS:
+                    return (mode, stack)
+                if ch == "{":
+                    return ("act_ws", ("O",))
+                return None
+            if mode == "act_ws":           # expect the forced "action" key
+                if ch in _WS:
+                    return (mode, stack)
+                if ch == '"':
+                    return ("actkey:0", stack)
+                return None
+            if mode.startswith("actkey:"):
+                i = int(mode[7:])
+                if i == len(ACTION_KEY):
+                    return ("act_colon", stack) if ch == '"' else None
+                return (f"actkey:{i + 1}", stack) \
+                    if ch == ACTION_KEY[i] else None
+            if mode == "act_colon":
+                if ch in _WS:
+                    return (mode, stack)
+                if ch == ":":
+                    return ("act_valws", stack)
+                return None
+            if mode == "act_valws":
+                if ch in _WS:
+                    return (mode, stack)
+                if ch == '"':
+                    return ("enum:", stack)
+                return None
+            if mode.startswith("enum:"):   # walk the action-name trie
+                prefix = mode[5:]
+                if ch == '"' and prefix in self.action_enum:
+                    return (OBJ_NEXT, stack)
+                if prefix + ch in self._enum_prefixes:
+                    return (f"enum:{prefix + ch}", stack)
+                return None
+            if mode.startswith("key1:"):   # later top-level keys: ≠ action
+                prog = mode[5:]
+                if ch == '"':
+                    return None if prog == ACTION_KEY else (AFTER_KEY, stack)
+                if ch == "\\":
+                    return None            # no escapes in top-level keys
+                if ord(ch) >= 0x20:
+                    nxt = prog + ch
+                    marker = nxt if nxt in self._act_prefixes else "x"
+                    return (f"key1:{marker}", stack)
+                return None
 
         # value start (including the empty-array / object-only specials)
         if mode == WS_VALUE or mode.startswith(WS_VALUE):
@@ -182,16 +259,20 @@ class CharDFA:
         if mode in (NUM_SIGN, NUM_DOT, NUM_ESIGN, NUM_E):
             if mode == NUM_E and ch in "+-":
                 return (NUM_ESIGN, stack)
+            if mode == NUM_SIGN and ch == "0":
+                return (NUM_ZERO, stack)   # -0 also ends the int part
             if ch in _DIGITS:
                 return {NUM_SIGN: NUM_INT, NUM_DOT: NUM_FRAC,
                         NUM_ESIGN: NUM_EXP, NUM_E: NUM_EXP}[mode], stack
             return None
-        if mode in (NUM_INT, NUM_FRAC, NUM_EXP):
+        if mode in (NUM_INT, NUM_ZERO, NUM_FRAC, NUM_EXP):
             if ch in _DIGITS:
+                if mode == NUM_ZERO:
+                    return None            # RFC 8259: no leading zeros
                 return (mode, stack)
-            if mode == NUM_INT and ch == ".":
+            if mode in (NUM_INT, NUM_ZERO) and ch == ".":
                 return (NUM_DOT, stack)
-            if mode in (NUM_INT, NUM_FRAC) and ch in "eE":
+            if mode in (NUM_INT, NUM_ZERO, NUM_FRAC) and ch in "eE":
                 return (NUM_E, stack)
             closed = self._close_value(stack)
             return self.step(closed, ch)   # delimiter handled by next mode
@@ -209,6 +290,8 @@ class CharDFA:
             if ch in _WS:
                 return (mode, stack)
             if ch == '"':
+                if self.action_enum is not None and stack == ("O",):
+                    return ("key1:", stack)   # top-level: guard dup "action"
                 return (KEY, stack)
             return None
         if mode == AFTER_KEY:
@@ -317,8 +400,9 @@ class JsonTokenTable:
     tokenizer; vectorized over states so 32k-128k vocabs build in seconds."""
 
     def __init__(self, token_texts: list[str], eos_id: int,
-                 max_depth: int = 4, extra_stop_ids: tuple = ()):
-        dfa = CharDFA(max_depth=max_depth)
+                 max_depth: int = 4, extra_stop_ids: tuple = (),
+                 action_enum: Optional[Sequence[str]] = None):
+        dfa = CharDFA(max_depth=max_depth, action_enum=action_enum)
         n_states = dfa.trans.shape[0]     # minimized class count
         vocab = len(token_texts)
         table = np.full((n_states, vocab), REJECT, np.int32)
@@ -349,6 +433,19 @@ class JsonTokenTable:
                 if 0 <= stop < vocab:
                     table[sid, stop] = sid
         assert n_states < 32767, "state space exceeds int16"
+        # Pad the state axis to a bucket so differently-sized enum grammars
+        # share one decode compilation (the table is a traced jit arg; its
+        # SHAPE keys the compile cache). Pad rows are all-REJECT.
+        padded = n_states
+        for b in (128, 256, 384, 512, 640, 768, 1024, 1536, 2048, 4096,
+                  8192):
+            if n_states <= b:
+                padded = b
+                break
+        if padded > n_states:
+            table = np.concatenate(
+                [table, np.full((padded - n_states, vocab), REJECT,
+                                np.int32)], axis=0)
         self.table = table.astype(np.int16)   # halves the device footprint
         self.start_state = int(dfa.start_id)
         self.n_states = n_states
@@ -356,7 +453,9 @@ class JsonTokenTable:
 
     @classmethod
     def for_tokenizer(cls, tokenizer, vocab_size: int, eos_id: int,
-                      extra_stop_ids: tuple = ()) -> "JsonTokenTable":
+                      extra_stop_ids: tuple = (),
+                      action_enum: Optional[Sequence[str]] = None,
+                      ) -> "JsonTokenTable":
         texts = []
         for tid in range(vocab_size):
             try:
@@ -369,4 +468,5 @@ class JsonTokenTable:
                     getattr(tokenizer, "pad_id", -1), *extra_stop_ids}:
             if 0 <= sid < vocab_size:
                 texts[sid] = ""
-        return cls(texts, eos_id, extra_stop_ids=extra_stop_ids)
+        return cls(texts, eos_id, extra_stop_ids=extra_stop_ids,
+                   action_enum=action_enum)

@@ -30,6 +30,7 @@ from quoracle_tpu.models.transformer import (
 # Finite mask value: a whole-row -inf would NaN the sampling softmax; the
 # grammar layer guarantees >= 1 allowed token, this is defense in depth.
 NEG_INF_LOGITS = -1e30
+REJECT_STATE = -1          # models/constrained.py REJECT
 
 
 def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jax.Array,
@@ -82,13 +83,17 @@ def decode(
     stop_ids: tuple = (),      # extra stop ids (llama-3 <|eot_id|> style)
     json_table: Optional[jax.Array] = None,   # [S, V] grammar transitions
     json_state: Optional[jax.Array] = None,   # [B] int32; -1 = unconstrained
-) -> tuple[jax.Array, jax.Array]:
+) -> tuple[jax.Array, jax.Array, KVCache]:
     """Autoregressive decode.
 
-    Returns (tokens [B, max_new], n_emitted [B]) where n_emitted counts real
-    tokens written per row INCLUDING a terminal EOS. The count is tracked in
-    the loop carry — output extraction must not scan for sentinels, because
-    pad_id can be a legitimate vocab token in real checkpoints.
+    Returns (tokens [B, max_new], n_emitted [B], final cache) where
+    n_emitted counts real tokens written per row INCLUDING a terminal EOS.
+    The count is tracked in the loop carry — output extraction must not scan
+    for sentinels, because pad_id can be a legitimate vocab token in real
+    checkpoints. The returned cache holds the RESPONSE tokens' KV too
+    (``lens[b]`` bounds the valid entries: prompt + every emitted token
+    except the last sampled one, which never ran forward) — sessions keep it
+    so refinement rounds skip re-prefilling the previous response.
 
     ``max_new`` is the STATIC loop/buffer bound (shape-bucketed for compile
     caching); ``row_limit`` is the TRACED per-row budget — min(requested
@@ -167,7 +172,7 @@ def decode(
             jstate0)
     _, done, _, out, n_emitted, cache, _, _ = \
         jax.lax.while_loop(cond, body, init)
-    return out, n_emitted
+    return out, n_emitted, cache
 
 
 def _round_up(n: int, buckets: Sequence[int]) -> int:
@@ -304,9 +309,19 @@ class GenerateEngine:
                        * jnp.dtype(self.cache_dtype).itemsize)
         self.sessions = SessionStore(
             max_tokens=max(1, session_max_bytes // token_bytes))
-        self._step = self._build_step()
+        # Per-call phase diagnostics (read by the bench + dashboards):
+        # wall seconds of the last prefill / decode device phases.
+        self.last_prefill_s = 0.0
+        self.last_decode_s = 0.0
+        self._build_step()
 
     def _build_step(self):
+        """Two jits per call instead of one fused step: PREFILL fills the
+        cache from the prompt chunk, DECODE runs the sampling loop. The
+        boundary costs one dispatch (~µs) and buys an honest per-phase
+        latency split (prefill is compute-bound on the MXU, decode is
+        HBM-bandwidth-bound — a single fused number hides which one
+        regressed; SURVEY §5 tracing asks for the split)."""
         cfg = self.cfg
         mesh = self.mesh
         if mesh is not None:
@@ -324,51 +339,41 @@ class GenerateEngine:
                 k=jax.lax.with_sharding_constraint(cache.k, kv_sharding),
                 v=jax.lax.with_sharding_constraint(cache.v, kv_sharding))
 
-        def _finish(params, cache, last_logits, rng, temperature, top_p,
-                    active, row_limit, max_new, json_table, json_state):
-            out, n_emitted = decode(params, cfg, cache, last_logits, rng,
-                                    temperature, top_p, max_new,
-                                    cfg.eos_token_id,
-                                    active=active, row_limit=row_limit,
-                                    pad_id=self.tokenizer.pad_id,
-                                    stop_ids=cfg.stop_token_ids,
-                                    json_table=json_table,
-                                    json_state=json_state)
-            return out, n_emitted, cache
-
-        @functools.partial(jax.jit, static_argnames=("max_new", "cache_len"))
-        def step(params, tokens, prompt_lens, rng, temperature, top_p, active,
-                 row_limit, json_table, json_state,
-                 max_new: int, cache_len: int):
+        @functools.partial(jax.jit, static_argnames=("cache_len",))
+        def step_prefill(params, tokens, prompt_lens, cache_len: int):
             B = tokens.shape[0]
             cache = _constrain(init_cache(cfg, B, cache_len,
                                           dtype=self.cache_dtype))
-            last_logits, cache = prefill(params, cfg, tokens, prompt_lens,
-                                         cache)
-            return _finish(params, cache, last_logits, rng, temperature,
-                           top_p, active, row_limit, max_new,
-                           json_table, json_state)
+            return prefill(params, cfg, tokens, prompt_lens, cache)
 
-        @functools.partial(jax.jit, static_argnames=("max_new", "cache_len"),
-                           donate_argnums=(1, 2))   # buffers update in place
-        def step_resume(params, k_buf, v_buf, tokens, prefix_lens, chunk_lens,
-                        rng, temperature, top_p, active, row_limit,
-                        json_table, json_state,
-                        max_new: int, cache_len: int):
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def step_prefill_resume(params, k_buf, v_buf, tokens, prefix_lens,
+                                chunk_lens):
             # KV prefix already in the buffers (session reuse); only the
-            # suffix chunk runs through the stack.
-            del cache_len
+            # suffix chunk runs through the stack. Buffers are donated —
+            # assembled fresh per call in _assemble_kv.
             B = tokens.shape[0]
             cache = _constrain(KVCache(k=k_buf, v=v_buf,
                                        lens=jnp.zeros((B,), jnp.int32)))
-            last_logits, cache = prefill_chunk(params, cfg, tokens,
-                                               prefix_lens, chunk_lens, cache)
-            return _finish(params, cache, last_logits, rng, temperature,
-                           top_p, active, row_limit, max_new,
-                           json_table, json_state)
+            return prefill_chunk(params, cfg, tokens, prefix_lens,
+                                 chunk_lens, cache)
 
-        self._step_resume = step_resume
-        return step
+        @functools.partial(jax.jit, static_argnames=("max_new",),
+                           donate_argnums=(1, 2))   # cache updates in place
+        def step_decode(params, k_buf, v_buf, lens, last_logits, rng,
+                        temperature, top_p, active, row_limit,
+                        json_table, json_state, max_new: int):
+            cache = _constrain(KVCache(k=k_buf, v=v_buf, lens=lens))
+            return decode(params, cfg, cache, last_logits, rng,
+                          temperature, top_p, max_new, cfg.eos_token_id,
+                          active=active, row_limit=row_limit,
+                          pad_id=self.tokenizer.pad_id,
+                          stop_ids=cfg.stop_token_ids,
+                          json_table=json_table, json_state=json_state)
+
+        self._step_prefill = step_prefill
+        self._step_prefill_resume = step_prefill_resume
+        self._step_decode = step_decode
 
     def next_rng(self) -> jax.Array:
         with self._rng_lock:
@@ -384,13 +389,19 @@ class GenerateEngine:
         rng: Optional[jax.Array] = None,
         session_ids: Optional[Sequence[Optional[str]]] = None,
         constrain_json: Optional[Sequence[bool]] = None,
+        action_enums: Optional[Sequence[Optional[Sequence[str]]]] = None,
     ) -> list[GenResult]:
         """``session_ids`` (aligned with prompts; None entries opt out)
         enables KV residency: each row reuses the longest token prefix it
         shares with its session's resident cache and prefills only the
         suffix; the prompt KV is stored back for the next round. Consensus
         refinement rounds extend the previous prompt, so rounds 2+ skip
-        re-prefilling the whole conversation (SURVEY §7 hard part 2)."""
+        re-prefilling the whole conversation (SURVEY §7 hard part 2).
+
+        ``action_enums`` (aligned; only read where constrain_json is True)
+        upgrades the JSON grammar to the schema-aware variant: the row's
+        top-level ``"action"`` value is constrained to the given names
+        (models/constrained.py action_enum)."""
         t0 = time.monotonic()
         n = len(prompts)
         if n == 0:
@@ -485,43 +496,67 @@ class GenerateEngine:
         samp = (put(temp_arr, row), put(top_arr, row),
                 put(active, row), put(limits, row))
 
-        # JSON grammar constraint: rows flagged True start in the grammar's
-        # start state; -1 rows sample unconstrained.
+        # JSON grammar constraint: rows flagged True start in their
+        # grammar's start state; -1 rows sample unconstrained. Rows may
+        # carry different action enums — distinct grammars stack into one
+        # table with offset state ids.
         if constrain_json is not None and any(constrain_json):
-            table = self._json_table_device()
+            enums = [None] * n
+            if action_enums is not None:
+                enums = [tuple(sorted(set(e))) if e else None
+                         for e in action_enums]
+            distinct = sorted({e for e, f in zip(enums, constrain_json)
+                               if f},
+                              key=lambda e: (e is not None, e or ()))
+            table, offsets = self._json_table_device(tuple(distinct))
             jstate = np.full((B,), -1, np.int32)
             for i, flag in enumerate(constrain_json):
                 if flag:
-                    jstate[i] = self._json_start
+                    jstate[i] = offsets[enums[i]]
             json_args = (table, put(jstate, row))
         else:
             json_args = (None, None)
 
         if resume:
             kb, vb = self._assemble_kv(sess_rows, prefixes, B, cache_len)
-            out, n_emitted, cache = self._step_resume(
+            last_logits, cache = self._step_prefill_resume(
                 self.params, kb, vb, put(tokens, mat), put(pre_arr, row),
-                put(chunk_arr, row), rng_key, *samp, *json_args,
-                max_new=max_new, cache_len=cache_len)
+                put(chunk_arr, row))
         else:
-            out, n_emitted, cache = self._step(
-                self.params, put(tokens, mat), put(chunk_arr, row), rng_key,
-                *samp, *json_args, max_new=max_new, cache_len=cache_len)
+            last_logits, cache = self._step_prefill(
+                self.params, put(tokens, mat), put(chunk_arr, row),
+                cache_len=cache_len)
+        jax.block_until_ready(last_logits)   # phase fence: prefill done
+        t_prefill = time.monotonic()
         self.last_prefill_tokens = sum(len(s) for s in suffixes)
 
-        # Store prompt-level KV back into sessions for the next round.
+        out, n_emitted, final = self._step_decode(
+            self.params, cache.k, cache.v, cache.lens, last_logits, rng_key,
+            *samp, *json_args, max_new=max_new)
+
+        out = np.asarray(out)
+        n_emitted = np.asarray(n_emitted)
+        now = time.monotonic()
+        self.last_prefill_s = t_prefill - t0
+        self.last_decode_s = now - t_prefill
+        latency = now - t0
+
+        # Store sessions from the FINAL cache: prompt AND response KV
+        # (final.lens bounds the valid entries — the response tokens'
+        # KV was already computed during decode; discarding it would make
+        # every refinement round re-prefill the previous response).
         if session_ids is not None:
+            lens_host = np.asarray(final.lens)
             for i, sid in enumerate(session_ids):
                 if not sid:
                     continue
                 plen = len(prompts[i])
+                valid = int(lens_host[i])
+                toks = list(prompts[i]) + [int(t)
+                                           for t in out[i, :valid - plen]]
                 self.sessions.put(sid, _Session(
-                    tokens=list(prompts[i]),
-                    k=cache.k[:, i, :plen], v=cache.v[:, i, :plen]))
-
-        out = np.asarray(out)
-        n_emitted = np.asarray(n_emitted)
-        latency = time.monotonic() - t0
+                    tokens=toks,
+                    k=final.k[:, i, :valid], v=final.v[:, i, :valid]))
 
         results = []
         for i in range(n):
@@ -545,20 +580,64 @@ class GenerateEngine:
             ))
         return results
 
-    def _json_table_device(self):
-        """Lazily build + cache the JSON grammar table for this tokenizer
-        (one vocab walk, a few hundred ms; then device-resident int16)."""
-        if getattr(self, "_json_table", None) is None:
-            from quoracle_tpu.models.constrained import JsonTokenTable
-            tt = JsonTokenTable.for_tokenizer(
-                self.tokenizer,
-                # vocab per the MODEL (logit width), padding beyond the
-                # tokenizer's ids stays rejected
-                self.cfg.vocab_size, self.cfg.eos_token_id,
-                extra_stop_ids=tuple(self.cfg.stop_token_ids))
-            self._json_table = jnp.asarray(tt.table)
-            self._json_start = tt.start_state
-        return self._json_table
+    def _json_table_device(self, enum_set: tuple):
+        """Lazily build + cache grammar tables for this tokenizer (one
+        vocab walk per distinct grammar, a few hundred ms; then
+        device-resident int16). ``enum_set`` is the tuple of DISTINCT
+        action enums present in the batch (None = plain JSON); returns
+        (stacked table, {enum: start-state offset into it}). Single-grammar
+        batches (the common case) hit a per-enum device cache; mixed
+        batches additionally cache the stacked result."""
+        from quoracle_tpu.models.constrained import JsonTokenTable
+        if not hasattr(self, "_json_cache"):
+            self._json_cache: dict = {}
+
+        def _evict(kind: str, keep: int) -> None:
+            # Bounded cache: device tables are padded_states × vocab int16
+            # (tens-to-hundreds of MB at 128k vocab); agents with varied
+            # capability sets must not accumulate tables until HBM OOM.
+            # dict preserves insertion order → drop oldest first.
+            keys = [k for k in self._json_cache if k[0] == kind]
+            for k in keys[:max(0, len(keys) - keep)]:
+                del self._json_cache[k]
+
+        def build(enum):
+            key = ("one", enum)
+            if key not in self._json_cache:
+                tt = JsonTokenTable.for_tokenizer(
+                    self.tokenizer,
+                    # vocab per the MODEL (logit width), padding beyond the
+                    # tokenizer's ids stays rejected
+                    self.cfg.vocab_size, self.cfg.eos_token_id,
+                    extra_stop_ids=tuple(self.cfg.stop_token_ids),
+                    action_enum=enum)
+                self._json_cache[key] = tt
+            return self._json_cache[key]
+
+        if len(enum_set) == 1:
+            tt = build(enum_set[0])
+            dkey = ("dev", enum_set[0])
+            if dkey not in self._json_cache:
+                _evict("dev", keep=3)
+                _evict("one", keep=7)
+                self._json_cache[dkey] = jnp.asarray(tt.table)
+            return self._json_cache[dkey], {enum_set[0]: tt.start_state}
+        skey = ("stack", enum_set)
+        if skey not in self._json_cache:
+            _evict("stack", keep=1)
+            _evict("one", keep=7)
+            tables, offsets, off = [], {}, 0
+            for enum in enum_set:
+                tt = build(enum)
+                shifted = tt.table.astype(np.int32)
+                shifted = np.where(shifted >= 0, shifted + off, REJECT_STATE)
+                tables.append(shifted.astype(np.int16))
+                offsets[enum] = off + tt.start_state
+                off += tt.table.shape[0]
+            assert off < 32767, "stacked grammar state space exceeds int16"
+            self._json_cache[skey] = (jnp.asarray(np.concatenate(tables)),
+                                      offsets)
+        return self._json_cache[skey]
 
     def _assemble_kv(self, sess_rows: list, prefixes: list[int], B: int,
                      cache_len: int):

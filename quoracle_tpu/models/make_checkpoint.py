@@ -1,0 +1,231 @@
+"""Local HF-format checkpoint factory.
+
+The bench host has no network, so real released checkpoints cannot be
+downloaded — but the checkpoint-serving path (models/loader.py →
+HFAutoTokenizer → TPUBackend) must still be exercised end-to-end at bench
+scale (VERDICT r2 item 2). This module GENERATES checkpoints in the standard
+HF layout entirely offline:
+
+  * ``config.json``      — per-family HF config (Llama/Mistral/Gemma);
+  * ``model.safetensors``— bf16 weights under the HF tensor names, random
+    with fan-in scaling (same spectrum as transformer.init_params, so
+    generation produces finite logits — text quality is irrelevant, the
+    bench measures serving compute);
+  * ``tokenizer.json``   — a REAL byte-level BPE tokenizer trained with the
+    ``tokenizers`` library on local corpus text (this repo's sources by
+    default);
+  * ``tokenizer_config.json`` — special tokens + a chat template, so
+    HFAutoTokenizer serves the checkpoint's own template exactly as it
+    would for a released model.
+
+The output directory round-trips through the SAME code path a user's real
+downloaded Llama/Mistral/Gemma checkpoint takes (register_hf_checkpoint →
+load_params → AutoTokenizer), with torch-parity already asserted by
+tests/test_loader.py.
+
+Usage:
+    python -m quoracle_tpu.models.make_checkpoint --out checkpoints/ \
+        --families llama,mistral,gemma --scale 1b
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable, Optional
+
+SPECIALS = ["<|pad|>", "<|bos|>", "<|eos|>", "<|system|>", "<|user|>",
+            "<|assistant|>"]
+CHAT_TEMPLATE = (
+    "{{ bos_token }}{% for message in messages %}"
+    "<|{{ message['role'] }}|>\n{{ message['content'] }}\n"
+    "{% endfor %}{% if add_generation_prompt %}<|assistant|>\n{% endif %}"
+)
+
+# HF config.json skeletons per family at bench scale — dimensioned to match
+# the catalog's bench models (config.py llama-1b / mistral-1b / gemma-1b) so
+# the checkpoint pool mirrors the random-init bench pool exactly.
+FAMILY_CONFIGS = {
+    "1b": {
+        "llama": dict(
+            architectures=["LlamaForCausalLM"], vocab_size=32768,
+            hidden_size=2048, intermediate_size=5632, num_hidden_layers=16,
+            num_attention_heads=16, num_key_value_heads=4,
+            max_position_embeddings=8192, rope_theta=500000.0,
+            rms_norm_eps=1e-5, hidden_act="silu", tie_word_embeddings=False),
+        "mistral": dict(
+            architectures=["MistralForCausalLM"], vocab_size=32768,
+            hidden_size=2048, intermediate_size=5632, num_hidden_layers=16,
+            num_attention_heads=16, num_key_value_heads=4,
+            max_position_embeddings=16384, rope_theta=1000000.0,
+            rms_norm_eps=1e-5, hidden_act="silu", sliding_window=4096,
+            tie_word_embeddings=False),
+        "gemma": dict(
+            architectures=["GemmaForCausalLM"], vocab_size=32768,
+            hidden_size=1792, intermediate_size=7168, num_hidden_layers=14,
+            num_attention_heads=14, num_key_value_heads=14, head_dim=128,
+            max_position_embeddings=8192, rope_theta=10000.0,
+            rms_norm_eps=1e-6, hidden_act="gelu_pytorch_tanh",
+            tie_word_embeddings=True),
+    },
+    "tiny": {
+        "llama": dict(
+            architectures=["LlamaForCausalLM"], vocab_size=2048,
+            hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=2048, rope_theta=10000.0,
+            rms_norm_eps=1e-5, hidden_act="silu", tie_word_embeddings=False),
+        "gemma": dict(
+            architectures=["GemmaForCausalLM"], vocab_size=2048,
+            hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=4, head_dim=16,
+            max_position_embeddings=2048, rope_theta=10000.0,
+            rms_norm_eps=1e-6, hidden_act="gelu_pytorch_tanh",
+            tie_word_embeddings=True),
+    },
+}
+
+
+def default_corpus(max_bytes: int = 8 << 20) -> Iterable[str]:
+    """Local training text for the BPE: this repo's own source + docs."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    total = 0
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in (".git", "checkpoints", "__pycache__")]
+        for fn in sorted(filenames):
+            if not fn.endswith((".py", ".md", ".txt", ".cpp", ".json")):
+                continue
+            try:
+                with open(os.path.join(dirpath, fn),
+                          encoding="utf-8", errors="ignore") as f:
+                    text = f.read()
+            except OSError:
+                continue
+            total += len(text)
+            yield text
+            if total > max_bytes:
+                return
+
+
+def make_tokenizer_files(out_dir: str, vocab_size: int,
+                         corpus: Optional[Iterable[str]] = None) -> dict:
+    """Train a byte-level BPE with the ``tokenizers`` library and write
+    tokenizer.json + tokenizer_config.json (special tokens, chat template).
+    Returns {token: id} for the specials."""
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+    tok = Tokenizer(models.BPE(unk_token=None))
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    trainer = trainers.BpeTrainer(
+        vocab_size=vocab_size, special_tokens=list(SPECIALS),
+        show_progress=False)
+    tok.train_from_iterator(corpus or default_corpus(), trainer)
+    tok.save(os.path.join(out_dir, "tokenizer.json"))
+    ids = {s: tok.token_to_id(s) for s in SPECIALS}
+    with open(os.path.join(out_dir, "tokenizer_config.json"), "w") as f:
+        json.dump({
+            "tokenizer_class": "PreTrainedTokenizerFast",
+            "bos_token": "<|bos|>", "eos_token": "<|eos|>",
+            "pad_token": "<|pad|>",
+            "chat_template": CHAT_TEMPLATE,
+            "model_max_length": 1 << 20,
+        }, f, indent=1)
+    return ids
+
+
+def write_weights(out_dir: str, hf: dict, seed: int = 0) -> None:
+    """Random bf16 weights under HF tensor names → model.safetensors.
+
+    Tensors are emitted one at a time straight into the save dict (torch
+    keeps them materialized until save_file, ~2 GB at 1b scale — fine).
+    Fan-in scaling keeps the forward finite, like transformer.init_params.
+    """
+    import torch
+    from safetensors.torch import save_file
+    g = torch.Generator().manual_seed(seed)
+    D = hf["hidden_size"]
+    F = hf["intermediate_size"]
+    H = hf["num_attention_heads"]
+    KV = hf.get("num_key_value_heads") or H
+    HD = hf.get("head_dim") or D // H
+    V = hf["vocab_size"]
+    gemma = hf["architectures"][0] == "GemmaForCausalLM"
+
+    def w(out_f: int, in_f: int) -> "torch.Tensor":
+        return (torch.randn(out_f, in_f, generator=g)
+                * in_f ** -0.5).to(torch.bfloat16)
+
+    def norm(n: int) -> "torch.Tensor":
+        # HF Gemma RMSNorm computes (1 + w) * x̂ — zero is identity there.
+        return (torch.zeros(n) if gemma else torch.ones(n)).to(torch.bfloat16)
+
+    tensors = {"model.embed_tokens.weight": w(V, D),
+               "model.norm.weight": norm(D)}
+    for i in range(hf["num_hidden_layers"]):
+        p = f"model.layers.{i}."
+        tensors[p + "input_layernorm.weight"] = norm(D)
+        tensors[p + "self_attn.q_proj.weight"] = w(H * HD, D)
+        tensors[p + "self_attn.k_proj.weight"] = w(KV * HD, D)
+        tensors[p + "self_attn.v_proj.weight"] = w(KV * HD, D)
+        tensors[p + "self_attn.o_proj.weight"] = w(D, H * HD)
+        tensors[p + "post_attention_layernorm.weight"] = norm(D)
+        tensors[p + "mlp.gate_proj.weight"] = w(F, D)
+        tensors[p + "mlp.up_proj.weight"] = w(F, D)
+        tensors[p + "mlp.down_proj.weight"] = w(D, F)
+    if not hf.get("tie_word_embeddings"):
+        tensors["lm_head.weight"] = w(V, D)
+    save_file(tensors, os.path.join(out_dir, "model.safetensors"),
+              metadata={"format": "pt"})
+
+
+def make_checkpoint(out_dir: str, family: str = "llama", scale: str = "1b",
+                    seed: int = 0,
+                    corpus: Optional[Iterable[str]] = None) -> str:
+    """Generate one complete HF checkpoint directory. Idempotent: an
+    existing complete directory is left untouched (bench reuse)."""
+    marker = os.path.join(out_dir, ".complete")
+    needed = ("config.json", "model.safetensors", "tokenizer.json",
+              "tokenizer_config.json")
+    if os.path.isfile(marker) and all(
+            os.path.isfile(os.path.join(out_dir, f)) for f in needed):
+        return out_dir
+    os.makedirs(out_dir, exist_ok=True)
+    hf = dict(FAMILY_CONFIGS[scale][family])
+    ids = make_tokenizer_files(out_dir, hf["vocab_size"], corpus)
+    hf["bos_token_id"] = ids["<|bos|>"]
+    hf["eos_token_id"] = ids["<|eos|>"]
+    hf["pad_token_id"] = ids["<|pad|>"]
+    hf["torch_dtype"] = "bfloat16"
+    with open(os.path.join(out_dir, "config.json"), "w") as f:
+        json.dump(hf, f, indent=1)
+    write_weights(out_dir, hf, seed=seed)
+    with open(marker, "w") as f:
+        f.write("ok\n")
+    return out_dir
+
+
+def make_bench_checkpoints(root: str, scale: str = "1b",
+                           families: Optional[list[str]] = None) -> list[str]:
+    """The bench pool's checkpoint trio under ``root``; returns the dirs."""
+    families = families or sorted(FAMILY_CONFIGS[scale])
+    return [make_checkpoint(os.path.join(root, f"{fam}-{scale}"),
+                            family=fam, scale=scale, seed=i)
+            for i, fam in enumerate(families)]
+
+
+def main() -> None:
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", required=True, help="output root directory")
+    ap.add_argument("--families", default="llama,mistral,gemma")
+    ap.add_argument("--scale", default="1b", choices=sorted(FAMILY_CONFIGS))
+    args = ap.parse_args()
+    dirs = make_bench_checkpoints(args.out, scale=args.scale,
+                                  families=args.families.split(","))
+    print(json.dumps({"checkpoints": dirs}))
+
+
+if __name__ == "__main__":
+    main()

@@ -47,6 +47,10 @@ class QueryRequest:
     # Grammar-masked sampling: the response is a syntactically valid JSON
     # object by construction (models/constrained.py; SURVEY §7 hard part 4).
     constrain_json: bool = False
+    # Schema-aware variant: constrain the top-level "action" value to this
+    # capability-gated set (None = syntax-only). Only read when
+    # constrain_json is True.
+    action_enum: Optional[tuple] = None
 
 
 @dataclasses.dataclass
@@ -215,7 +219,7 @@ class TPUBackend(ModelBackend):
             return
         t0 = time.monotonic()
         prompts, temps, tops, budgets, live_idxs, sess = [], [], [], [], [], []
-        cjson = []
+        cjson, enums = [], []
         max_seq = engine.max_seq
         for i in idxs:
             r = requests[i]
@@ -234,6 +238,7 @@ class TPUBackend(ModelBackend):
             tops.append(r.top_p)
             sess.append(r.session_id)
             cjson.append(r.constrain_json)
+            enums.append(r.action_enum)
             window, out_lim = engine.cfg.context_window, engine.cfg.output_limit
             floor = min(OUTPUT_FLOOR, out_lim)
             budget = min(out_lim, max(floor, window - len(ids)))
@@ -246,7 +251,8 @@ class TPUBackend(ModelBackend):
                 prompts, temperature=temps, top_p=tops,
                 max_new_tokens=budgets,
                 session_ids=sess if any(sess) else None,
-                constrain_json=cjson if any(cjson) else None)
+                constrain_json=cjson if any(cjson) else None,
+                action_enums=enums if any(enums) else None)
         except ContextOverflowError as e:
             for i in live_idxs:
                 results[i] = QueryResult(model_spec=spec,

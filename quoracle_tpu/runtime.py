@@ -37,6 +37,18 @@ class RuntimeConfig:
     seed: int = 0
     skills_dir: Optional[str] = None          # global skills directory
     groves_dir: Optional[str] = None          # directory of grove dirs
+    # HF checkpoint directories (real weights + the checkpoint's own
+    # tokenizer). Each registers into the catalog as xla:<dirname> and — when
+    # model_pool is unset — the registered names BECOME the pool, so
+    # `--backend tpu --checkpoint dir1 --checkpoint dir2` serves real
+    # checkpoints with zero extra wiring (reference model_query.ex:222-259
+    # serves whatever models credentials point at).
+    checkpoints: Optional[list[str]] = None
+    # Multi-chip serving: tensor-parallel size per pool member. With more
+    # than one visible device the pool is partitioned into per-member
+    # sub-meshes (parallel.mesh.pool_submeshes) and members overlap from
+    # host threads; on one chip this is ignored.
+    tp: Optional[int] = None
 
 
 class Runtime:
@@ -84,12 +96,32 @@ class Runtime:
 
     @staticmethod
     def _build_backend(config: RuntimeConfig) -> ModelBackend:
-        if config.backend == "tpu":
+        if config.backend != "tpu":
+            if config.checkpoints or config.tp:
+                # Silent fallback to mock would make the user believe their
+                # checkpoint is serving while scripted responses come back.
+                raise ValueError(
+                    "--checkpoint/--tp require --backend tpu "
+                    f"(backend is {config.backend!r})")
+            return MockBackend()
+        pool = list(config.model_pool or ())
+        if config.checkpoints:
+            from quoracle_tpu.models.loader import register_hf_checkpoint
+            registered = [register_hf_checkpoint(path).name
+                          for path in config.checkpoints]
+            if not pool:
+                pool = [f"xla:{name}" for name in registered]
+        if not pool:
             from quoracle_tpu.models.config import BENCH_POOL
-            return TPUBackend(config.model_pool or list(BENCH_POOL),
-                              seed=config.seed,
-                              embed_model=config.embed_model)
-        return MockBackend()
+            pool = list(BENCH_POOL)
+        import jax
+        submeshes = None
+        if len(jax.devices()) > 1:
+            from quoracle_tpu.parallel.mesh import pool_submeshes
+            submeshes = pool_submeshes(len(pool), tp=config.tp)
+        return TPUBackend(pool, seed=config.seed,
+                          embed_model=config.embed_model,
+                          submeshes=submeshes)
 
     async def boot(self) -> dict:
         """Boot-time revival of persisted running tasks (reference

@@ -176,3 +176,97 @@ def test_backend_consensus_never_parse_fails():
     # action name), but no response may fail JSON PARSING
     for f in outcome.failures:
         assert "parse" not in f.error, f.error
+
+
+# ---------------------------------------------------------------------------
+# Schema-aware grammar: action-enum constraint (VERDICT r2 item 7)
+# ---------------------------------------------------------------------------
+
+ENUM = ("send_message", "spawn_child", "todo", "wait")
+
+
+def test_enum_dfa_accepts_only_allowed_actions():
+    dfa = CharDFA(max_depth=4, action_enum=ENUM)
+    ok = '{"action": "wait", "params": {"duration": 3}, "wait": true}'
+    st = walk(dfa, ok)
+    assert st is not None and dfa.accept[st]
+    for bad in (
+        '{"action": "execute_shell", "params": {}}',   # not in enum
+        '{"action": "wai"}',                           # prefix only
+        '{"params": {}, "action": "wait"}',            # action must be first
+        '{"action": "wait", "action": "todo"}',        # duplicate key
+        '{"action": "wait", "\\u0061ction": "x"}',     # escaped respelling
+        '{}',                                          # action required
+    ):
+        st = walk(dfa, bad)
+        assert st is None or not dfa.accept[st], bad
+
+
+def test_enum_dfa_keeps_nested_objects_generic():
+    dfa = CharDFA(max_depth=4, action_enum=ENUM)
+    nested = ('{"action": "todo", "params": {"items": '
+              '[{"action": "anything", "task": "x"}]}, "reasoning": "r"}')
+    st = walk(dfa, nested)
+    assert st is not None and dfa.accept[st]
+
+
+def test_enum_token_walks_always_name_allowed_action():
+    tok = ByteTokenizer()
+    tt = JsonTokenTable.for_tokenizer(tok, tok.vocab_size, tok.eos_id,
+                                      action_enum=ENUM)
+    rng = np.random.default_rng(7)
+    closed = 0
+    for trial in range(20):
+        st, out = tt.start_state, []
+        for _ in range(400):
+            allowed = np.nonzero(tt.table[st] >= 0)[0]
+            assert allowed.size, "dead end"
+            t = int(rng.choice(allowed))
+            if t == tok.eos_id:
+                break
+            out.append(t)
+            st = int(tt.table[st, t])
+        if st >= 0 and st < len(tt.accept) and tt.accept[st]:
+            obj = json.loads(tok.decode(out))
+            assert obj["action"] in ENUM
+            closed += 1
+    assert closed >= 10
+
+
+def test_engine_rows_with_enum_emit_allowed_action():
+    eng = make_engine()
+    tok = eng.tokenizer
+    prompts = [tok.encode(f"decide #{i}", add_bos=True) for i in range(3)]
+    res = eng.generate(prompts, temperature=1.0, max_new_tokens=160,
+                       constrain_json=[True] * 3,
+                       action_enums=[ENUM] * 3)
+    for r in res:
+        if r.finish_reason == "stop":
+            assert json.loads(r.text)["action"] in ENUM
+
+
+def test_mixed_enum_batch_stacks_grammars():
+    """Rows with different enums (and a plain-JSON row) share one decode."""
+    eng = make_engine()
+    tok = eng.tokenizer
+    prompts = [tok.encode(f"row {i}", add_bos=True) for i in range(3)]
+    res = eng.generate(prompts, temperature=1.0, max_new_tokens=160,
+                       constrain_json=[True, True, True],
+                       action_enums=[("wait",), ("todo", "orient"), None])
+    for r, allowed in zip(res, [("wait",), ("todo", "orient"), None]):
+        if r.finish_reason == "stop":
+            obj = json.loads(r.text)
+            if allowed is not None:
+                assert obj["action"] in allowed
+
+
+def test_consensus_engine_threads_action_enum_to_backend():
+    from quoracle_tpu.consensus.engine import ConsensusConfig, ConsensusEngine
+    from quoracle_tpu.models.runtime import MockBackend
+    backend = MockBackend()
+    eng = ConsensusEngine(backend, ConsensusConfig(
+        model_pool=list(MockBackend.DEFAULT_POOL),
+        allowed_actions={"wait", "todo"}, constrained_json=True))
+    eng.decide({m: [{"role": "user", "content": "x"}]
+                for m in MockBackend.DEFAULT_POOL})
+    assert all(c.action_enum == ("todo", "wait") for c in backend.calls)
