@@ -1,0 +1,1 @@
+"""Developer tooling (prompt debugging, golden generation)."""

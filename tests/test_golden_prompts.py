@@ -1,0 +1,47 @@
+"""Golden-prompt suite: every show_prompts scenario must render byte-
+identically to its checked-in golden (SURVEY §4 carry-over 4; the
+reference's mix quoracle.show_llm_prompts 13 scenarios as tests).
+
+On an INTENTIONAL prompt change, regenerate with
+    python -m quoracle_tpu.tools.show_prompts --write-golden tests/golden
+and review the diff — prompt drift is a behavior change for every model in
+every pool, not a cosmetic edit.
+"""
+
+import os
+
+import pytest
+
+from quoracle_tpu.tools.show_prompts import SCENARIOS
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "golden")
+
+
+def test_every_scenario_has_a_golden():
+    have = {fn[:-4] for fn in os.listdir(GOLDEN_DIR) if fn.endswith(".txt")}
+    assert have == set(SCENARIOS), (
+        "golden files out of sync with scenarios — regenerate with "
+        "--write-golden")
+
+
+@pytest.mark.parametrize("name", sorted(SCENARIOS))
+def test_scenario_matches_golden(name):
+    with open(os.path.join(GOLDEN_DIR, f"{name}.txt")) as f:
+        want = f.read()
+    got = SCENARIOS[name]()
+    assert got == want, (
+        f"prompt drift in scenario {name!r} — if intentional, regenerate "
+        "goldens with --write-golden and review the diff")
+
+
+def test_scenarios_cover_the_reference_set():
+    """The reference's 12 named scenarios (+ all) have counterparts
+    (reference lib/mix/tasks/quoracle.show_llm_prompts.ex:10-25)."""
+    need = {
+        "generalist_initial", "generalist_with_history", "with_fields_full",
+        "with_cognitive_style", "refinement_round", "with_secrets",
+        "consensus_immediate", "consensus_exact_match_params",
+        "consensus_semantic_params", "consensus_different_actions",
+        "consensus_max_rounds", "consensus_cluster_merge",
+    }
+    assert need <= set(SCENARIOS)

@@ -1,0 +1,245 @@
+"""Property tests: the 8 consensus merge rules + escrow arithmetic.
+
+SURVEY §4 carry-over 5 — the reference's StreamData property style
+(74 properties) applied to the two most arithmetic-heavy subsystems:
+consensus param merging (consensus/rules.py) and budget escrow
+(infra/budget.py). Deterministic embedder, no models.
+"""
+
+from decimal import Decimal
+
+import pytest
+from hypothesis import given, settings, strategies as st
+
+from quoracle_tpu.consensus.json_utils import stable_dumps
+from quoracle_tpu.consensus.rules import (
+    merge_values, merge_wait, values_compatible,
+)
+from quoracle_tpu.infra.budget import BudgetError, Escrow, ZERO
+from quoracle_tpu.models.embeddings import HashingEmbedder
+
+EMB = HashingEmbedder()
+
+scalars = st.one_of(
+    st.integers(-1000, 1000),
+    st.text(max_size=20),
+    st.booleans(),
+    st.floats(allow_nan=False, allow_infinity=False, width=32),
+)
+values_nonempty = st.lists(scalars, min_size=1, max_size=6)
+
+
+# ---------------------------------------------------------------------------
+# merge rules
+# ---------------------------------------------------------------------------
+
+@given(values_nonempty)
+def test_exact_merge_returns_first_and_compat_is_equality(vals):
+    assert merge_values(("exact",), vals, EMB) == vals[0]
+    a, b = vals[0], vals[-1]
+    compat = values_compatible(("exact",), a, b, EMB)
+    assert compat == (stable_dumps(a) == stable_dumps(b))
+    # reflexive + symmetric
+    assert values_compatible(("exact",), a, a, EMB)
+    assert compat == values_compatible(("exact",), b, a, EMB)
+
+
+@given(st.lists(st.text(min_size=1, max_size=30), min_size=1, max_size=5))
+def test_semantic_merge_picks_an_input(texts):
+    out = merge_values(("semantic", 0.85), texts, EMB)
+    assert out in texts
+    # identical texts are always semantically equal to themselves
+    assert values_compatible(("semantic", 0.85), texts[0], texts[0], EMB)
+
+
+@given(values_nonempty)
+def test_mode_merge_is_a_maximal_count_input(vals):
+    out = merge_values(("mode",), vals, EMB)
+    keys = [stable_dumps(v) for v in vals]
+    assert stable_dumps(out) in keys
+    out_count = keys.count(stable_dumps(out))
+    assert all(out_count >= keys.count(k) for k in keys)
+
+
+@given(st.lists(st.one_of(scalars, st.lists(scalars, max_size=4)),
+                min_size=1, max_size=5))
+def test_union_merge_deduplicates_and_is_idempotent(vals):
+    out = merge_values(("union",), vals, EMB)
+    assert isinstance(out, list)
+    keys = [stable_dumps(v) for v in out]
+    assert len(keys) == len(set(keys))           # no duplicates
+    # every input item (flattened) appears
+    flat = [item for v in vals
+            for item in (v if isinstance(v, list) else [v])]
+    assert {stable_dumps(i) for i in flat} == set(keys)
+    # idempotent: merging the merge changes nothing
+    again = merge_values(("union",), [out], EMB)
+    assert [stable_dumps(v) for v in again] == keys
+
+
+@given(st.lists(st.dictionaries(st.sampled_from("abcd"), scalars,
+                                max_size=4), min_size=1, max_size=5))
+def test_structural_merge_unions_keys(dicts):
+    out = merge_values(("structural",), dicts, EMB)
+    assert isinstance(out, dict)
+    assert set(out) == {k for d in dicts for k in d}
+    for k, v in out.items():
+        assert stable_dumps(v) in [stable_dumps(d[k])
+                                   for d in dicts if k in d]
+
+
+@given(st.lists(st.integers(0, 10_000), min_size=1, max_size=7),
+       st.sampled_from([25, 50, 75, 90]))
+def test_percentile_merge_is_an_input_within_range(nums, p):
+    out = merge_values(("percentile", p), nums, EMB)
+    assert out in nums                            # method="nearest"
+    assert min(nums) <= out <= max(nums)
+
+
+@given(st.lists(st.one_of(st.none(), st.booleans(),
+                          st.integers(0, 3600)), min_size=1, max_size=7))
+def test_wait_merge_category_and_range(vals):
+    out = merge_wait(vals)
+    present = [v for v in vals if v is not None]
+    if not present:
+        assert out is None
+    elif out is True:
+        assert True in present
+    elif isinstance(out, bool):
+        assert out is False
+    elif isinstance(out, (int, float)):
+        nums = [v for v in present
+                if isinstance(v, (int, float)) and not isinstance(v, bool)]
+        assert nums and min(nums) <= out <= max(nums)
+
+
+@given(values_nonempty)
+def test_batch_sequence_merge_returns_first(vals):
+    assert merge_values(("batch_sequence",), vals, EMB) == vals[0]
+
+
+# ---------------------------------------------------------------------------
+# escrow arithmetic
+# ---------------------------------------------------------------------------
+
+amounts = st.integers(0, 10_000).map(lambda n: Decimal(n) / 100)
+
+
+@given(limit=st.integers(100, 100_000).map(lambda n: Decimal(n) / 100),
+       allocs=st.lists(amounts, min_size=1, max_size=6))
+@settings(max_examples=60)
+def test_lock_then_release_restores_available(limit, allocs):
+    esc = Escrow()
+    esc.register("root", mode="root", limit=limit)
+    before = esc.get("root").available
+    locked = []
+    for i, amt in enumerate(allocs):
+        try:
+            esc.lock_for_child("root", f"c{i}", amt)
+            locked.append((f"c{i}", amt))
+        except BudgetError:
+            # over-commit refused: available was insufficient
+            assert esc.get("root").available < amt
+    st_root = esc.get("root")
+    assert st_root.committed == sum((a for _, a in locked), ZERO)
+    assert st_root.available == limit - st_root.committed
+    for cid, _ in locked:
+        esc.release_child(cid)
+    after = esc.get("root")
+    # nothing was spent: the full escrow returns
+    assert after.available == before
+    assert after.committed == ZERO
+
+
+@given(limit=st.integers(1000, 100_000).map(lambda n: Decimal(n) / 100),
+       alloc=amounts, spend=amounts)
+@settings(max_examples=60)
+def test_release_accounts_spend_and_clamps(limit, alloc, spend):
+    esc = Escrow()
+    esc.register("root", mode="root", limit=limit)
+    try:
+        esc.lock_for_child("root", "c", alloc)
+    except BudgetError:
+        assert alloc > limit
+        return
+    esc.record_spend("c", spend)
+    released = esc.release_child("c")
+    assert released >= ZERO                       # clamp: never negative
+    assert released == max(ZERO, alloc - spend)
+    root = esc.get("root")
+    assert root.committed == ZERO
+    # the parent absorbs the child's spend, capped at the allocation
+    assert root.spent == min(alloc, spend)
+    assert root.available == limit - min(alloc, spend)
+
+
+@given(limit=st.integers(1000, 100_000).map(lambda n: Decimal(n) / 100),
+       alloc=amounts, new_alloc=amounts)
+@settings(max_examples=60)
+def test_adjust_child_conserves_parent_budget(limit, alloc, new_alloc):
+    esc = Escrow()
+    esc.register("root", mode="root", limit=limit)
+    try:
+        esc.lock_for_child("root", "c", alloc)
+    except BudgetError:
+        return
+    try:
+        esc.adjust_child("root", "c", new_alloc)
+        assert esc.get("root").committed == new_alloc
+        assert esc.get("c").limit == new_alloc
+    except BudgetError:
+        # refused: either an increase beyond available or below child floor
+        delta = new_alloc - alloc
+        assert (delta > ZERO and limit - alloc < delta) or new_alloc < ZERO
+        assert esc.get("root").committed == alloc   # unchanged on failure
+    # invariant either way: available + spent + committed == limit
+    root = esc.get("root")
+    assert root.available + root.spent + root.committed == limit
+
+
+@given(limit=st.integers(1000, 50_000).map(lambda n: Decimal(n) / 100),
+       chain=st.lists(amounts, min_size=2, max_size=4))
+@settings(max_examples=40)
+def test_out_of_order_dismissal_reparents_allocations(limit, chain):
+    """Dismiss a middle agent: its live children re-parent to the
+    grandparent and the ledger still balances."""
+    esc = Escrow()
+    esc.register("a0", mode="root", limit=limit)
+    parent = "a0"
+    ok = []
+    for i, amt in enumerate(chain):
+        cid = f"a{i + 1}"
+        try:
+            esc.lock_for_child(parent, cid, amt)
+            ok.append(cid)
+            parent = cid
+        except BudgetError:
+            break
+    if len(ok) < 2:
+        return
+    mid = ok[0]
+    esc.release_child(mid)                         # dismiss the middle
+    # grandchild survived with its allocation intact
+    grandchild = ok[1]
+    assert esc.get(grandchild).limit is not None
+    root = esc.get("a0")
+    assert root.available is not None and root.available >= ZERO
+    # full teardown drains every commitment
+    for cid in reversed(ok[1:]):
+        esc.release_child(cid)
+    assert esc.get("a0").committed == ZERO
+
+
+def test_na_mode_is_unlimited():
+    esc = Escrow()
+    esc.register("root", mode="na")
+    esc.lock_for_child("root", "c", Decimal("1000000"))
+    esc.record_spend("c", Decimal("5"))
+    assert esc.get("root").available is None
+    assert esc.get("root").over_budget is False
+
+
+def test_mode_requires_limit():
+    esc = Escrow()
+    with pytest.raises(BudgetError):
+        esc.register("r", mode="root", limit=None)
