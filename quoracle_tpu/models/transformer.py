@@ -153,6 +153,8 @@ def forward_hidden(
     cache: KVCache,
     write_offset: jax.Array,  # [B] int32: where this chunk's kv entries land
     kv_lens: jax.Array,       # [B] int32 valid kv count AFTER this chunk
+    kv_pos_offset: Optional[jax.Array] = None,  # [B] int32: absolute position
+                                                # of kv buffer index 0
 ) -> tuple[jax.Array, KVCache]:
     """Run the stack over a token chunk, updating the cache; returns final
     hidden states [B, T, D] (pre-head) — see project_logits.
@@ -198,7 +200,8 @@ def forward_hidden(
         from quoracle_tpu.ops.flash_attention import attend_auto
         attn = attend_auto(q, k_buf, v_buf, positions,
                            kv_len=kv_lens,
-                           sliding_window=cfg.sliding_window)
+                           sliding_window=cfg.sliding_window,
+                           kv_pos_offset=kv_pos_offset)
         x = x + jnp.einsum("bthd,hdD->btD", attn,
                            p["wo"].reshape(cfg.n_heads, cfg.head_dim, cfg.dim))
 

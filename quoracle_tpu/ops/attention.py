@@ -34,14 +34,17 @@ def attend(
     q_positions: jax.Array,  # [B, T] int32 absolute positions of the queries
     kv_len: jax.Array,       # [B] int32 number of valid kv entries (<= S)
     sliding_window: Optional[int] = None,
+    kv_pos_offset: Optional[jax.Array] = None,  # [B] int32; buffer idx 0's
+                                                # absolute position (default 0)
 ) -> jax.Array:
     """Causal attention of a query chunk against a (partially filled) kv buffer.
 
     Serves both prefill (T = prompt chunk) and decode (T = 1) — one code path,
     two jit specializations. Masking combines:
       * validity:  kv index < kv_len[b]
-      * causality: kv position <= query position (kv buffer is position-ordered,
-        so kv index == kv absolute position)
+      * causality: kv position <= query position (kv absolute position =
+        kv_pos_offset[b] + buffer index; the offset is nonzero for
+        sliding-window sessions whose leading pages were trimmed)
       * sliding window (optional): query_pos - kv_pos < window
     Returns [B, T, n_heads, hd].
     """
@@ -57,9 +60,13 @@ def attend(
     scores = jnp.einsum("bthd,bshd->bhts", q.astype(jnp.float32) * scale,
                         k.astype(jnp.float32))
 
-    kv_pos = jnp.arange(s, dtype=jnp.int32)[None, None, :]        # [1, 1, S]
+    kv_idx = jnp.arange(s, dtype=jnp.int32)[None, None, :]        # [1, 1, S]
+    if kv_pos_offset is None:
+        kv_pos = kv_idx
+    else:
+        kv_pos = kv_idx + kv_pos_offset.astype(jnp.int32)[:, None, None]
     qp = q_positions.astype(jnp.int32)[:, :, None]                # [B, T, 1]
-    valid = kv_pos < kv_len.astype(jnp.int32)[:, None, None]      # [B, T, S]
+    valid = kv_idx < kv_len.astype(jnp.int32)[:, None, None]      # [B, T, S]
     causal = kv_pos <= qp
     mask = valid & causal
     if sliding_window is not None:

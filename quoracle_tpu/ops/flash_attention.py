@@ -34,18 +34,20 @@ DEFAULT_TK = 128
 NEG_INF = -1e30
 
 
-def _flash_kernel(kv_len_ref, qpos_ref, q_ref, k_ref, v_ref, o_ref, *,
+def _flash_kernel(kv_meta_ref, qpos_ref, q_ref, k_ref, v_ref, o_ref, *,
                   tk: int, scale: float, sliding_window: Optional[int]):
     """One (batch, head, q-block) program: stream KV in tk-sized blocks.
 
     Block shapes (leading singleton dims dropped by indexing):
       q_ref [1, 1, TQ, hd]   k_ref/v_ref [1, 1, S, hd]
-      qpos_ref [1, TQ] (VMEM) kv_len_ref [1] (SMEM)  o_ref [1, 1, TQ, hd]
+      qpos_ref [1, TQ] (VMEM) kv_meta_ref [B, 2] (SMEM: kv_len, pos offset)
+      o_ref [1, 1, TQ, hd]
     """
     q = q_ref[0, 0].astype(jnp.float32) * scale          # [TQ, hd]
     tq, hd = q.shape
     s = k_ref.shape[2]
-    kv_len = kv_len_ref[pl.program_id(0)]                 # this batch row
+    kv_len = kv_meta_ref[pl.program_id(0), 0]             # this batch row
+    kv_off = kv_meta_ref[pl.program_id(0), 1]             # abs pos of idx 0
     q_pos = qpos_ref[0].astype(jnp.int32)                 # [TQ]
 
     def body(i, carry):
@@ -56,10 +58,11 @@ def _flash_kernel(kv_len_ref, qpos_ref, q_ref, k_ref, v_ref, o_ref, *,
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
         kv_idx = i * tk + jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1)
+        kv_pos = kv_idx + kv_off
         qp = q_pos[:, None]
-        mask = (kv_idx < kv_len) & (kv_idx <= qp)
+        mask = (kv_idx < kv_len) & (kv_pos <= qp)
         if sliding_window is not None:
-            mask &= qp - kv_idx < sliding_window
+            mask &= qp - kv_pos < sliding_window
         scores = jnp.where(mask, scores, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(scores, axis=1, keepdims=True))
         # NEG_INF is finite, so a fully-masked block would give
@@ -102,6 +105,7 @@ def flash_attend(
     q_positions: jax.Array,  # [B, T] int32
     kv_len: jax.Array,       # [B] int32
     sliding_window: Optional[int] = None,
+    kv_pos_offset: Optional[jax.Array] = None,   # [B] int32
     tq: int = DEFAULT_TQ,
     tk: int = DEFAULT_TK,
     interpret: bool = False,
@@ -150,13 +154,18 @@ def flash_attend(
         ),
         out_shape=jax.ShapeDtypeStruct((b, n_heads, t_p, hd_p), q.dtype),
         interpret=interpret,
-    )(kv_len.astype(jnp.int32), qpos2, q2, k2, v2)
+    )(jnp.stack([kv_len.astype(jnp.int32),
+                 (jnp.zeros_like(kv_len, jnp.int32)
+                  if kv_pos_offset is None
+                  else kv_pos_offset.astype(jnp.int32))], axis=1),
+      qpos2, q2, k2, v2)
 
     return out.transpose(0, 2, 1, 3)[:, :t, :, :hd]
 
 
 def attend_auto(q, k, v, q_positions, kv_len,
                 sliding_window: Optional[int] = None,
+                kv_pos_offset: Optional[jax.Array] = None,
                 min_flash_len: int = 256) -> jax.Array:
     """Pick the attention path: flash on TPU for long prefill chunks, dense
     XLA otherwise (decode steps and CPU tests). Same signature/semantics as
@@ -164,6 +173,8 @@ def attend_auto(q, k, v, q_positions, kv_len,
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu and q.shape[1] >= min_flash_len:
         return flash_attend(q, k, v, q_positions, kv_len,
-                            sliding_window=sliding_window)
+                            sliding_window=sliding_window,
+                            kv_pos_offset=kv_pos_offset)
     return attend(q, k, v, q_positions, kv_len,
-                  sliding_window=sliding_window)
+                  sliding_window=sliding_window,
+                  kv_pos_offset=kv_pos_offset)

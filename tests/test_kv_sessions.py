@@ -103,13 +103,26 @@ def test_mixed_batch_sessions_and_fresh_rows():
     assert got == want
 
 
-def test_session_store_lru_eviction():
-    store = SessionStore(max_tokens=10)
-    z = jnp.zeros((1, 1, 1, 1))
-    store.put("a", _Session(tokens=[1] * 6, k=z, v=z))
-    store.put("b", _Session(tokens=[1] * 6, k=z, v=z))
-    assert len(store) == 1          # a evicted: 12 > 10
-    assert store.get("b") is not None and store.get("a") is None
+def test_session_store_lru_page_eviction():
+    """Pool of 2 usable pages (+scratch): allocating for a second session
+    evicts the LRU one and recycles its pages."""
+    store = SessionStore(max_tokens=2 * store_page(), page=store_page())
+    pa = store.alloc(2)
+    assert sorted(pa) == [1, 2]
+    store.put("a", _Session(tokens=[1] * 6, pages=pa))
+    pb = store.alloc(2, protect=("b",))      # must evict "a"
+    assert sorted(pb) == [1, 2]
+    store.put("b", _Session(tokens=[2] * 6, pages=pb))
+    assert store.get("a") is None and store.get("b") is not None
+    # protected sessions never evict: a second alloc cannot be satisfied
+    assert store.alloc(2, protect=("b",)) is None
+    # drop returns the pages
+    store.drop("b")
+    assert store.free_pages() == 2
+
+
+def store_page():
+    return 4
 
 
 def test_session_reuse_on_tp_mesh(eight_devices):
