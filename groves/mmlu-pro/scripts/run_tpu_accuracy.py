@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Model-only MMLU-Pro accuracy signal on the TPU backend.
+
+Skips the agent tree: each question is put to every pool member in ONE
+batched query with FORCED-CHOICE decoding — the schema-aware grammar's
+enum slot (models/constrained.py action_enum) constrains the response to
+a JSON object opening with "action": "<one of A-J>", so every completed
+sample names exactly one option — and the pool's majority letter is scored
+against the key. With random-weight bench checkpoints the expected
+accuracy is chance (~10%); register real checkpoints (--checkpoint) for a
+meaningful number.
+
+    python groves/mmlu-pro/scripts/run_tpu_accuracy.py \
+        [--pool xla:llama-1b,...] [--checkpoint DIR ...] [--limit N]
+
+Prints one JSON line: {"metric": "mmlu_pro_subset_accuracy", ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import re
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _HERE)                                  # score_run
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(_HERE))))
+
+from score_run import load_questions  # noqa: E402  (same scripts dir)
+
+# the grammar forces {"action": "<LETTER>"} — the enum slot doubles as a
+# forced-choice constraint
+LETTER = re.compile(r'"action"\s*:\s*"([A-J])"')
+LETTERS = tuple("ABCDEFGHIJ")
+
+
+def ask(backend, pool, q) -> dict[str, str]:
+    from quoracle_tpu.models.runtime import QueryRequest
+    opts = "\n".join(f"{k}. {v}" for k, v in q["options"].items())
+    msgs = [
+        {"role": "system",
+         "content": "Answer the multiple-choice question. Respond ONLY "
+                    'with JSON: {"action": "<LETTER A-J>"}.'},
+        {"role": "user", "content": f"{q['question']}\n{opts}"},
+    ]
+    reqs = [QueryRequest(model_spec=m, messages=msgs, temperature=0.2,
+                         max_tokens=96, constrain_json=True,
+                         action_enum=LETTERS) for m in pool]
+    out = {}
+    for m, r in zip(pool, backend.query(reqs)):
+        match = LETTER.search(r.text or "")
+        out[m] = match.group(1) if (r.ok and match) else None
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pool", default=None,
+                    help="comma-separated model specs")
+    ap.add_argument("--checkpoint", action="append", default=[],
+                    help="HF checkpoint dir(s) to register + serve")
+    ap.add_argument("--limit", type=int, default=None)
+    args = ap.parse_args()
+
+    from quoracle_tpu.models.loader import register_hf_checkpoint
+    from quoracle_tpu.models.runtime import TPUBackend
+    pool = args.pool.split(",") if args.pool else []
+    for d in args.checkpoint:
+        cfg = register_hf_checkpoint(d)
+        pool.append(f"xla:{cfg.name}")
+    if not pool:
+        from quoracle_tpu.models.config import BENCH_POOL
+        pool = list(BENCH_POOL)
+    backend = TPUBackend(pool)
+
+    questions = load_questions()[: args.limit]
+    per_subject: dict[str, list[int]] = {}
+    votes_agree = correct = answered = 0
+    for q in questions:
+        letters = ask(backend, pool, q)
+        counts = collections.Counter(v for v in letters.values() if v)
+        if counts:
+            answered += 1
+            winner, n = counts.most_common(1)[0]
+            votes_agree += int(n > len(pool) // 2)
+            hit = int(winner == q["answer"])
+        else:
+            hit = 0
+        correct += hit
+        per_subject.setdefault(q["subject"], []).append(hit)
+        print(f"{q['id']}: votes={dict(counts)} key={q['answer']}",
+              file=sys.stderr, flush=True)
+
+    print(json.dumps({
+        "metric": "mmlu_pro_subset_accuracy",
+        "value": round(correct / max(1, len(questions)), 4),
+        "unit": "fraction",
+        "questions": len(questions),
+        "answered": answered,
+        "majority_rounds": votes_agree,
+        "pool": pool,
+        "per_subject": {s: round(sum(v) / len(v), 3)
+                        for s, v in sorted(per_subject.items())},
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

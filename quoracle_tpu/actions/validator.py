@@ -81,12 +81,14 @@ def validate_params(action: str, params: dict,
             errors.append(f"param {key!r} must be one of {enum}, got {value!r}")
 
     if action in ("batch_sync", "batch_async"):
-        errors.extend(_validate_batch(action, params, allowed_actions))
+        errors.extend(_validate_batch(action, params, allowed_actions,
+                                      profile_optional))
     return errors
 
 
 def _validate_batch(action: str, params: dict,
-                    allowed_actions: Optional[set[str]]) -> list[str]:
+                    allowed_actions: Optional[set[str]],
+                    profile_optional: bool = False) -> list[str]:
     errors: list[str] = []
     subs = params.get("actions")
     if not isinstance(subs, list) or not subs:
@@ -101,8 +103,12 @@ def _validate_batch(action: str, params: dict,
         if sub_action not in allowed_set:
             errors.append(f"batch item {i}: {sub_action!r} not batchable in {action}")
             continue
+        # profile_optional flows into sub-actions: a grove agent batching
+        # spawn_childs gets the same topology profile injection a bare
+        # spawn_child gets
         sub_errors = validate_params(sub_action, sub.get("params", {}),
-                                     allowed_actions=allowed_actions)
+                                     allowed_actions=allowed_actions,
+                                     profile_optional=profile_optional)
         errors.extend(f"batch item {i}: {e}" for e in sub_errors)
     return errors
 
