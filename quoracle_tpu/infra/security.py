@@ -173,6 +173,10 @@ class SecretStore:
                     for s in self._secrets.values()
                     if q in s.name.lower() or q in s.description.lower()]
 
+    def delete(self, name: str) -> bool:
+        with self._lock:
+            return self._secrets.pop(name, None) is not None
+
     def values(self) -> dict[str, str]:
         """name -> value snapshot for scrub_output."""
         with self._lock:

@@ -129,6 +129,15 @@ class PersistentSecretStore(SecretStore):
             (name, blob, int(enc), description, created_by, secret.created_at))
         return secret
 
+    def delete(self, name):
+        # the DB row can exist without an in-memory entry (degraded boot
+        # skipped encrypted secrets); report what was actually destroyed
+        row = self.db.query_one("SELECT name FROM secrets WHERE name=?",
+                                (name,))
+        existed = super().delete(name) or row is not None
+        self.db.execute("DELETE FROM secrets WHERE name=?", (name,))
+        return existed
+
     def lookup(self, name, *, agent_id="", action=""):
         value = super().lookup(name, agent_id=agent_id, action=action)
         if value is not None and agent_id:
@@ -266,6 +275,11 @@ class Persistence:
         return [r["name"] for r in
                 self.db.query("SELECT name FROM profiles ORDER BY name")]
 
+    def delete_profile(self, name: str) -> bool:
+        existed = self.get_profile(name) is not None
+        self.db.execute("DELETE FROM profiles WHERE name=?", (name,))
+        return existed
+
     def set_setting(self, key: str, value: Any) -> None:
         self.db.execute(
             "INSERT OR REPLACE INTO model_settings (key, value) VALUES (?,?)",
@@ -275,6 +289,11 @@ class Persistence:
         row = self.db.query_one(
             "SELECT value FROM model_settings WHERE key=?", (key,))
         return json.loads(row["value"]) if row else default
+
+    def all_settings(self) -> dict:
+        return {r["key"]: json.loads(r["value"]) for r in
+                self.db.query("SELECT key, value FROM model_settings "
+                              "ORDER BY key")}
 
     # -- durable event log (bus → logs/messages/actions rows) --------------
 

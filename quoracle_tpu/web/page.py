@@ -57,7 +57,44 @@ DASHBOARD_HTML = r"""<!doctype html>
 <header>
   <h1>quoracle-tpu</h1>
   <span class="status" id="status">connecting…</span>
+  <button id="settings-btn" style="margin-left:auto"
+          onclick="toggleSettings()">settings</button>
 </header>
+<div id="settings-panel" style="display:none;padding:12px 16px;
+     border-bottom:1px solid #333">
+  <div style="display:flex;gap:28px;flex-wrap:wrap">
+    <div>
+      <h2>System settings</h2>
+      <div id="st-settings"></div>
+      <div class="row">
+        <input id="st-key" placeholder="key" style="width:140px">
+        <input id="st-val" placeholder="value (JSON or text)"
+               style="width:180px">
+        <button onclick="saveSetting()">set</button>
+      </div>
+    </div>
+    <div>
+      <h2>Profiles</h2>
+      <div id="st-profiles"></div>
+      <div class="row">
+        <input id="pf-name" placeholder="name" style="width:110px">
+        <input id="pf-pool" placeholder="model pool (comma-sep)"
+               style="width:200px">
+        <button onclick="saveProfile()">save</button>
+      </div>
+    </div>
+    <div>
+      <h2>Secrets <span class="meta">(values never displayed)</span></h2>
+      <div id="st-secrets"></div>
+      <div class="row">
+        <input id="sc-name" placeholder="name" style="width:110px">
+        <input id="sc-val" placeholder="value (empty = generate)"
+               type="password" style="width:160px">
+        <button onclick="saveSecret()">save</button>
+      </div>
+    </div>
+  </div>
+</div>
 <main>
   <section id="left">
     <div id="newtask">
@@ -86,8 +123,12 @@ DASHBOARD_HTML = r"""<!doctype html>
 <script>
 let selTask = null, selAgent = null;
 const $ = id => document.getElementById(id);
-const esc = s => String(s ?? "").replace(/[&<>]/g,
-  c => ({"&":"&amp;","<":"&lt;",">":"&gt;"}[c]));
+const esc = s => String(s ?? "").replace(/[&<>"']/g,
+  c => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[c]));
+// For names interpolated into inline JS calls: JSON.stringify guards the
+// JS-string context (backslash-escapes quotes), esc() guards the HTML
+// attribute context around it.
+const jsArg = s => esc(JSON.stringify(String(s ?? "")));
 
 // Token-mode support: ?token=… (or #token=…) is remembered in
 // sessionStorage and attached to every request; EventSource can't set
@@ -109,6 +150,56 @@ async function api(path, opts) {
                              "authorization": "Bearer " + TOKEN};
   const r = await fetch(path, opts);
   return r.json();
+}
+
+// -- settings surface (reference SecretManagementLive) --------------------
+let settingsOpen = false;
+function toggleSettings() {
+  settingsOpen = !settingsOpen;
+  $("settings-panel").style.display = settingsOpen ? "block" : "none";
+  if (settingsOpen) refreshSettings();
+}
+async function refreshSettings() {
+  const s = await api("/api/settings");
+  $("st-settings").innerHTML = Object.entries(s.settings).map(([k, v]) =>
+    `<div class="meta">${esc(k)} = ${esc(JSON.stringify(v))}</div>`)
+    .join("") || '<div class="meta">none set</div>';
+  $("st-profiles").innerHTML = Object.entries(s.profiles).map(([n, p]) =>
+    `<div class="meta">${esc(n)}: ${esc((p.model_pool||[]).join(","))}
+     <a href="#" onclick="delProfile(${jsArg(n)});return false">✕</a>
+     </div>`).join("") || '<div class="meta">none</div>';
+  $("st-secrets").innerHTML = s.secrets.map(x =>
+    `<div class="meta">${esc(x.name)} — ${esc(x.description || "")}
+     <a href="#" onclick="delSecret(${jsArg(x.name)});return false">✕</a>
+     </div>`).join("") || '<div class="meta">none</div>';
+}
+async function saveSetting() {
+  let v = $("st-val").value;
+  try { v = JSON.parse(v); } catch (e) { /* keep as string */ }
+  await api("/api/settings", {method: "POST",
+    body: JSON.stringify({[$("st-key").value]: v})});
+  refreshSettings();
+}
+async function saveProfile() {
+  await api("/api/profiles", {method: "POST", body: JSON.stringify({
+    name: $("pf-name").value,
+    model_pool: $("pf-pool").value.split(",").map(s => s.trim())
+      .filter(Boolean)})});
+  refreshSettings();
+}
+async function saveSecret() {
+  await api("/api/secrets", {method: "POST", body: JSON.stringify({
+    name: $("sc-name").value, value: $("sc-val").value})});
+  $("sc-val").value = "";
+  refreshSettings();
+}
+async function delProfile(n) {
+  await api("/api/profiles/" + encodeURIComponent(n), {method: "DELETE"});
+  refreshSettings();
+}
+async function delSecret(n) {
+  await api("/api/secrets/" + encodeURIComponent(n), {method: "DELETE"});
+  refreshSettings();
 }
 
 async function refreshTasks() {

@@ -150,6 +150,21 @@ class DashboardServer:
             "ORDER BY id DESC LIMIT ?2", (task_id, limit))
         return [dict(r) for r in reversed(rows)]
 
+    def settings_payload(self) -> dict:
+        """The settings surface (reference SecretManagementLive): system
+        settings, profiles, secret METADATA (values never leave the vault),
+        and the served model catalog."""
+        from quoracle_tpu.models.config import list_models
+        store = self.runtime.store
+        return {
+            "settings": store.all_settings(),
+            "profiles": {name: store.get_profile(name)
+                         for name in store.list_profiles()},
+            "secrets": self.runtime.secrets.search(""),
+            "models": list_models(),
+            "default_pool": self.runtime.default_pool(),
+        }
+
 
 class _Handler(BaseHTTPRequestHandler):
     dashboard: DashboardServer = None  # bound by DashboardServer.start
@@ -218,6 +233,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.logs_payload(one("agent_id")))
             elif parsed.path == "/api/messages":
                 self._send_json(d.messages_payload(one("task_id")))
+            elif parsed.path == "/api/settings":
+                self._send_json(d.settings_payload())
             elif parsed.path == "/events":
                 self._stream_events()
             else:
@@ -310,8 +327,70 @@ class _Handler(BaseHTTPRequestHandler):
                     "type": "user_message",
                     "content": body.get("content", ""), "from": "user"})
                 self._send_json({"delivered": ok}, 200 if ok else 404)
+            elif self.path == "/api/settings":
+                # {key: value, ...} — merge into model_settings rows;
+                # validate ALL keys before writing any (atomic endpoint)
+                if not all(isinstance(k, str) and k for k in body):
+                    self._send_json({"error": "keys must be non-empty "
+                                              "strings"}, 400)
+                    return
+                for key, value in body.items():
+                    d.runtime.store.set_setting(key, value)
+                self._send_json(d.runtime.store.all_settings())
+            elif self.path == "/api/profiles":
+                name = body.get("name")
+                if not name or not isinstance(name, str):
+                    self._send_json({"error": "profile name required"}, 400)
+                    return
+                # MERGE into the existing profile: a form that carries only
+                # model_pool must not silently drop capability_groups etc.
+                data = d.runtime.store.get_profile(name) or {}
+                data.update({k: v for k, v in body.items() if k != "name"})
+                d.runtime.store.save_profile(name, data)
+                self._send_json({"name": name, **data}, 201)
+            elif self.path == "/api/secrets":
+                name = body.get("name")
+                if not name or not isinstance(name, str):
+                    self._send_json({"error": "secret name required"}, 400)
+                    return
+                if body.get("value"):
+                    d.runtime.secrets.put(
+                        name, str(body["value"]),
+                        description=body.get("description", ""),
+                        created_by="dashboard")
+                else:   # no value → generate (reference generate_secret)
+                    d.runtime.secrets.generate(
+                        name, length=int(body.get("length", 32)),
+                        charset=body.get("charset", "alphanumeric"),
+                        description=body.get("description", ""),
+                        created_by="dashboard")
+                # metadata only; the value never goes back over the wire
+                self._send_json(
+                    next(s for s in d.runtime.secrets.search("")
+                         if s["name"] == name), 201)
             else:
                 self._send_json({"error": "not found"}, 404)
         except Exception as e:
             logger.exception("dashboard POST %s failed", self.path)
+            self._send_json({"error": str(e)}, 500)
+
+    def do_DELETE(self) -> None:    # noqa: N802 (stdlib API)
+        d = self.dashboard
+        if not self._authorized():
+            self._send_json({"error": "unauthorized"}, 401)
+            return
+        try:
+            parts = self.path.rstrip("/").split("/")
+            if self.path.startswith("/api/profiles/") and len(parts) == 4:
+                ok = d.runtime.store.delete_profile(
+                    urllib.parse.unquote(parts[3]))
+                self._send_json({"deleted": ok}, 200 if ok else 404)
+            elif self.path.startswith("/api/secrets/") and len(parts) == 4:
+                ok = d.runtime.secrets.delete(
+                    urllib.parse.unquote(parts[3]))
+                self._send_json({"deleted": ok}, 200 if ok else 404)
+            else:
+                self._send_json({"error": "not found"}, 404)
+        except Exception as e:
+            logger.exception("dashboard DELETE %s failed", self.path)
             self._send_json({"error": str(e)}, 500)

@@ -7,6 +7,7 @@ against a live Runtime — covering exactly what a browser would do."""
 import asyncio
 import json
 import time
+import urllib.error
 import urllib.request
 
 from quoracle_tpu.models.runtime import MockBackend
@@ -27,8 +28,11 @@ async def http_json(url, method="GET", body=None):
             url, method=method,
             data=json.dumps(body).encode() if body is not None else None,
             headers={"content-type": "application/json"})
-        with urllib.request.urlopen(req, timeout=10) as resp:
-            return resp.status, json.loads(resp.read())
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
     return await asyncio.get_running_loop().run_in_executor(None, call)
 
 
@@ -179,14 +183,14 @@ def test_dashboard_auth_token_gates_mutations(monkeypatch):
             # health stays open; API reads are gated when a token is set
             status, _ = await http_json(base + "/healthz")
             assert status == 200
-            with pytest.raises(urllib.error.HTTPError) as ei:
-                await http_json(base + "/api/status")
-            assert ei.value.code == 401
+            status, _ = await http_json(base + "/api/status")
+            assert status == 401
             # POST without token → 401
-            with pytest.raises(urllib.error.HTTPError) as ei:
-                await http_json(base + "/api/messages", method="POST",
-                                body={"agent_id": "x", "content": "hi"})
-            assert ei.value.code == 401
+            status, _ = await http_json(base + "/api/messages",
+                                        method="POST",
+                                        body={"agent_id": "x",
+                                              "content": "hi"})
+            assert status == 401
             # POST with the token passes auth (404: no such agent)
 
             def call_with_token():
@@ -213,3 +217,113 @@ def test_dashboard_auth_token_gates_mutations(monkeypatch):
         DashboardServer(object(), host="0.0.0.0", port=0)
     with pytest.raises(ValueError):
         DashboardServer(object(), host="", port=0)
+
+
+def test_settings_surface_round_trips():
+    """Settings page API (reference SecretManagementLive): system settings,
+    profiles CRUD, vault-backed secrets CRUD — values never returned."""
+    async def main():
+        from quoracle_tpu.persistence.store import PersistentSecretStore
+        rt = Runtime(RuntimeConfig(encryption_key="k" * 16),
+                     backend=MockBackend())
+        server = await DashboardServer(rt, port=0).start()
+        base = server.url
+        try:
+            # empty state
+            status, s = await http_json(base + "/api/settings")
+            assert status == 200
+            assert s["profiles"] == {} and s["secrets"] == []
+            assert "models" in s and "default_pool" in s
+
+            # system settings merge + persist
+            status, merged = await http_json(
+                base + "/api/settings", "POST",
+                {"embedding_model": "xla:tiny", "ssrf_check": False})
+            assert status == 200
+            assert merged["embedding_model"] == "xla:tiny"
+            assert rt.store.get_setting("ssrf_check") is False
+
+            # profiles CRUD
+            status, prof = await http_json(
+                base + "/api/profiles", "POST",
+                {"name": "researcher", "model_pool": list(POOL),
+                 "capability_groups": ["file_read"]})
+            assert status == 201
+            _, s = await http_json(base + "/api/settings")
+            assert s["profiles"]["researcher"]["model_pool"] == list(POOL)
+            # a task can now resolve the profile
+            status, created = await http_json(
+                base + "/api/tasks", "POST",
+                {"description": "profile task", "profile": "researcher"})
+            assert status == 201
+            await http_json(
+                base + f"/api/tasks/{created['task_id']}/pause", "POST")
+
+            # secrets CRUD: explicit value + generated; metadata only
+            status, meta = await http_json(
+                base + "/api/secrets", "POST",
+                {"name": "api-key", "value": "hunter2-hunter2",
+                 "description": "service key"})
+            assert status == 201
+            assert "value" not in meta
+            status, meta2 = await http_json(
+                base + "/api/secrets", "POST", {"name": "generated-one"})
+            assert status == 201
+            _, s = await http_json(base + "/api/settings")
+            names = {x["name"] for x in s["secrets"]}
+            assert names == {"api-key", "generated-one"}
+            # never any value in the whole settings payload
+            assert "hunter2" not in json.dumps(s)
+            # encrypted at rest + usable via the secret store
+            assert rt.secrets.lookup("api-key") == "hunter2-hunter2"
+            row = rt.db.query_one("SELECT * FROM secrets WHERE name=?",
+                                  ("api-key",))
+            assert b"hunter2" not in bytes(row["value"])
+
+            # deletions
+            status, d = await http_json(
+                base + "/api/secrets/api-key", "DELETE")
+            assert status == 200 and d["deleted"]
+            status, d = await http_json(
+                base + "/api/profiles/researcher", "DELETE")
+            assert status == 200 and d["deleted"]
+            status, _ = await http_json(base + "/api/profiles/ghost",
+                                        "DELETE")
+            assert rt.secrets.lookup("api-key") is None
+            assert rt.store.get_profile("researcher") is None
+        finally:
+            await server.stop()
+            await rt.shutdown()
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_settings_mutations_require_token_when_configured():
+    async def main():
+        rt = Runtime(RuntimeConfig(), backend=MockBackend())
+        server = await DashboardServer(rt, port=0,
+                                       auth_token="sesame").start()
+        base = server.url
+        try:
+            for method, path, body in (
+                    ("GET", "/api/settings", None),
+                    ("POST", "/api/settings", {"k": 1}),
+                    ("POST", "/api/secrets", {"name": "x"}),
+                    ("DELETE", "/api/secrets/x", None)):
+                def call():
+                    req = urllib.request.Request(
+                        base + path, method=method,
+                        data=(json.dumps(body).encode()
+                              if body is not None else None),
+                        headers={"content-type": "application/json"})
+                    try:
+                        with urllib.request.urlopen(req, timeout=10) as r:
+                            return r.status
+                    except urllib.error.HTTPError as e:
+                        return e.code
+                status = await asyncio.get_running_loop() \
+                    .run_in_executor(None, call)
+                assert status == 401, (method, path)
+        finally:
+            await server.stop()
+            await rt.shutdown()
+    asyncio.run(asyncio.wait_for(main(), 60))
