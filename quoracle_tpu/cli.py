@@ -61,7 +61,7 @@ async def cmd_run(args: argparse.Namespace) -> int:
         pool = rt.default_pool()
     task_id, root = await rt.tasks.create_task(
         args.description, model_pool=pool, profile=args.profile,
-        budget=args.budget)
+        budget=args.budget, grove=args.grove)
     rt.bus.subscribe(f"agents:{root.agent_id}:logs", _print_event)
     rt.bus.subscribe(f"tasks:{task_id}:messages", _print_event)
     print(f"task {task_id} started, root agent {root.agent_id}", flush=True)
@@ -150,6 +150,8 @@ def build_parser() -> argparse.ArgumentParser:
     runp.add_argument("--pool", help="comma-separated model specs")
     runp.add_argument("--profile")
     runp.add_argument("--budget")
+    runp.add_argument("--grove", help="grove directory (topology + "
+                                      "governance manifest)")
     common(runp)
 
     resp = sub.add_parser("resume", help="boot revival of persisted tasks")

@@ -98,8 +98,15 @@ class CharDFA:
     duplicate in). Nested objects stay fully generic."""
 
     def __init__(self, max_depth: int = 5,
-                 action_enum: Optional[Sequence[str]] = None):
+                 action_enum: Optional[Sequence[str]] = None,
+                 limit_ws: bool = True):
+        """``limit_ws``: cap inter-token whitespace to ONE char. Strings
+        are untouched (a space there is content). This restricts the
+        grammar to compact(ish) JSON — for SAMPLING that is strictly
+        better: an unbounded-ws grammar lets a model burn its whole budget
+        on whitespace runs without ever being forced toward content."""
         self.max_depth = max_depth
+        self.limit_ws = limit_ws
         self.action_enum = (tuple(sorted(set(action_enum)))
                             if action_enum else None)
         if self.action_enum:
@@ -149,7 +156,38 @@ class CharDFA:
             return (DONE, ())
         return (OBJ_NEXT if stack[-1] == "O" else ARR_NEXT, stack)
 
+    # modes where a 0x20 space is string CONTENT, not whitespace
+    _STRINGY_PREFIXES = ("key1:", "kw:")
+
+    def _stringy(self, mode: str) -> bool:
+        return mode in (STRING, KEY, STR_ESC, KEY_ESC, STR_U1, STR_U2,
+                        STR_U3, STR_U4, KEY_U1, KEY_U2, KEY_U3, KEY_U4) \
+            or mode.startswith(self._STRINGY_PREFIXES)
+
+    # ws-tag sentinel: \x00 cannot appear in any mode name (enum prefixes
+    # are action-name chars, key1 progress is capped to "action"-prefixes)
+    _WS_TAG = "\x00w"
+
     def step(self, state: tuple, ch: str) -> Optional[tuple]:
+        mode, stack = state
+        if self.limit_ws:
+            if mode.endswith(self._WS_TAG):   # one ws char consumed already
+                if ch in _WS:
+                    return None
+                return self.step((mode[:-len(self._WS_TAG)], stack), ch)
+            if ch in _WS and not self._stringy(mode):
+                nxt = self._step_raw(state, ch)
+                if nxt is None:
+                    return None
+                nm, ns = nxt
+                # the number-closing path re-enters step() and may have
+                # tagged the state already
+                if nm.endswith(self._WS_TAG) or self._stringy(nm):
+                    return nxt
+                return (nm + self._WS_TAG, ns)
+        return self._step_raw(state, ch)
+
+    def _step_raw(self, state: tuple, ch: str) -> Optional[tuple]:
         mode, stack = state
 
         # ---- action-enum modes (schema-aware top-level object) ----------
@@ -346,7 +384,7 @@ class CharDFA:
                     trans[i, ci] = idx[t]
         accept = np.zeros(n, bool)
         for s, i in idx.items():
-            accept[i] = s[0] == DONE
+            accept[i] = s[0] in (DONE, DONE + "\x00w")
         self.states = idx
         self.trans, self.accept = self._minimize(trans, accept)
         start_class = self._class_of[idx[self.start]]

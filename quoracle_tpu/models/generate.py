@@ -37,6 +37,7 @@ def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jax.Array,
                   prefix_lens: jax.Array, chunk_lens: jax.Array,
                   cache: KVCache,
                   kv_off: Optional[jax.Array] = None,
+                  ring: Optional[tuple] = None,
                   ) -> tuple[jax.Array, KVCache]:
     """Fill the cache from a right-padded token CHUNK starting at per-row
     buffer index ``prefix_lens`` (0 = fresh prefill; >0 = resume on top
@@ -61,6 +62,7 @@ def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jax.Array,
         write_offset=prefix_lens.astype(jnp.int32),
         kv_lens=total,
         kv_pos_offset=kv_off,
+        ring=ring,
     )
     last_h = jnp.take_along_axis(
         hidden, (chunk_lens - 1)[:, None, None].astype(jnp.int32), axis=1)
@@ -69,11 +71,13 @@ def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jax.Array,
 
 
 def prefill(params: dict, cfg: ModelConfig, tokens: jax.Array,
-            prompt_lens: jax.Array, cache: KVCache) -> tuple[jax.Array, KVCache]:
+            prompt_lens: jax.Array, cache: KVCache,
+            ring: Optional[tuple] = None) -> tuple[jax.Array, KVCache]:
     """Fresh prefill = prefill_chunk from position 0."""
     B = tokens.shape[0]
     return prefill_chunk(params, cfg, tokens,
-                         jnp.zeros((B,), jnp.int32), prompt_lens, cache)
+                         jnp.zeros((B,), jnp.int32), prompt_lens, cache,
+                         ring=ring)
 
 
 def decode(
@@ -272,15 +276,17 @@ class SessionStore:
     def alloc(self, n: int, protect: tuple = ()) -> Optional[list[int]]:
         """Take n pages from the free list, evicting LRU sessions (never
         the ``protect`` keys — the batch's own sessions) as needed.
-        Returns None if the request can exceed the whole pool."""
+        Returns None — WITHOUT evicting anything — when the request cannot
+        be satisfied even by evicting every unprotected session."""
         with self.lock:
-            if n > self.n_pages - 1:
+            victims = [k for k in self._sessions if k not in protect]
+            attainable = len(self._free) + sum(
+                len(self._sessions[k].pages) for k in victims)
+            if n > attainable:
                 return None
             while len(self._free) < n:
-                victims = [k for k in self._sessions if k not in protect]
-                if not victims:
-                    return None
                 lru = min(victims, key=lambda k: self._sessions[k].last_used)
+                victims.remove(lru)
                 self._release(self._sessions.pop(lru).pages)
             return [self._free.pop() for _ in range(n)]
 
@@ -355,7 +361,8 @@ class GenerateEngine:
     def __init__(self, cfg: ModelConfig, params: dict, tokenizer,
                  max_seq: Optional[int] = None, seed: int = 0,
                  prompt_buckets: Sequence[int] = (128, 256, 512, 1024, 2048, 4096, 8192),
-                 mesh=None, session_max_bytes: int = 2 << 30):
+                 mesh=None, session_max_bytes: int = 2 << 30,
+                 sp_window: Optional[int] = None):
         import threading
         self.cfg = cfg
         self.mesh = mesh
@@ -366,6 +373,14 @@ class GenerateEngine:
         self.params = params
         self.tokenizer = tokenizer
         self.max_seq = max_seq or cfg.context_window
+        # Sequence-parallel serving (mesh with an sp axis): prompts longer
+        # than one chip's window (``sp_window``, default max_seq / sp) take
+        # the ring-attention prefill path; shorter prompts stay on the
+        # dense path (SURVEY §5 long-context).
+        sp_size = int(mesh.shape.get("sp", 1)) if mesh is not None else 1
+        self.sp_window = (sp_window if sp_window is not None
+                          else (self.max_seq // sp_size if sp_size > 1
+                                else None))
         self.prompt_buckets = tuple(b for b in prompt_buckets if b <= self.max_seq)
         self._rng = jax.random.PRNGKey(seed)
         self._rng_lock = threading.Lock()
@@ -422,6 +437,28 @@ class GenerateEngine:
             cache = _constrain(init_cache(cfg, B, cache_len,
                                           dtype=self.cache_dtype))
             return prefill(params, cfg, tokens, prompt_lens, cache)
+
+        if mesh is not None and int(mesh.shape.get("sp", 1)) > 1:
+            ring_args = (mesh, "sp",
+                         "dp" if int(mesh.shape.get("dp", 1)) > 1 else None,
+                         "tp" if int(mesh.shape.get("tp", 1)) > 1 else None)
+
+            @functools.partial(jax.jit, static_argnames=("cache_len",))
+            def step_prefill_ring(params, tokens, prompt_lens,
+                                  cache_len: int):
+                # Long-prompt path: the prompt exceeds one chip's window,
+                # so prefill attention runs sequence-parallel over the sp
+                # ring; the cache stays S-sharded (cache_spec) so the full
+                # KV never materializes on one chip.
+                B = tokens.shape[0]
+                cache = _constrain(init_cache(cfg, B, cache_len,
+                                              dtype=self.cache_dtype))
+                return prefill(params, cfg, tokens, prompt_lens, cache,
+                               ring=ring_args)
+
+            self._step_prefill_ring = step_prefill_ring
+        else:
+            self._step_prefill_ring = None
 
         @functools.partial(jax.jit, static_argnames=("max_new",),
                            donate_argnums=(1, 2))   # cache updates in place
@@ -567,12 +604,19 @@ class GenerateEngine:
         # (sliding-window sessions trim leading pages, offsetting the
         # buffer). A session id appearing twice in one batch would collide
         # on its pages — later duplicates run sessionless.
+        # Long-prompt sequence-parallel path: prompts beyond one chip's
+        # window ring-prefill over sp. Sessions don't compose with the
+        # S-sharded ring layout yet — such rows run a full fresh prefill.
+        use_ring = (self._step_prefill_ring is not None
+                    and self.sp_window is not None
+                    and max_prompt > self.sp_window)
+
         sess_rows: list[Optional[_Session]] = [None] * n
         reuse_abs = [0] * n
         kv_off_host = [0] * n
         store_sids: list[Optional[str]] = [None] * n
         paged = False
-        if session_ids is not None:
+        if session_ids is not None and not use_ring:
             seen: set[str] = set()
             for i, sid in enumerate(session_ids):
                 if not sid or sid in seen:
@@ -599,6 +643,9 @@ class GenerateEngine:
         suffixes = [list(p[r:]) for p, r in zip(prompts, reuse_abs)]
         max_chunk = max(len(s) for s in suffixes)
         T = _round_up(max_chunk, self.prompt_buckets)
+        if use_ring:
+            sp = int(self.mesh.shape["sp"])
+            T = ((T + sp - 1) // sp) * sp   # ring shards the chunk evenly
         B = _round_up(n, self.BATCH_BUCKETS)
         if self.mesh is not None:
             # batch rows ride the dp axis — pad the bucket to a multiple
@@ -680,7 +727,9 @@ class GenerateEngine:
                 store_sids, B, maxp, tokens, pre_arr, off_arr, chunk_arr,
                 limits, rng_key, samp, json_args, max_new, put, mat, row, t0)
         else:
-            last_logits, cache = self._step_prefill(
+            step_pre = (self._step_prefill_ring if use_ring
+                        else self._step_prefill)
+            last_logits, cache = step_pre(
                 self.params, put(tokens, mat), put(chunk_arr, row),
                 cache_len=cache_len)
             jax.block_until_ready(last_logits)  # phase fence: prefill done

@@ -276,7 +276,10 @@ class TPUBackend(ModelBackend):
         keep = None if model_specs is None else set(model_specs)
         for spec, engine in self.engines.items():
             if keep is None or spec in keep:
-                engine.sessions.drop(session_id)
+                # the ENGINE's drop serializes with in-flight sessioned
+                # generates — a bare store drop could free pages a running
+                # batch still references
+                engine.drop_session(session_id)
 
     def count_tokens(self, model_spec: str, text: str) -> int:
         return self.engines[model_spec].tokenizer.count(text)

@@ -155,6 +155,10 @@ def forward_hidden(
     kv_lens: jax.Array,       # [B] int32 valid kv count AFTER this chunk
     kv_pos_offset: Optional[jax.Array] = None,  # [B] int32: absolute position
                                                 # of kv buffer index 0
+    ring: Optional[tuple] = None,   # (mesh, seq_axis, batch_axis, head_axis):
+                                    # sequence-parallel prefill — attention
+                                    # runs as ring_attend over the chunk
+                                    # itself (fresh full-prompt prefill only)
 ) -> tuple[jax.Array, KVCache]:
     """Run the stack over a token chunk, updating the cache; returns final
     hidden states [B, T, D] (pre-head) — see project_logits.
@@ -195,13 +199,25 @@ def forward_hidden(
         k_buf = jax.vmap(write_row)(k_buf, k, write_offset)
         v_buf = jax.vmap(write_row)(v_buf, v, write_offset)
 
-        # attend_auto: pallas flash kernel for long prefill chunks on TPU,
-        # dense fused XLA otherwise (decode steps, CPU tests).
-        from quoracle_tpu.ops.flash_attention import attend_auto
-        attn = attend_auto(q, k_buf, v_buf, positions,
-                           kv_len=kv_lens,
-                           sliding_window=cfg.sliding_window,
-                           kv_pos_offset=kv_pos_offset)
+        if ring is not None:
+            # Sequence-parallel prefill: the chunk IS the whole (fresh)
+            # prompt, so attention is chunk-vs-chunk — K/V shards rotate
+            # the ring while each device keeps its Q shard (SURVEY §5
+            # long-context; ops/ring_attention.py).
+            from quoracle_tpu.ops.ring_attention import ring_attend
+            mesh_, seq_ax, batch_ax, head_ax = ring
+            attn = ring_attend(mesh_, q, k, v, kv_len=kv_lens,
+                               axis_name=seq_ax,
+                               sliding_window=cfg.sliding_window,
+                               batch_axis=batch_ax, head_axis=head_ax)
+        else:
+            # attend_auto: pallas flash kernel for long prefill chunks on
+            # TPU, dense fused XLA otherwise (decode steps, CPU tests).
+            from quoracle_tpu.ops.flash_attention import attend_auto
+            attn = attend_auto(q, k_buf, v_buf, positions,
+                               kv_len=kv_lens,
+                               sliding_window=cfg.sliding_window,
+                               kv_pos_offset=kv_pos_offset)
         x = x + jnp.einsum("bthd,hdD->btD", attn,
                            p["wo"].reshape(cfg.n_heads, cfg.head_dim, cfg.dim))
 

@@ -95,20 +95,29 @@ def ring_attend(
     kv_len: jax.Array,       # [B] int32 (valid prefix of the GLOBAL seq)
     axis_name: str = "sp",
     sliding_window: Optional[int] = None,
+    batch_axis: Optional[str] = None,   # mesh axis carrying B (serving: dp)
+    head_axis: Optional[str] = None,    # mesh axis carrying heads (tp)
 ) -> jax.Array:
     """Causal attention over a sequence sharded on ``axis_name``. The
-    global sequence length must divide the axis size."""
+    global sequence length must divide the axis size. ``batch_axis`` /
+    ``head_axis`` let the serving path keep its dp/tp layout inside the
+    shard_map (heads only shard when both q and kv head counts divide)."""
     n_shards = int(mesh.shape[axis_name])
     if q.shape[1] % n_shards:
         raise ValueError(f"sequence {q.shape[1]} not divisible by "
                          f"{axis_name}={n_shards}")
-    seq_spec = P(None, axis_name, None, None)
+    if head_axis is not None:
+        hs = int(mesh.shape[head_axis])
+        if q.shape[2] % hs or k.shape[2] % hs:
+            head_axis = None            # MQA/GQA mismatch: replicate heads
+    q_spec = P(batch_axis, axis_name, head_axis, None)
+    kv_spec = P(batch_axis, axis_name, head_axis, None)
     fn = jax.shard_map(
         functools.partial(_ring_shard, axis_name=axis_name,
                           n_shards=n_shards, sliding_window=sliding_window),
         mesh=mesh,
-        in_specs=(seq_spec, seq_spec, seq_spec, P(None)),
-        out_specs=seq_spec,
+        in_specs=(q_spec, kv_spec, kv_spec, P(batch_axis)),
+        out_specs=q_spec,
         check_vma=False,
     )
     return fn(q, k, v, kv_len.astype(jnp.int32))

@@ -124,10 +124,14 @@ def param_specs(cfg: ModelConfig) -> dict:
 
 def cache_spec(cfg: ModelConfig, mesh: Mesh) -> P:
     """KV cache [L, B, S, n_kv, hd]: batch on dp, kv heads on tp (when they
-    divide; MQA/MHA mismatches fall back to replicated kv heads)."""
+    divide; MQA/MHA mismatches fall back to replicated kv heads). On an
+    sp-capable mesh the SEQUENCE axis shards over sp — ring-prefilled
+    prompts never materialize whole on one chip, and decode's attention
+    contraction over S becomes a GSPMD psum across the ring."""
     tp_size = mesh.shape.get("tp", 1)
     kv_axis = "tp" if cfg.n_kv_heads % tp_size == 0 else None
-    return P(None, "dp", None, kv_axis, None)
+    sp_axis = "sp" if mesh.shape.get("sp", 1) > 1 else None
+    return P(None, "dp", sp_axis, kv_axis, None)
 
 
 def data_spec() -> P:

@@ -34,12 +34,16 @@ VALID = [
     '{"action": "wait", "params": {"x": [1, 2.5e-3, true, null]}}',
     '{ }',
     '{"s": "q\\"\\\\ \\u0041"}',
-    '{"a": {"b": [1, 2]}}  ',
+    '{"a": {"b": [1, 2]}} ',
     '{"neg": -0.5, "exp": 1e10}',
+    '{"two  spaces": "in  strings  are  content"}',
 ]
 INVALID = [
     "{", '{"a" 1}', "{'a': 1}", '{"a": tru}', '{"a": 1,}',
     '{"a": "\\q"}', "hello", '{"a": 1}}', "false", "[1]", '{"a": .5}',
+    # ws runs are capped at ONE char between tokens (sampling grammar:
+    # unbounded ws lets a model burn its budget without emitting content)
+    '{  "a": 1}', '{"a":  1}', '{"a": 1}  ', '{"a": 07}', '{"a": -012}',
 ]
 
 
