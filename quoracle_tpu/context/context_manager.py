@@ -18,18 +18,67 @@ from quoracle_tpu.context.history import (
 from quoracle_tpu.utils.normalize import to_json
 
 
-def _entry_text(entry: HistoryEntry) -> str:
+def _strip_images(value, found: list):
+    """Recursively pull image payloads out of a result structure, leaving a
+    textual marker (reference ImageDetector: base64/URL image parts in
+    action results become multimodal message content,
+    agent/consensus/image_detector.ex)."""
+    if isinstance(value, dict):
+        if value.get("image_base64"):
+            found.append(str(value["image_base64"]))
+            return {**{k: _strip_images(v, found) for k, v in value.items()
+                       if k != "image_base64"},
+                    "image": f"[attached image #{len(found)}]"}
+        return {k: _strip_images(v, found) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_strip_images(v, found) for v in value]
+    return value
+
+
+def _entry_content(entry: HistoryEntry):
+    """str for plain entries; a multimodal parts list when a RESULT carries
+    image data (so a VLM pool member actually SEES the fetched image)."""
     if entry.kind == DECISION:
         return "[DECISION] " + (entry.content if isinstance(entry.content, str)
                                 else to_json(entry.content))
     if entry.kind == RESULT:
         tag = f" action={entry.action_type}" if entry.action_type else ""
-        body = entry.content if isinstance(entry.content, str) else to_json(entry.content)
-        return f"[RESULT{tag}] {body}"
+        if isinstance(entry.content, str):
+            return f"[RESULT{tag}] {entry.content}"
+        images: list[str] = []
+        stripped = _strip_images(entry.content, images)
+        text = f"[RESULT{tag}] {to_json(stripped)}"
+        if images:
+            return [{"type": "text", "text": text}] + [
+                {"type": "image_base64", "data": b64} for b64 in images]
+        return text
     if entry.kind == SUMMARY:
         body = entry.content if isinstance(entry.content, str) else to_json(entry.content)
         return "[CONDENSED HISTORY SUMMARY] " + body
     return entry.as_text()
+
+
+def _as_parts(content) -> list:
+    if isinstance(content, list):
+        return content
+    return [{"type": "text", "text": content}]
+
+
+def merge_content(a, b):
+    """Append message content; strings stay strings, anything multimodal
+    becomes a parts list (adjacent text parts collapse)."""
+    if isinstance(a, str) and isinstance(b, str):
+        return a + "\n\n" + b
+    parts = _as_parts(a) + _as_parts(b)
+    out: list = []
+    for p in parts:
+        if (out and p.get("type") == "text"
+                and out[-1].get("type") == "text"):
+            out[-1] = {"type": "text",
+                       "text": out[-1]["text"] + "\n\n" + p["text"]}
+        else:
+            out.append(dict(p))
+    return out
 
 
 def build_conversation_messages(
@@ -45,11 +94,12 @@ def build_conversation_messages(
     if preamble_parts:
         messages.append({"role": "user", "content": "\n\n".join(preamble_parts)})
     for entry in history:
-        role, text = entry.role(), _entry_text(entry)
+        role, content = entry.role(), _entry_content(entry)
         if messages and messages[-1]["role"] == role:
-            messages[-1]["content"] += "\n\n" + text
+            messages[-1]["content"] = merge_content(
+                messages[-1]["content"], content)
         else:
-            messages.append({"role": role, "content": text})
+            messages.append({"role": role, "content": content})
     if not messages:
         messages.append({"role": "user", "content": "(no history yet)"})
     # Chat templates require the last message to be user-side for a new

@@ -25,12 +25,15 @@ from quoracle_tpu.context.token_manager import TokenManager
 from quoracle_tpu.utils.normalize import to_json
 
 
+from quoracle_tpu.context.context_manager import merge_content
+
+
 def _append_to_last(messages: list[dict], block: str) -> None:
-    messages[-1]["content"] = messages[-1]["content"] + "\n\n" + block
+    messages[-1]["content"] = merge_content(messages[-1]["content"], block)
 
 
 def _prepend_to_last(messages: list[dict], block: str) -> None:
-    messages[-1]["content"] = block + "\n\n" + messages[-1]["content"]
+    messages[-1]["content"] = merge_content(block, messages[-1]["content"])
 
 
 def _ace_block(ctx: AgentContext, model_spec: str) -> Optional[str]:
@@ -65,7 +68,7 @@ def build_messages_for_model(
     if ace:
         for m in messages:
             if m["role"] == "user":
-                m["content"] = ace + "\n\n" + m["content"]
+                m["content"] = merge_content(ace, m["content"])
                 break
 
     # 3. refinement prompt (a fresh user turn: the refinement is the newest event)

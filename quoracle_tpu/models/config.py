@@ -75,6 +75,12 @@ class ModelConfig:
     # HF checkpoint directory for real weights (models/loader.py); None =
     # random-init (tests/bench). The directory's tokenizer files are used too.
     checkpoint_path: Optional[str] = None
+    # VLM member (BASELINE config 5): an in-tree ViT tower whose projected
+    # patches splice into the prompt at ``image_token_id`` placeholders
+    # (models/vision.py). None = text-only model. VisionConfig is a frozen
+    # dataclass, so ModelConfig stays hashable for jit.
+    vision: Optional["VisionConfig"] = None          # noqa: F821
+    image_token_id: Optional[int] = None
 
     def __post_init__(self):
         if self.head_dim is None:
@@ -178,6 +184,19 @@ TINY_GEMMA = register_model(ModelConfig(
     ffn_dim=128, activation="gelu", tie_embeddings=True,
     scale_embeddings=True, rmsnorm_plus_one=True,
     context_window=512, output_limit=128,
+))
+
+def _tiny_vision():
+    from quoracle_tpu.models.vision import VisionConfig
+    return VisionConfig(image_size=28, patch_size=14, dim=32, n_layers=1,
+                        n_heads=2, ffn_dim=64, out_dim=64)
+
+
+TINY_VLM = register_model(ModelConfig(
+    name="tiny-vlm",
+    vocab_size=512, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+    ffn_dim=128, context_window=512, output_limit=128,
+    vision=_tiny_vision(), image_token_id=3,
 ))
 
 TINY_POOL = ["xla:tiny", "xla:tiny-gemma"]

@@ -38,6 +38,7 @@ def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jax.Array,
                   cache: KVCache,
                   kv_off: Optional[jax.Array] = None,
                   ring: Optional[tuple] = None,
+                  input_embeds: Optional[jax.Array] = None,
                   ) -> tuple[jax.Array, KVCache]:
     """Fill the cache from a right-padded token CHUNK starting at per-row
     buffer index ``prefix_lens`` (0 = fresh prefill; >0 = resume on top
@@ -63,6 +64,7 @@ def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jax.Array,
         kv_lens=total,
         kv_pos_offset=kv_off,
         ring=ring,
+        input_embeds=input_embeds,
     )
     last_h = jnp.take_along_axis(
         hidden, (chunk_lens - 1)[:, None, None].astype(jnp.int32), axis=1)
@@ -72,12 +74,14 @@ def prefill_chunk(params: dict, cfg: ModelConfig, tokens: jax.Array,
 
 def prefill(params: dict, cfg: ModelConfig, tokens: jax.Array,
             prompt_lens: jax.Array, cache: KVCache,
-            ring: Optional[tuple] = None) -> tuple[jax.Array, KVCache]:
+            ring: Optional[tuple] = None,
+            input_embeds: Optional[jax.Array] = None,
+            ) -> tuple[jax.Array, KVCache]:
     """Fresh prefill = prefill_chunk from position 0."""
     B = tokens.shape[0]
     return prefill_chunk(params, cfg, tokens,
                          jnp.zeros((B,), jnp.int32), prompt_lens, cache,
-                         ring=ring)
+                         ring=ring, input_embeds=input_embeds)
 
 
 def decode(
@@ -460,6 +464,39 @@ class GenerateEngine:
         else:
             self._step_prefill_ring = None
 
+        if cfg.vision is not None:
+            from quoracle_tpu.models.vision import (
+                splice_image_embeds, vision_encode,
+            )
+
+            @functools.partial(jax.jit, static_argnames=("cache_len",))
+            def step_prefill_vlm(params, tokens, prompt_lens, pixels,
+                                 cache_len: int):
+                # VLM prefill: the ViT tower runs inside the same jit as
+                # the decoder prefill — projected patches replace the
+                # image-placeholder tokens' embeddings (LLaVA-style soft
+                # prompt; models/vision.py).
+                B = tokens.shape[0]
+                cache = _constrain(init_cache(cfg, B, cache_len,
+                                              dtype=self.cache_dtype))
+                img = vision_encode(params["vision"], cfg.vision, pixels)
+                embeds = params["embed"][tokens]
+                if cfg.scale_embeddings:
+                    # text embeds scale BEFORE the splice: projected image
+                    # features enter at the projector's own scale (standard
+                    # VLM semantics — an sqrt(dim) blow-up on soft tokens
+                    # would swamp every gemma-family prompt)
+                    embeds = (embeds.astype(jnp.float32)
+                              * (cfg.dim ** 0.5)).astype(embeds.dtype)
+                embeds = splice_image_embeds(embeds, tokens, img,
+                                             cfg.image_token_id)
+                return prefill(params, cfg, tokens, prompt_lens, cache,
+                               input_embeds=embeds)
+
+            self._step_prefill_vlm = step_prefill_vlm
+        else:
+            self._step_prefill_vlm = None
+
         @functools.partial(jax.jit, static_argnames=("max_new",),
                            donate_argnums=(1, 2))   # cache updates in place
         def step_decode(params, k_buf, v_buf, lens, last_logits, rng,
@@ -539,6 +576,7 @@ class GenerateEngine:
         session_ids: Optional[Sequence[Optional[str]]] = None,
         constrain_json: Optional[Sequence[bool]] = None,
         action_enums: Optional[Sequence[Optional[Sequence[str]]]] = None,
+        images: Optional[Sequence] = None,
     ) -> list[GenResult]:
         """``session_ids`` (aligned with prompts; None entries opt out)
         enables KV residency: each row reuses the longest token prefix it
@@ -550,7 +588,53 @@ class GenerateEngine:
         ``action_enums`` (aligned; only read where constrain_json is True)
         upgrades the JSON grammar to the schema-aware variant: the row's
         top-level ``"action"`` value is constrained to the given names
-        (models/constrained.py action_enum)."""
+        (models/constrained.py action_enum).
+
+        ``images`` (aligned; None entries = text-only row) enables the VLM
+        path on vision-configured models: each entry is a preprocessed
+        [H, W, 3] float array whose projected patches replace the row's
+        image-placeholder tokens. Image rows skip KV sessions (identical
+        placeholder ids under different images must not prefix-match)."""
+        if images is not None and any(i is not None for i in images):
+            if self.cfg.vision is None:
+                raise ValueError(
+                    f"model {self.cfg.name} has no vision tower")
+            # Image rows opt out of sessions (identical placeholder ids
+            # under different images must not prefix-match). Text rows
+            # KEEP their resident prefixes: a mixed batch splits into a
+            # VLM sub-batch and a (possibly paged) text sub-batch.
+            txt_idx = [i for i, im in enumerate(images) if im is None]
+            if txt_idx and session_ids is not None and any(
+                    session_ids[i] for i in txt_idx):
+                img_idx = [i for i, im in enumerate(images)
+                           if im is not None]
+
+                def pick(seq, idxs):
+                    if seq is None or isinstance(seq, (int, float)):
+                        return seq
+                    return [seq[i] for i in idxs]
+
+                res_img = self.generate(
+                    [prompts[i] for i in img_idx],
+                    pick(temperature, img_idx), pick(top_p, img_idx),
+                    pick(max_new_tokens, img_idx), None, None,
+                    pick(constrain_json, img_idx),
+                    pick(action_enums, img_idx),
+                    [images[i] for i in img_idx])
+                res_txt = self.generate(
+                    [prompts[i] for i in txt_idx],
+                    pick(temperature, txt_idx), pick(top_p, txt_idx),
+                    pick(max_new_tokens, txt_idx), None,
+                    pick(session_ids, txt_idx),
+                    pick(constrain_json, txt_idx),
+                    pick(action_enums, txt_idx), None)
+                merged: list = [None] * len(prompts)
+                for j, i in enumerate(img_idx):
+                    merged[i] = res_img[j]
+                for j, i in enumerate(txt_idx):
+                    merged[i] = res_txt[j]
+                return merged
+            session_ids = None       # image-only (or sessionless) batch
         if session_ids is not None and any(session_ids):
             # Sessioned calls serialize per engine: session lookup, page
             # allocation/eviction, the pool-donating steps, and the store
@@ -559,10 +643,10 @@ class GenerateEngine:
             with self._paged_lock:
                 return self._generate_impl(
                     prompts, temperature, top_p, max_new_tokens, rng,
-                    session_ids, constrain_json, action_enums)
+                    session_ids, constrain_json, action_enums, images)
         return self._generate_impl(prompts, temperature, top_p,
                                    max_new_tokens, rng, session_ids,
-                                   constrain_json, action_enums)
+                                   constrain_json, action_enums, images)
 
     def drop_session(self, session_id: str) -> None:
         """Release a session's pages. Serialized with sessioned generate
@@ -572,8 +656,8 @@ class GenerateEngine:
 
     def _generate_impl(self, prompts, temperature=1.0, top_p=1.0,
                        max_new_tokens=256, rng=None, session_ids=None,
-                       constrain_json=None, action_enums=None
-                       ) -> list[GenResult]:
+                       constrain_json=None, action_enums=None,
+                       images=None) -> list[GenResult]:
         t0 = time.monotonic()
         n = len(prompts)
         if n == 0:
@@ -727,11 +811,22 @@ class GenerateEngine:
                 store_sids, B, maxp, tokens, pre_arr, off_arr, chunk_arr,
                 limits, rng_key, samp, json_args, max_new, put, mat, row, t0)
         else:
-            step_pre = (self._step_prefill_ring if use_ring
-                        else self._step_prefill)
-            last_logits, cache = step_pre(
-                self.params, put(tokens, mat), put(chunk_arr, row),
-                cache_len=cache_len)
+            if images is not None and any(i is not None for i in images):
+                vc = self.cfg.vision
+                pixels = np.zeros((B, vc.image_size, vc.image_size, 3),
+                                  np.float32)
+                for i, img in enumerate(images):
+                    if img is not None:
+                        pixels[i] = np.asarray(img, np.float32)
+                last_logits, cache = self._step_prefill_vlm(
+                    self.params, put(tokens, mat), put(chunk_arr, row),
+                    jnp.asarray(pixels), cache_len=cache_len)
+            else:
+                step_pre = (self._step_prefill_ring if use_ring
+                            else self._step_prefill)
+                last_logits, cache = step_pre(
+                    self.params, put(tokens, mat), put(chunk_arr, row),
+                    cache_len=cache_len)
             jax.block_until_ready(last_logits)  # phase fence: prefill done
             t_prefill = time.monotonic()
             out, n_emitted, _ = self._step_decode(

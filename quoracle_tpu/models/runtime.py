@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import abc
 import dataclasses
+import logging
 import time
 from typing import Callable, Optional, Sequence
 
@@ -30,6 +31,8 @@ from quoracle_tpu.models.config import (
 )
 from quoracle_tpu.models.generate import ContextOverflowError, GenerateEngine
 from quoracle_tpu.models.tokenizer import Tokenizer, get_tokenizer
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -115,6 +118,65 @@ class ModelBackend(abc.ABC):
 # ---------------------------------------------------------------------------
 # TPU backend
 # ---------------------------------------------------------------------------
+
+def _encode_multimodal(engine, messages) -> tuple[list[int], Optional[object]]:
+    """VLM prompt construction: the first image part in the conversation
+    becomes ``n_patches`` placeholder ids at its position in the rendered
+    chat (the engine's VLM prefill splices projected patches there); any
+    further images degrade to the textual "[image]" marker. Returns
+    (ids, preprocessed HWC image or None).
+
+    Reference parity: ImageDetector collects base64/URL image parts into
+    the provider payload (reference agent/consensus/image_detector.ex);
+    here the payload is the in-tree vision tower's pixel input."""
+    import base64
+
+    cfg = engine.cfg
+    tok = engine.tokenizer
+    SENT = "\x00IMG\x00"
+    image = None
+    flat = []
+    for m in messages:
+        content = m.get("content", "")
+        if isinstance(content, str):
+            flat.append({"role": m.get("role", "user"), "content": content})
+            continue
+        parts_txt = []
+        for part in content if isinstance(content, (list, tuple)) else []:
+            if not isinstance(part, dict):
+                parts_txt.append(str(part))
+                continue
+            if part.get("type") in ("image", "image_base64", "image_url"):
+                data = (part.get("data") or part.get("image_base64")
+                        or part.get("base64"))
+                if image is None and data:
+                    try:
+                        from quoracle_tpu.native.image import (
+                            preprocess_for_vision,
+                        )
+                        image = preprocess_for_vision(
+                            base64.b64decode(data),
+                            size=cfg.vision.image_size)
+                        parts_txt.append(SENT)
+                        continue
+                    except Exception:
+                        logger.warning(
+                            "image part could not be decoded; degrading "
+                            "to [image]")
+                parts_txt.append("[image]")
+            else:
+                parts_txt.append(str(part.get("text", "")))
+        flat.append({"role": m.get("role", "user"),
+                     "content": "\n".join(parts_txt)})
+    rendered = tok.render_chat(flat)
+    if image is not None and SENT in rendered:
+        pre, post = rendered.split(SENT, 1)
+        ids = (tok.encode(pre, add_bos=True)
+               + [cfg.image_token_id] * cfg.vision.n_patches
+               + tok.encode(post))
+        return ids, image
+    return tok.encode(rendered, add_bos=True), None
+
 
 class TPUBackend(ModelBackend):
     """Serves a pool of catalog models resident on the chip/mesh.
@@ -219,11 +281,23 @@ class TPUBackend(ModelBackend):
             return
         t0 = time.monotonic()
         prompts, temps, tops, budgets, live_idxs, sess = [], [], [], [], [], []
-        cjson, enums = [], []
+        cjson, enums, imgs = [], [], []
         max_seq = engine.max_seq
         for i in idxs:
             r = requests[i]
-            ids = engine.tokenizer.encode_chat(r.messages)
+            has_image = engine.cfg.vision is not None and any(
+                isinstance(m.get("content"), (list, tuple))
+                and any(isinstance(p, dict) and p.get("type") in
+                        ("image", "image_base64", "image_url")
+                        for p in m["content"])
+                for m in r.messages)
+            if has_image:
+                ids, img = _encode_multimodal(engine, r.messages)
+            else:
+                # text-only requests keep the tokenizer's own chat template
+                # (HF checkpoints) — only image-carrying prompts need the
+                # placeholder-splicing render
+                ids, img = engine.tokenizer.encode_chat(r.messages), None
             if len(ids) >= max_seq:
                 # Per-ROW overflow: only the oversized row errors; the
                 # rest of the group still runs (the condensation layer
@@ -239,6 +313,7 @@ class TPUBackend(ModelBackend):
             sess.append(r.session_id)
             cjson.append(r.constrain_json)
             enums.append(r.action_enum)
+            imgs.append(img)
             window, out_lim = engine.cfg.context_window, engine.cfg.output_limit
             floor = min(OUTPUT_FLOOR, out_lim)
             budget = min(out_lim, max(floor, window - len(ids)))
@@ -252,7 +327,8 @@ class TPUBackend(ModelBackend):
                 max_new_tokens=budgets,
                 session_ids=sess if any(sess) else None,
                 constrain_json=cjson if any(cjson) else None,
-                action_enums=enums if any(enums) else None)
+                action_enums=enums if any(enums) else None,
+                images=imgs if any(i is not None for i in imgs) else None)
         except ContextOverflowError as e:
             for i in live_idxs:
                 results[i] = QueryResult(model_spec=spec,

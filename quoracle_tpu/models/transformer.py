@@ -85,6 +85,12 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> dict:
         params["final_norm"] = jnp.zeros((D,), dtype)
     if not cfg.tie_embeddings:
         params["lm_head"] = normal(k_head, (D, cfg.vocab_size), D)
+    if cfg.vision is not None:
+        from quoracle_tpu.models.vision import init_vision_params
+        assert cfg.vision.out_dim == cfg.dim, \
+            "vision projector must target the decoder dim"
+        params["vision"] = init_vision_params(
+            cfg.vision, jax.random.fold_in(k_head, 7), dtype)
     return params
 
 
@@ -159,6 +165,12 @@ def forward_hidden(
                                     # sequence-parallel prefill — attention
                                     # runs as ring_attend over the chunk
                                     # itself (fresh full-prompt prefill only)
+    input_embeds: Optional[jax.Array] = None,   # [B, T, D] overrides the
+                                    # embedding lookup (VLM soft tokens).
+                                    # Callers pass these FULLY PREPARED —
+                                    # scale_embeddings is NOT re-applied
+                                    # (image features splice in unscaled,
+                                    # matching standard VLM semantics)
 ) -> tuple[jax.Array, KVCache]:
     """Run the stack over a token chunk, updating the cache; returns final
     hidden states [B, T, D] (pre-head) — see project_logits.
@@ -172,9 +184,12 @@ def forward_hidden(
     the traced body lets the same trace serve speculative / chunked prefill.
     """
     B, T = tokens.shape
-    x = params["embed"][tokens]  # gather: [B, T, D]
-    if cfg.scale_embeddings:
-        x = (x.astype(jnp.float32) * (cfg.dim ** 0.5)).astype(x.dtype)
+    if input_embeds is not None:
+        x = input_embeds                # prepared by the caller (VLM)
+    else:
+        x = params["embed"][tokens]     # gather: [B, T, D]
+        if cfg.scale_embeddings:
+            x = (x.astype(jnp.float32) * (cfg.dim ** 0.5)).astype(x.dtype)
 
     # Offsets are per-row; rows share one buffer write position only when all
     # offsets are equal. We write per-row with a vmap'd dynamic slice.

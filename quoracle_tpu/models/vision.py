@@ -1,0 +1,143 @@
+"""Vision tower: a TPU-native ViT encoder feeding the decoder as soft
+tokens (BASELINE config 5: image inputs → VLM member in the consensus
+pool).
+
+The reference has no local vision compute — images ride HTTPS to hosted
+multimodal models (reference lib/quoracle/agent/consensus/image_detector.ex
+collects base64/URL image parts into the provider payload). Here the tower
+runs in-tree: ``native/image.py`` preprocesses (decode/resize/normalize,
+C++ fast path), this module embeds patches and runs a pre-LN ViT
+(lax.scan over stacked layers, like models/transformer.py), and a linear
+projector maps patch embeddings into the decoder's embedding space —
+the LLaVA-style soft-prompt interface. The decoder sees the image as
+``n_patches`` placeholder tokens whose embeddings are replaced by the
+projected patches (models/generate.py VLM prefill).
+
+No weight-layout mapping to released VLM checkpoints yet — the tower is
+an in-tree architecture (random or locally-trained weights); the serving
+path, cost accounting, and consensus integration are real.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionConfig:
+    image_size: int = 224
+    patch_size: int = 14
+    dim: int = 256
+    n_layers: int = 4
+    n_heads: int = 4
+    ffn_dim: int = 1024
+    out_dim: int = 2048           # decoder embedding dim
+    norm_eps: float = 1e-5
+
+    @property
+    def n_patches(self) -> int:
+        side = self.image_size // self.patch_size
+        return side * side
+
+    @property
+    def patch_dim(self) -> int:
+        return self.patch_size * self.patch_size * 3
+
+
+def init_vision_params(cfg: VisionConfig, key: jax.Array,
+                       dtype=jnp.bfloat16) -> dict:
+    k = jax.random.split(key, 8)
+    L, D, F, P = cfg.n_layers, cfg.dim, cfg.ffn_dim, cfg.patch_dim
+
+    def normal(key, shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (fan_in ** -0.5)).astype(dtype)
+
+    return {
+        "patch_embed": normal(k[0], (P, D), P),
+        "pos_embed": normal(k[1], (cfg.n_patches, D), D),
+        "layers": {
+            "ln1": jnp.ones((L, D), dtype),
+            "wqkv": normal(k[2], (L, D, 3 * D), D),
+            "wo": normal(k[3], (L, D, D), D),
+            "ln2": jnp.ones((L, D), dtype),
+            "w_up": normal(k[4], (L, D, F), D),
+            "w_down": normal(k[5], (L, F, D), F),
+        },
+        "final_ln": jnp.ones((D,), dtype),
+        "projector": normal(k[6], (D, cfg.out_dim), D),
+    }
+
+
+def _ln(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean((xf - mu) ** 2, axis=-1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps)
+            * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def patchify(pixels: jax.Array, patch: int) -> jax.Array:
+    """[B, H, W, 3] float → [B, n_patches, patch*patch*3]."""
+    B, H, W, C = pixels.shape
+    ph, pw = H // patch, W // patch
+    x = pixels.reshape(B, ph, patch, pw, patch, C)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(B, ph * pw, patch * patch * C)
+
+
+def vision_encode(params: dict, cfg: VisionConfig,
+                  pixels: jax.Array) -> jax.Array:
+    """[B, H, W, 3] (preprocessed, ~N(0,1) channels) → soft tokens
+    [B, n_patches, out_dim] in the DECODER's embedding space."""
+    x = patchify(pixels.astype(jnp.float32), cfg.patch_size)
+    x = jnp.einsum("bpd,dk->bpk", x,
+                   params["patch_embed"].astype(jnp.float32))
+    x = (x + params["pos_embed"].astype(jnp.float32)[None]).astype(
+        params["patch_embed"].dtype)
+    B, P, D = x.shape
+    H, HD = cfg.n_heads, cfg.dim // cfg.n_heads
+
+    def layer(x, p):
+        h = _ln(x, p["ln1"], cfg.norm_eps)
+        qkv = jnp.einsum("bpd,dk->bpk", h, p["wqkv"])
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, P, H, HD)
+        k = k.reshape(B, P, H, HD)
+        v = v.reshape(B, P, H, HD)
+        scores = jnp.einsum("bphd,bqhd->bhpq", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) * (HD ** -0.5)
+        probs = jax.nn.softmax(scores, axis=-1)
+        att = jnp.einsum("bhpq,bqhd->bphd", probs,
+                         v.astype(jnp.float32)).reshape(B, P, D)
+        x = x + jnp.einsum("bpd,dk->bpk", att.astype(x.dtype), p["wo"])
+        h = _ln(x, p["ln2"], cfg.norm_eps)
+        up = jax.nn.gelu(jnp.einsum("bpd,df->bpf", h, p["w_up"]),
+                         approximate=True)
+        x = x + jnp.einsum("bpf,fd->bpd", up, p["w_down"])
+        return x, None
+
+    x, _ = jax.lax.scan(layer, x, params["layers"])
+    x = _ln(x, params["final_ln"], cfg.norm_eps)
+    return jnp.einsum("bpd,dk->bpk", x, params["projector"])
+
+
+def splice_image_embeds(embeds: jax.Array, tokens: jax.Array,
+                        image_embeds: jax.Array,
+                        image_token_id: int) -> jax.Array:
+    """Replace the embeddings of image-placeholder tokens with projected
+    patches. ``embeds`` [B, T, D]; ``image_embeds`` [B, P, D]; row b's i-th
+    placeholder (in sequence order) takes patch i. Rows without
+    placeholders pass through; placeholders beyond P clamp to the last
+    patch (prompt-construction bug guard, masked anyway)."""
+    mask = tokens == image_token_id                    # [B, T]
+    idx = jnp.clip(jnp.cumsum(mask, axis=1) - 1, 0,
+                   image_embeds.shape[1] - 1)          # [B, T]
+    gathered = jnp.take_along_axis(
+        image_embeds, idx[:, :, None].astype(jnp.int32), axis=1)
+    return jnp.where(mask[:, :, None], gathered.astype(embeds.dtype),
+                     embeds)

@@ -145,9 +145,8 @@ def decode_resize(png_bytes: bytes, out_w: int, out_h: int) -> np.ndarray:
 
 
 def preprocess_for_vision(png_bytes: bytes, size: int = 224) -> np.ndarray:
-    """Vision-tower input: float32 CHW in [-1, 1] (the layout the VLM
-    tower consumes; normalization constants live with the model config
-    when a real checkpoint lands)."""
+    """Vision-tower input: float32 HWC in [-1, 1] — the layout
+    models/vision.py patchifies ([B, H, W, 3]); normalization constants
+    live with the model config when a real checkpoint lands."""
     rgb = decode_resize(png_bytes, size, size)
-    chw = np.transpose(rgb.astype(np.float32) / 127.5 - 1.0, (2, 0, 1))
-    return chw
+    return rgb.astype(np.float32) / 127.5 - 1.0

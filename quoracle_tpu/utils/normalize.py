@@ -67,7 +67,10 @@ def stringify_content(content: Any) -> str:
             elif isinstance(part, dict):
                 if part.get("type") == "text":
                     parts.append(str(part.get("text", "")))
-                elif part.get("type") in ("image", "image_url"):
+                elif part.get("type") in ("image", "image_url",
+                                          "image_base64"):
+                    # NEVER inline image payloads: a base64 body would blow
+                    # text-only members' windows and wreck token budgeting
                     parts.append("[image]")
                 else:
                     parts.append(to_json(part))

@@ -157,10 +157,10 @@ def test_png_decode_resize(tmp_path):
 
 def test_preprocess_for_vision(tmp_path):
     png = _gradient_png(tmp_path)
-    chw = preprocess_for_vision(png, size=64)
-    assert chw.shape == (3, 64, 64)
-    assert chw.dtype == np.float32
-    assert -1.0 <= chw.min() and chw.max() <= 1.0
+    hwc = preprocess_for_vision(png, size=64)
+    assert hwc.shape == (64, 64, 3)           # HWC: what the ViT patchifies
+    assert hwc.dtype == np.float32
+    assert -1.0 <= hwc.min() and hwc.max() <= 1.0
 
 
 def test_bad_png_raises(tmp_path):
