@@ -175,11 +175,20 @@ def measure_config(backend, pool, name: str, n_agents: int = 1,
 
 
 def main() -> None:
+    import argparse
+
     import jax
 
     from quoracle_tpu.models.config import get_model_config
     from quoracle_tpu.models.loader import register_hf_checkpoint
     from quoracle_tpu.models.runtime import TPUBackend
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile", metavar="DIR", default=None,
+                    help="capture a JAX/XLA profiler trace of one measured "
+                         "config-2 cycle into DIR (view with "
+                         "tensorboard/xprof; SURVEY §5 tracing)")
+    args = ap.parse_args()
 
     devs = jax.devices()
     n_chips = len(devs)
@@ -215,6 +224,13 @@ def main() -> None:
     run_cycle(backend, pool, "warmup", TASKS[0])
     run_cycle(backend, pool, "warmup3", TASKS[0], n_agents=3, rounds=1)
     log(f"warmup (compiles) {time.monotonic() - t0:.1f}s")
+
+    if args.profile:
+        # one traced cycle AFTER warmup: steady-state device timeline with
+        # prefill/decode/grammar ops attributed, no compile noise
+        with jax.profiler.trace(args.profile):
+            run_cycle(backend, pool, "profiled", TASKS[1])
+        log(f"profiler trace written to {args.profile}")
 
     cfg1 = measure_config(backend, [pool[0]], "config1")
     cfg2 = measure_config(backend, pool, "config2")

@@ -70,6 +70,8 @@ class ConsensusOutcome:
     failures: list[ModelFailure] = dataclasses.field(default_factory=list)
     rounds_used: int = 1
     latency_ms: float = 0.0
+    prefill_ms: float = 0.0          # summed per-member device phase times
+    decode_ms: float = 0.0
     prompt_tokens: int = 0
     completion_tokens: int = 0
     cost: float = 0.0
@@ -134,7 +136,9 @@ class ConsensusEngine:
                                              round_num, cfg.threshold)
             self._log("consensus_round", {
                 "round": round_num, "clusters": len(clusters),
-                "responses": len(proposals), "majority": majority is not None})
+                "responses": len(proposals), "majority": majority is not None,
+                "prefill_ms": round(outcome.prefill_ms, 1),
+                "decode_ms": round(outcome.decode_ms, 1)})
             # force_reflection: a round-1 majority is not accepted as-is; the
             # pool reviews once before committing (reference consensus.ex
             # single-model/force_reflection refinement, :304-329).
@@ -216,6 +220,8 @@ class ConsensusEngine:
             outcome.prompt_tokens += res.usage.prompt_tokens
             outcome.completion_tokens += res.usage.completion_tokens
             outcome.cost += res.usage.cost
+            outcome.prefill_ms += getattr(res, "prefill_ms", 0.0)
+            outcome.decode_ms += getattr(res, "decode_ms", 0.0)
             if not res.ok:
                 failures.append(ModelFailure(res.model_spec, res.error))
                 continue

@@ -69,6 +69,10 @@ class QueryResult:
     text: str = ""
     usage: Usage = dataclasses.field(default_factory=Usage)
     latency_ms: float = 0.0
+    # Per-phase device timing (SURVEY §5 tracing): prefill is MXU-bound,
+    # decode is HBM-bound — a single latency hides which one regressed.
+    prefill_ms: float = 0.0
+    decode_ms: float = 0.0
     error: Optional[str] = None        # None = success
     permanent_error: bool = False      # parity: only auth-type errors are
                                        # permanent (model_query.ex:322-332)
@@ -342,7 +346,9 @@ class TPUBackend(ModelBackend):
             results[i] = QueryResult(
                 model_spec=spec, text=g.text,
                 usage=Usage(g.n_prompt_tokens, g.n_gen_tokens, cost),
-                latency_ms=latency_ms)
+                latency_ms=latency_ms,
+                prefill_ms=engine.last_prefill_s * 1000,
+                decode_ms=engine.last_decode_s * 1000)
 
     def embed(self, texts: Sequence[str]) -> list[np.ndarray]:
         return self.embedder.embed(texts)
