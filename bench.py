@@ -44,10 +44,14 @@ MAX_NEW = 128
 N_CYCLES = 4          # measured agent turns per config (plus 1 warmup)
 ROUNDS_PER_CYCLE = 3  # initial + 2 refinement rounds
 
-# Public HBM-bandwidth specs per device generation — the decode roofline.
-# Most-specific key first (matched by substring of device_kind).
+# Public HBM-bandwidth and bf16-FLOPs specs per device generation — the
+# decode (bandwidth) and prefill (compute) rooflines. Most-specific key
+# first (matched by substring of device_kind).
 PEAK_HBM_GBPS = {"TPU v5 lite": 819.0, "TPU v5e": 819.0, "TPU v5p": 2765.0,
                  "TPU v6 lite": 1640.0, "TPU v6e": 1640.0, "TPU v4": 1228.0}
+PEAK_BF16_TFLOPS = {"TPU v5 lite": 197.0, "TPU v5e": 197.0,
+                    "TPU v5p": 459.0, "TPU v6 lite": 918.0,
+                    "TPU v6e": 918.0, "TPU v4": 275.0}
 
 TASKS = [
     "Survey the repository layout and report the three largest source files "
@@ -194,6 +198,8 @@ def main() -> None:
     n_chips = len(devs)
     kind = getattr(devs[0], "device_kind", "unknown")
     peak_gbps = next((v for k, v in PEAK_HBM_GBPS.items() if k in kind), None)
+    peak_tflops = next((v for k, v in PEAK_BF16_TFLOPS.items()
+                        if k in kind), None)
     log(f"devices: {devs} (kind={kind!r})")
 
     dirs = ensure_checkpoints()
@@ -244,6 +250,13 @@ def main() -> None:
     decode_gb = sum(per_member_tokens * b for b in param_bytes.values()) / 1e9
     bw_gbps = decode_gb / max(cfg2["decode_s"], 1e-9)
     util = bw_gbps / peak_gbps if peak_gbps else None
+    # Prefill MFU: forward FLOPs ≈ 2 · params · tokens actually prefilled
+    # (suffix after KV residency), against the chip's bf16 peak.
+    n_params = {s: b / 2 for s, b in param_bytes.items()}   # bf16: 2 B/param
+    prefill_flops = (cfg2["prefill_tokens"] / len(pool)) * sum(
+        2 * p for p in n_params.values())
+    mfu = (prefill_flops / max(cfg2["prefill_s"], 1e-9)
+           / (peak_tflops * 1e12)) if peak_tflops else None
 
     p50 = cfg2["p50_round_ms"]
     tps_chip = cfg2["tokens_per_sec"] / max(1, n_chips)
@@ -268,6 +281,7 @@ def main() -> None:
         "kv_residency_prefill_savings": round(residency_saved, 3),
         "decode_hbm_gbps": round(bw_gbps, 1),
         "decode_hbm_utilization": round(util, 3) if util else None,
+        "prefill_mfu": round(mfu, 3) if mfu else None,
         "avg_model_gb": round(avg_param_gb, 2),
         "config1_p50_ms": round(cfg1["p50_round_ms"], 1),
         "config3_p50_ms": round(cfg3["p50_round_ms"], 1),

@@ -72,3 +72,12 @@ def test_cli_run_and_status(tmp_path, capsys):
     out = capsys.readouterr().out
     status = json.loads(out)
     assert list(status["tasks"].values()) == ["paused"]
+
+
+def test_checkpoints_require_tpu_backend():
+    """--checkpoint on the default mock backend must fail loudly, not
+    silently serve scripted responses (review r3 finding)."""
+    import pytest
+    from quoracle_tpu.runtime import Runtime, RuntimeConfig
+    with pytest.raises(ValueError, match="require --backend tpu"):
+        Runtime(RuntimeConfig(checkpoints=["/nonexistent"]))
