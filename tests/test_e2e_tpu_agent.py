@@ -81,3 +81,63 @@ def test_agent_decides_and_executes_on_tpu_backend():
         assert all(e.sessions.get("agent-e2e-tpu") is None
                    for e in backend.engines.values())
     asyncio.run(asyncio.wait_for(main(), 900))
+
+
+def test_pause_restore_on_tpu_backend(tmp_path):
+    """Checkpoint/resume depth on the REAL backend: an agent that decided
+    and executed on XLA models pauses, restores into a fresh runtime stack,
+    and continues deciding — sessions rebuilt by re-prefill, history intact
+    (the reference never persists KV; resume re-prefills, SURVEY §5)."""
+    from quoracle_tpu.persistence import Database, Persistence, TaskManager
+
+    async def main():
+        db = Database(str(tmp_path / "e2e.db"), encryption_key="k" * 16)
+        store = Persistence(db)
+        backend = TPUBackend(POOL)
+        deps = AgentDeps.for_tests(backend)
+        deps.persistence = store
+        sup = AgentSupervisor(deps)
+        tm = TaskManager(deps, store)
+        base = filter_actions(list(ACTIONS), [], ())
+        forbidden = tuple(a for a in base if a != "wait")
+
+        task_id, root = await tm.create_task(
+            "decide actions on the real backend", model_pool=list(POOL))
+        root.config.capability_groups = []
+        root.config.forbidden_actions = forbidden
+        root.engine = root._build_engine()
+        root.config.max_refinement_rounds = 2
+        root._system_prompt = (
+            'You are an agent. Respond ONLY with JSON {"action": "wait"}.')
+
+        def decided(core):
+            h = core.ctx.history(POOL[0])
+            return any(e.kind == DECISION for e in h) and \
+                any(e.kind == RESULT for e in h)
+        await until(lambda: decided(root))
+        n_before = len(root.ctx.history(POOL[0]))
+        await tm.pause_task(task_id)
+
+        # fresh stack over the same DB + backend (KV sessions were dropped
+        # at termination; the restored agent re-prefills from history)
+        deps2 = AgentDeps.for_tests(backend)
+        deps2.persistence = store
+        sup2 = AgentSupervisor(deps2)
+        tm2 = TaskManager(deps2, store)
+        n = await tm2.restore_task(task_id)
+        assert n >= 1
+        restored = deps2.registry.agents_for_task(task_id)[0].core
+        assert len(restored.ctx.history(POOL[0])) >= n_before
+        restored.config.capability_groups = []
+        restored.config.forbidden_actions = forbidden
+        restored.engine = restored._build_engine()
+        restored._system_prompt = (
+            'You are an agent. Respond ONLY with JSON {"action": "wait"}.')
+        restored.post({"type": "user_message", "from": "user",
+                       "content": "continue deciding"})
+        await until(lambda: len([e for e in restored.ctx.history(POOL[0])
+                                 if e.kind == DECISION])
+                    > len([e for e in root.ctx.history(POOL[0])
+                           if e.kind == DECISION]))
+        await tm2.pause_task(task_id)
+    asyncio.run(asyncio.wait_for(main(), 900))
