@@ -119,6 +119,29 @@ def config_from_hf(hf: dict, name: str,
     sliding = hf.get("sliding_window")
     if hf.get("use_sliding_window") is False:
         sliding = None
+    # Multimodal checkpoints (make_checkpoint --families vlm, or any dir
+    # using the in-tree serialization): a ``vision_config`` section marks
+    # the ViT tower (models/vision.py) whose weights live under
+    # vision_tower.* / multi_modal_projector.* in the safetensors.
+    vision = None
+    image_token_id = None
+    vc = hf.get("vision_config")
+    if vc:
+        from quoracle_tpu.models.vision import VisionConfig
+        vision = VisionConfig(
+            image_size=vc["image_size"],
+            patch_size=vc["patch_size"],
+            dim=vc["hidden_size"],
+            n_layers=vc["num_hidden_layers"],
+            n_heads=vc["num_attention_heads"],
+            ffn_dim=vc["intermediate_size"],
+            out_dim=hf["hidden_size"],
+        )
+        if hf.get("image_token_id") is None:
+            raise ValueError(
+                "vision_config present but no image_token_id — the prompt "
+                "builder cannot place soft tokens without it")
+        image_token_id = int(hf["image_token_id"])
     return ModelConfig(
         name=name,
         vocab_size=hf["vocab_size"],
@@ -139,6 +162,8 @@ def config_from_hf(hf: dict, name: str,
         stop_token_ids=tuple(eos_ids[1:]),
         bos_token_id=bos_ids[0] if bos_ids else 1,
         checkpoint_path=checkpoint_path,
+        vision=vision,
+        image_token_id=image_token_id,
         **over,
     )
 
@@ -267,6 +292,33 @@ def load_params(path: str, cfg: ModelConfig, dtype=None) -> dict:
         # HF omits lm_head from the file when tied; when untied it's at the
         # top level regardless of the "model." prefix.
         params["lm_head"] = g("lm_head.weight", transpose=True)
+    if cfg.vision is not None:
+        # ViT tower + projector (in-tree serialization, make_checkpoint
+        # vlm family) → the init_vision_params pytree layout with layers
+        # stacked on [L, ...] for the tower's lax.scan.
+        VL = cfg.vision.n_layers
+        vp = "vision_tower.layers.{i}."
+
+        def vstack(fmt: str, transpose: bool = False) -> np.ndarray:
+            return np.stack([g(fmt.format(i=i), transpose)
+                             for i in range(VL)])
+
+        params["vision"] = {
+            "patch_embed": g("vision_tower.patch_embed.weight",
+                             transpose=True),
+            "pos_embed": g("vision_tower.pos_embed"),
+            "layers": {
+                "ln1": vstack(vp + "ln1.weight"),
+                "wqkv": vstack(vp + "attn.qkv_proj.weight", transpose=True),
+                "wo": vstack(vp + "attn.o_proj.weight", transpose=True),
+                "ln2": vstack(vp + "ln2.weight"),
+                "w_up": vstack(vp + "mlp.up_proj.weight", transpose=True),
+                "w_down": vstack(vp + "mlp.down_proj.weight",
+                                 transpose=True),
+            },
+            "final_ln": g("vision_tower.final_ln.weight"),
+            "projector": g("multi_modal_projector.weight", transpose=True),
+        }
     r.close()
     return params
 

@@ -35,7 +35,7 @@ import os
 from typing import Iterable, Optional
 
 SPECIALS = ["<|pad|>", "<|bos|>", "<|eos|>", "<|system|>", "<|user|>",
-            "<|assistant|>"]
+            "<|assistant|>", "<|image|>"]
 CHAT_TEMPLATE = (
     "{{ bos_token }}{% for message in messages %}"
     "<|{{ message['role'] }}|>\n{{ message['content'] }}\n"
@@ -67,6 +67,21 @@ FAMILY_CONFIGS = {
             max_position_embeddings=8192, rope_theta=10000.0,
             rms_norm_eps=1e-6, hidden_act="gelu_pytorch_tanh",
             tie_word_embeddings=True),
+        # VLM: llama-1b decoder + in-tree ViT tower (LLaVA-style soft
+        # tokens). vision_config marks the checkpoint as multimodal; the
+        # tower weights serialize under vision_tower.* / multi_modal_
+        # projector.* (loader.py layout — in-tree scheme, no released-VLM
+        # weight mapping yet, models/vision.py docstring).
+        "vlm": dict(
+            architectures=["LlamaForCausalLM"], vocab_size=32768,
+            hidden_size=2048, intermediate_size=5632, num_hidden_layers=16,
+            num_attention_heads=16, num_key_value_heads=4,
+            max_position_embeddings=8192, rope_theta=500000.0,
+            rms_norm_eps=1e-5, hidden_act="silu", tie_word_embeddings=False,
+            vision_config=dict(
+                image_size=224, patch_size=14, hidden_size=512,
+                num_hidden_layers=6, num_attention_heads=8,
+                intermediate_size=2048)),
     },
     "tiny": {
         "llama": dict(
@@ -82,6 +97,16 @@ FAMILY_CONFIGS = {
             max_position_embeddings=2048, rope_theta=10000.0,
             rms_norm_eps=1e-6, hidden_act="gelu_pytorch_tanh",
             tie_word_embeddings=True),
+        "vlm": dict(
+            architectures=["LlamaForCausalLM"], vocab_size=2048,
+            hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=2048, rope_theta=10000.0,
+            rms_norm_eps=1e-5, hidden_act="silu", tie_word_embeddings=False,
+            vision_config=dict(
+                image_size=28, patch_size=14, hidden_size=32,
+                num_hidden_layers=1, num_attention_heads=2,
+                intermediate_size=64)),
     },
 }
 
@@ -176,6 +201,27 @@ def write_weights(out_dir: str, hf: dict, seed: int = 0) -> None:
         tensors[p + "mlp.down_proj.weight"] = w(D, F)
     if not hf.get("tie_word_embeddings"):
         tensors["lm_head.weight"] = w(V, D)
+    vc = hf.get("vision_config")
+    if vc:
+        # ViT tower + projector under the loader's in-tree VLM layout
+        # (loader.load_params vision subtree; models/vision.py structure).
+        VD = vc["hidden_size"]
+        VF = vc["intermediate_size"]
+        patch_dim = vc["patch_size"] ** 2 * 3
+        n_patches = (vc["image_size"] // vc["patch_size"]) ** 2
+        tensors["vision_tower.patch_embed.weight"] = w(VD, patch_dim)
+        tensors["vision_tower.pos_embed"] = w(n_patches, VD)
+        for i in range(vc["num_hidden_layers"]):
+            p = f"vision_tower.layers.{i}."
+            tensors[p + "ln1.weight"] = torch.ones(VD).to(torch.bfloat16)
+            tensors[p + "attn.qkv_proj.weight"] = w(3 * VD, VD)
+            tensors[p + "attn.o_proj.weight"] = w(VD, VD)
+            tensors[p + "ln2.weight"] = torch.ones(VD).to(torch.bfloat16)
+            tensors[p + "mlp.up_proj.weight"] = w(VF, VD)
+            tensors[p + "mlp.down_proj.weight"] = w(VD, VF)
+        tensors["vision_tower.final_ln.weight"] = \
+            torch.ones(VD).to(torch.bfloat16)
+        tensors["multi_modal_projector.weight"] = w(D, VD)
     save_file(tensors, os.path.join(out_dir, "model.safetensors"),
               metadata={"format": "pt"})
 
@@ -197,6 +243,8 @@ def make_checkpoint(out_dir: str, family: str = "llama", scale: str = "1b",
     hf["bos_token_id"] = ids["<|bos|>"]
     hf["eos_token_id"] = ids["<|eos|>"]
     hf["pad_token_id"] = ids["<|pad|>"]
+    if "vision_config" in hf:
+        hf["image_token_id"] = ids["<|image|>"]
     hf["torch_dtype"] = "bfloat16"
     with open(os.path.join(out_dir, "config.json"), "w") as f:
         json.dump(hf, f, indent=1)

@@ -211,6 +211,43 @@ def test_register_and_backend_serves_checkpoint(tmp_path):
     assert out[0].usage.prompt_tokens > 0
 
 
+def test_vlm_checkpoint_roundtrip_and_serves_images(tmp_path):
+    """make_checkpoint --families vlm at tiny scale → loader parses
+    vision_config + image_token_id, loads the tower pytree, and the
+    backend serves a multimodal message through the real-checkpoint path
+    (BASELINE config 5 capability)."""
+    import base64
+    from quoracle_tpu.models.make_checkpoint import make_checkpoint
+    from quoracle_tpu.models.images import write_png
+    from quoracle_tpu.models.loader import load_params
+    from quoracle_tpu.models.runtime import QueryRequest, TPUBackend
+
+    out = make_checkpoint(str(tmp_path / "vlm"), family="vlm", scale="tiny")
+    cfg = register_hf_checkpoint(out, name="ck-vlm-test")
+    assert cfg.vision is not None and cfg.vision.n_patches == 4
+    assert cfg.image_token_id is not None
+
+    params = load_params(out, cfg)
+    vl = params["vision"]["layers"]
+    assert vl["wqkv"].shape == (cfg.vision.n_layers, cfg.vision.dim,
+                                3 * cfg.vision.dim)
+    assert params["vision"]["projector"].shape == (cfg.vision.dim, cfg.dim)
+
+    rng = np.random.default_rng(3)
+    png = str(tmp_path / "i.png")
+    write_png(png, rng.integers(0, 255, (28 * 28 * 3,),
+                                dtype=np.uint8).tobytes(), 28, 28)
+    b64 = base64.b64encode(open(png, "rb").read()).decode()
+    backend = TPUBackend(pool=["xla:ck-vlm-test"])
+    msgs = [{"role": "user", "content": [
+        {"type": "text", "text": "describe"},
+        {"type": "image_base64", "data": b64}]}]
+    r = backend.query([QueryRequest("xla:ck-vlm-test", msgs,
+                                    temperature=0.0, max_tokens=6)])[0]
+    assert r.ok, r.error
+    assert r.usage.prompt_tokens > cfg.vision.n_patches
+
+
 # ---------------------------------------------------------------------------
 # Real-tokenizer path: chat template from the checkpoint directory
 # ---------------------------------------------------------------------------
