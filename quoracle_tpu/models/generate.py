@@ -84,6 +84,56 @@ def prefill(params: dict, cfg: ModelConfig, tokens: jax.Array,
                          ring=ring, input_embeds=input_embeds)
 
 
+def _sampling_fns(json_table: Optional[jax.Array], eos_id: int,
+                  stop_ids: tuple):
+    """The stop/grammar closures shared by decode() and decode_paged() —
+    one implementation so the gather and direct paged paths can never
+    drift apart on stop handling or grammar dead-end recovery (the two
+    must stay token-exact; tests/test_paged_kv.py equality test)."""
+    stops = jnp.asarray((eos_id,) + tuple(stop_ids), jnp.int32)
+    constrained = json_table is not None
+
+    def is_stop(tok):
+        return jnp.any(tok[:, None] == stops[None, :], axis=1)
+
+    def mask_logits(logits, jstate):
+        if not constrained:
+            return logits
+        allowed = json_table[jnp.clip(jstate, 0, None)] >= 0   # [B, V]
+        # dead-end safety: if no token is allowed (vocab gap), permit eos
+        # so the row stops instead of sampling from an all -inf row
+        none_ok = ~jnp.any(allowed, axis=-1, keepdims=True)
+        eos_hot = (jnp.arange(logits.shape[-1]) == eos_id)[None, :]
+        allowed = allowed | (none_ok & eos_hot) | (jstate < 0)[:, None]
+        return jnp.where(allowed, logits, NEG_INF_LOGITS)
+
+    def advance(jstate, tok, done):
+        if not constrained:
+            return jstate
+        nxt = json_table[jnp.clip(jstate, 0, None), tok].astype(jnp.int32)
+        return jnp.where((jstate >= 0) & ~done, nxt, jstate)
+
+    return is_stop, mask_logits, advance, constrained
+
+
+def _first_token(fns, first_logits, rng, temperature, top_p, active,
+                 row_limit, json_state, max_new: int, pad_id: int):
+    """Shared decode bootstrap: sample token 0 from the prefill logits and
+    build the initial (tok0, n0, done0, jstate0, out0, rng) carry."""
+    is_stop, mask_logits, advance, constrained = fns
+    B = first_logits.shape[0]
+    jstate0 = json_state if constrained else jnp.zeros((B,), jnp.int32)
+    rng, k0 = jax.random.split(rng)
+    tok0 = sample_tokens(mask_logits(first_logits, jstate0), k0,
+                         temperature, top_p)
+    n0 = jnp.where(active, 1, 0).astype(jnp.int32)
+    done0 = ~active | is_stop(tok0) | (n0 >= row_limit)
+    # advance on tok0 for every active row (eos self-loops in accept states)
+    jstate0 = advance(jstate0, tok0, ~active)
+    out0 = jnp.full((B, max_new), pad_id, jnp.int32).at[:, 0].set(tok0)
+    return tok0, n0, done0, jstate0, out0, rng
+
+
 def decode(
     params: dict,
     cfg: ModelConfig,
@@ -126,41 +176,11 @@ def decode(
     a scalar gather to advance the state — output is valid JSON by
     construction (SURVEY §7 hard part 4).
     """
-    B = first_logits.shape[0]
-    stops = jnp.asarray((eos_id,) + tuple(stop_ids), jnp.int32)
-    constrained = json_table is not None
-
-    def is_stop(tok):
-        return jnp.any(tok[:, None] == stops[None, :], axis=1)
-
-    def mask_logits(logits, jstate):
-        if not constrained:
-            return logits
-        allowed = json_table[jnp.clip(jstate, 0, None)] >= 0   # [B, V]
-        # dead-end safety: if no token is allowed (vocab gap), permit eos so
-        # the row stops instead of sampling from an all -inf row
-        none_ok = ~jnp.any(allowed, axis=-1, keepdims=True)
-        eos_hot = (jnp.arange(logits.shape[-1]) == eos_id)[None, :]
-        allowed = allowed | (none_ok & eos_hot) | (jstate < 0)[:, None]
-        return jnp.where(allowed, logits, NEG_INF_LOGITS)
-
-    def advance(jstate, tok, done):
-        if not constrained:
-            return jstate
-        nxt = json_table[jnp.clip(jstate, 0, None),
-                         tok].astype(jnp.int32)
-        return jnp.where((jstate >= 0) & ~done, nxt, jstate)
-
-    jstate0 = json_state if constrained else jnp.zeros((B,), jnp.int32)
-
-    rng, k0 = jax.random.split(rng)
-    tok0 = sample_tokens(mask_logits(first_logits, jstate0), k0,
-                         temperature, top_p)
-    n0 = jnp.where(active, 1, 0).astype(jnp.int32)
-    done0 = ~active | is_stop(tok0) | (n0 >= row_limit)
-    # advance on tok0 for every active row (eos self-loops in accept states)
-    jstate0 = advance(jstate0, tok0, ~active)
-    out0 = jnp.full((B, max_new), pad_id, jnp.int32).at[:, 0].set(tok0)
+    fns = _sampling_fns(json_table, eos_id, stop_ids)
+    is_stop, mask_logits, advance, _ = fns
+    tok0, n0, done0, jstate0, out0, rng = _first_token(
+        fns, first_logits, rng, temperature, top_p, active, row_limit,
+        json_state, max_new, pad_id)
 
     def cond(carry):
         i, done, *_ = carry
@@ -194,6 +214,85 @@ def decode(
     _, done, _, out, n_emitted, cache, _, _ = \
         jax.lax.while_loop(cond, body, init)
     return out, n_emitted, cache
+
+
+def decode_paged(
+    params: dict,
+    cfg: ModelConfig,
+    k_pool: jax.Array,         # [L, n_pages, page, KV, hd] — READ-ONLY
+    v_pool: jax.Array,
+    tables: jax.Array,         # [B, maxp] int32
+    pool_lens: jax.Array,      # [B] int32 valid pool tokens (the prompt)
+    kv_off: jax.Array,         # [B] int32 abs position of pool index 0
+    first_logits: jax.Array,   # [B, V]
+    rng: jax.Array,
+    temperature: jax.Array,
+    top_p: jax.Array,
+    max_new: int,
+    eos_id: int,
+    active: jax.Array,
+    row_limit: jax.Array,
+    pad_id: int = 0,
+    stop_ids: tuple = (),
+    json_table: Optional[jax.Array] = None,
+    json_state: Optional[jax.Array] = None,
+    tail_dtype=jnp.bfloat16,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Autoregressive decode against the PAGED pool: same sampling/grammar
+    semantics as decode(), but attention reads the row's pages directly
+    (ragged — transformer.forward_hidden_paged) and new tokens' KV land in
+    a [L, B, max_new] TAIL buffer instead of a gathered working cache. The
+    memory high-water drops from pool + [B, maxp·page] working cache to
+    pool + tail, and per-step KV reads are proportional to each row's real
+    length (NOTES_r03 gap 2).
+
+    Returns (tokens [B, max_new], n_emitted [B], lens [B], tail_k, tail_v)
+    where lens = pool_lens + valid tail entries per row — the caller
+    scatters tail[:, :lens-pool_lens] into the row's pages (page bookkeeping
+    is host-side, as in the gather path).
+    """
+    from quoracle_tpu.models.transformer import forward_hidden_paged
+    B = first_logits.shape[0]
+    L, _, page, KV, HD = k_pool.shape
+    fns = _sampling_fns(json_table, eos_id, stop_ids)
+    is_stop, mask_logits, advance, _ = fns
+    tok0, n0, done0, jstate0, out0, rng = _first_token(
+        fns, first_logits, rng, temperature, top_p, active, row_limit,
+        json_state, max_new, pad_id)
+    tail_k0 = jnp.zeros((L, B, max_new, KV, HD), tail_dtype)
+    tail_v0 = jnp.zeros((L, B, max_new, KV, HD), tail_dtype)
+    lens0 = pool_lens.astype(jnp.int32)
+
+    def cond(carry):
+        i, done, *_ = carry
+        return (i < max_new) & ~jnp.all(done)
+
+    def body(carry):
+        (i, done, cur, out, n_emitted, lens, tail_k, tail_v, rng,
+         jstate) = carry
+        positions = (lens + kv_off.astype(jnp.int32))[:, None]
+        hidden, tail_k, tail_v = forward_hidden_paged(
+            params, cfg, cur[:, None], positions, k_pool, v_pool, tables,
+            pool_lens, kv_off, tail_k, tail_v, step=i - 1)
+        logits = project_logits(params, cfg, hidden)
+        rng, k = jax.random.split(rng)
+        nxt = sample_tokens(mask_logits(logits[:, 0, :], jstate), k,
+                            temperature, top_p)
+        nxt = jnp.where(done, pad_id, nxt)
+        out = jax.lax.dynamic_update_slice_in_dim(out, nxt[:, None], i,
+                                                  axis=1)
+        n_emitted = n_emitted + jnp.where(done, 0, 1).astype(jnp.int32)
+        lens = lens + jnp.where(done, 0, 1)
+        jstate = advance(jstate, nxt, done)
+        done = done | is_stop(nxt) | (n_emitted >= row_limit)
+        return (i + 1, done, nxt, out, n_emitted, lens, tail_k, tail_v,
+                rng, jstate)
+
+    init = (jnp.asarray(1, jnp.int32), done0, tok0, out0, n0, lens0,
+            tail_k0, tail_v0, rng, jstate0)
+    (_, done, _, out, n_emitted, lens, tail_k, tail_v, _, _) = \
+        jax.lax.while_loop(cond, body, init)
+    return out, n_emitted, lens, tail_k, tail_v
 
 
 def _round_up(n: int, buckets: Sequence[int]) -> int:
@@ -277,12 +376,22 @@ class SessionStore:
                 s.last_used = time.monotonic()
             return s
 
-    def alloc(self, n: int, protect: tuple = ()) -> Optional[list[int]]:
+    def alloc(self, n: int, protect: tuple = (),
+              evict: bool = True) -> Optional[list[int]]:
         """Take n pages from the free list, evicting LRU sessions (never
         the ``protect`` keys — the batch's own sessions) as needed.
         Returns None — WITHOUT evicting anything — when the request cannot
-        be satisfied even by evicting every unprotected session."""
+        be satisfied even by evicting every unprotected session.
+
+        ``evict=False`` takes only from the free list: TEMP allocations
+        (direct-decode scratch for sessionless rows) must never destroy
+        other agents' resident sessions for pages that die at call end —
+        the caller falls back to the gather decode instead."""
         with self.lock:
+            if not evict:
+                if n > len(self._free):
+                    return None
+                return [self._free.pop() for _ in range(n)]
             victims = [k for k in self._sessions if k not in protect]
             attainable = len(self._free) + sum(
                 len(self._sessions[k].pages) for k in victims)
@@ -339,6 +448,56 @@ def _lcp(a: Sequence[int], b: Sequence[int]) -> int:
     while i < n and a[i] == b[i]:
         i += 1
     return i
+
+
+def splice_session_prompt(tokenizer, sess_tokens: Sequence[int],
+                          plain_ids: Sequence[int]) -> Optional[list[int]]:
+    """Token-level session splice: rebuild a prompt so it shares the longest
+    possible TOKEN prefix with ``sess_tokens`` (the session's actual ids —
+    original prompt + the ids the model itself sampled).
+
+    Refinement rounds append the assistant's raw text to the conversation
+    and re-render the chat template (consensus/engine.py:161); re-ENCODING
+    that text rarely reproduces the ids the model SAMPLED, so a plain token
+    LCP stops at the previous round's prompt and the retained response KV
+    (already resident, generate.py decode) never matches. Comparing decoded
+    TEXT instead — and keeping the session's own ids for the shared region —
+    resumes the whole previous conversation from resident KV; only the
+    genuinely new suffix (template glue + the refinement message) re-encodes.
+
+    Returns the spliced ids, or None when the plain encoding already matches
+    the session at least as far (nothing to gain).
+    """
+    plain_reuse = _lcp(sess_tokens, plain_ids)
+    canonical = tokenizer.decode_raw(plain_ids)
+    if not canonical:
+        return None
+    # Fast path: clean extension — the refinement-round shape.
+    if canonical.startswith(tokenizer.decode_raw(sess_tokens)):
+        k = len(sess_tokens)
+    else:
+        # Largest k with decode(sess[:k]) a prefix of the new text (invariant:
+        # lo always satisfies it; a k ending mid-UTF-8 decodes to U+FFFD and
+        # naturally fails). Divergence happens when condensation rewrote
+        # history — the shared region shrinks to the still-common prefix.
+        lo, hi = 0, len(sess_tokens)
+        while lo < hi:
+            mid = (lo + hi + 1) // 2
+            if canonical.startswith(tokenizer.decode_raw(sess_tokens[:mid])):
+                lo = mid
+            else:
+                hi = mid - 1
+        k = lo
+    # ≥1 suffix token must run through prefill to produce last-position
+    # logits; and the splice must beat the plain prefix to be worth
+    # diverging from the canonical tokenization.
+    while k > plain_reuse:
+        suffix = tokenizer.encode(
+            canonical[len(tokenizer.decode_raw(sess_tokens[:k])):])
+        if suffix:
+            return list(sess_tokens[:k]) + suffix
+        k -= 1
+    return None
 
 
 class GenerateEngine:
@@ -405,6 +564,18 @@ class GenerateEngine:
         # The paged steps donate the pool buffers; calls that touch the pool
         # must serialize (concurrent members use separate engines).
         self._paged_lock = threading.Lock()
+        # Resident-size threshold (max prompt tokens in the batch) for the
+        # DIRECT (ragged-kernel) paged decode. Default OFF: measured on
+        # this deployment (tools/bench_longctx.py, v5e via the remote
+        # dispatch relay), the kernel's per-layer launch overhead
+        # (~2.7 ms × n_layers per token) beats the gather path's padded KV
+        # reads even at 16k resident tokens and batch 1 (1115 vs 2516 ms
+        # per 32-token round) — the crossover needs ~1M padded KV tokens
+        # per step (large ragged batches or local-dispatch hosts). The
+        # kernel also caps peak HBM (no [B, maxp·page] working cache),
+        # so memory-pressured deployments may enable it below the
+        # latency crossover.
+        self.direct_decode_min_tokens = 1 << 30
         # Per-call phase diagnostics (read by the bench + dashboards):
         # wall seconds of the last prefill / decode device phases.
         self.last_prefill_s = 0.0
@@ -556,10 +727,55 @@ class GenerateEngine:
             return out, n_emitted, cache.lens, k_pool, v_pool, cache.k, \
                 cache.v
 
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+        def step_scatter_prompt(k_pool, v_pool, k_work, v_work, dst_pages):
+            # Working cache (prefix gather + suffix prefill) → dst pages,
+            # BEFORE decode: the direct-decode path then reads pages only.
+            # k_work/v_work are donated so the working cache's HBM frees
+            # here (the memory win of the direct path) — XLA warns the
+            # donation isn't aliasable into an output; that's the point,
+            # it's a free, not an alias.
+            B, maxp = dst_pages.shape
+            kp = k_work.reshape(L, B, maxp, page, KV, HD)
+            vp = v_work.reshape(L, B, maxp, page, KV, HD)
+            k_pool = k_pool.at[:, dst_pages].set(kp, mode="drop")
+            v_pool = v_pool.at[:, dst_pages].set(vp, mode="drop")
+            return k_pool, v_pool
+
+        @functools.partial(jax.jit, static_argnames=("max_new",))
+        def step_paged_decode_direct(params, k_pool, v_pool, tables,
+                                     pool_lens, kv_off, last_logits, rng,
+                                     temperature, top_p, active, row_limit,
+                                     json_table, json_state, max_new: int):
+            # Pools are READ-ONLY here (not donated): attention streams
+            # pages via ops/paged_attention.py; new KV accumulates in the
+            # tail buffer, scattered into pages by step_scatter_tail.
+            return decode_paged(
+                params, cfg, k_pool, v_pool, tables, pool_lens, kv_off,
+                last_logits, rng, temperature, top_p, max_new,
+                cfg.eos_token_id, active=active, row_limit=row_limit,
+                pad_id=self.tokenizer.pad_id, stop_ids=cfg.stop_token_ids,
+                json_table=json_table, json_state=json_state,
+                tail_dtype=self.cache_dtype)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def step_scatter_tail(k_pool, v_pool, tail_k, tail_v, flat_idx):
+            # tail slot t of row b → pool token slot flat_idx[b, t]
+            # (host-computed; out-of-range = drop for invalid slots)
+            n_tok = k_pool.shape[1] * page
+            kf = k_pool.reshape(L, n_tok, KV, HD)
+            vf = v_pool.reshape(L, n_tok, KV, HD)
+            kf = kf.at[:, flat_idx].set(tail_k, mode="drop")
+            vf = vf.at[:, flat_idx].set(tail_v, mode="drop")
+            return (kf.reshape(k_pool.shape), vf.reshape(v_pool.shape))
+
         self._step_prefill = step_prefill
         self._step_decode = step_decode
         self._step_paged_prefill = step_paged_prefill
         self._step_paged_decode = step_paged_decode
+        self._step_scatter_prompt = step_scatter_prompt
+        self._step_paged_decode_direct = step_paged_decode_direct
+        self._step_scatter_tail = step_scatter_tail
 
     def next_rng(self) -> jax.Array:
         with self._rng_lock:
@@ -653,6 +869,15 @@ class GenerateEngine:
         calls so an in-flight batch never loses pages it references."""
         with self._paged_lock:
             self.sessions.drop(session_id)
+
+    def session_tokens(self, session_id: str) -> Optional[list[int]]:
+        """The session's resident conversation ids (host ints, prompt +
+        retained response), or None. Callers use these to SPLICE the next
+        round's prompt (splice_session_prompt) so its token prefix matches
+        the resident KV exactly. Snapshot copy: generate replaces the
+        _Session object wholesale, never mutates tokens in place."""
+        s = self.sessions.get(session_id)
+        return None if s is None else list(s.tokens)
 
     def _generate_impl(self, prompts, temperature=1.0, top_p=1.0,
                        max_new_tokens=256, rng=None, session_ids=None,
@@ -897,8 +1122,25 @@ class GenerateEngine:
         src = np.zeros((B, maxp), np.int32)
         dst = np.zeros((B, maxp), np.int32)
         dst_lists: list[Optional[list[int]]] = [None] * n
+        temp_lists: list[Optional[list[int]]] = [None] * n
         spills: list[list[int]] = [[] for _ in range(n)]
         protect = tuple(s for s in store_sids if s)
+        # DIRECT paged decode (ops/paged_attention.py) vs gather decode.
+        # The ragged kernel costs one pallas launch per LAYER per token, so
+        # wherever launch overhead exceeds the gather path's padded KV
+        # reads the fused gather decode is faster (measured: 656 → 1640 ms
+        # per bench config-1 round at ~1k tokens; still 2.3× slower at 16k
+        # resident, batch 1 — tools/bench_longctx.py). The kernel's wins
+        # are peak-HBM (no [B, maxp·page] working cache) and very large
+        # ragged batches; the gate compares the batch's max RESIDENT
+        # (prompt) tokens against direct_decode_min_tokens (default off —
+        # see __init__). Mesh engines always gather (kernel is
+        # single-device). _force_gather_decode is the equality-test seam
+        # (tests/test_paged_kv.py).
+        use_direct = (self.mesh is None
+                      and not getattr(self, "_force_gather_decode", False)
+                      and max(len(p) for p in prompts)
+                      >= self.direct_decode_min_tokens)
         with st.lock:   # one allocation transaction for the batch
             for i in range(n):
                 s = sess_rows[i]
@@ -933,6 +1175,29 @@ class GenerateEngine:
                     old = old + extra
                 dst_lists[i] = old
                 dst[i, :len(old)] = old
+            if use_direct:
+                # Direct decode reads EVERY row's prompt from pages, so
+                # rows without a stored session need TEMP pages for this
+                # call. Exhaustion falls back to the gather decode.
+                for i in range(n):
+                    if dst_lists[i] is not None:
+                        continue
+                    need_tokens = min(len(suffixes[i]) + int(limits[i])
+                                      + int(pre_arr[i]), maxp * page)
+                    # free-list only: scratch pages that die at call end
+                    # must not evict other agents' resident sessions
+                    tmp = st.alloc(-(-need_tokens // page),
+                                   protect=protect, evict=False)
+                    if tmp is None:
+                        use_direct = False
+                        break
+                    temp_lists[i] = tmp
+                    dst[i, :len(tmp)] = tmp
+                if not use_direct:
+                    for i, tmp in enumerate(temp_lists):
+                        if tmp:
+                            st._release(tmp)
+                        temp_lists[i] = None
 
         last_logits, cache = self._step_paged_prefill(
             self.params, st.k, st.v, put(src, mat), put(tokens, mat),
@@ -940,14 +1205,48 @@ class GenerateEngine:
         jax.block_until_ready(last_logits)  # phase fence: prefill done
         t_prefill = time.monotonic()
 
-        out, n_emitted, final_lens, st.k, st.v, _, _ = \
-            self._step_paged_decode(
-                self.params, st.k, st.v, cache.k, cache.v, cache.lens,
-                put(dst, mat), put(off_arr, row), last_logits, rng_key,
-                *samp, *json_args, max_new=max_new)
-        out = np.asarray(out)
-        n_emitted = np.asarray(n_emitted)
-        now = time.monotonic()
+        if use_direct:
+            # prompt KV → pages, free the working cache, decode straight
+            # off the pool (ragged paged attention), then scatter only the
+            # generated tail back.
+            pool_lens_dev = cache.lens
+            st.k, st.v = self._step_scatter_prompt(
+                st.k, st.v, cache.k, cache.v, put(dst, mat))
+            cache = None    # drop host refs: k/v donated above, HBM freed
+            out, n_emitted, final_lens, tail_k, tail_v = \
+                self._step_paged_decode_direct(
+                    self.params, st.k, st.v, put(dst, mat), pool_lens_dev,
+                    put(off_arr, row), last_logits, rng_key, *samp,
+                    *json_args, max_new=max_new)
+            out = np.asarray(out)
+            n_emitted = np.asarray(n_emitted)
+            lens_host = np.asarray(final_lens)
+            pool_lens_host = np.asarray(pool_lens_dev)
+            flat = np.full((B, tail_k.shape[2]), st.n_pages * page,
+                           np.int32)          # OOB sentinel = dropped
+            for i in range(n):
+                n_tail = int(lens_host[i]) - int(pool_lens_host[i])
+                if n_tail <= 0:
+                    continue
+                pos = int(pool_lens_host[i]) + np.arange(n_tail)
+                pos = pos[pos < maxp * page]
+                flat[i, :len(pos)] = dst[i, pos // page] * page + pos % page
+            st.k, st.v = self._step_scatter_tail(
+                st.k, st.v, tail_k, tail_v, jnp.asarray(flat))
+            # the scatter belongs to this call's decode phase: sync before
+            # stamping, or its device time leaks into the NEXT call's
+            # prefill fence and skews the bench's phase split
+            jax.block_until_ready(st.k)
+            now = time.monotonic()
+        else:
+            out, n_emitted, final_lens, st.k, st.v, _, _ = \
+                self._step_paged_decode(
+                    self.params, st.k, st.v, cache.k, cache.v, cache.lens,
+                    put(dst, mat), put(off_arr, row), last_logits, rng_key,
+                    *samp, *json_args, max_new=max_new)
+            out = np.asarray(out)
+            n_emitted = np.asarray(n_emitted)
+            now = time.monotonic()
 
         lens_host = np.asarray(final_lens)
         for i in range(n):
@@ -976,6 +1275,10 @@ class GenerateEngine:
             # releases above cover exactly the no-longer-referenced ones)
             st.put_raw(sid, _Session(tokens=toks, pages=pages,
                                      start_pos=start))
+        # temp pages (direct decode for sessionless rows) die with the call
+        for tmp in temp_lists:
+            if tmp:
+                st.release(tmp)
         return out, n_emitted, t_prefill, now
 
     def _json_table_device(self, enum_set: tuple):

@@ -29,7 +29,9 @@ import numpy as np
 from quoracle_tpu.models.config import (
     OUTPUT_FLOOR, ModelConfig, get_model_config,
 )
-from quoracle_tpu.models.generate import ContextOverflowError, GenerateEngine
+from quoracle_tpu.models.generate import (
+    ContextOverflowError, GenerateEngine, splice_session_prompt,
+)
 from quoracle_tpu.models.tokenizer import Tokenizer, get_tokenizer
 
 logger = logging.getLogger(__name__)
@@ -394,6 +396,21 @@ class TPUBackend(ModelBackend):
                 # (HF checkpoints) — only image-carrying prompts need the
                 # placeholder-splicing render
                 ids, img = engine.tokenizer.encode_chat(r.messages), None
+                if r.session_id:
+                    # Token-level session splice: share the session's ACTUAL
+                    # ids (prompt + sampled response) as the prompt prefix so
+                    # the retained response KV resumes too — re-encoding the
+                    # assistant text would break the token match at the
+                    # previous prompt's end (generate.splice_session_prompt).
+                    sess_toks = engine.session_tokens(r.session_id)
+                    if sess_toks:
+                        spliced = splice_session_prompt(
+                            engine.tokenizer, sess_toks, ids)
+                        # dropped-id decode asymmetries can inflate the
+                        # spliced length — never let the splice push a
+                        # fitting prompt over the window
+                        if spliced is not None and len(spliced) < max_seq:
+                            ids = spliced
             if len(ids) >= max_seq:
                 # Per-ROW overflow: only the oversized row errors; the
                 # rest of the group still runs (the condensation layer

@@ -39,6 +39,15 @@ class Tokenizer(abc.ABC):
     @abc.abstractmethod
     def decode(self, ids: Sequence[int]) -> str: ...
 
+    def decode_raw(self, ids: Sequence[int]) -> str:
+        """Decode for TEXT-PREFIX comparison (session splicing): must be
+        consistent under concatenation of the same template's renderings —
+        template marker tokens must not silently vanish on tokenizers where
+        they re-encode losslessly (HF specials). Byte-level tokenizers keep
+        their default decode: their specials have no textual form on either
+        side of the comparison, so dropping them is consistent."""
+        return self.decode(ids)
+
     @property
     @abc.abstractmethod
     def vocab_size(self) -> int: ...
@@ -134,6 +143,14 @@ class HFAutoTokenizer(Tokenizer):
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)
 
+    def decode_raw(self, ids: Sequence[int]) -> str:
+        # Keep template markers: the splice suffix must re-encode them back
+        # to their special ids (added-token matching is independent of
+        # add_special_tokens), or a spliced prompt would lose its chat
+        # structure after the resumed region.
+        return self._tok.decode(list(ids), skip_special_tokens=False,
+                                clean_up_tokenization_spaces=False)
+
     @property
     def vocab_size(self) -> int:
         return len(self._tok)
@@ -165,6 +182,9 @@ class HFTokenizer(Tokenizer):
 
     def decode(self, ids: Sequence[int]) -> str:
         return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def decode_raw(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(list(ids), skip_special_tokens=False)
 
     @property
     def vocab_size(self) -> int:

@@ -248,6 +248,69 @@ def forward_hidden(
     return x, KVCache(k=new_k, v=new_v, lens=cache.lens)
 
 
+def forward_hidden_paged(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jax.Array,       # [B, 1] int32 (decode step)
+    positions: jax.Array,    # [B, 1] int32 absolute positions
+    k_pool: jax.Array,       # [L, n_pages, page, n_kv, hd] — read-only
+    v_pool: jax.Array,
+    tables: jax.Array,       # [B, maxp] int32 page table
+    pool_lens: jax.Array,    # [B] int32 valid pool tokens (fixed in decode)
+    kv_off: jax.Array,       # [B] int32 absolute position of pool index 0
+    tail_k: jax.Array,       # [L, B, Tmax, n_kv, hd] generated-token KV
+    tail_v: jax.Array,
+    step: jax.Array,         # scalar int32: tail slot this token writes
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Decode-step forward against the PAGED session pool: attention reads
+    the row's pages directly (ops/paged_attention.py — ragged, only
+    ceil(pool_lens/page) pages stream per row) merged with the dense tail
+    of tokens generated this call. The pool is never gathered into a
+    contiguous working cache (NOTES_r03 gap 2). Returns (hidden [B, 1, D],
+    new tail_k, new tail_v)."""
+    from quoracle_tpu.ops.paged_attention import paged_decode_attend
+    B, T = tokens.shape
+    x = params["embed"][tokens]
+    if cfg.scale_embeddings:
+        x = (x.astype(jnp.float32) * (cfg.dim ** 0.5)).astype(x.dtype)
+
+    def layer_body(x, scanned):
+        p, kp, vp, tk, tv = scanned
+        h = rmsnorm(x, p["attn_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
+        q = jnp.einsum("btd,dh->bth", h, p["wq"])
+        k = jnp.einsum("btd,dh->bth", h, p["wk"])
+        v = jnp.einsum("btd,dh->bth", h, p["wv"])
+        if cfg.attn_bias:
+            q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+        q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+        # all rows write the same tail slot (done rows deposit junk there;
+        # the causal mask excludes it — their frozen q_pos precedes it)
+        tk = jax.lax.dynamic_update_slice_in_dim(tk, k, step, axis=1)
+        tv = jax.lax.dynamic_update_slice_in_dim(tv, v, step, axis=1)
+        attn = paged_decode_attend(
+            q, kp, vp, tables, pool_lens, kv_off, tk, tv,
+            tail_len=step + 1, q_pos=positions[:, 0],
+            sliding_window=cfg.sliding_window)
+        x = x + jnp.einsum("bthd,hdD->btD", attn,
+                           p["wo"].reshape(cfg.n_heads, cfg.head_dim,
+                                           cfg.dim))
+        h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
+        gate = _activation(jnp.einsum("btd,df->btf", h, p["w_gate"]),
+                           cfg.activation)
+        up = jnp.einsum("btd,df->btf", h, p["w_up"])
+        x = x + jnp.einsum("btf,fd->btd", gate * up, p["w_down"])
+        return x, (tk, tv)
+
+    x, (new_tk, new_tv) = jax.lax.scan(
+        layer_body, x, (params["layers"], k_pool, v_pool, tail_k, tail_v))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
+    return x, new_tk, new_tv
+
+
 def project_logits(params: dict, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
     """Final hidden states [B, T, D] -> logits [B, T, vocab] fp32.
 

@@ -1,0 +1,337 @@
+"""Ragged paged decode attention (PAPERS.md: Ragged Paged Attention,
+arxiv 2604.15464 — pattern only, the kernel is written here for the
+engine's page-pool layout).
+
+The paged KV session cache (models/generate.py SessionStore) keeps every
+resident conversation as a PAGE LIST into one device pool. Until this op,
+decode still gathered each batch row's pages into a contiguous working
+cache ([B, maxp·page, ...] materialized in HBM) and attended over the
+PADDED length. Here decode reads the pool directly:
+
+  * the Pallas kernel walks each row's page table and streams only
+    ceil(kv_len/page) pages through VMEM (double-buffered HBM DMA) — work
+    is RAGGED, proportional to each row's real length, not the batch max;
+  * newly generated tokens land in a small contiguous TAIL buffer
+    ([B, max_new, ...]) whose attention is a dense partial;
+  * the two pieces merge by online-softmax statistics (m, l, acc) — the
+    same recipe ops/flash_attention.py uses across KV blocks.
+
+So the decode loop's memory high-water drops from pool + working cache to
+pool + tail, and a 32k-token session batch no longer materializes a second
+copy of itself per call (SURVEY §7 hard part 2; NOTES_r03 gap 2).
+
+Partial convention: (acc [.., hd] f32 UNNORMALIZED, m rowmax, l denom);
+empty sets give (0, NEG_INF, 0) — NEG_INF is finite so merging an empty
+partial is exact (exp(NEG_INF - NEG_INF) = 1 scales l = 0).
+
+No reference counterpart: the reference never executes attention
+(SURVEY.md §2.8 — all inference was remote HTTPS).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Partials: dense pieces + merge (plain XLA)
+# ---------------------------------------------------------------------------
+
+def _partials_from_scores(scores: jax.Array, mask: jax.Array,
+                          v: jax.Array) -> tuple:
+    """scores [B, KV, G, S], mask broadcastable to it, v [B, KV, S, hd] →
+    (acc [B, KV, G, hd], m [B, KV, G], l [B, KV, G]) f32 partials."""
+    scores = jnp.where(mask, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)
+    p = jnp.where(jnp.broadcast_to(mask, scores.shape),
+                  jnp.exp(scores - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bkgs,bksd->bkgd", p, v)
+    return acc, m, l
+
+
+def merge_partials(p1: tuple, p2: tuple) -> jax.Array:
+    """Combine two online-softmax partials → normalized output (f32)."""
+    a1, m1, l1 = p1
+    a2, m2, l2 = p2
+    m = jnp.maximum(m1, m2)
+    c1 = jnp.exp(m1 - m)
+    c2 = jnp.exp(m2 - m)
+    l = l1 * c1 + l2 * c2
+    acc = a1 * c1[..., None] + a2 * c2[..., None]
+    return acc / jnp.where(l > 0, l, 1.0)[..., None]
+
+
+def _grouped(q: jax.Array, n_kv: int) -> jax.Array:
+    """[B, H, hd] → [B, KV, G, hd] (GQA grouping, no repetition)."""
+    b, h, hd = q.shape
+    return q.reshape(b, n_kv, h // n_kv, hd)
+
+
+def tail_attend_partials(
+    q: jax.Array,          # [B, H, hd]
+    tail_k: jax.Array,     # [B, Tmax, KV, hd]
+    tail_v: jax.Array,     # [B, Tmax, KV, hd]
+    tail_len,              # scalar or [B] int32: valid tail entries
+    tail_pos0: jax.Array,  # [B] int32 absolute position of tail index 0
+    q_pos: jax.Array,      # [B] int32
+    sliding_window: Optional[int] = None,
+) -> tuple:
+    """Dense partials of the decode queries against the tail buffer."""
+    B, H, hd = q.shape
+    KV = tail_k.shape[2]
+    scale = hd ** -0.5
+    qg = _grouped(q.astype(jnp.float32) * scale, KV)     # [B, KV, G, hd]
+    k = tail_k.astype(jnp.float32).transpose(0, 2, 1, 3)  # [B, KV, T, hd]
+    v = tail_v.astype(jnp.float32).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bkgd,bktd->bkgt", qg, k)
+    idx = jnp.arange(tail_k.shape[1], dtype=jnp.int32)[None, :]   # [1, T]
+    tl = jnp.broadcast_to(jnp.asarray(tail_len, jnp.int32),
+                          (B,))[:, None]
+    kv_pos = tail_pos0.astype(jnp.int32)[:, None] + idx
+    mask = (idx < tl) & (kv_pos <= q_pos.astype(jnp.int32)[:, None])
+    if sliding_window is not None:
+        mask &= q_pos.astype(jnp.int32)[:, None] - kv_pos < sliding_window
+    mask = mask[:, None, None, :]                         # [B, 1, 1, T]
+    acc, m, l = _partials_from_scores(scores, mask, v)
+    return (acc.reshape(B, H, hd), m.reshape(B, H), l.reshape(B, H))
+
+
+# ---------------------------------------------------------------------------
+# Paged piece: XLA reference (gathers pages — CPU tests / fallback)
+# ---------------------------------------------------------------------------
+
+def paged_attend_ref(
+    q: jax.Array,          # [B, H, hd]
+    k_pages: jax.Array,    # [n_pages, page, KV, hd]
+    v_pages: jax.Array,
+    tables: jax.Array,     # [B, maxp] int32
+    kv_lens: jax.Array,    # [B] int32 valid POOL tokens per row
+    kv_off: jax.Array,     # [B] int32 absolute position of pool index 0
+    q_pos: jax.Array,      # [B] int32
+    sliding_window: Optional[int] = None,
+) -> tuple:
+    """Partials of q against the paged pool, via a page gather. Used off-TPU
+    and as the numerical oracle for the kernel."""
+    B, H, hd = q.shape
+    n_pages, page, KV, _ = k_pages.shape
+    maxp = tables.shape[1]
+    k = k_pages[tables].reshape(B, maxp * page, KV, hd)
+    v = v_pages[tables].reshape(B, maxp * page, KV, hd)
+    scale = hd ** -0.5
+    qg = _grouped(q.astype(jnp.float32) * scale, KV)
+    kT = k.astype(jnp.float32).transpose(0, 2, 1, 3)      # [B, KV, S, hd]
+    vT = v.astype(jnp.float32).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bkgd,bksd->bkgs", qg, kT)
+    idx = jnp.arange(maxp * page, dtype=jnp.int32)[None, :]
+    kv_pos = idx + kv_off.astype(jnp.int32)[:, None]
+    mask = (idx < kv_lens.astype(jnp.int32)[:, None]) \
+        & (kv_pos <= q_pos.astype(jnp.int32)[:, None])
+    if sliding_window is not None:
+        mask &= q_pos.astype(jnp.int32)[:, None] - kv_pos < sliding_window
+    mask = mask[:, None, None, :]
+    acc, m, l = _partials_from_scores(scores, mask, vT)
+    return (acc.reshape(B, H, hd), m.reshape(B, H), l.reshape(B, H))
+
+
+# ---------------------------------------------------------------------------
+# Paged piece: Pallas kernel (TPU)
+# ---------------------------------------------------------------------------
+
+def _paged_kernel(tables_ref, meta_ref, q_ref, k_hbm, v_hbm,
+                  acc_ref, stats_ref, k_scr, v_scr, sems, *,
+                  page: int, n_kv: int, hd: int, scale: float):
+    """One batch row: stream this row's pages through VMEM double-buffered.
+
+    Refs: tables_ref [B, maxp] / meta_ref [B, 4] (SMEM, scalar-prefetched;
+    meta = kv_len, kv_off, q_pos, qlo where qlo = q_pos - window, or
+    INT32_MIN); q_ref [1, H, hd] VMEM; k_hbm/v_hbm stay in HBM (ANY) as
+    [n_pages, page, KV·hd] — the kv-head axis is FLATTENED into the lane
+    dimension so every memref slice keeps Mosaic's (8, 128) tiling happy
+    for any head count (KV = 14 broke the [page, KV, hd] layout), and
+    per-head math uses static 128-aligned lane slices. The kernel DMAs
+    page blocks on demand: VMEM holds 2 pages, not the row's history.
+    """
+    b = pl.program_id(0)
+    kv_len = meta_ref[b, 0]
+    kv_off = meta_ref[b, 1]
+    q_pos = meta_ref[b, 2]
+    qlo = meta_ref[b, 3]
+    n = (kv_len + page - 1) // page                      # pages this row
+
+    q = q_ref[0].astype(jnp.float32) * scale             # [H, hd]
+    H = q.shape[0]
+    G = H // n_kv
+
+    def start_dma(j, slot):
+        pid = tables_ref[b, j]
+        pltpu.make_async_copy(k_hbm.at[pid], k_scr.at[slot],
+                              sems.at[slot, 0]).start()
+        pltpu.make_async_copy(v_hbm.at[pid], v_scr.at[slot],
+                              sems.at[slot, 1]).start()
+
+    def wait_dma(j, slot):
+        pid = tables_ref[b, j]
+        pltpu.make_async_copy(k_hbm.at[pid], k_scr.at[slot],
+                              sems.at[slot, 0]).wait()
+        pltpu.make_async_copy(v_hbm.at[pid], v_scr.at[slot],
+                              sems.at[slot, 1]).wait()
+
+    @pl.when(n > 0)
+    def _():
+        start_dma(0, 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < n)
+        def _():
+            start_dma(j + 1, jax.lax.rem(j + 1, 2))
+
+        wait_dma(j, slot)
+        k_blk = k_scr[slot].astype(jnp.float32)          # [page, KV·hd]
+        v_blk = v_scr[slot].astype(jnp.float32)
+        # per-kv-head static lane slices (hd is a 128 multiple)
+        scores = jnp.concatenate([
+            jax.lax.dot_general(                         # [G, page]
+                q[kv * G:(kv + 1) * G],
+                k_blk[:, kv * hd:(kv + 1) * hd],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            for kv in range(n_kv)], axis=0)              # [H, page]
+        idx = j * page + jax.lax.broadcasted_iota(jnp.int32, (1, page), 1)
+        pos = idx + kv_off
+        mask = (idx < kv_len) & (pos <= q_pos) & (pos > qlo)
+        scores = jnp.where(mask, scores, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(scores - m_new), 0.0)  # [H, page]
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+        pv = jnp.concatenate([
+            jax.lax.dot_general(                         # [G, hd]
+                p[kv * G:(kv + 1) * G],
+                v_blk[:, kv * hd:(kv + 1) * hd],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            for kv in range(n_kv)], axis=0)              # [H, hd]
+        acc_new = acc * corr + pv
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((H, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((H, 1), jnp.float32)
+    acc0 = jnp.zeros((H, hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n, body, (m0, l0, acc0))
+    acc_ref[0] = acc
+    # (m, l) share one [2, H] stats block — TPU block shapes require the
+    # trailing dims to tile or equal the array's, which a bare [1, H] block
+    # can't satisfy for small H.
+    stats_ref[0, 0] = m[:, 0]
+    stats_ref[0, 1] = l[:, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("sliding_window", "interpret"))
+def paged_attend(
+    q: jax.Array,          # [B, H, hd]
+    k_pages: jax.Array,    # [n_pages, page, KV, hd]
+    v_pages: jax.Array,
+    tables: jax.Array,     # [B, maxp] int32
+    kv_lens: jax.Array,    # [B] int32
+    kv_off: jax.Array,     # [B] int32
+    q_pos: jax.Array,      # [B] int32
+    sliding_window: Optional[int] = None,
+    interpret: bool = False,
+) -> tuple:
+    """Pallas partials of q against the paged pool (same contract as
+    paged_attend_ref; tests assert numerical agreement)."""
+    B, H, hd = q.shape
+    n_pages, page, KV, _ = k_pages.shape
+    # lane alignment: pad head_dim to 128. Production models (config.py
+    # catalog) all have hd = 128, so the pool pad below is a no-op there;
+    # tiny test models pay a copy, which only interpret/validation runs see.
+    hd_p = max(128, ((hd + 127) // 128) * 128)
+    if hd_p != hd:
+        q = jnp.pad(q, [(0, 0), (0, 0), (0, hd_p - hd)])
+        padkv = [(0, 0), (0, 0), (0, 0), (0, hd_p - hd)]
+        k_pages = jnp.pad(k_pages, padkv)
+        v_pages = jnp.pad(v_pages, padkv)
+    # Flatten kv-heads into the lane dim: [n_pages, page, KV·hd] keeps every
+    # Mosaic memref slice (8, 128)-tiled for ANY head count (KV = 14 is not
+    # sublane-tileable). Minor-dim merge → free bitcast, no data movement.
+    kf = k_pages.reshape(n_pages, page, KV * hd_p)
+    vf = v_pages.reshape(n_pages, page, KV * hd_p)
+    window = sliding_window
+    qlo = (q_pos.astype(jnp.int32) - jnp.int32(window) if window is not None
+           else jnp.full_like(q_pos, jnp.iinfo(jnp.int32).min))
+    meta = jnp.stack([kv_lens.astype(jnp.int32),
+                      kv_off.astype(jnp.int32),
+                      q_pos.astype(jnp.int32),
+                      qlo.astype(jnp.int32)], axis=1)     # [B, 4]
+    scale = hd ** -0.5
+
+    kernel = functools.partial(_paged_kernel, page=page, n_kv=KV, hd=hd_p,
+                               scale=scale)
+    acc, stats = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,                        # tables, meta
+            grid=(B,),
+            in_specs=[
+                pl.BlockSpec((1, H, hd_p), lambda b, *_: (b, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.ANY),     # k pool in HBM
+                pl.BlockSpec(memory_space=pltpu.ANY),     # v pool in HBM
+            ],
+            out_specs=[
+                pl.BlockSpec((1, H, hd_p), lambda b, *_: (b, 0, 0)),
+                pl.BlockSpec((1, 2, H), lambda b, *_: (b, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, page, KV * hd_p), k_pages.dtype),
+                pltpu.VMEM((2, page, KV * hd_p), v_pages.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, hd_p), jnp.float32),
+            jax.ShapeDtypeStruct((B, 2, H), jnp.float32),
+        ],
+        interpret=interpret,
+    )(tables.astype(jnp.int32), meta, q, kf, vf)
+    return acc[..., :hd], stats[:, 0], stats[:, 1]
+
+
+def paged_decode_attend(
+    q: jax.Array,          # [B, 1, H, hd] (decode step)
+    k_pages: jax.Array,    # [n_pages, page, KV, hd]
+    v_pages: jax.Array,
+    tables: jax.Array,
+    pool_lens: jax.Array,  # [B] valid pool tokens (fixed through decode)
+    kv_off: jax.Array,     # [B] absolute position of pool index 0
+    tail_k: jax.Array,     # [B, Tmax, KV, hd]
+    tail_v: jax.Array,
+    tail_len,              # scalar/[B] valid tail entries (incl. current)
+    q_pos: jax.Array,      # [B] absolute query position
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """Full decode attention = paged pool piece ⊕ tail piece → [B, 1, H, hd]
+    in q.dtype. Picks the Pallas kernel on TPU, the gather reference
+    elsewhere (CPU tests — same numerics, no paging win)."""
+    B, _, H, hd = q.shape
+    q1 = q[:, 0]
+    on_tpu = jax.devices()[0].platform == "tpu"
+    fn = paged_attend if on_tpu else paged_attend_ref
+    pooled = fn(q1, k_pages, v_pages, tables, pool_lens, kv_off, q_pos,
+                sliding_window)
+    tail_pos0 = kv_off.astype(jnp.int32) + pool_lens.astype(jnp.int32)
+    tail = tail_attend_partials(q1, tail_k, tail_v, tail_len, tail_pos0,
+                                q_pos, sliding_window)
+    out = merge_partials(pooled, tail)                   # [B, H, hd] f32
+    return out[:, None].astype(q.dtype)

@@ -1,0 +1,120 @@
+"""Long-context decode micro-benchmark: ragged paged kernel vs gather.
+
+The main bench (bench.py) measures consensus rounds at ~1-2k resident
+tokens, where the fused gather decode wins (the ragged kernel pays ~16
+pallas launches per token — models/generate.py `direct_decode_min_tokens`
+gate). This tool measures the regime the kernel exists for: a LONG
+resident session resumed for short decodes, where the gather path
+materializes a [B, maxp·page] working cache and attends over the padded
+length every step while the kernel reads only the row's real pages.
+
+Run on the TPU host (ONE python process; keeps /root/.axon_site on
+PYTHONPATH):
+
+    PYTHONPATH=/root/repo:/root/.axon_site python -m \
+        quoracle_tpu.tools.bench_longctx --resident 16384 --rounds 4
+
+Prints one JSON line: p50 resumed-round ms for each decode path at the
+given resident size. Uses the bench llama-1b checkpoint with a widened
+catalog window (perf measurement only — RoPE beyond the family's trained
+window is numerically fine and irrelevant to timing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import statistics
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--resident", type=int, default=16384,
+                    help="target resident session size in tokens")
+    ap.add_argument("--rounds", type=int, default=4,
+                    help="timed resumed rounds per path")
+    ap.add_argument("--new-tokens", type=int, default=32)
+    ap.add_argument("--scale", default="1b", choices=["1b", "tiny"])
+    args = ap.parse_args()
+
+    import jax
+
+    from quoracle_tpu.models.config import register_model
+    from quoracle_tpu.models.generate import GenerateEngine
+    from quoracle_tpu.models.loader import (
+        load_params, register_hf_checkpoint, to_device,
+    )
+    from quoracle_tpu.models.make_checkpoint import make_checkpoint
+    from quoracle_tpu.models.tokenizer import get_tokenizer
+
+    root = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "checkpoints")
+    ckpt = make_checkpoint(os.path.join(root, f"llama-{args.scale}"),
+                           family="llama", scale=args.scale)
+    base = register_hf_checkpoint(ckpt, name="longctx-base")
+    max_seq = args.resident + 4 * args.new_tokens * (args.rounds + 2) + 1024
+    cfg = register_model(dataclasses.replace(
+        base, name="longctx", context_window=max_seq))
+    tok = get_tokenizer("xla:longctx")
+    params = to_device(load_params(ckpt, cfg))
+    eng = GenerateEngine(
+        cfg, params, tok, max_seq=max_seq,
+        prompt_buckets=(1024, args.resident, max_seq),
+        session_max_bytes=8 << 30)
+    log(f"engine ready; resident target {args.resident} tokens")
+
+    # Build the resident session with one long prefill.
+    filler = ("The quick brown fox jumps over the lazy dog. "
+              "Numbers: 0123456789. ")
+    ids = tok.encode(filler)
+    prompt = (ids * (args.resident // len(ids) + 1))[:args.resident - 1]
+    prompt = [tok.bos_id] + prompt
+    t0 = time.monotonic()
+    r = eng.generate([prompt], temperature=0.0,
+                     max_new_tokens=args.new_tokens, session_ids=["s"])[0]
+    log(f"prefill of {len(prompt)} tokens: {time.monotonic() - t0:.1f}s")
+
+    results = {}
+    conv = list(prompt) + r.token_ids
+    for path, setup in (("gather", lambda: setattr(
+            eng, "_force_gather_decode", True)),
+            ("direct_kernel", lambda: (
+                setattr(eng, "_force_gather_decode", False),
+                setattr(eng, "direct_decode_min_tokens", 0)))):
+        setup()
+        lats = []
+        for i in range(args.rounds + 1):       # first = warmup/compile
+            nxt = conv + tok.encode(f" continue {path} {i}.")
+            t0 = time.monotonic()
+            rr = eng.generate([nxt], temperature=0.0,
+                              max_new_tokens=args.new_tokens,
+                              session_ids=["s"])[0]
+            lats.append((time.monotonic() - t0) * 1000)
+            conv = nxt + rr.token_ids
+            log(f"{path} round {i}: {lats[-1]:.0f}ms "
+                f"(reused {rr.n_cached_tokens} tokens)")
+        results[path] = {
+            "p50_round_ms": statistics.median(lats[1:]),
+            "rounds": args.rounds,
+        }
+
+    print(json.dumps({
+        "metric": "longctx_resumed_round_p50",
+        "resident_tokens": args.resident,
+        "new_tokens_per_round": args.new_tokens,
+        **{f"{k}_p50_ms": round(v["p50_round_ms"], 1)
+           for k, v in results.items()},
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -196,6 +196,80 @@ def test_session_budget_derived_from_bytes():
     assert 512 <= small.sessions.max_tokens <= 2048
 
 
+def test_splice_recovers_response_ids():
+    """Refinement re-encodes the assistant text, so the plain token LCP dies
+    at the previous prompt's end when gen ids don't re-encode identically
+    (out-of-tokenizer-range ids here; BPE boundary merges in general). The
+    splice keeps the session's ACTUAL ids for the shared text and re-encodes
+    only the new suffix."""
+    from quoracle_tpu.models.generate import splice_session_prompt
+    tok = ByteTokenizer()
+    render1 = "<|user|>\nhi\n<|assistant|>\n"
+    p1 = tok.encode(render1, add_bos=True)
+    gen = [ord("H") + 3, 300, ord("i") + 3]     # "Hi" + out-of-range id
+    sess = p1 + gen
+    raw = tok.decode(gen)
+    assert raw == "Hi"
+    p2 = tok.encode(render1 + raw + "\n<|user|>\nrefine\n<|assistant|>\n",
+                    add_bos=True)
+    assert _lcp(sess, p2) < len(sess)           # plain ids miss the response
+    spliced = splice_session_prompt(tok, sess, p2)
+    assert spliced is not None
+    assert spliced[:len(sess)] == sess          # full session reuse
+    assert tok.decode_raw(spliced) == tok.decode_raw(p2)  # same text
+
+
+def test_splice_no_gain_returns_none():
+    """Divergence at the TEXT level (condensation rewrote history): the
+    shared text prefix equals the plain token LCP on a reversible
+    tokenizer, so splicing buys nothing and must return None."""
+    from quoracle_tpu.models.generate import splice_session_prompt
+    tok = ByteTokenizer()
+    sys_part = "<|system|>\nstable\n<|user|>\n"
+    sess = tok.encode(sys_part + "old history\n", add_bos=True) + [300]
+    p2 = tok.encode(sys_part + "condensed summary\n<|assistant|>\n",
+                    add_bos=True)
+    assert splice_session_prompt(tok, sess, p2) is None
+
+
+def test_splice_identical_conversation_keeps_one_suffix_token():
+    """canonical == session text: the splice must back off so >= 1 suffix
+    token still runs through prefill (last-position logits)."""
+    from quoracle_tpu.models.generate import splice_session_prompt
+    tok = ByteTokenizer()
+    p1 = tok.encode("<|user|>\nsame\n<|assistant|>\n", add_bos=True)
+    sess = list(p1)
+    spliced = splice_session_prompt(tok, sess, list(p1))
+    # plain ids already match everywhere -> nothing to gain
+    assert spliced is None
+
+
+def test_backend_splices_response_kv(monkeypatch):
+    """Consensus-shaped round 2 (history + assistant raw text + refinement
+    message) through TPUBackend: prefill must run only the new template
+    glue + refinement message — the response KV resumes from the session
+    even though re-encoding the response text yields different ids."""
+    from quoracle_tpu.models.runtime import QueryRequest, TPUBackend
+    backend = TPUBackend(pool=["xla:tiny"])
+    eng = backend.engines["xla:tiny"]
+    msgs = [{"role": "user", "content": "round one"}]
+    r1 = backend.query([QueryRequest("xla:tiny", msgs, temperature=1.0,
+                                     max_tokens=24, session_id="ag")])[0]
+    assert r1.ok and r1.text
+    sess_len = len(eng.session_tokens("ag"))
+    msgs2 = msgs + [{"role": "assistant", "content": r1.text},
+                    {"role": "user", "content": "refine"}]
+    r2 = backend.query([QueryRequest("xla:tiny", msgs2, temperature=0.0,
+                                     max_tokens=6, session_id="ag")])[0]
+    assert r2.ok
+    # new text = (up to one length-capped trailing token's chars) +
+    # "\n" + "<|user|>\nrefine\n<|assistant|>\n"
+    glue = len(eng.tokenizer.encode("\n<|user|>\nrefine\n<|assistant|>\n"))
+    assert eng.last_prefill_tokens <= glue + 8
+    # and the resident session grew on top of the old one, not from scratch
+    assert len(eng.session_tokens("ag")) > sess_len
+
+
 def test_drop_session_frees_engine_state():
     from quoracle_tpu.models.runtime import QueryRequest, TPUBackend
     backend = TPUBackend(pool=["xla:tiny"])

@@ -243,3 +243,38 @@ def test_mode_requires_limit():
     esc = Escrow()
     with pytest.raises(BudgetError):
         esc.register("r", mode="root", limit=None)
+
+
+# ---------------------------------------------------------------------------
+# Token-level session splicing (models/generate.splice_session_prompt)
+# ---------------------------------------------------------------------------
+
+_texts = st.text(alphabet=st.characters(min_codepoint=32, max_codepoint=126),
+                 min_size=0, max_size=60)
+_gen_ids = st.lists(st.integers(3, 400), min_size=0, max_size=24)
+
+
+@settings(max_examples=60, deadline=None)
+@given(prev=_texts, resp_ids=_gen_ids, nxt=_texts)
+def test_splice_preserves_text_and_session_prefix(prev, resp_ids, nxt):
+    """For any conversation shape (previous rendered text, actual sampled
+    response ids — including ids outside the tokenizer's range — and a new
+    suffix), a successful splice must (a) decode to exactly the same text
+    as the plain encoding, (b) start with a prefix of the session's own
+    ids at least as long as the plain LCP, and (c) keep >= 1 suffix token."""
+    from quoracle_tpu.models.generate import _lcp, splice_session_prompt
+    from quoracle_tpu.models.tokenizer import ByteTokenizer
+    tok = ByteTokenizer()
+    sess = tok.encode(prev, add_bos=True) + list(resp_ids)
+    plain = tok.encode(prev + tok.decode(resp_ids) + nxt, add_bos=True)
+    spliced = splice_session_prompt(tok, sess, plain)
+    if spliced is None:
+        return
+    assert tok.decode_raw(spliced) == tok.decode_raw(plain)       # (a)
+    k = _lcp(sess, spliced)
+    assert k >= _lcp(sess, plain)                                 # (b)
+    assert spliced[:k] == sess[:k]
+    # A spliced prompt may equal the WHOLE session when the re-encoded
+    # suffix reproduces the session's own ids; the engine caps reuse at
+    # len(prompt)-1 so >= 1 token still runs through prefill.
+    assert len(spliced) >= 1                                      # (c)
