@@ -18,6 +18,10 @@ prior conversation — with sessions on, only the new suffix prefills
   config 2 — 3-model consensus pool, single agent turn (3 rounds)  [headline]
   config 3 — 3 agents deciding concurrently, 3-model pool, one round each
              (rows batch per pool member)
+  config 4 — embedding + retrieval (LessonManager shape): embed new lessons
+             on-device and cosine-search a stored lesson matrix
+  config 5 — vision: a VLM checkpoint (ViT tower + soft-token splice) joins
+             the pool and every round's task carries an image part
 
 ``vs_baseline`` divides the estimated hosted-API 3-model round p50 by the
 measured config-2 p50. The estimate is DERIVED in BASELINE.md (per-call
@@ -75,18 +79,43 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def ensure_checkpoints() -> list[str]:
+def ensure_checkpoints(families=None) -> list[str]:
     from quoracle_tpu.models.make_checkpoint import make_bench_checkpoints
     root = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                         "checkpoints")
     t0 = time.monotonic()
-    dirs = make_bench_checkpoints(root, scale=SCALE, families=FAMILIES)
+    dirs = make_bench_checkpoints(root, scale=SCALE,
+                                  families=families or FAMILIES)
     log(f"checkpoints ready in {time.monotonic() - t0:.1f}s: {dirs}")
     return dirs
 
 
+def bench_image_b64() -> str:
+    """A deterministic in-memory PNG for the vision config (no asset files;
+    the C++ decode/resize path still runs on it)."""
+    import base64
+
+    import numpy as np
+
+    from quoracle_tpu.models.images import write_png
+    rng = np.random.default_rng(7)
+    w = h = 224
+    # structured, not pure noise: gradients + blocks so resize/normalize do
+    # real work
+    y, x = np.mgrid[0:h, 0:w]
+    img = np.stack([(x * 255 / w), (y * 255 / h),
+                    ((x // 32 + y // 32) % 2) * 255], axis=-1)
+    img = (img + rng.integers(0, 32, img.shape)).clip(0, 255).astype(np.uint8)
+    import tempfile
+    with tempfile.NamedTemporaryFile(suffix=".png") as f:
+        write_png(f.name, img.tobytes(), w, h)
+        f.seek(0)
+        return base64.b64encode(f.read()).decode()
+
+
 def run_cycle(backend, pool, session_prefix: str, task: str,
-              n_agents: int = 1, rounds: int = ROUNDS_PER_CYCLE):
+              n_agents: int = 1, rounds: int = ROUNDS_PER_CYCLE,
+              image_b64: str = None):
     """One simulated agent turn: initial round + refinement rounds that
     extend each member's own conversation (consensus/engine.py shape).
     Returns per-round stats dicts."""
@@ -99,9 +128,15 @@ def run_cycle(backend, pool, session_prefix: str, task: str,
               '"wait": false}. Available actions: send_message, todo, wait, '
               "orient, spawn_child, execute_shell, file_read, file_write, "
               "fetch_web, call_api, batch_sync, dismiss_child.")
-    # per (agent, member) conversation, as the consensus engine keeps them
+    # per (agent, member) conversation, as the consensus engine keeps them.
+    # With an image, the task message is multimodal: VLM members splice the
+    # ViT soft tokens, text members see the stringified "[image]" marker —
+    # the same message set serves the whole pool (runtime._encode_multimodal).
+    task_content = ([{"type": "text", "text": task},
+                     {"type": "image_base64", "data": image_b64}]
+                    if image_b64 else task)
     convs = {(a, m): [{"role": "system", "content": system},
-                      {"role": "user", "content": task}]
+                      {"role": "user", "content": task_content}]
              for a in range(n_agents) for m in pool}
     stats = []
     for rnd in range(1, rounds + 1):
@@ -141,13 +176,15 @@ def run_cycle(backend, pool, session_prefix: str, task: str,
 
 
 def measure_config(backend, pool, name: str, n_agents: int = 1,
-                   rounds: int = ROUNDS_PER_CYCLE) -> dict:
+                   rounds: int = ROUNDS_PER_CYCLE,
+                   image_b64: str = None) -> dict:
     all_rounds = []
     t_all = time.monotonic()
     for c in range(N_CYCLES):
         task = TASKS[c % len(TASKS)]
         rs = run_cycle(backend, pool, f"{name}-c{c}", task,
-                       n_agents=n_agents, rounds=rounds)
+                       n_agents=n_agents, rounds=rounds,
+                       image_b64=image_b64)
         all_rounds.extend(rs)
         log(f"{name} cycle {c}: " + "  ".join(
             f"r{s['round']} {s['wall_ms']:.0f}ms"
@@ -164,6 +201,7 @@ def measure_config(backend, pool, name: str, n_agents: int = 1,
     med_tokens = statistics.median(s["gen_tokens"] for s in all_rounds)
     steady_tps = med_tokens / (statistics.median(lat) / 1000.0)
     return {
+        "rounds": all_rounds,
         "steady_tokens_per_sec": steady_tps,
         "p50_round_ms": statistics.median(lat),
         "p50_round1_ms": statistics.median(r1),
@@ -175,6 +213,56 @@ def measure_config(backend, pool, name: str, n_agents: int = 1,
         "decode_s": sum(s["decode_s"] for s in all_rounds),
         "prefill_tokens": sum(s["prefill_tokens"] for s in all_rounds),
         "prompt_tokens": sum(s["prompt_tokens"] for s in all_rounds),
+    }
+
+
+def measure_embed_retrieval(backend) -> dict:
+    """Config 4: the LessonManager / skills-retrieval shape
+    (context/lessons.py; reference agent AGENTS.md lesson dedup): embed a
+    batch of new lesson texts on the on-device encoder and cosine-search a
+    stored lesson matrix (100 lessons/model is the reference's prune
+    bound). Measures the consensus-critical-path embedding latency —
+    semantic-similarity merge rules call this during clustering
+    (SURVEY §7 hard part 6)."""
+    import numpy as np
+    store_texts = [
+        f"Lesson {i}: when {t.lower()} fails, prefer retrying with a "
+        f"narrower scope and report the delta to the parent."
+        for i, t in enumerate(TASKS * 20)
+    ][:100]
+    queries = [
+        "The shell command timed out; what did we learn about retries?",
+        "Parent asked for a status update format.",
+        "Deployment order disagreements between children.",
+        "Budget overruns near the end of a task.",
+        "Which files matter most in this repository?",
+        "How to investigate test failures effectively.",
+        "When to spawn a child vs do the work inline.",
+        "Compressing long histories without losing decisions.",
+    ]
+    t0 = time.monotonic()
+    M = np.stack(backend.embed(store_texts))
+    M /= np.linalg.norm(M, axis=1, keepdims=True) + 1e-9
+    build_s = time.monotonic() - t0
+    lats = []
+    for it in range(1 + N_CYCLES):          # first iteration = warmup
+        # unique per iteration: the encoder's SHA-keyed TTL cache would
+        # otherwise serve repeats host-side and measure nothing
+        qs = [f"[turn {it}] {q}" for q in queries]
+        t0 = time.monotonic()
+        q = np.stack(backend.embed(qs))
+        q /= np.linalg.norm(q, axis=1, keepdims=True) + 1e-9
+        sims = q @ M.T
+        top = np.argsort(-sims, axis=1)[:, :5]
+        assert top.shape == (len(queries), 5)
+        lats.append((time.monotonic() - t0) * 1000.0)
+    lats = lats[1:]
+    return {
+        "p50_embed_retrieve_ms": statistics.median(lats),
+        "store_size": len(store_texts),
+        "queries_per_batch": len(queries),
+        "store_build_s": build_s,
+        "texts_per_sec": len(queries) / (statistics.median(lats) / 1000.0),
     }
 
 
@@ -192,7 +280,15 @@ def main() -> None:
                     help="capture a JAX/XLA profiler trace of one measured "
                          "config-2 cycle into DIR (view with "
                          "tensorboard/xprof; SURVEY §5 tracing)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny-scale end-to-end smoke (CPU-friendly): same "
+                         "code path, meaningless numbers")
     args = ap.parse_args()
+
+    global SCALE, FAMILIES, N_CYCLES, MAX_NEW
+    if args.smoke:
+        SCALE, FAMILIES, N_CYCLES, MAX_NEW = \
+            "tiny", ["llama", "gemma"], 1, 16
 
     devs = jax.devices()
     n_chips = len(devs)
@@ -225,9 +321,14 @@ def main() -> None:
 
     # warmup: compile each member's (prefill, decode) buckets for every
     # measured shape — the B=1 rounds (configs 1-2) AND config 3's
-    # batch-of-3 rows per member
+    # batch-of-3 rows per member. TWO full cycles: a growing conversation
+    # crosses prompt/cache shape buckets in later rounds, and a bucket
+    # first seen mid-measurement costs a 15-20s XLA compile inside a
+    # measured round (the per-round medians below are robust to stragglers,
+    # but covering the buckets up front keeps the tail honest too).
     t0 = time.monotonic()
     run_cycle(backend, pool, "warmup", TASKS[0])
+    run_cycle(backend, pool, "warmup2", max(TASKS, key=len))
     run_cycle(backend, pool, "warmup3", TASKS[0], n_agents=3, rounds=1)
     log(f"warmup (compiles) {time.monotonic() - t0:.1f}s")
 
@@ -241,28 +342,62 @@ def main() -> None:
     cfg1 = measure_config(backend, [pool[0]], "config1")
     cfg2 = measure_config(backend, pool, "config2")
     cfg3 = measure_config(backend, pool, "config3", n_agents=3, rounds=1)
+    cfg4 = measure_embed_retrieval(backend)
+    log(f"config4: {cfg4}")
+
+    # config 5: vision pool — free the trio's HBM first (weights + KV page
+    # pools), then serve llama + the VLM checkpoint with an image-carrying
+    # task. The VLM member runs the ViT tower inside the prefill jit.
+    import gc
+    first_member = pool[0]
+    del backend
+    gc.collect()
+    from quoracle_tpu.models.loader import register_hf_checkpoint as _reg
+    vlm_dir = ensure_checkpoints(families=["vlm"])[0]
+    vcfg = _reg(vlm_dir)
+    pool5 = [first_member, f"xla:{vcfg.name}"]
+    log(f"config5 pool: {pool5}")
+    t0 = time.monotonic()
+    backend5 = TPUBackend(pool5, overlap=(n_chips > 1))
+    log(f"vision backend ready in {time.monotonic() - t0:.1f}s")
+    img = bench_image_b64()
+    run_cycle(backend5, pool5, "warmup5", TASKS[0], image_b64=img)
+    cfg5 = measure_config(backend5, pool5, "config5", image_b64=img)
+    del backend5
+    gc.collect()
 
     # Decode-phase roofline: every decoded token streams the member's full
     # bf16 weights from HBM (batch 1 per member). Utilization uses summed
     # per-member device decode time (members serialize on one chip).
+    # MEDIAN over rounds, not totals: a round that first touches a new
+    # shape bucket pays a 15-20s XLA compile inside its decode fence, and
+    # a total-based rate would report that as bandwidth collapse.
     avg_param_gb = sum(param_bytes.values()) / len(param_bytes) / 1e9
-    per_member_tokens = cfg2["gen_tokens"] / len(pool)
-    decode_gb = sum(per_member_tokens * b for b in param_bytes.values()) / 1e9
-    bw_gbps = decode_gb / max(cfg2["decode_s"], 1e-9)
+    sum_param_b = sum(param_bytes.values())
+    per_round_bw = [
+        (s["gen_tokens"] / len(pool)) * sum_param_b / 1e9 / s["decode_s"]
+        for s in cfg2["rounds"] if s["decode_s"] > 0]
+    bw_gbps = statistics.median(per_round_bw) if per_round_bw else 0.0
     util = bw_gbps / peak_gbps if peak_gbps else None
     # Prefill MFU: forward FLOPs ≈ 2 · params · tokens actually prefilled
-    # (suffix after KV residency), against the chip's bf16 peak.
+    # (suffix after KV residency), against the chip's bf16 peak. With the
+    # session splice resident prefixes cover ~70% of prompts, so measured
+    # chunks are a few hundred tokens — small enough that fixed dispatch
+    # overhead, not the MXU, bounds this number (see BASELINE.md).
     n_params = {s: b / 2 for s, b in param_bytes.items()}   # bf16: 2 B/param
-    prefill_flops = (cfg2["prefill_tokens"] / len(pool)) * sum(
-        2 * p for p in n_params.values())
-    mfu = (prefill_flops / max(cfg2["prefill_s"], 1e-9)
-           / (peak_tflops * 1e12)) if peak_tflops else None
+    per_round_mfu = [
+        (s["prefill_tokens"] / len(pool)) * sum(2 * p for p in
+                                                n_params.values())
+        / s["prefill_s"] / (peak_tflops * 1e12)
+        for s in cfg2["rounds"] if s["prefill_s"] > 0] if peak_tflops else []
+    mfu = statistics.median(per_round_mfu) if per_round_mfu else None
 
     p50 = cfg2["p50_round_ms"]
     tps_chip = cfg2["tokens_per_sec"] / max(1, n_chips)
     residency_saved = 1.0 - (cfg2["prefill_tokens"]
                              / max(1, cfg2["prompt_tokens"]))
-    log(json.dumps({"config1": cfg1, "config2": cfg2, "config3": cfg3},
+    log(json.dumps({"config1": cfg1, "config2": cfg2, "config3": cfg3,
+                    "config4": cfg4, "config5": cfg5},
                    indent=1, default=str))
     print(json.dumps({
         "metric": "consensus_round_p50_latency",
@@ -285,6 +420,10 @@ def main() -> None:
         "avg_model_gb": round(avg_param_gb, 2),
         "config1_p50_ms": round(cfg1["p50_round_ms"], 1),
         "config3_p50_ms": round(cfg3["p50_round_ms"], 1),
+        "config4_embed_retrieve_p50_ms": round(
+            cfg4["p50_embed_retrieve_ms"], 1),
+        "config5_p50_ms": round(cfg5["p50_round_ms"], 1),
+        "config5_steady_tps": round(cfg5["steady_tokens_per_sec"], 1),
         "n_chips": n_chips,
         "device_kind": kind,
         "pool": pool,
