@@ -55,7 +55,8 @@ async def cmd_run(args: argparse.Namespace) -> int:
     pool = args.pool.split(",") if args.pool else None
     rt = Runtime(RuntimeConfig(db_path=args.db, backend=args.backend,
                                model_pool=pool,
-                               checkpoints=args.checkpoints, tp=args.tp))
+                               checkpoints=args.checkpoints, tp=args.tp,
+                               image_backend=args.image_backend))
     _attach_printer(rt)
     if pool is None and args.profile is None:
         pool = rt.default_pool()
@@ -76,7 +77,8 @@ async def cmd_run(args: argparse.Namespace) -> int:
 
 async def cmd_resume(args: argparse.Namespace) -> int:
     rt = Runtime(RuntimeConfig(db_path=args.db, backend=args.backend,
-                               checkpoints=args.checkpoints, tp=args.tp))
+                               checkpoints=args.checkpoints, tp=args.tp,
+                               image_backend=args.image_backend))
     _attach_printer(rt)
     result = await rt.boot()
     print(json.dumps(result), flush=True)
@@ -94,7 +96,8 @@ async def cmd_serve(args: argparse.Namespace) -> int:
     rt = Runtime(RuntimeConfig(
         db_path=args.db, backend=args.backend,
         model_pool=args.pool.split(",") if args.pool else None,
-        checkpoints=args.checkpoints, tp=args.tp))
+        checkpoints=args.checkpoints, tp=args.tp,
+        image_backend=args.image_backend))
     # Validate host/token BEFORE boot so a refused bind exits with a clean
     # message instead of a traceback over a half-started runtime.
     try:
@@ -144,6 +147,11 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--tp", type=int, default=None,
                         help="tensor-parallel size per pool member on "
                              "multi-chip slices")
+        sp.add_argument("--image-backend", dest="image_backend",
+                        choices=["procedural", "diffusion"],
+                        default="procedural",
+                        help="generate_images backend: placeholder PNGs or "
+                             "the on-device diffusion model")
 
     runp = sub.add_parser("run", help="create a task and watch it")
     runp.add_argument("description")

@@ -49,6 +49,11 @@ class RuntimeConfig:
     # sub-meshes (parallel.mesh.pool_submeshes) and members overlap from
     # host threads; on one chip this is ignored.
     tp: Optional[int] = None
+    # generate_images backend: "procedural" (deterministic placeholder
+    # PNGs, zero compute) or "diffusion" (on-device UNet + DDIM sampler,
+    # models/diffusion.py — the TPU-native analog of the reference's hosted
+    # image models, image_query.ex:1-12).
+    image_backend: str = "procedural"
 
 
 class Runtime:
@@ -80,7 +85,12 @@ class Runtime:
         self.skills = SkillsLoader(global_dir=skills_dir)
         from quoracle_tpu.infra.http import urllib_http
         from quoracle_tpu.infra.mcp import MCPManager
-        from quoracle_tpu.models.images import ProceduralImageBackend
+        if config.image_backend == "diffusion":
+            from quoracle_tpu.models.diffusion import DiffusionImageBackend
+            images = DiffusionImageBackend(seed=config.seed)
+        else:
+            from quoracle_tpu.models.images import ProceduralImageBackend
+            images = ProceduralImageBackend()
         self.mcp = MCPManager(self.store.get_setting("mcp_servers") or {})
         self.deps = AgentDeps(
             backend=self.backend, registry=self.registry, supervisor=None,
@@ -89,7 +99,7 @@ class Runtime:
             persistence=self.store, skills=self.skills,
             http=urllib_http,
             ssrf_check=bool(self.store.get_setting("ssrf_check", True)),
-            mcp=self.mcp, images=ProceduralImageBackend())
+            mcp=self.mcp, images=images)
         self.supervisor = AgentSupervisor(self.deps)
         self.tasks = TaskManager(self.deps, self.store)
         self.store.attach_bus(self.bus)
