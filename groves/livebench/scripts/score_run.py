@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Score a LiveBench grove run (reference priv/groves/livebench scoring
+equivalent, done in-tree with zero LLM judging).
+
+    --prepare            copy data/ (keys + checks stripped) into the workspace
+    --run RUN_ID         score runs/RUN_ID/answers/*.json against the key
+    --workspace DIR      override the grove's workspace
+
+Graders are mechanical per answer_type:
+  exact    — case/whitespace/punctuation-normalized string equality
+  numeric  — float equality (1e-6), commas tolerated
+  checks   — every programmatic check passes (word_count / max_words /
+             contains / n_lines / no_digits) — the LiveBench
+             instruction-following recipe
+
+Writes runs/RUN_ID/score.json: per-category and overall accuracy. The
+prepare/score/CLI skeleton is shared with the other benchmark groves
+(quoracle_tpu/governance/bench_scoring.py); this script supplies only the
+LiveBench grading.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+GROVE_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO = os.path.dirname(os.path.dirname(GROVE_DIR))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from quoracle_tpu.governance import bench_scoring as _bs  # noqa: E402
+
+DEFAULT_WORKSPACE = os.path.expanduser(
+    "~/.quoracle_tpu/benchmarks/livebench")
+SECRET_FIELDS = ("answer", "answer_type", "checks")
+
+
+def load_questions() -> list[dict]:
+    return _bs.load_questions(GROVE_DIR)
+
+
+def _norm(s: str) -> str:
+    return " ".join(s.lower().split()).strip(" .!?'\"")
+
+
+def _check(c: dict, text: str) -> bool:
+    kind = c["type"]
+    words = text.split()
+    if kind == "word_count":
+        return len(words) == c["n"]
+    if kind == "max_words":
+        return len(words) <= c["n"]
+    if kind == "contains":
+        return c["text"].lower() in text.lower()
+    if kind == "n_lines":
+        return len([ln for ln in text.splitlines() if ln.strip()]) == c["n"]
+    if kind == "no_digits":
+        return not any(ch.isdigit() for ch in text)
+    raise ValueError(f"unknown check type {kind!r}")
+
+
+def grade(q: dict, got) -> bool:
+    if not isinstance(got, str) or not got.strip():
+        return False
+    t = q["answer_type"]
+    if t == "exact":
+        return _norm(got) == _norm(q["answer"])
+    if t == "numeric":
+        try:
+            return abs(float(got.replace(",", "").strip())
+                       - float(q["answer"])) < 1e-6
+        except ValueError:
+            return False
+    if t == "checks":
+        return all(_check(c, got.strip()) for c in q["checks"])
+    raise ValueError(f"unknown answer_type {t!r}")
+
+
+def prepare(workspace: str) -> None:
+    _bs.prepare(workspace, GROVE_DIR, SECRET_FIELDS)
+
+
+def score(workspace: str, run_id: str) -> dict:
+    return _bs.score(workspace, run_id, GROVE_DIR, grade,
+                     group_key="category", group_field="per_category")
+
+
+def main() -> int:
+    return _bs.run_cli(GROVE_DIR, DEFAULT_WORKSPACE, grade,
+                       group_key="category", group_field="per_category",
+                       secret_fields=SECRET_FIELDS, doc=__doc__)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

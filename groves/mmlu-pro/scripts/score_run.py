@@ -2,96 +2,55 @@
 """Score an MMLU-Pro grove run (reference priv/groves/mmlu-pro/scripts/
 score-run.sh equivalent, done in-tree).
 
-    --prepare            copy data/ into the workspace, create runs/
+    --prepare            copy data/ (answer key stripped) into the workspace
     --run RUN_ID         score runs/RUN_ID/answers/*.json against the key
     --workspace DIR      override the grove's workspace
 
-Writes runs/RUN_ID/score.json: per-subject and overall accuracy. The
-answer key never enters the agent workspace's answers dir — scoring reads
-it from the grove's own data file.
+Grading is exact letter match (A-J). Writes runs/RUN_ID/score.json:
+per-subject and overall accuracy. The answer key never enters the agent
+workspace — scoring reads it from the grove's own data file. The
+prepare/score/CLI skeleton is shared with the other benchmark groves
+(quoracle_tpu/governance/bench_scoring.py); this script supplies only the
+MMLU-specific grading.
 """
 
 from __future__ import annotations
 
-import argparse
-import json
 import os
-import shutil
 import sys
 
 GROVE_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_REPO = os.path.dirname(os.path.dirname(GROVE_DIR))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from quoracle_tpu.governance import bench_scoring as _bs  # noqa: E402
+
 DEFAULT_WORKSPACE = os.path.expanduser("~/.quoracle_tpu/benchmarks/mmlu-pro")
+SECRET_FIELDS = ("answer",)
 
 
 def load_questions() -> list[dict]:
-    with open(os.path.join(GROVE_DIR, "data", "questions.jsonl")) as f:
-        return [json.loads(line) for line in f if line.strip()]
+    return _bs.load_questions(GROVE_DIR)
+
+
+def grade(q: dict, got) -> bool:
+    return got == q["answer"]
 
 
 def prepare(workspace: str) -> None:
-    os.makedirs(os.path.join(workspace, "runs"), exist_ok=True)
-    dst = os.path.join(workspace, "data")
-    if os.path.isdir(dst):
-        shutil.rmtree(dst)
-    shutil.copytree(os.path.join(GROVE_DIR, "data"), dst)
-    # the key stays with the grove; the workspace copy is questions only
-    qs = load_questions()
-    with open(os.path.join(dst, "questions.jsonl"), "w") as f:
-        for q in qs:
-            f.write(json.dumps({k: v for k, v in q.items()
-                                if k != "answer"}) + "\n")
-    print(f"workspace prepared at {workspace} ({len(qs)} questions)")
+    _bs.prepare(workspace, GROVE_DIR, SECRET_FIELDS)
 
 
 def score(workspace: str, run_id: str) -> dict:
-    key = {q["id"]: q for q in load_questions()}
-    answers_dir = os.path.join(workspace, "runs", run_id, "answers")
-    per_subject: dict[str, list[int]] = {}
-    answered = correct = 0
-    for qid, q in key.items():
-        path = os.path.join(answers_dir, f"{qid}.json")
-        got = None
-        if os.path.isfile(path):
-            try:
-                with open(path) as f:
-                    got = json.load(f).get("answer")
-            except (json.JSONDecodeError, OSError):
-                got = None
-        hit = int(got == q["answer"])
-        if got is not None:
-            answered += 1
-        correct += hit
-        per_subject.setdefault(q["subject"], []).append(hit)
-    result = {
-        "run_id": run_id,
-        "total": len(key),
-        "answered": answered,
-        "correct": correct,
-        "accuracy": correct / max(1, len(key)),
-        "per_subject": {s: sum(v) / len(v)
-                        for s, v in sorted(per_subject.items())},
-    }
-    out = os.path.join(workspace, "runs", run_id, "score.json")
-    os.makedirs(os.path.dirname(out), exist_ok=True)
-    with open(out, "w") as f:
-        json.dump(result, f, indent=1)
-    return result
+    return _bs.score(workspace, run_id, GROVE_DIR, grade,
+                     group_key="subject", group_field="per_subject")
 
 
 def main() -> int:
-    ap = argparse.ArgumentParser(description=__doc__)
-    ap.add_argument("--prepare", action="store_true")
-    ap.add_argument("--run")
-    ap.add_argument("--workspace", default=DEFAULT_WORKSPACE)
-    args = ap.parse_args()
-    if args.prepare:
-        prepare(args.workspace)
-        return 0
-    if args.run:
-        print(json.dumps(score(args.workspace, args.run), indent=1))
-        return 0
-    ap.print_help()
-    return 2
+    return _bs.run_cli(GROVE_DIR, DEFAULT_WORKSPACE, grade,
+                       group_key="subject", group_field="per_subject",
+                       secret_fields=SECRET_FIELDS, doc=__doc__)
 
 
 if __name__ == "__main__":

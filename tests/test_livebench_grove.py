@@ -1,12 +1,11 @@
-"""The shipped MMLU-Pro grove (groves/mmlu-pro): manifest loads, the
-topology spawns coordinator → answerers, answers and the report flow
-through grove schema validation + confinement, and the scoring script
-produces the score artifact (VERDICT r2 item 6).
+"""The shipped LiveBench grove (groves/livebench): manifest loads, graders
+score every category mechanically (no LLM judges), the topology spawns
+coordinator → solvers with the benchmark governance applied, and the
+scoring script produces the score artifact.
 
-The reference ships this benchmark as priv/groves/mmlu-pro; this is the
-in-tree equivalent run end-to-end on the mock backend (CI). The
-model-only TPU accuracy signal runs via
-groves/mmlu-pro/scripts/run_tpu_accuracy.py in the bench environment.
+The reference ships this benchmark as priv/groves/livebench (~1,150
+questions / 6 categories); this is the in-tree equivalent with a
+locally-authored 30-task subset, run end-to-end on the mock backend (CI).
 """
 
 import asyncio
@@ -24,10 +23,14 @@ from quoracle_tpu.persistence import Database, Persistence, TaskManager
 
 POOL = MockBackend.DEFAULT_POOL
 GROVE_SRC = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "groves", "mmlu-pro")
+    os.path.abspath(__file__))), "groves", "livebench")
 
-# mock answer sheet: two right, one wrong — the score must show 2/24
-MOCK_ANSWERS = {"q001": "C", "q002": "A", "q003": "F"}
+# mock answers: lb001 right (numeric w/ commas tolerated), lb006 wrong,
+# lb026 right by checks — score must show 2/30
+MOCK_ANSWERS = {"lb001": "408", "lb006": "10", "lb026": "vast salty deep"}
+
+CATEGORIES = {"math", "coding", "reasoning", "language", "data_analysis",
+              "instruction_following"}
 
 
 def j(action, params=None, wait=False):
@@ -36,14 +39,13 @@ def j(action, params=None, wait=False):
 
 
 def grove_in_tmp(tmp_path):
-    """Copy the shipped grove and point its workspace at a tmp dir."""
-    dst = tmp_path / "mmlu-pro"
+    dst = tmp_path / "livebench"
     shutil.copytree(GROVE_SRC, dst)
     ws = tmp_path / "workspace"
     (ws / "runs").mkdir(parents=True)
     manifest = (dst / "GROVE.md").read_text()
     patched = manifest.replace(
-        'workspace: "~/.quoracle_tpu/benchmarks/mmlu-pro"',
+        'workspace: "~/.quoracle_tpu/benchmarks/livebench"',
         f'workspace: "{ws}"')
     # fail fast if the manifest's workspace line drifted — a silent no-op
     # here would point the e2e test at the user's real home workspace
@@ -63,7 +65,7 @@ async def until(cond, timeout=20.0):
 
 def load_score_module():
     spec = importlib.util.spec_from_file_location(
-        "mmlu_score", os.path.join(GROVE_SRC, "scripts", "score_run.py"))
+        "lb_score", os.path.join(GROVE_SRC, "scripts", "score_run.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
@@ -71,9 +73,9 @@ def load_score_module():
 
 def test_shipped_manifest_loads():
     m = load_grove(GROVE_SRC)
-    assert m.name == "mmlu-pro"
-    assert m.root_node == "mmlu-coordinator"
-    assert [e.child for e in m.edges] == ["mmlu-answerer"]
+    assert m.name == "livebench"
+    assert m.root_node == "lb-coordinator"
+    assert [e.child for e in m.edges] == ["lb-solver"]
     assert any(r.type == "shell_pattern_block" for r in m.hard_rules)
     assert any(r.type == "action_block" for r in m.hard_rules)
     assert {s.name for s in m.schemas} == {"benchmark-report", "answer"}
@@ -82,11 +84,37 @@ def test_shipped_manifest_loads():
 def test_questions_dataset_is_wellformed():
     with open(os.path.join(GROVE_SRC, "data", "questions.jsonl")) as f:
         qs = [json.loads(line) for line in f]
-    assert len(qs) >= 24
+    assert len(qs) >= 30
+    assert len({q["id"] for q in qs}) == len(qs)
+    assert {q["category"] for q in qs} == CATEGORIES
     for q in qs:
-        assert set(q) == {"id", "subject", "question", "options", "answer"}
-        assert sorted(q["options"]) == list("ABCDEFGHIJ")
-        assert q["answer"] in q["options"]
+        assert q["answer_type"] in ("exact", "numeric", "checks")
+        if q["answer_type"] == "checks":
+            assert q["checks"]
+        else:
+            assert q["answer"]
+
+
+def test_graders_cover_every_category():
+    score = load_score_module()
+    qs = {q["id"]: q for q in score.load_questions()}
+    # exact: normalization forgives case/trailing punctuation, not content
+    assert score.grade(qs["lb012"], "lee")
+    assert score.grade(qs["lb012"], " Lee. ")
+    assert not score.grade(qs["lb012"], "Kim")
+    # numeric: commas and whitespace tolerated, wrong numbers are wrong
+    assert score.grade(qs["lb005"], "210")
+    assert score.grade(qs["lb005"], " 210 ")
+    assert not score.grade(qs["lb005"], "211")
+    # checks: every check must pass
+    assert score.grade(qs["lb026"], "vast salty deep")
+    assert not score.grade(qs["lb026"], "the vast salty deep")  # 4 words
+    assert score.grade(qs["lb028"], "apple\nbanana\npear")
+    assert not score.grade(qs["lb028"], "1. apple\n2. banana\n3. pear")
+    assert not score.grade(qs["lb029"], "green")                # no 'yellow'
+    # missing/empty answers never score
+    assert not score.grade(qs["lb001"], None)
+    assert not score.grade(qs["lb001"], "")
 
 
 def test_grove_benchmark_end_to_end(tmp_path):
@@ -94,20 +122,16 @@ def test_grove_benchmark_end_to_end(tmp_path):
         grove_dir, ws = grove_in_tmp(tmp_path)
 
         def respond(r):
-            # joined EXCLUDES the system prompt: skills/schemas there spell
-            # every action name and path, so history-state markers must only
-            # scan the conversation itself
             sys_prompt = r.messages[0]["content"] if r.messages else ""
             joined = "\n".join(str(m.get("content", ""))
                                for m in r.messages[1:])
-            # role detection by the grove-injected SKILL content
-            if "You answer exactly one multiple-choice question" in sys_prompt:
-                m = re.search(r"ANSWER-THIS (q\d+) OUTPUT-PATH: (\S+)",
+            if "You solve exactly one benchmark task" in sys_prompt:
+                m = re.search(r"SOLVE-THIS (lb\d+) OUTPUT-PATH: (\S+)",
                               joined)
                 qid, out_path = m.group(1), m.group(2)
                 if f"answered {qid}" in joined:
                     return j("wait", {})
-                if '"file_write"' in joined:          # write already decided
+                if '"file_write"' in joined:
                     return j("send_message", {
                         "target": "parent",
                         "content": f"answered {qid}"})
@@ -116,27 +140,26 @@ def test_grove_benchmark_end_to_end(tmp_path):
                     "content": json.dumps({
                         "question_id": qid,
                         "answer": MOCK_ANSWERS[qid]})})
-            # coordinator
             done = [q for q in MOCK_ANSWERS if f"answered {q}" in joined]
             if len(done) == len(MOCK_ANSWERS):
-                if '"run_id": "r1"' in joined:        # report write decided
+                if '"run_id": "r1"' in joined:
                     return j("wait", {})
                 return j("file_write", {
                     "path": f"{ws}/runs/r1/report.json",
                     "content": json.dumps({
-                        "run_id": "r1", "total": 24,
+                        "run_id": "r1", "total": 30,
                         "answered": len(done),
                         "answers_dir": "runs/r1/answers"})})
-            if "Answer question q" in joined:         # already spawned
+            if "Solve task lb" in joined:
                 return j("wait", {})
             return j("batch_async", {"actions": [
                 {"action": "spawn_child", "params": {
-                    "task_description": f"Answer question {qid}",
+                    "task_description": f"Solve task {qid}",
                     "success_criteria": "answer file written",
                     "immediate_context":
-                        f"ANSWER-THIS {qid} OUTPUT-PATH: "
+                        f"SOLVE-THIS {qid} OUTPUT-PATH: "
                         f"{ws}/runs/r1/answers/{qid}.json",
-                    "approach_guidance": "answer from knowledge",
+                    "approach_guidance": "follow the answer format",
                 }} for qid in MOCK_ANSWERS]})
 
         backend = MockBackend(respond=respond)
@@ -145,42 +168,38 @@ def test_grove_benchmark_end_to_end(tmp_path):
         tm = TaskManager(deps, Persistence(Database(":memory:")))
         task_id, root = await tm.create_task(grove=grove_dir,
                                              model_pool=list(POOL))
-        # bootstrap pre-filled the coordinator role + skills + node
-        assert root.config.grove_node == "mmlu-coordinator"
-        assert root.active_skills == ["mmlu-coordinator"]
-        assert "never fabricate" in root.config.governance_docs.lower()
+        assert root.config.grove_node == "lb-coordinator"
+        assert root.active_skills == ["lb-coordinator"]
 
-        # every answer file lands through confinement + schema validation
         answers_dir = os.path.join(ws, "runs", "r1", "answers")
         await until(lambda: os.path.isdir(answers_dir)
                     and len(os.listdir(answers_dir)) == 3, timeout=30)
-        # children ran as mmlu-answerer nodes with the blocks applied
         child = deps.registry.lookup(root.children[0]["agent_id"]).core
-        assert child.config.grove_node == "mmlu-answerer"
+        assert child.config.grove_node == "lb-solver"
         assert "fetch_web" in child.config.forbidden_actions
-        assert "mmlu-answerer" in child.active_skills
+        assert "lb-solver" in child.active_skills
 
-        # the report lands (schema-validated by the grove)
         report_path = os.path.join(ws, "runs", "r1", "report.json")
         await until(lambda: os.path.isfile(report_path), timeout=30)
-        report = json.load(open(report_path))
-        assert report["answered"] == 3
 
-        # scoring produces the artifact with the right accuracy
         score_mod = load_score_module()
         result = score_mod.score(ws, "r1")
         assert result["answered"] == 3
-        assert result["correct"] == 2          # q002 answered wrong
-        assert result["accuracy"] == 2 / 24
+        assert result["correct"] == 2              # lb006 answered wrong
+        assert result["accuracy"] == 2 / 30
+        assert result["per_category"]["math"] == 0.2       # 1 of 5
+        assert result["per_category"]["coding"] == 0.0
         assert os.path.isfile(os.path.join(ws, "runs", "r1", "score.json"))
         await tm.pause_task(task_id)
     asyncio.run(asyncio.wait_for(main(), 90))
 
 
-def test_prepare_strips_answer_key(tmp_path):
+def test_prepare_strips_keys_and_checks(tmp_path):
     score_mod = load_score_module()
     ws = str(tmp_path / "ws")
     score_mod.prepare(ws)
     with open(os.path.join(ws, "data", "questions.jsonl")) as f:
         for line in f:
-            assert "answer" not in json.loads(line)
+            q = json.loads(line)
+            assert "answer" not in q and "checks" not in q
+            assert "answer_type" not in q
