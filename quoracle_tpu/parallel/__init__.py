@@ -8,6 +8,13 @@ for long context, all expressed as jax.sharding annotations over one Mesh so
 XLA inserts ICI collectives.
 """
 
+from quoracle_tpu.parallel.distributed import (  # noqa: F401
+    ProcessInfo,
+    barrier,
+    host_local_batch,
+    init_process,
+    multihost_mesh,
+)
 from quoracle_tpu.parallel.mesh import (  # noqa: F401
     cache_spec,
     data_spec,

@@ -1,0 +1,76 @@
+"""Worker process for tests/test_distributed.py (NOT a test module).
+
+Joins a two-process JAX distributed system on CPU (Gloo collectives across
+process boundaries — the DCN stand-in), builds the global dp×tp mesh with
+tp packed inside this host, and runs sharded train steps on the tiny
+catalog model. Prints one JSON line per assertion-relevant fact; the
+parent test asserts both workers report identical replicated losses.
+
+Usage: python distributed_worker.py <port> <process_id>
+"""
+
+import json
+import sys
+
+import numpy as np
+
+
+def main() -> None:
+    port, pid = int(sys.argv[1]), int(sys.argv[2])
+    from quoracle_tpu.parallel.distributed import (
+        barrier, host_local_batch, init_process, multihost_mesh,
+    )
+    info = init_process(coordinator_address=f"localhost:{port}",
+                        num_processes=2, process_id=pid)
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from quoracle_tpu.models.config import get_model_config
+    from quoracle_tpu.models.train import (
+        TrainState, make_optimizer, train_step,
+    )
+    from quoracle_tpu.models.transformer import init_params
+    from quoracle_tpu.parallel.mesh import param_specs
+
+    assert info.num_processes == 2 and info.global_devices == 8
+    mesh = multihost_mesh(tp=2)
+    assert dict(mesh.shape) == {"dp": 4, "tp": 2}
+    # tp groups never span hosts: both devices of each tp column belong to
+    # the same process
+    for row in mesh.devices:
+        assert len({d.process_index for d in row}) == 1
+
+    cfg = get_model_config("xla:tiny")
+    # bf16 like serving/dryrun: loss_fn's cache is bf16 (train.py)
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.bfloat16)
+    specs = param_specs(cfg)
+    params = jax.device_put(params, jax.tree.map(
+        lambda s: NamedSharding(mesh, s), specs,
+        is_leaf=lambda x: isinstance(x, P)))
+    opt = make_optimizer(1e-3)
+    state = TrainState(params, opt.init(params), jnp.zeros((), jnp.int32))
+
+    # dp-sharded global batch of 8 rows: each host feeds ITS 4 rows
+    rng = np.random.default_rng(0)
+    tokens_all = rng.integers(3, cfg.vocab_size, (8, 16)).astype(np.int32)
+    mask_all = np.ones((8, 16), np.float32)
+    local = slice(pid * 4, pid * 4 + 4)
+    tokens = host_local_batch(tokens_all[local], mesh, P("dp", None))
+    mask = host_local_batch(mask_all[local], mesh, P("dp", None))
+
+    step = jax.jit(train_step, static_argnames=("cfg", "optimizer"),
+                   out_shardings=(None, NamedSharding(mesh, P())))
+    losses = []
+    for _ in range(2):
+        state, loss = step(state, cfg, opt, tokens, mask)
+        losses.append(float(loss))
+    barrier("after-train")
+    assert all(np.isfinite(losses))
+    print(json.dumps({"pid": pid, "losses": [round(l, 6) for l in losses]}),
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
