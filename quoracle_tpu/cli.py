@@ -56,7 +56,10 @@ async def cmd_run(args: argparse.Namespace) -> int:
     rt = Runtime(RuntimeConfig(db_path=args.db, backend=args.backend,
                                model_pool=pool,
                                checkpoints=args.checkpoints, tp=args.tp,
-                               image_backend=args.image_backend))
+                               image_backend=args.image_backend,
+                               coordinator_address=args.coordinator,
+                               num_processes=args.num_processes,
+                               process_id=args.process_id))
     _attach_printer(rt)
     if pool is None and args.profile is None:
         pool = rt.default_pool()
@@ -78,7 +81,10 @@ async def cmd_run(args: argparse.Namespace) -> int:
 async def cmd_resume(args: argparse.Namespace) -> int:
     rt = Runtime(RuntimeConfig(db_path=args.db, backend=args.backend,
                                checkpoints=args.checkpoints, tp=args.tp,
-                               image_backend=args.image_backend))
+                               image_backend=args.image_backend,
+                               coordinator_address=args.coordinator,
+                               num_processes=args.num_processes,
+                               process_id=args.process_id))
     _attach_printer(rt)
     result = await rt.boot()
     print(json.dumps(result), flush=True)
@@ -97,7 +103,10 @@ async def cmd_serve(args: argparse.Namespace) -> int:
         db_path=args.db, backend=args.backend,
         model_pool=args.pool.split(",") if args.pool else None,
         checkpoints=args.checkpoints, tp=args.tp,
-        image_backend=args.image_backend))
+        image_backend=args.image_backend,
+        coordinator_address=args.coordinator,
+        num_processes=args.num_processes,
+        process_id=args.process_id))
     # Validate host/token BEFORE boot so a refused bind exits with a clean
     # message instead of a traceback over a half-started runtime.
     try:
@@ -152,6 +161,14 @@ def build_parser() -> argparse.ArgumentParser:
                         default="procedural",
                         help="generate_images backend: placeholder PNGs or "
                              "the on-device diffusion model")
+        sp.add_argument("--coordinator", dest="coordinator", default=None,
+                        help="multi-host: coordinator address "
+                             "(host:port) to join the JAX distributed "
+                             "system; auto-detected on TPU pods")
+        sp.add_argument("--num-processes", dest="num_processes", type=int,
+                        default=None)
+        sp.add_argument("--process-id", dest="process_id", type=int,
+                        default=None)
 
     runp = sub.add_parser("run", help="create a task and watch it")
     runp.add_argument("description")

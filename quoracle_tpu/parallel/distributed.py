@@ -27,8 +27,6 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Sequence
 
-import numpy as np
-
 
 @dataclasses.dataclass
 class ProcessInfo:
@@ -42,14 +40,26 @@ class ProcessInfo:
         return self.process_id == 0
 
 
-# Environment keys whose presence means "a cluster really is configured":
-# an auto-init failure under any of these must surface, not degrade to a
-# silent 1/N-of-the-pod run.
-_CLUSTER_ENV_KEYS = (
-    "JAX_COORDINATOR_ADDRESS", "COORDINATOR_ADDRESS",
-    "MEGASCALE_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
-    "SLURM_JOB_ID", "OMPI_COMM_WORLD_SIZE",
-)
+def _cluster_env_expects_peers() -> bool:
+    """True when the environment says MULTIPLE processes should form a
+    cluster — then an auto-init failure must surface, not degrade to a
+    silent 1/N-of-the-pod run. Mere key PRESENCE is not enough: single-host
+    TPU VMs routinely export TPU_WORKER_HOSTNAMES with one (or a garbage)
+    entry, and crashing those would break every single-host serve."""
+    import os
+    if (os.environ.get("JAX_COORDINATOR_ADDRESS")
+            or os.environ.get("COORDINATOR_ADDRESS")
+            or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")):
+        return True
+    if "," in os.environ.get("TPU_WORKER_HOSTNAMES", ""):
+        return True                              # >= 2 workers listed
+    for key in ("OMPI_COMM_WORLD_SIZE", "SLURM_NTASKS"):
+        try:
+            if int(os.environ.get(key, "1")) > 1:
+                return True
+        except ValueError:
+            pass
+    return False
 
 
 def init_process(coordinator_address: Optional[str] = None,
@@ -63,9 +73,26 @@ def init_process(coordinator_address: Optional[str] = None,
     swallowing it would leave this process training on 1/N of the pod or
     hanging in the first collective its peers enter without it."""
     import logging
-    import os
 
     import jax
+
+    def _info() -> ProcessInfo:
+        return ProcessInfo(
+            process_id=jax.process_index(),
+            num_processes=jax.process_count(),
+            local_devices=jax.local_device_count(),
+            global_devices=jax.device_count(),
+        )
+
+    try:
+        from jax._src import distributed as _dist
+        already = _dist.global_state.client is not None
+    except Exception:
+        already = False
+    if already:
+        # a second Runtime / repeated call in one process: the system is
+        # up, just report it
+        return _info()
     if coordinator_address is not None or num_processes is not None:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
@@ -74,16 +101,11 @@ def init_process(coordinator_address: Optional[str] = None,
         try:
             jax.distributed.initialize()
         except Exception as e:
-            if any(os.environ.get(k) for k in _CLUSTER_ENV_KEYS):
+            if _cluster_env_expects_peers():
                 raise
             logging.getLogger(__name__).debug(
                 "no cluster environment; single-process operation (%s)", e)
-    return ProcessInfo(
-        process_id=jax.process_index(),
-        num_processes=jax.process_count(),
-        local_devices=jax.local_device_count(),
-        global_devices=jax.device_count(),
-    )
+    return _info()
 
 
 def _hosts_of(devs: Sequence) -> list[list]:

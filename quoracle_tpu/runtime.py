@@ -12,6 +12,7 @@ AGENTS.md:5-33).
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Any, Optional
 
 from quoracle_tpu.agent.registry import AgentRegistry
@@ -25,6 +26,9 @@ from quoracle_tpu.infra.event_history import EventHistory
 from quoracle_tpu.models.runtime import MockBackend, ModelBackend, TPUBackend
 from quoracle_tpu.persistence import Database, Persistence, TaskManager
 from quoracle_tpu.persistence.store import PersistentSecretStore
+
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -54,6 +58,13 @@ class RuntimeConfig:
     # models/diffusion.py — the TPU-native analog of the reference's hosted
     # image models, image_query.ex:1-12).
     image_backend: str = "procedural"
+    # Multi-host: join the JAX distributed system before building the
+    # backend (parallel/distributed.init_process). On TPU pods the three
+    # values are usually auto-detected — set coordinator_address (and
+    # num_processes/process_id on CPU/GPU clusters) to join explicitly.
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
 
 
 class Runtime:
@@ -107,13 +118,32 @@ class Runtime:
     @staticmethod
     def _build_backend(config: RuntimeConfig) -> ModelBackend:
         if config.backend != "tpu":
-            if config.checkpoints or config.tp:
+            if (config.checkpoints or config.tp
+                    or config.coordinator_address or config.num_processes
+                    or config.process_id is not None):
                 # Silent fallback to mock would make the user believe their
-                # checkpoint is serving while scripted responses come back.
+                # checkpoint (or cluster) is serving while scripted
+                # responses come back.
                 raise ValueError(
-                    "--checkpoint/--tp require --backend tpu "
+                    "--checkpoint/--tp/--coordinator/--num-processes/"
+                    "--process-id require --backend tpu "
                     f"(backend is {config.backend!r})")
             return MockBackend()
+        # Join the JAX distributed system BEFORE any jax.devices() call:
+        # explicit args when given, pod auto-detection otherwise (the
+        # no-arg form degrades cleanly off-cluster but re-raises when the
+        # environment says a cluster exists — parallel/distributed.py).
+        from quoracle_tpu.parallel.distributed import init_process
+        if (config.coordinator_address or config.num_processes
+                or config.process_id is not None):
+            info = init_process(config.coordinator_address,
+                                config.num_processes, config.process_id)
+        else:
+            info = init_process()
+        if info.num_processes > 1:
+            logger.info("joined distributed system: process %d/%d, "
+                        "%d global devices", info.process_id,
+                        info.num_processes, info.global_devices)
         pool = list(config.model_pool or ())
         if config.checkpoints:
             from quoracle_tpu.models.loader import register_hf_checkpoint
@@ -125,10 +155,19 @@ class Runtime:
             from quoracle_tpu.models.config import BENCH_POOL
             pool = list(BENCH_POOL)
         import jax
+        # Serving is HOST-LOCAL by design: the agent runtime on each host
+        # drives its own engines over its own chips (the analog of the
+        # reference's one-node BEAM; scale out = one Runtime per host).
+        # Cross-host meshes would require every process to issue identical
+        # collectives in lockstep, which independent agent loops cannot
+        # guarantee — a cross-host psum would simply hang. The multihost
+        # mesh layer (parallel/distributed.multihost_mesh) serves SPMD
+        # jobs (training, dryruns) where one program drives all hosts.
         submeshes = None
-        if len(jax.devices()) > 1:
+        if len(jax.local_devices()) > 1:
             from quoracle_tpu.parallel.mesh import pool_submeshes
-            submeshes = pool_submeshes(len(pool), tp=config.tp)
+            submeshes = pool_submeshes(len(pool), tp=config.tp,
+                                       devices=jax.local_devices())
         return TPUBackend(pool, seed=config.seed,
                           embed_model=config.embed_model,
                           submeshes=submeshes)

@@ -81,3 +81,15 @@ def test_checkpoints_require_tpu_backend():
     from quoracle_tpu.runtime import Runtime, RuntimeConfig
     with pytest.raises(ValueError, match="require --backend tpu"):
         Runtime(RuntimeConfig(checkpoints=["/nonexistent"]))
+
+
+def test_cluster_flags_require_tpu_backend():
+    """--coordinator/--num-processes/--process-id on the mock backend must
+    fail loudly — a user who believes they launched a multi-host run must
+    not get scripted mock responses (same rule as --checkpoint)."""
+    import pytest
+    from quoracle_tpu.runtime import Runtime, RuntimeConfig
+    for kw in ({"coordinator_address": "h:1"}, {"num_processes": 2},
+               {"process_id": 0}):
+        with pytest.raises(ValueError, match="require --backend tpu"):
+            Runtime(RuntimeConfig(**kw))
