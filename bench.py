@@ -290,6 +290,11 @@ def main() -> None:
         SCALE, FAMILIES, N_CYCLES, MAX_NEW = \
             "tiny", ["llama", "gemma"], 1, 16
 
+    from quoracle_tpu.utils.compile_cache import enable_compilation_cache
+    cache_dir = enable_compilation_cache()
+    if cache_dir:
+        log(f"persistent compilation cache: {cache_dir}")
+
     devs = jax.devices()
     n_chips = len(devs)
     kind = getattr(devs[0], "device_kind", "unknown")

@@ -93,6 +93,15 @@ def init_process(coordinator_address: Optional[str] = None,
         # a second Runtime / repeated call in one process: the system is
         # up, just report it
         return _info()
+    if process_id is not None and coordinator_address is None \
+            and num_processes is None:
+        # an explicit rank with nothing to join would silently degrade to
+        # a single-process run with the rank dropped — the exact failure
+        # mode this module exists to surface
+        raise ValueError(
+            "process_id given without coordinator_address/num_processes — "
+            "pass all three for explicit clusters, or none for pod "
+            "auto-detection")
     if coordinator_address is not None or num_processes is not None:
         jax.distributed.initialize(
             coordinator_address=coordinator_address,

@@ -214,6 +214,11 @@ class Persistence:
             "SELECT amount FROM agent_costs WHERE task_id=?", (task_id,))
         return sum((Decimal(r["amount"]) for r in rows), Decimal("0"))
 
+    def total_costs(self) -> Decimal:
+        """Every recorded cost across all tasks (telemetry roll-up)."""
+        rows = self.db.query("SELECT amount FROM agent_costs")
+        return sum((Decimal(r["amount"]) for r in rows), Decimal("0"))
+
     def agent_spent(self, agent_id: str) -> Decimal:
         rows = self.db.query(
             "SELECT amount FROM agent_costs WHERE agent_id=?", (agent_id,))

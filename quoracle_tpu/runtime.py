@@ -129,6 +129,10 @@ class Runtime:
                     "--process-id require --backend tpu "
                     f"(backend is {config.backend!r})")
             return MockBackend()
+        from quoracle_tpu.utils.compile_cache import (
+            enable_compilation_cache,
+        )
+        enable_compilation_cache()
         # Join the JAX distributed system BEFORE any jax.devices() call:
         # explicit args when given, pod auto-detection otherwise (the
         # no-arg form degrades cleanly off-cluster but re-raises when the

@@ -47,6 +47,9 @@ def main() -> None:
 
     import jax
 
+    from quoracle_tpu.utils.compile_cache import enable_compilation_cache
+    enable_compilation_cache()
+
     from quoracle_tpu.models.config import register_model
     from quoracle_tpu.models.generate import GenerateEngine
     from quoracle_tpu.models.loader import (

@@ -5,6 +5,7 @@ Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
   GET  /healthz             health check (reference HealthController)
   GET  /events              SSE: every bus broadcast as one JSON event
   GET  /api/status          runtime summary
+  GET  /api/metrics         telemetry snapshot (VM, rows, serving phases)
   GET  /api/tasks           tasks + live agent counts
   GET  /api/agents?task_id  agent tree with budget/cost/todo state
   GET  /api/logs?agent_id   durable logs (newest last)
@@ -60,6 +61,8 @@ class DashboardServer:
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        import time as _time
+        self._t0 = _time.monotonic()
 
     # ------------------------------------------------------------------
 
@@ -150,6 +153,55 @@ class DashboardServer:
             "ORDER BY id DESC LIMIT ?2", (task_id, limit))
         return [dict(r) for r in reversed(rows)]
 
+    def metrics_payload(self) -> dict:
+        """Runtime telemetry snapshot (reference parity: QuoracleWeb.
+        Telemetry polls Phoenix/Ecto/VM metrics into LiveDashboard,
+        telemetry.ex:20-50 — here the same classes of numbers come from
+        one on-demand endpoint): process/VM stats, durable-row counts,
+        live-agent counts, cost totals, and the serving backend's
+        per-member phase timings + KV-session occupancy."""
+        import resource
+        import threading
+        import time as _time
+
+        rt = self.runtime
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        vm = {
+            "rss_mb": round(ru.ru_maxrss / 1024, 1),
+            "user_cpu_s": round(ru.ru_utime, 1),
+            "system_cpu_s": round(ru.ru_stime, 1),
+            "threads": threading.active_count(),
+            "uptime_s": round(_time.monotonic() - self._t0, 1),
+        }
+        counts = {
+            row_kind: rt.db.query(
+                f"SELECT COUNT(*) AS n FROM {row_kind}")[0]["n"]
+            for row_kind in ("tasks", "agents", "logs", "messages",
+                             "actions", "agent_costs")
+        }
+        live = rt.registry.all()
+        agents = {
+            "live": len(live),
+            "pending_actions": sum(len(r.core.pending_actions)
+                                   for r in live),
+        }
+        backend = {"type": type(rt.backend).__name__}
+        engines = getattr(rt.backend, "engines", None)
+        if engines:
+            backend["members"] = {
+                spec: {
+                    "last_prefill_ms": round(e.last_prefill_s * 1000, 1),
+                    "last_decode_ms": round(e.last_decode_s * 1000, 1),
+                    "last_prefill_tokens": e.last_prefill_tokens,
+                    "kv_sessions": len(e.sessions),
+                    "kv_free_pages": e.sessions.free_pages(),
+                }
+                for spec, e in engines.items()
+            }
+        return {"vm": vm, "rows": counts, "agents": agents,
+                "backend": backend,
+                "total_cost": str(rt.store.total_costs())}
+
     def settings_payload(self) -> dict:
         """The settings surface (reference SecretManagementLive): system
         settings, profiles, secret METADATA (values never leave the vault),
@@ -235,6 +287,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.messages_payload(one("task_id")))
             elif parsed.path == "/api/settings":
                 self._send_json(d.settings_payload())
+            elif parsed.path == "/api/metrics":
+                self._send_json(d.metrics_payload())
             elif parsed.path == "/events":
                 self._stream_events()
             else:

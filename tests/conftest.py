@@ -20,6 +20,9 @@ _flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
                 os.environ.get("XLA_FLAGS", ""))
 os.environ["XLA_FLAGS"] = (
     _flags.strip() + " --xla_force_host_platform_device_count=8").strip()
+# hermetic tests: never write the persistent compilation cache
+# (utils/compile_cache.py honors this before touching jax.config)
+os.environ.setdefault("QUORACLE_XLA_CACHE", "off")
 
 import jax  # noqa: E402
 

@@ -327,3 +327,29 @@ def test_settings_mutations_require_token_when_configured():
             await server.stop()
             await rt.shutdown()
     asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_dashboard_metrics_endpoint():
+    async def main():
+        rt = Runtime(RuntimeConfig(), backend=MockBackend())
+        server = await DashboardServer(rt, port=0).start()
+        try:
+            _, created = await http_json(
+                server.url + "/api/tasks", "POST",
+                {"description": "metrics probe",
+                 "model_pool": list(MockBackend.DEFAULT_POOL)})
+            await until(lambda: rt.registry.all())
+            _, m = await http_json(server.url + "/api/metrics")
+            assert m["vm"]["rss_mb"] > 0
+            assert m["vm"]["threads"] >= 2         # http + main at least
+            assert set(m["rows"]) == {"tasks", "agents", "logs",
+                                      "messages", "actions", "agent_costs"}
+            assert m["rows"]["tasks"] == 1
+            assert m["agents"]["live"] >= 1
+            assert m["backend"]["type"] == "MockBackend"
+            assert "total_cost" in m and m["total_cost"] is not None
+            await rt.tasks.pause_task(created["task_id"])
+        finally:
+            await server.stop()
+            rt.close()
+    asyncio.run(asyncio.wait_for(main(), 60))

@@ -71,6 +71,12 @@ def test_two_process_mesh_trains_identically(tmp_path):
     assert by_pid[0][1] < by_pid[0][0]
 
 
+def test_process_id_alone_is_rejected():
+    from quoracle_tpu.parallel.distributed import init_process
+    with pytest.raises(ValueError, match="process_id given without"):
+        init_process(process_id=1)
+
+
 def test_single_process_helpers_degrade():
     """init_process with no cluster env, multihost_mesh, host_local_batch,
     and barrier must all work in a plain single-process run."""
