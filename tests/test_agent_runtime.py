@@ -576,3 +576,40 @@ def test_registry_queries():
     assert {r.agent_id for r in reg.agents_for_task("t1")} == {"a", "b", "c"}
     with pytest.raises(Exception):
         reg.register("a", object(), None, "t1")
+
+
+def test_spawn_dismiss_race_leaves_no_orphan():
+    """The spawn/dismiss race (reference core.ex:213-220, spawn.ex:76-106):
+    a parent's async spawn is in flight when the parent's tree is torn
+    down. Whichever side wins, the registry must end empty — a child that
+    escaped the dismissal BFS gets reaped by the spawn task itself."""
+    async def main():
+        release = asyncio.Event()
+
+        class SlowSupervisor(AgentSupervisor):
+            async def start_agent(self, cfg, *a, **kw):
+                if cfg.agent_id != "agent-root":
+                    # hold the child's startup until dismissal is underway
+                    await release.wait()
+                return await super().start_agent(cfg, *a, **kw)
+
+        backend = scripted(
+            j("spawn_child", spawn_params()),
+            j("wait", {}))
+        deps = AgentDeps.for_tests(backend)
+        sup = SlowSupervisor(deps)
+        root = await sup.start_agent(root_config())
+        root.post({"type": "user_message", "content": "go", "from": "user"})
+        # wait for the spawn action to be dispatched (pending background task)
+        await until(lambda: any(
+            d.get("action") == "spawn_child" for d in decisions(root)))
+        # dismissal starts while the child's startup is parked
+        teardown = asyncio.create_task(
+            sup.terminate_tree("agent-root", by="test", reason="race"))
+        await asyncio.sleep(0.05)
+        release.set()
+        await teardown
+        # give the spawn task time to observe the dismissal and reap
+        await until(lambda: not deps.registry.all(), timeout=10)
+
+    run(main())
