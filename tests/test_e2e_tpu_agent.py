@@ -141,3 +141,39 @@ def test_pause_restore_on_tpu_backend(tmp_path):
                            if e.kind == DECISION]))
         await tm2.pause_task(task_id)
     asyncio.run(asyncio.wait_for(main(), 900))
+
+
+def test_consensus_refinement_splices_session_on_backend():
+    """Two consensus cycles through TPUBackend where cycle 2's messages
+    embed cycle 1's raw response text (the agent-loop shape): the token
+    splice must resume the resident prompt AND response KV so cycle 2
+    prefills only the new suffix — not the whole conversation. Robust to
+    parse outcome: raw_text is captured from proposals or failures alike
+    (random weights may length-cap the JSON)."""
+    from quoracle_tpu.consensus.engine import ConsensusConfig, ConsensusEngine
+
+    backend = TPUBackend(["xla:tiny"])
+    engine = ConsensusEngine(backend, ConsensusConfig(
+        model_pool=["xla:tiny"], max_refinement_rounds=0,
+        session_key="splice-e2e", constrained_json=True,
+        allowed_actions={"wait"}, max_tokens=48))
+    msgs = [
+        {"role": "system", "content": "Decide your next action as JSON."},
+        {"role": "user", "content": "report status then continue"}]
+    out1 = engine.decide({"xla:tiny": list(msgs)})
+    raw = (out1.proposals[0].raw_text if out1.proposals
+           else out1.failures[0].raw_text)
+    # backend-level failures carry no raw_text; surface the error instead
+    # of an opaque bare assert
+    assert raw, f"no response text; failures={out1.failures}"
+    eng = backend.engines["xla:tiny"]
+    sess = eng.session_tokens("splice-e2e")
+    assert sess is not None                  # cycle 1 is resident
+    resident = len(sess)
+
+    msgs2 = msgs + [{"role": "assistant", "content": raw},
+                    {"role": "user", "content": "refine your proposal"}]
+    engine.decide({"xla:tiny": msgs2})
+    # cycle 2 prefilled only the refinement glue: far less than the
+    # resident conversation it extended
+    assert 0 < eng.last_prefill_tokens < resident // 2
