@@ -57,3 +57,36 @@ def train_step(state: TrainState, cfg: ModelConfig, optimizer,
     updates, opt_state = optimizer.update(grads, state.opt_state, state.params)
     params = optax.apply_updates(state.params, updates)
     return TrainState(params, opt_state, state.step + 1), loss
+
+
+def save_train_state(path: str, state: TrainState) -> None:
+    """Durable TrainState checkpoint (orbax): params + optimizer state +
+    step, restorable across processes/hosts. Complements the agent-state
+    persistence layer (persistence/) — that checkpoints the ORCHESTRATION
+    (conversations, tasks, costs); this checkpoints the fine-tuning
+    substrate's weights, a capability the reference cannot have (its
+    models are hosted APIs, SURVEY §2.3)."""
+    import os
+
+    import orbax.checkpoint as ocp
+    with ocp.StandardCheckpointer() as ckptr:
+        # force=True: periodic saves to a stable path (ckpt/latest every N
+        # steps) must overwrite, not crash on the second call
+        ckptr.save(os.path.abspath(path), state, force=True)
+
+
+def load_train_state(path: str, template: TrainState) -> TrainState:
+    """Restore a TrainState saved by save_train_state. ``template`` is a
+    same-shaped state (e.g. freshly initialized) that tells orbax the tree
+    structure, dtypes, AND shardings — restoring onto a multihost mesh
+    lays the weights out exactly as the template's arrays are."""
+    import os
+
+    import orbax.checkpoint as ocp
+    with ocp.StandardCheckpointer() as ckptr:
+        restored = ckptr.restore(os.path.abspath(path), template)
+    if isinstance(restored, TrainState):
+        return restored
+    # template-less/dict restore shape ({'params','opt_state','step'}) —
+    # keyword construction, never positional star-unpacking of dict KEYS
+    return TrainState(**restored)
