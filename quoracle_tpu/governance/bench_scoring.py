@@ -64,6 +64,11 @@ def score(workspace: str, run_id: str, grove_dir: str,
                     got = json.load(f).get("answer")
             except (json.JSONDecodeError, OSError):
                 got = None
+        # Normalize non-string answers (e.g. {"answer": 408}) so "answered"
+        # never exceeds what graders can actually credit — write-time schema
+        # validation can be bypassed by manual runs / external answer dirs.
+        if got is not None and not isinstance(got, str):
+            got = str(got)
         hit = int(grade_fn(q, got))
         answered += int(got is not None)
         correct += hit

@@ -476,17 +476,42 @@ def splice_session_prompt(tokenizer, sess_tokens: Sequence[int],
     if canonical.startswith(tokenizer.decode_raw(sess_tokens)):
         k = len(sess_tokens)
     else:
-        # Largest k with decode(sess[:k]) a prefix of the new text (invariant:
-        # lo always satisfies it; a k ending mid-UTF-8 decodes to U+FFFD and
-        # naturally fails). Divergence happens when condensation rewrote
-        # history — the shared region shrinks to the still-common prefix.
+        # Largest k with decode(sess[:k]) a prefix of the new text (lo always
+        # satisfies it). The predicate is NOT strictly monotone: a k ending
+        # mid-UTF-8 decodes with trailing U+FFFD and fails even when a
+        # LONGER prefix decodes cleanly — and such pockets CHAIN when
+        # byte-fallback tokens straddle char boundaries (emoji runs). So:
+        # bisect, then scan past the settle point while the mismatch is
+        # confined to the trailing replacement-char run (still mid-char);
+        # any clean success restarts the bisection from there. A mismatch
+        # before the trailing U+FFFDs is genuine divergence (condensation
+        # rewrote history) and ends the scan. A probe budget bounds the
+        # worst-case decode work on the serving hot path.
+        def _pred(j: int) -> bool:
+            return canonical.startswith(tokenizer.decode_raw(sess_tokens[:j]))
+
         lo, hi = 0, len(sess_tokens)
-        while lo < hi:
-            mid = (lo + hi + 1) // 2
-            if canonical.startswith(tokenizer.decode_raw(sess_tokens[:mid])):
-                lo = mid
-            else:
-                hi = mid - 1
+        misses = 64
+        while True:
+            while lo < hi:
+                mid = (lo + hi + 1) // 2
+                if _pred(mid):
+                    lo = mid
+                else:
+                    hi = mid - 1
+            escaped = False
+            j = lo + 1
+            while j <= len(sess_tokens) and misses > 0:
+                s = tokenizer.decode_raw(sess_tokens[:j])
+                if canonical.startswith(s):
+                    lo, hi, escaped = j, len(sess_tokens), True
+                    break
+                misses -= 1
+                if not canonical.startswith(s.rstrip("�")):
+                    break       # diverges before the partial-char tail
+                j += 1
+            if not escaped:
+                break
         k = lo
     # ≥1 suffix token must run through prefill to produce last-position
     # logits; and the splice must beat the plain prefix to be worth

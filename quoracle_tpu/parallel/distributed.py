@@ -126,8 +126,12 @@ def _hosts_of(devs: Sequence) -> list[list]:
         by_proc.setdefault(getattr(d, "process_index", 0), []).append(d)
     groups = [by_proc[p] for p in sorted(by_proc)]
     sizes = {len(g) for g in groups}
-    assert len(sizes) == 1, \
-        f"uneven devices per host: { {p: len(g) for p, g in by_proc.items()} }"
+    if len(sizes) != 1:
+        # ValueError (not assert): this guard must survive `python -O` —
+        # a ragged host layout silently reshaped would misplace shards.
+        raise ValueError(
+            f"uneven devices per host: "
+            f"{ {p: len(g) for p, g in by_proc.items()} }")
     return groups
 
 
@@ -146,9 +150,13 @@ def multihost_mesh(tp: Optional[int] = None, sp: int = 1,
     hosts = _hosts_of(devs)
     n_local = len(hosts[0])
     tp = tp or 1
-    assert n_local % tp == 0, \
-        f"tp={tp} must divide the per-host device count {n_local} (tp " \
-        f"stays within one host so its collectives ride ICI, not DCN)"
+    if n_local % tp != 0:
+        # ValueError (not assert): stripped asserts under `python -O` would
+        # let a cross-host tp mesh build silently — the exact cross-DCN-psum
+        # hang this module exists to prevent.
+        raise ValueError(
+            f"tp={tp} must divide the per-host device count {n_local} (tp "
+            f"stays within one host so its collectives ride ICI, not DCN)")
     ordered = [d for g in hosts for d in g]
     return make_mesh(devices=ordered, tp=tp, sp=sp)
 

@@ -25,6 +25,7 @@ import asyncio
 import json
 import logging
 import queue
+import sys
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -166,8 +167,10 @@ class DashboardServer:
 
         rt = self.runtime
         ru = resource.getrusage(resource.RUSAGE_SELF)
+        # ru_maxrss is KiB on Linux but BYTES on darwin (getrusage(2))
+        rss_div = 1024 * 1024 if sys.platform == "darwin" else 1024
         vm = {
-            "rss_mb": round(ru.ru_maxrss / 1024, 1),
+            "rss_mb": round(ru.ru_maxrss / rss_div, 1),
             "user_cpu_s": round(ru.ru_utime, 1),
             "system_cpu_s": round(ru.ru_stime, 1),
             "threads": threading.active_count(),

@@ -111,8 +111,9 @@ def test_multihost_mesh_rejects_cross_host_tp():
     from quoracle_tpu.parallel.distributed import _hosts_of, multihost_mesh
     devs = [_FakeDev(p) for p in (0, 0, 0, 0, 1, 1, 1, 1)]
     assert [len(g) for g in _hosts_of(devs)] == [4, 4]
-    with pytest.raises(AssertionError, match="ICI"):
+    # ValueError, not AssertionError: these contracts must hold under -O too
+    with pytest.raises(ValueError, match="ICI"):
         multihost_mesh(tp=8, devices=devs)       # divides global, spans DCN
     # uneven host populations are a layout bug, not a reshape surprise
-    with pytest.raises(AssertionError, match="uneven"):
+    with pytest.raises(ValueError, match="uneven"):
         _hosts_of([_FakeDev(0), _FakeDev(0), _FakeDev(1)])

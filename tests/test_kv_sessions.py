@@ -244,6 +244,67 @@ def test_splice_identical_conversation_keeps_one_suffix_token():
     assert spliced is None
 
 
+def test_splice_recovers_past_mid_utf8_pocket():
+    """The prefix predicate is non-monotone when a token boundary cuts a
+    multi-byte char: decode(sess[:k]) ends in U+FFFD and fails while k+1
+    decodes cleanly. The bisection can settle BELOW such a pocket — the
+    bounded lookahead must probe past the failing k and recover the true
+    maximal shared region (ADVICE r3; splice_session_prompt)."""
+    from quoracle_tpu.models.generate import splice_session_prompt
+
+    class PocketTok:
+        # id -> utf-8 bytes; 2+3 are the two halves of "é", 5 is "é" whole
+        TOK = {0: b"a", 1: b"b", 2: b"\xc3", 3: b"\xa9", 4: b"Z",
+               5: b"\xc3\xa9", 6: b"c"}
+        CANON = {"a": 0, "b": 1, "Z": 4, "c": 6, "é": 5}
+
+        def decode_raw(self, ids):
+            return b"".join(self.TOK[i] for i in ids).decode(
+                "utf-8", "replace")
+
+        def encode(self, text, add_bos=False):
+            return [self.CANON[ch] for ch in text]
+
+    tok = PocketTok()
+    # session decodes "abéZ" with é SPLIT across ids 2,3; the new canonical
+    # prompt is "abéc" (é one token). Predicate by k: T T F T F — bisection
+    # probes k=3 (the U+FFFD pocket), discards the upper true region, and
+    # settles at k=2; lookahead must land on k=4.
+    sess = [0, 1, 2, 3, 4]
+    plain = tok.encode("abéc")
+    spliced = splice_session_prompt(tok, sess, plain)
+    assert spliced == [0, 1, 2, 3, 6]   # keeps BOTH halves of é from sess
+    assert tok.decode_raw(spliced) == "abéc"
+
+
+def test_splice_recovers_chained_pockets():
+    """Pockets CHAIN when byte-fallback tokens straddle char boundaries:
+    two adjacent 4-byte emoji split as [f0][9f][98][80 f0][9f][98][80] give
+    predicate T F F F F F F T — wider than any per-char bound. The scan
+    must keep probing while the mismatch is only the trailing U+FFFD run,
+    and still stop at genuine divergence."""
+    from quoracle_tpu.models.generate import splice_session_prompt
+
+    class StraddleTok:
+        TOK = {0: b"\xf0", 1: b"\x9f", 2: b"\x98", 3: b"\x80\xf0",
+               4: b"\x9f", 5: b"\x98", 6: b"\x80", 7: b"Z",
+               8: "😀".encode(), 9: b"c"}
+
+        def decode_raw(self, ids):
+            return b"".join(self.TOK[i] for i in ids).decode(
+                "utf-8", "replace")
+
+        def encode(self, text, add_bos=False):
+            return [{"😀": 8, "c": 9, "Z": 7}[ch] for ch in text]
+
+    tok = StraddleTok()
+    sess = [0, 1, 2, 3, 4, 5, 6, 7]          # "😀😀" byte-split, then "Z"
+    plain = tok.encode("😀😀c")               # canonical: whole-emoji ids
+    spliced = splice_session_prompt(tok, sess, plain)
+    assert spliced == [0, 1, 2, 3, 4, 5, 6, 9]  # full 7-token KV reuse + "c"
+    assert tok.decode_raw(spliced) == "😀😀c"
+
+
 def test_backend_splices_response_kv(monkeypatch):
     """Consensus-shaped round 2 (history + assistant raw text + refinement
     message) through TPUBackend: prefill must run only the new template
