@@ -237,6 +237,7 @@ def decode_paged(
     json_table: Optional[jax.Array] = None,
     json_state: Optional[jax.Array] = None,
     tail_dtype=jnp.bfloat16,
+    shard: Optional[tuple] = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
     """Autoregressive decode against the PAGED pool: same sampling/grammar
     semantics as decode(), but attention reads the row's pages directly
@@ -273,7 +274,7 @@ def decode_paged(
         positions = (lens + kv_off.astype(jnp.int32))[:, None]
         hidden, tail_k, tail_v = forward_hidden_paged(
             params, cfg, cur[:, None], positions, k_pool, v_pool, tables,
-            pool_lens, kv_off, tail_k, tail_v, step=i - 1)
+            pool_lens, kv_off, tail_k, tail_v, step=i - 1, shard=shard)
         logits = project_logits(params, cfg, hidden)
         rng, k = jax.random.split(rng)
         nxt = sample_tokens(mask_logits(logits[:, 0, :], jstate), k,
@@ -589,18 +590,24 @@ class GenerateEngine:
         # The paged steps donate the pool buffers; calls that touch the pool
         # must serialize (concurrent members use separate engines).
         self._paged_lock = threading.Lock()
-        # Resident-size threshold (max prompt tokens in the batch) for the
-        # DIRECT (ragged-kernel) paged decode. Default OFF: measured on
-        # this deployment (tools/bench_longctx.py, v5e via the remote
-        # dispatch relay), the kernel's per-layer launch overhead
-        # (~2.7 ms × n_layers per token) beats the gather path's padded KV
-        # reads even at 16k resident tokens and batch 1 (1115 vs 2516 ms
-        # per 32-token round) — the crossover needs ~1M padded KV tokens
-        # per step (large ragged batches or local-dispatch hosts). The
-        # kernel also caps peak HBM (no [B, maxp·page] working cache),
-        # so memory-pressured deployments may enable it below the
-        # latency crossover.
-        self.direct_decode_min_tokens = 1 << 30
+        # Resident-size thresholds (max prompt tokens in the batch) for the
+        # DIRECT (ragged-kernel) paged decode and paged PREFILL. These are
+        # MEASURED gates, not constants: where the kernels win depends on
+        # the deployment's launch cost (remote-dispatch relay ~2.7 ms vs
+        # local-dispatch ~µs — BASELINE.md "Long-context regime"), so
+        # tools/calibrate_paged.py measures the gather/direct crossover on
+        # the current host and the engine loads it
+        # (utils/calibration.py; env QUORACLE_PAGED_CALIB). With no
+        # calibration file both paths stay off — a documented absence of
+        # data. Beyond latency the direct paths cap peak HBM (no
+        # [B, maxp·page] working cache), so memory-pressured deployments
+        # may calibrate them on below the latency crossover.
+        from quoracle_tpu.utils.calibration import load_paged_gates
+        gates = load_paged_gates()
+        self.paged_gates = gates
+        self.direct_decode_min_tokens = gates.decode_min_resident
+        self.direct_prefill_min_tokens = gates.prefill_min_resident
+        self.direct_prefill_max_chunk = gates.prefill_max_chunk
         # Per-call phase diagnostics (read by the bench + dashboards):
         # wall seconds of the last prefill / decode device phases.
         self.last_prefill_s = 0.0
@@ -708,6 +715,19 @@ class GenerateEngine:
 
         KV, HD, L = cfg.n_kv_heads, cfg.head_dim, cfg.n_layers
         page = self.sessions.page
+        # tp-sharded ragged kernels: each tp shard runs the single-device
+        # kernel on its local heads under shard_map (heads independent, no
+        # collective) — mesh engines keep the direct paths instead of
+        # silently falling back to gather (VERDICT r4 item 3). Gated on
+        # whole GQA groups per shard; _run_paged checks the same.
+        paged_shard = None
+        if (mesh is not None and int(mesh.shape.get("tp", 1)) > 1
+                and cfg.n_heads % int(mesh.shape["tp"]) == 0
+                and cfg.n_kv_heads % int(mesh.shape["tp"]) == 0):
+            paged_shard = (mesh, "tp",
+                           "dp" if int(mesh.shape.get("dp", 1)) > 1
+                           else None)
+        self._paged_shard = paged_shard
 
         @functools.partial(jax.jit, static_argnames=())
         def step_paged_prefill(params, k_pool, v_pool, src_pages, tokens,
@@ -752,6 +772,32 @@ class GenerateEngine:
             return out, n_emitted, cache.lens, k_pool, v_pool, cache.k, \
                 cache.v
 
+        @functools.partial(jax.jit, donate_argnums=(1, 2))
+        def step_paged_prefill_direct(params, k_pool, v_pool, src_tables,
+                                      tokens, prefix_lens, chunk_lens,
+                                      kv_off, flat_dst):
+            # DIRECT paged prefill: the suffix chunk attends to the
+            # resident prefix straight off its pages (one kernel launch
+            # per layer per chunk) and its KV scatters into the dst pages
+            # in place — the [B, maxp·page] working cache never
+            # materializes (VERDICT r4 item 2). Pools donated: the
+            # scatter aliases them.
+            from quoracle_tpu.models.transformer import (
+                forward_hidden_paged_prefill,
+            )
+            B, T = tokens.shape
+            positions = ((prefix_lens + kv_off).astype(jnp.int32)[:, None]
+                         + jnp.arange(T, dtype=jnp.int32)[None, :])
+            hidden, k_pool, v_pool = forward_hidden_paged_prefill(
+                params, cfg, tokens, positions, k_pool, v_pool,
+                src_tables, prefix_lens, chunk_lens, flat_dst,
+                shard=paged_shard)
+            last_h = jnp.take_along_axis(
+                hidden, (chunk_lens - 1)[:, None, None].astype(jnp.int32),
+                axis=1)
+            last = project_logits(params, cfg, last_h)[:, 0, :]
+            return last, k_pool, v_pool
+
         @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
         def step_scatter_prompt(k_pool, v_pool, k_work, v_work, dst_pages):
             # Working cache (prefix gather + suffix prefill) → dst pages,
@@ -781,7 +827,7 @@ class GenerateEngine:
                 cfg.eos_token_id, active=active, row_limit=row_limit,
                 pad_id=self.tokenizer.pad_id, stop_ids=cfg.stop_token_ids,
                 json_table=json_table, json_state=json_state,
-                tail_dtype=self.cache_dtype)
+                tail_dtype=self.cache_dtype, shard=paged_shard)
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
         def step_scatter_tail(k_pool, v_pool, tail_k, tail_v, flat_idx):
@@ -797,6 +843,7 @@ class GenerateEngine:
         self._step_prefill = step_prefill
         self._step_decode = step_decode
         self._step_paged_prefill = step_paged_prefill
+        self._step_paged_prefill_direct = step_paged_prefill_direct
         self._step_paged_decode = step_paged_decode
         self._step_scatter_prompt = step_scatter_prompt
         self._step_paged_decode_direct = step_paged_decode_direct
@@ -1158,11 +1205,16 @@ class GenerateEngine:
         # resident, batch 1 — tools/bench_longctx.py). The kernel's wins
         # are peak-HBM (no [B, maxp·page] working cache) and very large
         # ragged batches; the gate compares the batch's max RESIDENT
-        # (prompt) tokens against direct_decode_min_tokens (default off —
-        # see __init__). Mesh engines always gather (kernel is
-        # single-device). _force_gather_decode is the equality-test seam
+        # (prompt) tokens against direct_decode_min_tokens (measured gate,
+        # utils/calibration.py — see __init__). tp meshes run the kernel
+        # per-shard via shard_map (_paged_shard, whole GQA groups per
+        # shard required); other meshes (sp rings, non-divisible heads)
+        # gather. _force_gather_decode is the equality-test seam
         # (tests/test_paged_kv.py).
-        use_direct = (self.mesh is None
+        mesh_ok = (self.mesh is None
+                   or (self._paged_shard is not None
+                       and int(self.mesh.shape.get("sp", 1)) == 1))
+        use_direct = (mesh_ok
                       and not getattr(self, "_force_gather_decode", False)
                       and max(len(p) for p in prompts)
                       >= self.direct_decode_min_tokens)
@@ -1224,20 +1276,61 @@ class GenerateEngine:
                             st._release(tmp)
                         temp_lists[i] = None
 
-        last_logits, cache = self._step_paged_prefill(
-            self.params, st.k, st.v, put(src, mat), put(tokens, mat),
-            put(pre_arr, row), put(chunk_arr, row), put(off_arr, row))
-        jax.block_until_ready(last_logits)  # phase fence: prefill done
-        t_prefill = time.monotonic()
+        # DIRECT paged prefill composes with the direct decode only (the
+        # gather decode needs the working cache the direct prefill exists
+        # to skip): suffix chunks attend to resident pages in place, chunk
+        # KV scatters to dst pages, and the decode then reads pages — no
+        # [B, maxp·page] materialization anywhere in the call. Gated by
+        # the measured calibration (utils/calibration.py) + a chunk-size
+        # cap (the intra-chunk piece is dense O(T²)).
+        T = tokens.shape[1]
+        use_direct_pre = (
+            use_direct
+            and not getattr(self, "_force_gather_prefill", False)
+            and max(len(p) for p in prompts) >= self.direct_prefill_min_tokens
+            and T <= self.direct_prefill_max_chunk
+            # every prefix-reusing row must write through its OWN session
+            # pages (dst prefix == src prefix, so the resident KV is
+            # already where the decode will read it). A row whose store
+            # was declined (pool exhaustion) reuses a prefix but targets
+            # TEMP pages — its prefix would never reach dst; gather
+            # handles that batch instead.
+            and all(sess_rows[i] is None or dst_lists[i] is not None
+                    for i in range(n)))
+
+        if use_direct_pre:
+            n_tok = st.n_pages * page
+            flat = np.full((B, T), n_tok, np.int32)   # OOB sentinel = drop
+            for i in range(n):
+                n_chunk = min(len(suffixes[i]) or 1,
+                              maxp * page - int(pre_arr[i]))
+                pos = int(pre_arr[i]) + np.arange(max(0, n_chunk))
+                flat[i, :len(pos)] = dst[i, pos // page] * page + pos % page
+            last_logits, st.k, st.v = self._step_paged_prefill_direct(
+                self.params, st.k, st.v, put(src, mat), put(tokens, mat),
+                put(pre_arr, row), put(chunk_arr, row), put(off_arr, row),
+                put(flat, mat))
+            cache = None
+            pool_lens_dev = put(pre_arr + chunk_arr, row)
+            jax.block_until_ready(last_logits)  # phase fence: prefill done
+            t_prefill = time.monotonic()
+        else:
+            last_logits, cache = self._step_paged_prefill(
+                self.params, st.k, st.v, put(src, mat), put(tokens, mat),
+                put(pre_arr, row), put(chunk_arr, row), put(off_arr, row))
+            jax.block_until_ready(last_logits)  # phase fence: prefill done
+            t_prefill = time.monotonic()
 
         if use_direct:
-            # prompt KV → pages, free the working cache, decode straight
-            # off the pool (ragged paged attention), then scatter only the
+            # prompt KV → pages (unless the direct prefill already wrote
+            # them there), free the working cache, decode straight off the
+            # pool (ragged paged attention), then scatter only the
             # generated tail back.
-            pool_lens_dev = cache.lens
-            st.k, st.v = self._step_scatter_prompt(
-                st.k, st.v, cache.k, cache.v, put(dst, mat))
-            cache = None    # drop host refs: k/v donated above, HBM freed
+            if not use_direct_pre:
+                pool_lens_dev = cache.lens
+                st.k, st.v = self._step_scatter_prompt(
+                    st.k, st.v, cache.k, cache.v, put(dst, mat))
+                cache = None  # drop host refs: k/v donated above, HBM freed
             out, n_emitted, final_lens, tail_k, tail_v = \
                 self._step_paged_decode_direct(
                     self.params, st.k, st.v, put(dst, mat), pool_lens_dev,

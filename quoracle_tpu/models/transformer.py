@@ -261,6 +261,7 @@ def forward_hidden_paged(
     tail_k: jax.Array,       # [L, B, Tmax, n_kv, hd] generated-token KV
     tail_v: jax.Array,
     step: jax.Array,         # scalar int32: tail slot this token writes
+    shard: Optional[tuple] = None,   # (mesh, tp_axis, dp_axis|None)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Decode-step forward against the PAGED session pool: attention reads
     the row's pages directly (ops/paged_attention.py — ragged, only
@@ -294,7 +295,7 @@ def forward_hidden_paged(
         attn = paged_decode_attend(
             q, kp, vp, tables, pool_lens, kv_off, tk, tv,
             tail_len=step + 1, q_pos=positions[:, 0],
-            sliding_window=cfg.sliding_window)
+            sliding_window=cfg.sliding_window, shard=shard)
         x = x + jnp.einsum("bthd,hdD->btD", attn,
                            p["wo"].reshape(cfg.n_heads, cfg.head_dim,
                                            cfg.dim))
@@ -309,6 +310,78 @@ def forward_hidden_paged(
         layer_body, x, (params["layers"], k_pool, v_pool, tail_k, tail_v))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
     return x, new_tk, new_tv
+
+
+def forward_hidden_paged_prefill(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jax.Array,       # [B, T] int32 right-padded suffix chunk
+    positions: jax.Array,    # [B, T] int32 absolute positions
+    k_pool: jax.Array,       # [L, n_pages, page, n_kv, hd] (donated by jit)
+    v_pool: jax.Array,
+    src_tables: jax.Array,   # [B, maxp] pages holding the resident prefix
+    prefix_lens: jax.Array,  # [B] int32 resident pool tokens per row
+    chunk_lens: jax.Array,   # [B] int32 valid chunk tokens per row
+    flat_dst: jax.Array,     # [B, T] int32 flat pool token slot for each
+                             # chunk position (OOB sentinel = drop), from
+                             # the row's DST page table
+    interpret: Optional[bool] = None,
+    shard: Optional[tuple] = None,   # (mesh, tp_axis, dp_axis|None)
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """PREFILL against the paged session pool: the suffix chunk attends to
+    the resident prefix by streaming its pages directly
+    (ops/paged_attention.paged_prefill_merge — one kernel launch per layer
+    per CHUNK) merged with dense causal intra-chunk attention, and the
+    chunk's own KV scatters straight into the row's dst pages. The
+    [B, maxp·page] contiguous working cache the gather path materializes
+    never exists (VERDICT r4 item 2; NOTES_r03 gap 1). Returns
+    (hidden [B, T, D], k_pool, v_pool) with the chunk KV written."""
+    from quoracle_tpu.ops.paged_attention import paged_prefill_merge
+    B, T = tokens.shape
+    n_tok = k_pool.shape[1] * k_pool.shape[2]
+    x = params["embed"][tokens]
+    if cfg.scale_embeddings:
+        x = (x.astype(jnp.float32) * (cfg.dim ** 0.5)).astype(x.dtype)
+
+    def layer_body(x, scanned):
+        p, kp, vp = scanned          # kp/vp: [n_pages, page, kv, hd]
+        h = rmsnorm(x, p["attn_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
+        q = jnp.einsum("btd,dh->bth", h, p["wq"])
+        k = jnp.einsum("btd,dh->bth", h, p["wk"])
+        v = jnp.einsum("btd,dh->bth", h, p["wv"])
+        if cfg.attn_bias:
+            q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+        q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+        attn = paged_prefill_merge(
+            q, k.astype(kp.dtype), v.astype(vp.dtype), kp, vp, src_tables,
+            prefix_lens, chunk_lens, sliding_window=cfg.sliding_window,
+            interpret=interpret, shard=shard)
+        # chunk KV → dst pages in place (padding/overflow slots carry the
+        # OOB sentinel and drop). The attention above read the pool BEFORE
+        # this write; chunk↔chunk attention used the dense piece, so
+        # nothing this layer needs re-reading.
+        kf = kp.reshape(n_tok, *kp.shape[2:])
+        vf = vp.reshape(n_tok, *vp.shape[2:])
+        kf = kf.at[flat_dst].set(k.astype(kp.dtype), mode="drop")
+        vf = vf.at[flat_dst].set(v.astype(vp.dtype), mode="drop")
+        x = x + jnp.einsum("bthd,hdD->btD", attn.astype(x.dtype),
+                           p["wo"].reshape(cfg.n_heads, cfg.head_dim,
+                                           cfg.dim))
+        h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
+        gate = _activation(jnp.einsum("btd,df->btf", h, p["w_gate"]),
+                           cfg.activation)
+        up = jnp.einsum("btd,df->btf", h, p["w_up"])
+        x = x + jnp.einsum("btf,fd->btd", gate * up, p["w_down"])
+        return x, (kf.reshape(kp.shape), vf.reshape(vp.shape))
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_body, x, (params["layers"], k_pool, v_pool))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
+    return x, new_k, new_v
 
 
 def project_logits(params: dict, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:

@@ -58,6 +58,25 @@ def _partials_from_scores(scores: jax.Array, mask: jax.Array,
     return acc, m, l
 
 
+def _partials_from_scores_t(scores: jax.Array, mask: jax.Array,
+                            v: jax.Array) -> tuple:
+    """Multi-query variant: scores [B, KV, G, T, S], mask broadcastable to
+    it, v [B, S, KV, hd] → partials reshaped to query-major layout
+    (acc [B, T, H, hd], m [B, T, H], l [B, T, H]) f32. Shares the partial
+    convention documented at the top of the file with
+    _partials_from_scores — keep them in lockstep."""
+    scores = jnp.where(mask, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)
+    p = jnp.where(jnp.broadcast_to(mask, scores.shape),
+                  jnp.exp(scores - m[..., None]), 0.0)
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bkgts,bskd->bkgtd", p, v.astype(jnp.float32))
+    B, KV, G, T, hd = acc.shape
+    acc = acc.transpose(0, 3, 1, 2, 4).reshape(B, T, KV * G, hd)
+    return (acc, m.transpose(0, 3, 1, 2).reshape(B, T, KV * G),
+            l.transpose(0, 3, 1, 2).reshape(B, T, KV * G))
+
+
 def merge_partials(p1: tuple, p2: tuple) -> jax.Array:
     """Combine two online-softmax partials → normalized output (f32)."""
     a1, m1, l1 = p1
@@ -308,6 +327,294 @@ def paged_attend(
     return acc[..., :hd], stats[:, 0], stats[:, 1]
 
 
+def chunk_attend_partials(
+    q: jax.Array,          # [B, T, H, hd] (prefill chunk queries)
+    k: jax.Array,          # [B, T, KV, hd] (the chunk's own KV)
+    v: jax.Array,
+    chunk_lens: jax.Array,  # [B] int32 valid chunk tokens per row
+    sliding_window: Optional[int] = None,
+) -> tuple:
+    """Dense causal partials of the chunk against ITSELF (the paged-prefill
+    counterpart of tail_attend_partials). Both sides share the row's
+    absolute offset (kv_off + prefix), so causality reduces to s <= t and
+    the window to t - s < W — no absolute positions needed. fp32, O(T²)
+    scores: the direct-prefill gate caps the chunk size (resumed rounds
+    splice most of the prompt; long FRESH prefills are dense already and
+    never gather, so they stay on the standard path)."""
+    B, T, H, hd = q.shape
+    KV = k.shape[2]
+    scale = hd ** -0.5
+    qg = (q.astype(jnp.float32) * scale).reshape(B, T, KV, H // KV, hd)
+    kT = k.astype(jnp.float32)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, kT)       # [B,KV,G,T,S]
+    t_idx = jnp.arange(T, dtype=jnp.int32)
+    causal = t_idx[:, None] >= t_idx[None, :]              # [T, S]
+    valid = t_idx[None, :] < chunk_lens.astype(jnp.int32)[:, None]  # [B, S]
+    mask = causal[None, :, :] & valid[:, None, :]
+    if sliding_window is not None:
+        mask &= (t_idx[:, None] - t_idx[None, :]
+                 < sliding_window)[None, :, :]
+    mask = mask[:, None, None, :, :]                       # [B,1,1,T,S]
+    return _partials_from_scores_t(scores, mask, v)
+
+
+def paged_prefill_attend_ref(
+    q: jax.Array,          # [B, T, H, hd] (chunk queries)
+    k_pages: jax.Array,    # [n_pages, page, KV, hd]
+    v_pages: jax.Array,
+    tables: jax.Array,     # [B, maxp] int32
+    kv_lens: jax.Array,    # [B] int32 resident PREFIX tokens per row
+    sliding_window: Optional[int] = None,
+) -> tuple:
+    """Partials of the whole chunk against the resident pool prefix, via a
+    page gather (CPU tests / fallback oracle for the kernel). Every pool
+    token precedes every chunk token (the chunk starts at buffer index
+    kv_lens), so causality is just s < kv_len; the window uses the shared
+    offset: q_abs - s_abs = kv_len + t - s."""
+    B, T, H, hd = q.shape
+    n_pages, page, KV, _ = k_pages.shape
+    maxp = tables.shape[1]
+    k = k_pages[tables].reshape(B, maxp * page, KV, hd)
+    v = v_pages[tables].reshape(B, maxp * page, KV, hd)
+    scale = hd ** -0.5
+    qg = (q.astype(jnp.float32) * scale).reshape(B, T, KV, H // KV, hd)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, k.astype(jnp.float32))
+    s_idx = jnp.arange(maxp * page, dtype=jnp.int32)
+    t_idx = jnp.arange(T, dtype=jnp.int32)
+    kl = kv_lens.astype(jnp.int32)[:, None, None]          # [B,1,1]
+    mask = jnp.broadcast_to(s_idx[None, None, :] < kl,
+                            (B, T, maxp * page))
+    if sliding_window is not None:
+        dist = (kl + t_idx[None, :, None]) - s_idx[None, None, :]
+        mask &= dist < sliding_window
+    mask = mask[:, None, None, :, :]                       # [B,1,1,T,S]
+    return _partials_from_scores_t(scores, mask, v)
+
+
+def _paged_prefill_kernel(tables_ref, meta_ref, q_ref, k_hbm, v_hbm,
+                          acc_ref, stats_ref, k_scr, v_scr, sems, *,
+                          page: int, n_kv: int, hd: int, t_blk: int,
+                          scale: float, window: int):
+    """One (batch row, T-block): stream the row's PREFIX pages through VMEM
+    double-buffered (same DMA/layout recipe as _paged_kernel — kv heads
+    flattened into the lane dim) and accumulate online-softmax partials
+    for every query in the block at once — ONE launch per layer per
+    chunk, not per token: the launch overhead that makes the decode
+    kernel lose at small batch amortizes over the whole chunk here."""
+    b = pl.program_id(0)
+    tb = pl.program_id(1)
+    kv_len = meta_ref[b, 0]
+    n = (kv_len + page - 1) // page
+
+    q = q_ref[0].astype(jnp.float32) * scale             # [Tb, H, hd]
+    Tb = q.shape[0]
+    H = q.shape[1]
+    G = H // n_kv
+
+    def start_dma(j, slot):
+        pid = tables_ref[b, j]
+        pltpu.make_async_copy(k_hbm.at[pid], k_scr.at[slot],
+                              sems.at[slot, 0]).start()
+        pltpu.make_async_copy(v_hbm.at[pid], v_scr.at[slot],
+                              sems.at[slot, 1]).start()
+
+    def wait_dma(j, slot):
+        pid = tables_ref[b, j]
+        pltpu.make_async_copy(k_hbm.at[pid], k_scr.at[slot],
+                              sems.at[slot, 0]).wait()
+        pltpu.make_async_copy(v_hbm.at[pid], v_scr.at[slot],
+                              sems.at[slot, 1]).wait()
+
+    @pl.when(n > 0)
+    def _():
+        start_dma(0, 0)
+
+    # Window validity shared by every kv head: q_abs - s_abs = kv_len + t - s
+    # (the row's absolute offset cancels on both sides).
+    t_of_row = tb * t_blk + jax.lax.broadcasted_iota(
+        jnp.int32, (Tb, G), 0).reshape(Tb * G, 1)
+
+    def body(j, carry):
+        # carry: per-kv-head tuples of (m [Tb·G,1], l [Tb·G,1], acc [Tb·G,hd])
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < n)
+        def _():
+            start_dma(j + 1, jax.lax.rem(j + 1, 2))
+
+        wait_dma(j, slot)
+        k_blk = k_scr[slot].astype(jnp.float32)          # [page, KV·hd]
+        v_blk = v_scr[slot].astype(jnp.float32)
+        s_idx = j * page + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page), 1)                     # [1, page]
+        valid = s_idx < kv_len
+        if window >= 0:
+            valid = valid & (kv_len + t_of_row - s_idx < window)
+        out = []
+        for kv in range(n_kv):
+            m, l, acc = carry[kv]
+            scores = jax.lax.dot_general(                # [Tb·G, page]
+                q[:, kv * G:(kv + 1) * G].reshape(Tb * G, hd),
+                k_blk[:, kv * hd:(kv + 1) * hd],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            scores = jnp.where(valid, scores, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(scores, axis=1, keepdims=True))
+            p = jnp.where(valid, jnp.exp(scores - m_new), 0.0)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+            pv = jax.lax.dot_general(                    # [Tb·G, hd]
+                p, v_blk[:, kv * hd:(kv + 1) * hd],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            out.append((m_new, l_new, acc * corr + pv))
+        return tuple(out)
+
+    init = tuple((jnp.full((Tb * G, 1), NEG_INF, jnp.float32),
+                  jnp.zeros((Tb * G, 1), jnp.float32),
+                  jnp.zeros((Tb * G, hd), jnp.float32))
+                 for _ in range(n_kv))
+    final = jax.lax.fori_loop(0, n, body, init)
+    for kv in range(n_kv):
+        m, l, acc = final[kv]
+        acc_ref[0, :, kv * G:(kv + 1) * G] = acc.reshape(Tb, G, hd)
+        stats_ref[0, :, 0, kv * G:(kv + 1) * G] = m.reshape(Tb, G)
+        stats_ref[0, :, 1, kv * G:(kv + 1) * G] = l.reshape(Tb, G)
+
+
+@functools.partial(jax.jit, static_argnames=("sliding_window", "interpret",
+                                             "t_blk"))
+def paged_prefill_attend(
+    q: jax.Array,          # [B, T, H, hd] (chunk queries)
+    k_pages: jax.Array,    # [n_pages, page, KV, hd]
+    v_pages: jax.Array,
+    tables: jax.Array,     # [B, maxp] int32
+    kv_lens: jax.Array,    # [B] int32 resident prefix tokens
+    sliding_window: Optional[int] = None,
+    interpret: bool = False,
+    t_blk: int = 128,
+) -> tuple:
+    """Pallas partials of a whole prefill chunk against the paged pool
+    (same contract as paged_prefill_attend_ref; tests assert agreement).
+    Grid is (B, T/t_blk): each launch streams the row's prefix pages once
+    for t_blk queries — launch cost amortizes over the chunk."""
+    B, T, H, hd = q.shape
+    n_pages, page, KV, _ = k_pages.shape
+    hd_p = max(128, ((hd + 127) // 128) * 128)
+    if hd_p != hd:
+        q = jnp.pad(q, [(0, 0), (0, 0), (0, 0), (0, hd_p - hd)])
+        padkv = [(0, 0), (0, 0), (0, 0), (0, hd_p - hd)]
+        k_pages = jnp.pad(k_pages, padkv)
+        v_pages = jnp.pad(v_pages, padkv)
+    t_blk = min(t_blk, T)
+    if T % t_blk:
+        pad_t = t_blk - T % t_blk
+        q = jnp.pad(q, [(0, 0), (0, pad_t), (0, 0), (0, 0)])
+    Tp = q.shape[1]
+    kf = k_pages.reshape(n_pages, page, KV * hd_p)
+    vf = v_pages.reshape(n_pages, page, KV * hd_p)
+    meta = kv_lens.astype(jnp.int32)[:, None]            # [B, 1]
+    scale = hd ** -0.5
+    kernel = functools.partial(
+        _paged_prefill_kernel, page=page, n_kv=KV, hd=hd_p, t_blk=t_blk,
+        scale=scale,
+        window=-1 if sliding_window is None else int(sliding_window))
+    acc, stats = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,                        # tables, meta
+            grid=(B, Tp // t_blk),
+            in_specs=[
+                pl.BlockSpec((1, t_blk, H, hd_p),
+                             lambda b, tb, *_: (b, tb, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, t_blk, H, hd_p),
+                             lambda b, tb, *_: (b, tb, 0, 0)),
+                pl.BlockSpec((1, t_blk, 2, H),
+                             lambda b, tb, *_: (b, tb, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, page, KV * hd_p), k_pages.dtype),
+                pltpu.VMEM((2, page, KV * hd_p), v_pages.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Tp, H, hd_p), jnp.float32),
+            jax.ShapeDtypeStruct((B, Tp, 2, H), jnp.float32),
+        ],
+        interpret=interpret,
+    )(tables.astype(jnp.int32), meta, q, kf, vf)
+    return (acc[:, :T, :, :hd], stats[:, :T, 0], stats[:, :T, 1])
+
+
+def _tp_shard_map(inner, shard, q_rank4: bool):
+    """Wrap a paged-attention piece in shard_map over the tp axis: every
+    head attends independently (GQA groups stay whole per shard — callers
+    gate on H % tp == KV % tp == 0), so each tp shard runs the
+    single-device kernel on its local heads with NO collective; dp shards
+    the batch. This is how mesh engines keep the ragged kernels instead
+    of falling back to gather (VERDICT r4 item 3)."""
+    try:
+        from jax import shard_map
+    except ImportError:                      # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    mesh, tp_ax, dp_ax = shard
+    head = P(dp_ax, None, tp_ax, None)       # [B, T|1, H, hd] (and tails)
+    kv = P(None, None, tp_ax, None)          # [n_pages, page, KV, hd]
+    row = P(dp_ax)
+    tbl = P(dp_ax, None)
+    if q_rank4:   # decode: q [B,1,H,hd]; prefill merge: q [B,T,H,hd]
+        specs = dict(in_specs=(head, kv, kv, tbl, row, row,
+                               head, head, P(), row),
+                     out_specs=head)
+    else:
+        specs = dict(in_specs=(head, head, head, kv, kv, tbl, row, row),
+                     out_specs=head)
+    return shard_map(inner, mesh=mesh, check_rep=False, **specs)
+
+
+def paged_prefill_merge(
+    q: jax.Array,          # [B, T, H, hd]
+    chunk_k: jax.Array,    # [B, T, KV, hd]
+    chunk_v: jax.Array,
+    k_pages: jax.Array,    # [n_pages, page, KV, hd]
+    v_pages: jax.Array,
+    tables: jax.Array,
+    prefix_lens: jax.Array,   # [B] resident pool tokens
+    chunk_lens: jax.Array,    # [B] valid chunk tokens
+    sliding_window: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    shard: Optional[tuple] = None,   # (mesh, tp_axis, dp_axis|None)
+) -> jax.Array:
+    """Full paged-prefill attention = pool-prefix piece ⊕ intra-chunk
+    causal piece → [B, T, H, hd] in q.dtype. Pallas kernel on TPU, gather
+    reference elsewhere (CPU tests — same numerics, no paging win). With
+    ``shard``, runs per-tp-shard under shard_map (heads independent)."""
+    if shard is not None:
+        inner = functools.partial(paged_prefill_merge,
+                                  sliding_window=sliding_window,
+                                  interpret=interpret, shard=None)
+        return _tp_shard_map(inner, shard, q_rank4=False)(
+            q, chunk_k, chunk_v, k_pages, v_pages, tables, prefix_lens,
+            chunk_lens)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu or interpret:
+        pooled = paged_prefill_attend(q, k_pages, v_pages, tables,
+                                      prefix_lens, sliding_window,
+                                      interpret=bool(interpret))
+    else:
+        pooled = paged_prefill_attend_ref(q, k_pages, v_pages, tables,
+                                          prefix_lens, sliding_window)
+    chunk = chunk_attend_partials(q, chunk_k, chunk_v, chunk_lens,
+                                  sliding_window)
+    return merge_partials(pooled, chunk).astype(q.dtype)
+
+
 def paged_decode_attend(
     q: jax.Array,          # [B, 1, H, hd] (decode step)
     k_pages: jax.Array,    # [n_pages, page, KV, hd]
@@ -320,10 +627,18 @@ def paged_decode_attend(
     tail_len,              # scalar/[B] valid tail entries (incl. current)
     q_pos: jax.Array,      # [B] absolute query position
     sliding_window: Optional[int] = None,
+    shard: Optional[tuple] = None,   # (mesh, tp_axis, dp_axis|None)
 ) -> jax.Array:
     """Full decode attention = paged pool piece ⊕ tail piece → [B, 1, H, hd]
     in q.dtype. Picks the Pallas kernel on TPU, the gather reference
-    elsewhere (CPU tests — same numerics, no paging win)."""
+    elsewhere (CPU tests — same numerics, no paging win). With ``shard``,
+    runs per-tp-shard under shard_map (heads independent)."""
+    if shard is not None:
+        inner = functools.partial(paged_decode_attend,
+                                  sliding_window=sliding_window, shard=None)
+        return _tp_shard_map(inner, shard, q_rank4=True)(
+            q, k_pages, v_pages, tables, pool_lens, kv_off, tail_k, tail_v,
+            jnp.asarray(tail_len), q_pos)
     B, _, H, hd = q.shape
     q1 = q[:, 0]
     on_tpu = jax.devices()[0].platform == "tpu"

@@ -188,3 +188,63 @@ def test_member_batcher_coalesces_concurrent_rounds():
     # sessions stored per agent despite the merge
     assert all(engine.sessions.get(f"agent-{a}") is not None
                for a in range(3))
+
+
+def test_tp_sharded_direct_paged_paths_match_gather(eight_devices):
+    """Mesh engines must run the ragged paged kernels per-tp-shard via
+    shard_map instead of silently falling back to gather (VERDICT r4
+    item 3): direct decode + direct prefill on a tp=2 mesh produce the
+    same greedy tokens as the single-device gather path, across a
+    session-resumed refinement round with a sessionless neighbor row."""
+    from quoracle_tpu.parallel.mesh import make_mesh
+    cfg = get_model_config("xla:tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    tok = ByteTokenizer()
+
+    def run(eng):
+        pa = tok.encode("user: compare sharded paged paths", add_bos=True)
+        pb = tok.encode("user: sessionless neighbor", add_bos=True)
+        r = eng.generate([pa, pb], temperature=0.0, max_new_tokens=8,
+                         session_ids=["s", None])
+        pa2 = pa + r[0].token_ids + tok.encode(" refine")[0:]
+        r2 = eng.generate([pa2, pb], temperature=0.0, max_new_tokens=8,
+                          session_ids=["s", None])
+        return [x.token_ids for x in r + r2]
+
+    plain = GenerateEngine(cfg, params, tok, max_seq=256,
+                           prompt_buckets=(32, 64))
+    plain._force_gather_decode = True
+
+    mesh = make_mesh(2, tp=2, devices=eight_devices[:2])
+    direct = GenerateEngine(cfg, params, tok, max_seq=256,
+                            prompt_buckets=(32, 64), mesh=mesh)
+    assert direct._paged_shard is not None
+    direct.direct_decode_min_tokens = 0
+    direct.direct_prefill_min_tokens = 0
+    want, got = run(plain), run(direct)
+    assert got == want
+
+
+def test_tp_dp_sharded_direct_decode_matches(eight_devices):
+    """dp×tp mesh: batch rides dp, heads ride tp, kernels per-shard."""
+    from quoracle_tpu.parallel.mesh import make_mesh
+    cfg = get_model_config("xla:tiny")
+    params = init_params(cfg, jax.random.PRNGKey(1), dtype=jnp.float32)
+    tok = ByteTokenizer()
+    prompts = [tok.encode(f"row {i} with some content", add_bos=True)
+               for i in range(4)]
+    sids = [f"s{i}" for i in range(4)]
+
+    plain = GenerateEngine(cfg, params, tok, max_seq=256,
+                           prompt_buckets=(32, 64))
+    plain._force_gather_decode = True
+    mesh = make_mesh(4, tp=2, devices=eight_devices[:4])  # dp=2 x tp=2
+    direct = GenerateEngine(cfg, params, tok, max_seq=256,
+                            prompt_buckets=(32, 64), mesh=mesh)
+    direct.direct_decode_min_tokens = 0
+    direct.direct_prefill_min_tokens = 0
+    a = plain.generate(prompts, temperature=0.0, max_new_tokens=8,
+                       session_ids=sids)
+    b = direct.generate(prompts, temperature=0.0, max_new_tokens=8,
+                        session_ids=sids)
+    assert [r.token_ids for r in a] == [r.token_ids for r in b]
