@@ -185,9 +185,15 @@ async def call_mcp_action(core, router, params: dict) -> dict:
         result = await mcp.call_tool(
             params["server"], params["tool"], params.get("arguments") or {},
             timeout_s=float(params["timeout"]) if params.get("timeout")
-            else None)
+            else None, agent_id=core.agent_id)
     except (MCPError, asyncio.TimeoutError) as e:
-        raise ActionError(f"call_mcp failed: {e}")
+        # surface the server's captured stderr tail into the agent-visible
+        # error (reference error_context.ex) — a dying stdio server's last
+        # words are usually the whole diagnosis
+        ctx = mcp.error_context(params["server"])
+        extra = f"\nserver stderr tail:\n{ctx}" if (
+            ctx and "stderr tail" not in str(e)) else ""
+        raise ActionError(f"call_mcp failed: {e}{extra}")
     # MCP results carry a content list; flatten text parts for the history
     content = (result or {}).get("content", [])
     texts = [c.get("text", "") for c in content if c.get("type") == "text"]

@@ -54,13 +54,46 @@ class MCPServerConfig:
                    timeout_s=float(d.get("timeout_s", DEFAULT_TIMEOUT_S)))
 
 
+STDERR_TAIL_LINES = 40             # bounded per-connection error context
+
+
 class _StdioConnection:
     def __init__(self, config: MCPServerConfig):
+        import collections
         self.config = config
         self.proc: Optional[Any] = None
         self._id = 0
         self._lock = asyncio.Lock()
         self.tools: Optional[list[dict]] = None
+        # Error context (reference mcp/error_context.ex: logger output
+        # captured per client): the server's stderr tail, drained by a
+        # background task so a dying server's last words survive into the
+        # agent-visible error instead of vanishing (VERDICT r4 item 7).
+        self.stderr_tail: "collections.deque[str]" = collections.deque(
+            maxlen=STDERR_TAIL_LINES)
+        self._stderr_task: Optional[asyncio.Task] = None
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.returncode is None
+
+    def error_context(self) -> str:
+        return "\n".join(self.stderr_tail)
+
+    async def _drain_stderr(self) -> None:
+        assert self.proc is not None and self.proc.stderr is not None
+        while True:
+            line = await self.proc.stderr.readline()
+            if not line:
+                return
+            self.stderr_tail.append(
+                line.decode("utf-8", errors="replace").rstrip("\n"))
+
+    def _death_note(self) -> str:
+        ctx = self.error_context()
+        rc = self.proc.returncode if self.proc else None
+        note = f" (exit code {rc})" if rc is not None else ""
+        return note + (f"; stderr tail:\n{ctx}" if ctx else "")
 
     async def start(self) -> None:
         if not self.config.command:
@@ -69,8 +102,10 @@ class _StdioConnection:
             *self.config.command,
             stdin=asyncio.subprocess.PIPE,
             stdout=asyncio.subprocess.PIPE,
-            stderr=asyncio.subprocess.DEVNULL,
+            stderr=asyncio.subprocess.PIPE,
             start_new_session=True)
+        self._stderr_task = asyncio.get_running_loop().create_task(
+            self._drain_stderr())
         await self._request("initialize", {
             "protocolVersion": PROTOCOL_VERSION,
             "capabilities": {},
@@ -107,8 +142,10 @@ class _StdioConnection:
                 line = await asyncio.wait_for(self.proc.stdout.readline(),
                                               remaining)
                 if not line:
+                    # give the stderr drain a beat to collect last words
+                    await asyncio.sleep(0.05)
                     raise MCPError(f"server {self.config.name} closed the "
-                                   f"stdio stream")
+                                   f"stdio stream{self._death_note()}")
                 try:
                     msg = json.loads(line)
                 except json.JSONDecodeError:
@@ -122,6 +159,9 @@ class _StdioConnection:
                 return msg.get("result")
 
     async def close(self) -> None:
+        if self._stderr_task is not None:
+            self._stderr_task.cancel()
+            self._stderr_task = None
         if self.proc is not None and self.proc.returncode is None:
             from quoracle_tpu.actions.router import (
                 close_subprocess_transport, kill_process_group,
@@ -135,11 +175,16 @@ class _StdioConnection:
 
 
 class _HttpConnection:
+    alive = True                             # stateless transport
+
     def __init__(self, config: MCPServerConfig, http_fn):
         self.config = config
         self._http = http_fn
         self._id = 0
         self.tools: Optional[list[dict]] = None
+
+    def error_context(self) -> str:
+        return ""
 
     async def start(self) -> None:
         await self._request("initialize", {
@@ -185,11 +230,12 @@ class MCPManager:
         self._connections: dict[str, Any] = {}
         self._lock = asyncio.Lock()              # guards the dicts only
         self._key_locks: dict[str, asyncio.Lock] = {}
+        self._users: dict[str, set[str]] = {}    # dedup key -> agent ids
 
     def add_server(self, name: str, config: dict) -> None:
         self.configs[name] = MCPServerConfig.from_dict(name, config)
 
-    async def _connection(self, server: str):
+    async def _connection(self, server: str, agent_id: Optional[str] = None):
         config = self.configs.get(server)
         if config is None:
             raise MCPError(
@@ -198,7 +244,20 @@ class MCPManager:
         key = config.dedup_key()
         async with self._lock:
             conn = self._connections.get(key)
+            if conn is not None and not conn.alive:
+                # the server process died since the last call: retire the
+                # dead connection (tool cache included) and reconnect —
+                # one crashed tool call must not poison the target forever
+                logger.warning("MCP server %s died%s; reconnecting",
+                               config.name,
+                               conn._death_note()
+                               if hasattr(conn, "_death_note") else "")
+                self._connections.pop(key, None)
+                dead, conn = conn, None
+                asyncio.get_running_loop().create_task(dead.close())
             if conn is not None:
+                if agent_id:
+                    self._users.setdefault(key, set()).add(agent_id)
                 return conn
             key_lock = self._key_locks.setdefault(key, asyncio.Lock())
         # Connect under a per-target lock so one slow/hung server's 120s
@@ -223,21 +282,55 @@ class MCPManager:
                 raise
             async with self._lock:
                 self._connections[key] = conn
+                if agent_id:
+                    self._users.setdefault(key, set()).add(agent_id)
             return conn
 
-    async def list_tools(self, server: str) -> list[dict]:
-        conn = await self._connection(server)
-        if conn.tools is None:
+    async def list_tools(self, server: str,
+                         agent_id: Optional[str] = None) -> list[dict]:
+        conn = await self._connection(server, agent_id)
+        if conn.tools is None:   # cached per connection (mcp/client.ex:1-15)
             result = await conn._request("tools/list", {})
             conn.tools = (result or {}).get("tools", [])
         return conn.tools
 
     async def call_tool(self, server: str, tool: str, arguments: dict,
-                        timeout_s: Optional[float] = None) -> Any:
-        conn = await self._connection(server)
+                        timeout_s: Optional[float] = None,
+                        agent_id: Optional[str] = None) -> Any:
+        conn = await self._connection(server, agent_id)
         return await conn._request(
             "tools/call", {"name": tool, "arguments": arguments},
             timeout_s=timeout_s)
+
+    def error_context(self, server: str) -> str:
+        """The server's captured stderr tail (empty for http / unknown) —
+        surfaced into agent-visible errors (reference error_context.ex)."""
+        config = self.configs.get(server)
+        if config is None:
+            return ""
+        conn = self._connections.get(config.dedup_key())
+        return conn.error_context() if conn is not None else ""
+
+    async def release_agent(self, agent_id: str) -> None:
+        """Teardown on agent dismiss: drop the agent from every
+        connection's user set and close connections no live agent uses
+        (reference: per-agent Client GenServers die with their agent; the
+        deduped equivalent is refcounting). Connections acquired without
+        an agent id (runtime-level callers, tests) are never auto-closed."""
+        to_close = []
+        async with self._lock:
+            for key, users in list(self._users.items()):
+                users.discard(agent_id)
+                if not users:
+                    del self._users[key]
+                    conn = self._connections.pop(key, None)
+                    if conn is not None:
+                        to_close.append(conn)
+        for conn in to_close:
+            try:
+                await conn.close()
+            except Exception:
+                logger.exception("MCP close on agent release failed")
 
     async def close(self) -> None:
         for conn in self._connections.values():
@@ -246,3 +339,4 @@ class MCPManager:
             except Exception:
                 logger.exception("MCP connection close failed")
         self._connections.clear()
+        self._users.clear()

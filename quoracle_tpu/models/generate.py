@@ -211,9 +211,11 @@ def decode(
     # Feed the first sampled token through the loop starting at step 1.
     init = (jnp.asarray(1, jnp.int32), done0, tok0, out0, n0, cache, rng,
             jstate0)
-    _, done, _, out, n_emitted, cache, _, _ = \
+    _, done, _, out, n_emitted, cache, _, jstate = \
         jax.lax.while_loop(cond, body, init)
-    return out, n_emitted, cache
+    # jstate returned so chunked continuations (models/scheduler.py) can
+    # resume the grammar mid-stream via initial_json_state.
+    return out, n_emitted, cache, jstate
 
 
 def decode_paged(
@@ -291,9 +293,9 @@ def decode_paged(
 
     init = (jnp.asarray(1, jnp.int32), done0, tok0, out0, n0, lens0,
             tail_k0, tail_v0, rng, jstate0)
-    (_, done, _, out, n_emitted, lens, tail_k, tail_v, _, _) = \
+    (_, done, _, out, n_emitted, lens, tail_k, tail_v, _, jstate) = \
         jax.lax.while_loop(cond, body, init)
-    return out, n_emitted, lens, tail_k, tail_v
+    return out, n_emitted, lens, tail_k, tail_v, jstate
 
 
 def _round_up(n: int, buckets: Sequence[int]) -> int:
@@ -318,6 +320,10 @@ class GenResult:
     latency_s: float
     finish_reason: str  # "stop" | "length"
     n_cached_tokens: int = 0   # prompt prefix served from a resident KV session
+    json_state: int = -1  # final grammar state (-1 = unconstrained); feed
+                          # back as initial_json_state to resume a
+                          # constrained stream mid-JSON (chunked
+                          # continuation, models/scheduler.py)
 
 
 PAGE = 128   # tokens per KV page
@@ -752,7 +758,7 @@ class GenerateEngine:
                               temperature, top_p, active, row_limit,
                               json_table, json_state, max_new: int):
             cache = _constrain(KVCache(k=k_work, v=v_work, lens=lens))
-            out, n_emitted, cache = decode(
+            out, n_emitted, cache, jstate = decode(
                 params, cfg, cache, last_logits, rng, temperature, top_p,
                 max_new, cfg.eos_token_id, active=active,
                 row_limit=row_limit, pad_id=self.tokenizer.pad_id,
@@ -770,7 +776,7 @@ class GenerateEngine:
             # work buffers alias an output — the decode loop then runs
             # truly in place instead of copying the working cache.
             return out, n_emitted, cache.lens, k_pool, v_pool, cache.k, \
-                cache.v
+                cache.v, jstate
 
         @functools.partial(jax.jit, donate_argnums=(1, 2))
         def step_paged_prefill_direct(params, k_pool, v_pool, src_tables,
@@ -865,6 +871,7 @@ class GenerateEngine:
         constrain_json: Optional[Sequence[bool]] = None,
         action_enums: Optional[Sequence[Optional[Sequence[str]]]] = None,
         images: Optional[Sequence] = None,
+        initial_json_state: Optional[Sequence[Optional[int]]] = None,
     ) -> list[GenResult]:
         """``session_ids`` (aligned with prompts; None entries opt out)
         enables KV residency: each row reuses the longest token prefix it
@@ -931,10 +938,12 @@ class GenerateEngine:
             with self._paged_lock:
                 return self._generate_impl(
                     prompts, temperature, top_p, max_new_tokens, rng,
-                    session_ids, constrain_json, action_enums, images)
+                    session_ids, constrain_json, action_enums, images,
+                    initial_json_state)
         return self._generate_impl(prompts, temperature, top_p,
                                    max_new_tokens, rng, session_ids,
-                                   constrain_json, action_enums, images)
+                                   constrain_json, action_enums, images,
+                                   initial_json_state)
 
     def drop_session(self, session_id: str) -> None:
         """Release a session's pages. Serialized with sessioned generate
@@ -954,7 +963,8 @@ class GenerateEngine:
     def _generate_impl(self, prompts, temperature=1.0, top_p=1.0,
                        max_new_tokens=256, rng=None, session_ids=None,
                        constrain_json=None, action_enums=None,
-                       images=None) -> list[GenResult]:
+                       images=None,
+                       initial_json_state=None) -> list[GenResult]:
         t0 = time.monotonic()
         n = len(prompts)
         if n == 0:
@@ -1085,6 +1095,7 @@ class GenerateEngine:
         # grammar's start state; -1 rows sample unconstrained. Rows may
         # carry different action enums — distinct grammars stack into one
         # table with offset state ids.
+        grammar_bases = None
         if constrain_json is not None and any(constrain_json):
             enums = [None] * n
             if action_enums is not None:
@@ -1093,17 +1104,27 @@ class GenerateEngine:
             distinct = sorted({e for e, f in zip(enums, constrain_json)
                                if f},
                               key=lambda e: (e is not None, e or ()))
-            table, offsets = self._json_table_device(tuple(distinct))
+            table, offsets, bases = self._json_table_device(tuple(distinct))
+            grammar_bases = [bases.get(e, 0) for e in enums]
             jstate = np.full((B,), -1, np.int32)
             for i, flag in enumerate(constrain_json):
                 if flag:
-                    jstate[i] = offsets[enums[i]]
+                    # resume a mid-stream grammar state (chunked
+                    # continuation): states travel RELATIVE to their
+                    # grammar's block base, so they survive different
+                    # table stackings across calls
+                    init_js = (initial_json_state[i]
+                               if initial_json_state is not None else None)
+                    if init_js is not None and init_js >= 0:
+                        jstate[i] = grammar_bases[i] + init_js
+                    else:
+                        jstate[i] = offsets[enums[i]]
             json_args = (table, put(jstate, row))
         else:
             json_args = (None, None)
 
         if paged:
-            out, n_emitted, t_prefill, now = self._run_paged(
+            out, n_emitted, jstate_f, t_prefill, now = self._run_paged(
                 prompts, suffixes, sess_rows, reuse_abs, kv_off_host,
                 store_sids, B, maxp, tokens, pre_arr, off_arr, chunk_arr,
                 limits, rng_key, samp, json_args, max_new, put, mat, row, t0)
@@ -1126,11 +1147,12 @@ class GenerateEngine:
                     cache_len=cache_len)
             jax.block_until_ready(last_logits)  # phase fence: prefill done
             t_prefill = time.monotonic()
-            out, n_emitted, _ = self._step_decode(
+            out, n_emitted, _, jstate_f = self._step_decode(
                 self.params, cache.k, cache.v, cache.lens, last_logits,
                 rng_key, *samp, *json_args, max_new=max_new)
             out = np.asarray(out)
             n_emitted = np.asarray(n_emitted)
+            jstate_f = np.asarray(jstate_f)
             now = time.monotonic()
         self.last_prefill_tokens = sum(len(s) for s in suffixes)
         self.last_prefill_s = t_prefill - t0
@@ -1156,6 +1178,9 @@ class GenerateEngine:
                 latency_s=latency,
                 finish_reason=finish,
                 n_cached_tokens=reuse_abs[i],
+                json_state=(int(jstate_f[i]) - grammar_bases[i]
+                            if constrain_json is not None
+                            and constrain_json[i] else -1),
             ))
         return results
 
@@ -1331,13 +1356,14 @@ class GenerateEngine:
                 st.k, st.v = self._step_scatter_prompt(
                     st.k, st.v, cache.k, cache.v, put(dst, mat))
                 cache = None  # drop host refs: k/v donated above, HBM freed
-            out, n_emitted, final_lens, tail_k, tail_v = \
+            out, n_emitted, final_lens, tail_k, tail_v, jstate_f = \
                 self._step_paged_decode_direct(
                     self.params, st.k, st.v, put(dst, mat), pool_lens_dev,
                     put(off_arr, row), last_logits, rng_key, *samp,
                     *json_args, max_new=max_new)
             out = np.asarray(out)
             n_emitted = np.asarray(n_emitted)
+            jstate_f = np.asarray(jstate_f)
             lens_host = np.asarray(final_lens)
             pool_lens_host = np.asarray(pool_lens_dev)
             flat = np.full((B, tail_k.shape[2]), st.n_pages * page,
@@ -1357,13 +1383,14 @@ class GenerateEngine:
             jax.block_until_ready(st.k)
             now = time.monotonic()
         else:
-            out, n_emitted, final_lens, st.k, st.v, _, _ = \
+            out, n_emitted, final_lens, st.k, st.v, _, _, jstate_f = \
                 self._step_paged_decode(
                     self.params, st.k, st.v, cache.k, cache.v, cache.lens,
                     put(dst, mat), put(off_arr, row), last_logits, rng_key,
                     *samp, *json_args, max_new=max_new)
             out = np.asarray(out)
             n_emitted = np.asarray(n_emitted)
+            jstate_f = np.asarray(jstate_f)
             now = time.monotonic()
 
         lens_host = np.asarray(final_lens)
@@ -1397,7 +1424,7 @@ class GenerateEngine:
         for tmp in temp_lists:
             if tmp:
                 st.release(tmp)
-        return out, n_emitted, t_prefill, now
+        return out, n_emitted, jstate_f, t_prefill, now
 
     def _json_table_device(self, enum_set: tuple):
         """Lazily build + cache grammar tables for this tokenizer (one
@@ -1440,20 +1467,25 @@ class GenerateEngine:
                 _evict("dev", keep=3)
                 _evict("one", keep=7)
                 self._json_cache[dkey] = jnp.asarray(tt.table)
-            return self._json_cache[dkey], {enum_set[0]: tt.start_state}
+            # third element: each grammar's state-block BASE — states
+            # relative to it are portable across calls with different
+            # stackings (chunked continuation, models/scheduler.py)
+            return (self._json_cache[dkey], {enum_set[0]: tt.start_state},
+                    {enum_set[0]: 0})
         skey = ("stack", enum_set)
         if skey not in self._json_cache:
             _evict("stack", keep=1)
             _evict("one", keep=7)
-            tables, offsets, off = [], {}, 0
+            tables, offsets, bases, off = [], {}, {}, 0
             for enum in enum_set:
                 tt = build(enum)
                 shifted = tt.table.astype(np.int32)
                 shifted = np.where(shifted >= 0, shifted + off, REJECT_STATE)
                 tables.append(shifted.astype(np.int16))
                 offsets[enum] = off + tt.start_state
+                bases[enum] = off
                 off += tt.table.shape[0]
             assert off < 32767, "stacked grammar state space exceeds int16"
             self._json_cache[skey] = (jnp.asarray(np.concatenate(tables)),
-                                      offsets)
+                                      offsets, bases)
         return self._json_cache[skey]

@@ -284,12 +284,23 @@ class TPUBackend(ModelBackend):
                  engines: Optional[dict[str, GenerateEngine]] = None,
                  embedder=None, init_params_fn=None,
                  submeshes: Optional[Sequence] = None,
-                 overlap: bool = True):
+                 overlap: bool = True,
+                 continuous: bool = False, continuous_chunk: int = 32,
+                 continuous_slots: int = 8):
         """``submeshes``: one jax Mesh per pool member (parallel.mesh.
         pool_submeshes) — each member's engine serves tp-sharded on its own
         chips, and ``overlap`` runs members concurrently from host threads
         instead of the sequential loop (SURVEY §7 hard part 1). None =
-        single-device engines."""
+        single-device engines.
+
+        ``continuous`` replaces round-granularity baton batching with
+        DECODE-level continuous batching (models/scheduler.py): each
+        member runs a chunked decode loop that concurrent agents' text
+        rows join and leave at ``continuous_chunk``-token boundaries, up
+        to ``continuous_slots`` rows per step. Image rows (which skip KV
+        sessions by design) stay on the baton path. Under continuous
+        mode the per-call prefill/decode phase split is not meaningful
+        (many rows share each device step) and is reported as 0."""
         import jax
         from quoracle_tpu.models.embeddings import EmbeddingEncoder
         from quoracle_tpu.models.transformer import init_params
@@ -322,6 +333,14 @@ class TPUBackend(ModelBackend):
         # One baton batcher per member: concurrent agents' rounds coalesce
         self._batchers = {spec: _MemberBatcher(e)
                           for spec, e in self.engines.items()}
+        self.continuous = continuous
+        self._cbatchers = {}
+        if continuous:
+            from quoracle_tpu.models.scheduler import ContinuousBatcher
+            self._cbatchers = {
+                spec: ContinuousBatcher(e, chunk=continuous_chunk,
+                                        max_slots=continuous_slots)
+                for spec, e in self.engines.items()}
 
         if embedder is not None:
             self.embedder = embedder
@@ -435,6 +454,10 @@ class TPUBackend(ModelBackend):
             live_idxs.append(i)
         if not live_idxs:
             return
+        if self.continuous:
+            self._query_member_continuous(spec, rows, live_idxs, results,
+                                          t0)
+            return
         # The member's baton batcher may merge these rows with concurrent
         # agents' rounds into one generate.
         futs = self._batchers[spec].submit(rows)
@@ -458,6 +481,63 @@ class TPUBackend(ModelBackend):
                 usage=Usage(g.n_prompt_tokens, g.n_gen_tokens, cost),
                 latency_ms=latency_ms,
                 prefill_ms=prefill_ms, decode_ms=decode_ms)
+
+    def _query_member_continuous(self, spec: str, rows: list[dict],
+                                 live_idxs: list[int],
+                                 results: list, t0: float) -> None:
+        """Continuous mode: text rows join the member's shared decode loop
+        (models/scheduler.py) at chunk boundaries; image rows — which skip
+        KV sessions by design — take a direct engine call."""
+        engine = self.engines[spec]
+        cfg = engine.cfg
+        cb = self._cbatchers[spec]
+        futs = []
+        for r in rows:
+            if r["image"] is not None:
+                from concurrent.futures import Future
+                f = Future()
+                try:
+                    # sessionless image calls skip generate()'s internal
+                    # serialization; take the engine's paged lock so this
+                    # call can't race the batcher thread's sessioned
+                    # generates on shared engine state (grammar cache,
+                    # phase stats)
+                    with engine._paged_lock:
+                        g = engine.generate(
+                            [r["prompt"]], temperature=r["temperature"],
+                            top_p=r["top_p"], max_new_tokens=r["budget"],
+                            constrain_json=[r["constrain_json"]],
+                            action_enums=[r["action_enum"]],
+                            images=[r["image"]])[0]
+                    f.set_result(g)
+                except Exception as e:    # noqa: BLE001 — per-row capture
+                    f.set_exception(e)
+                futs.append(f)
+            else:
+                futs.append(cb.submit(
+                    r["prompt"], temperature=r["temperature"],
+                    top_p=r["top_p"], max_new_tokens=r["budget"],
+                    session_id=r["session_id"],
+                    constrain_json=r["constrain_json"],
+                    action_enum=r["action_enum"]))
+        for i, f in zip(live_idxs, futs):
+            try:
+                g = f.result()
+            except ContextOverflowError as e:
+                results[i] = QueryResult(model_spec=spec,
+                                         error=f"context_overflow: {e}")
+                continue
+            except Exception as e:        # noqa: BLE001 — row-level error
+                results[i] = QueryResult(model_spec=spec,
+                                         error=f"generate failed: {e}")
+                continue
+            latency_ms = (time.monotonic() - t0) * 1000
+            cost = (g.n_prompt_tokens * cfg.input_cost_per_mtok
+                    + g.n_gen_tokens * cfg.output_cost_per_mtok) / 1e6
+            results[i] = QueryResult(
+                model_spec=spec, text=g.text,
+                usage=Usage(g.n_prompt_tokens, g.n_gen_tokens, cost),
+                latency_ms=latency_ms, prefill_ms=0.0, decode_ms=0.0)
 
     def embed(self, texts: Sequence[str]) -> list[np.ndarray]:
         return self.embedder.embed(texts)

@@ -575,7 +575,16 @@ def _tp_shard_map(inner, shard, q_rank4: bool):
     else:
         specs = dict(in_specs=(head, head, head, kv, kv, tbl, row, row),
                      out_specs=head)
-    return shard_map(inner, mesh=mesh, check_rep=False, **specs)
+    try:
+        # experimental shard_map needs replication checking OFF (pallas
+        # calls aren't analyzable); jax.shard_map (0.7+) dropped the kwarg
+        # and raises TypeError here — fall back to the bare call. This
+        # order matters: the bare call "succeeds" on the experimental API
+        # too (check_rep defaults ON) and would then fail later at trace
+        # time inside jit.
+        return shard_map(inner, mesh=mesh, check_rep=False, **specs)
+    except TypeError:
+        return shard_map(inner, mesh=mesh, **specs)
 
 
 def paged_prefill_merge(

@@ -98,6 +98,10 @@ DASHBOARD_HTML = r"""<!doctype html>
 <main>
   <section id="left">
     <div id="newtask">
+      <select id="nt-grove" onchange="groveSelected()">
+        <option value="">no grove (plain task)</option>
+      </select>
+      <div id="nt-grove-info" class="meta" style="display:none"></div>
       <input id="nt-desc" placeholder="new task description">
       <div class="row">
         <input id="nt-budget" placeholder="budget (optional)" style="width:120px">
@@ -112,6 +116,8 @@ DASHBOARD_HTML = r"""<!doctype html>
     <div id="logs"></div>
   </section>
   <section id="right" style="border-right:none">
+    <h2>Todos <span id="todo-scope" class="meta"></span></h2>
+    <div id="todos" class="meta" style="margin-bottom:10px"></div>
     <h2>Mailbox</h2>
     <div id="messages"></div>
     <form onsubmit="sendMessage(event)">
@@ -216,20 +222,61 @@ async function refreshTasks() {
     </div>`).join("");
 }
 
+let agentIndex = {};   // agent_id -> payload row (todo panel, badges)
+
+// Budget badge (reference budget_badge.ex): remaining escrow when the
+// agent is capped, else its own spend; tree roll-up sums the subtree's
+// costs client-side (CostAggregator feeds per-agent cost server-side).
+function budgetBadge(a) {
+  const b = a.budget;
+  if (b && b.available != null) {
+    const cls = parseFloat(b.available) <= 0 ? "lvl-error" : "";
+    return `<span class="meta ${cls}" title="spent ${esc(b.spent)} of ` +
+           `${esc(b.limit)}">⛁ ${esc(b.available)} left</span>`;
+  }
+  return `<span class="meta">$${esc(a.cost)}</span>`;
+}
+
 async function refreshAgents() {
   const qs = selTask ? "?task_id=" + selTask : "";
   const agents = await api("/api/agents" + qs);
+  agentIndex = Object.fromEntries(agents.map(a => [a.agent_id, a]));
   const byParent = {};
   agents.forEach(a => (byParent[a.parent_id ?? ""] ??= []).push(a));
-  const render = (pid, depth) => (byParent[pid ?? ""] || []).map(a => `
+  const treeCost = a => (byParent[a.agent_id] || [])
+    .reduce((s, c) => s + treeCost(c), parseFloat(a.cost) || 0);
+  const render = (pid, depth) => (byParent[pid ?? ""] || []).map(a => {
+    const sub = treeCost(a);
+    const roll = (byParent[a.agent_id] || []).length
+      ? `<span class="meta" title="subtree cost">Σ$${sub.toFixed(4)}</span>`
+      : "";
+    return `
     <div class="agent ${a.agent_id===selAgent?"sel":""}"
          style="padding-left:${8+depth*14}px"
          onclick="selectAgent('${a.agent_id}')">
       <span class="aid">${esc(a.agent_id)}</span>
       <span class="meta"> ${esc(a.grove_node||a.profile||"")}
-        ${a.pending_actions ? "⚙" : ""} $${esc(a.cost)}</span>
-    </div>` + render(a.agent_id, depth + 1)).join("");
+        ${a.pending_actions ? "⚙" : ""}
+        ${a.todos && a.todos.length ? "☰" + a.todos.length : ""}</span>
+      ${budgetBadge(a)} ${roll}
+    </div>` + render(a.agent_id, depth + 1);
+  }).join("");
   $("agents").innerHTML = render("", 0);
+  refreshTodos();
+}
+
+function refreshTodos() {
+  const a = selAgent ? agentIndex[selAgent] : null;
+  $("todo-scope").textContent = selAgent || "(select an agent)";
+  const todos = a ? (a.todos || []) : [];
+  $("todos").innerHTML = todos.length
+    ? todos.map(t => {
+        const item = typeof t === "string" ? {text: t} : t;
+        const done = item.done || item.status === "done";
+        return `<div class="log">${done ? "☑" : "☐"} ${
+          esc(item.text || item.item || JSON.stringify(item))}</div>`;
+      }).join("")
+    : '<div class="meta">no todos</div>';
 }
 
 async function refreshLogs() {
@@ -255,7 +302,49 @@ async function refreshMessages() {
 }
 
 function selectTask(id) { selTask = id; refreshAll(); }
-function selectAgent(id) { selAgent = id; refreshLogs(); }
+function selectAgent(id) { selAgent = id; refreshLogs(); refreshTodos(); }
+
+// -- grove selector + bootstrap pre-fill (reference new_task_modal.ex) ----
+let groves = [];
+async function loadGroves() {
+  try { groves = await api("/api/groves"); } catch (e) { groves = []; }
+  const sel = $("nt-grove");
+  sel.innerHTML = '<option value="">no grove (plain task)</option>'
+    + groves.map((g, i) =>
+        `<option value="${i}">${esc(g.name)}</option>`).join("");
+}
+function groveSelected() {
+  const i = $("nt-grove").value;
+  const info = $("nt-grove-info");
+  const desc = $("nt-desc"), budget = $("nt-budget");
+  // switching groves (or back to none) must not leave the PREVIOUS
+  // grove's pre-fill behind — clear anything this selector filled
+  if (desc.dataset.groveFilled === "1") {
+    desc.value = ""; desc.dataset.groveFilled = "";
+  }
+  if (budget.dataset.groveFilled === "1") {
+    budget.value = ""; budget.dataset.groveFilled = "";
+  }
+  if (i === "") { info.style.display = "none"; return; }
+  const g = groves[+i];
+  const boot = g.bootstrap || {};
+  // pre-fill from the grove's resolved bootstrap (never clobber text the
+  // user typed themselves)
+  if (!desc.value) {
+    desc.value = boot.task_description || g.description || "";
+    desc.dataset.groveFilled = "1";
+  }
+  if (boot.budget && !budget.value) {
+    budget.value = boot.budget;
+    budget.dataset.groveFilled = "1";
+  }
+  info.style.display = "block";
+  info.innerHTML = `${esc(g.description || "")}`
+    + (g.root_node ? ` · root node <b>${esc(g.root_node)}</b>` : "")
+    + (boot.success_criteria
+       ? `<div title="${esc(boot.success_criteria)}">success criteria: ${
+          esc(String(boot.success_criteria).slice(0, 120))}…</div>` : "");
+}
 
 async function taskOp(id, op) { await api(`/api/tasks/${id}/${op}`,
   {method: "POST"}); refreshAll(); }
@@ -264,10 +353,17 @@ async function createTask() {
   const body = {description: $("nt-desc").value};
   const budget = $("nt-budget").value;
   if (budget) body.budget = budget;
+  const gi = $("nt-grove").value;
+  if (gi !== "") body.grove = groves[+gi].dir;
   await api("/api/tasks", {method: "POST",
     headers: {"content-type": "application/json"},
     body: JSON.stringify(body)});
   $("nt-desc").value = "";
+  $("nt-desc").dataset.groveFilled = "";
+  $("nt-budget").value = "";
+  $("nt-budget").dataset.groveFilled = "";
+  $("nt-grove").value = "";
+  $("nt-grove-info").style.display = "none";
   refreshAll();
 }
 
@@ -292,6 +388,7 @@ es.onmessage = () => {        // debounce bursts into one refresh
   if (pending) return;
   pending = setTimeout(() => { pending = null; refreshAll(); }, 250);
 };
+loadGroves();
 refreshAll();
 </script>
 </body>

@@ -154,6 +154,27 @@ class DashboardServer:
             "ORDER BY id DESC LIMIT ?2", (task_id, limit))
         return [dict(r) for r in reversed(rows)]
 
+    def groves_payload(self) -> list[dict]:
+        """Available groves + resolved bootstrap pre-fill for the new-task
+        modal (reference new_task_modal.ex grove selector +
+        bootstrap_resolver.ex — the browser shows the fields a grove run
+        would start with and posts the grove dir back on create)."""
+        from quoracle_tpu.governance.grove import GroveEnforcer
+        out = []
+        for m in self.runtime.list_groves():
+            try:
+                boot = GroveEnforcer(m).bootstrap_fields()
+            except Exception:            # noqa: BLE001 — list what loads
+                boot = {}
+            out.append({
+                "name": m.name, "dir": m.path,
+                "description": m.description,
+                "root_node": m.root_node,
+                "bootstrap": {k: (v[:2000] if isinstance(v, str) else v)
+                              for k, v in boot.items()},
+            })
+        return out
+
     def metrics_payload(self) -> dict:
         """Runtime telemetry snapshot (reference parity: QuoracleWeb.
         Telemetry polls Phoenix/Ecto/VM metrics into LiveDashboard,
@@ -288,6 +309,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.logs_payload(one("agent_id")))
             elif parsed.path == "/api/messages":
                 self._send_json(d.messages_payload(one("task_id")))
+            elif parsed.path == "/api/groves":
+                self._send_json(d.groves_payload())
             elif parsed.path == "/api/settings":
                 self._send_json(d.settings_payload())
             elif parsed.path == "/api/metrics":

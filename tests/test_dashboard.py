@@ -353,3 +353,43 @@ def test_dashboard_metrics_endpoint():
             await server.stop()
             rt.close()
     asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_dashboard_groves_endpoint_and_grove_task_create(tmp_path):
+    """VERDICT r4 item 6: the browser can list groves (with resolved
+    bootstrap pre-fill) and start a grove task — the grove selector's
+    whole server contract."""
+    from test_governance_grove import write_grove
+
+    async def main():
+        grove_dir, _ws = write_grove(tmp_path, confinement_mode="warn")
+        rt = Runtime(RuntimeConfig(groves_dir=str(tmp_path)),
+                     backend=MockBackend(respond=lambda r: j("wait", {})))
+        server = await DashboardServer(rt, port=0).start()
+        base = server.url
+        try:
+            status, groves = await http_json(base + "/api/groves")
+            assert status == 200
+            assert len(groves) == 1
+            g = groves[0]
+            assert g["dir"] == str(grove_dir)
+            assert g["root_node"]                     # topology root listed
+            assert isinstance(g["bootstrap"], dict)   # resolved pre-fill
+            # create a task THROUGH the grove (what the selector posts)
+            status, made = await http_json(
+                base + "/api/tasks", method="POST",
+                body={"description": "from the browser",
+                      "grove": g["dir"], "model_pool": list(POOL)})
+            assert status == 201, made
+            await until(lambda: rt.registry.all())
+            root = rt.registry.all()[0]
+            assert root.core.config.grove_node == g["root_node"]
+            # agents payload carries todos + budget + cost for the badges
+            status, agents = await http_json(base + "/api/agents")
+            assert status == 200 and agents
+            row = agents[0]
+            assert "todos" in row and "budget" in row and "cost" in row
+        finally:
+            await server.stop()
+            await rt.shutdown()
+    asyncio.run(main())

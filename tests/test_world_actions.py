@@ -285,3 +285,118 @@ def test_zero_egress_mode_fails_cleanly():
         core, text = await run_one_action(backend, http=None)
         assert "zero-egress" in text
     run(main())
+
+
+# ---------------------------------------------------------------------------
+# MCP hardening (VERDICT r4 item 7): death mid-call w/ stderr context,
+# reconnect after death, tool-list cache, agent-dismiss teardown
+# ---------------------------------------------------------------------------
+
+MCP_DYING_SERVER = r'''
+import json, os, sys
+marker = sys.argv[1]          # dies on the first-ever call, then recovers
+for line in sys.stdin:
+    msg = json.loads(line)
+    mid = msg.get("id")
+    method = msg.get("method")
+    if mid is None:
+        continue
+    if method == "initialize":
+        result = {"protocolVersion": msg["params"]["protocolVersion"],
+                  "capabilities": {"tools": {}},
+                  "serverInfo": {"name": "dying", "version": "0"}}
+    elif method == "tools/list":
+        result = {"tools": [{"name": "boom", "inputSchema": {}}]}
+    elif method == "tools/call":
+        if not os.path.exists(marker):
+            open(marker, "w").close()
+            sys.stderr.write("FATAL: tool exploded spectacularly\n")
+            sys.stderr.flush()
+            sys.exit(3)              # die MID-CALL, stderr explains why
+        result = {"content": [{"type": "text", "text": "recovered"}]}
+    else:
+        result = {}
+    sys.stdout.write(json.dumps({"jsonrpc": "2.0", "id": mid,
+                                 "result": result}) + "\n")
+    sys.stdout.flush()
+'''
+
+
+def test_mcp_server_death_mid_call_surfaces_stderr_and_reconnects(tmp_path):
+    """A stdio server dying mid-call must (a) fail THAT call with the
+    server's stderr tail in the error — not a bare 'closed the stream' —
+    and (b) not poison the target: the next call reconnects fresh."""
+    from quoracle_tpu.infra.mcp import MCPError
+
+    async def main():
+        server_py = tmp_path / "dying_server.py"
+        server_py.write_text(MCP_DYING_SERVER)
+        mcp = MCPManager({"dying": {"transport": "stdio",
+                                    "command": [sys.executable,
+                                                str(server_py),
+                                                str(tmp_path / "died")]}})
+        tools = await mcp.list_tools("dying", agent_id="agent-x")
+        assert tools[0]["name"] == "boom"
+        try:
+            await mcp.call_tool("dying", "boom", {}, agent_id="agent-x")
+            raise AssertionError("expected the call to fail")
+        except MCPError as e:
+            assert "exploded spectacularly" in str(e)   # stderr captured
+            assert "exit code 3" in str(e)
+        # error_context stays queryable for agent logs
+        assert "exploded" in mcp.error_context("dying")
+        # next call transparently reconnects (fresh process) and succeeds
+        result = await mcp.call_tool("dying", "boom", {},
+                                     agent_id="agent-x")
+        assert result["content"][0]["text"] == "recovered"
+        await mcp.close()
+    run(main())
+
+
+def test_mcp_tool_list_cached_per_connection(tmp_path):
+    """tools/list hits the wire once per connection (reference
+    mcp/client.ex:1-15 caching) — a counting server proves it."""
+    server_py = tmp_path / "counting_server.py"
+    server_py.write_text(MCP_SERVER.replace(
+        '"tools": tools}',
+        '"tools": tools, "_hits": globals().setdefault("h", 0)}')
+        .replace('elif method == "tools/list":',
+                 'elif method == "tools/list":\n'
+                 '        globals()["h"] = globals().get("h", 0) + 1'))
+
+    async def main():
+        mcp = MCPManager({"calc": {"transport": "stdio",
+                                   "command": [sys.executable,
+                                               str(server_py)]}})
+        t1 = await mcp.list_tools("calc")
+        t2 = await mcp.list_tools("calc")
+        assert t1 is t2                       # served from the cache
+        await mcp.close()
+    run(main())
+
+
+def test_mcp_connections_close_on_agent_release(tmp_path):
+    """Dismissing the only agent using a connection closes it (reference:
+    per-agent clients die with their agent); a connection shared with a
+    live agent survives."""
+    async def main():
+        server_py = tmp_path / "mcp_server.py"
+        server_py.write_text(MCP_SERVER)
+        mcp = MCPManager({"calc": {"transport": "stdio",
+                                   "command": [sys.executable,
+                                               str(server_py)]}})
+        await mcp.list_tools("calc", agent_id="a1")
+        await mcp.list_tools("calc", agent_id="a2")
+        conn = mcp._connections[
+            mcp.configs["calc"].dedup_key()]
+        await mcp.release_agent("a1")
+        assert conn.alive                      # a2 still uses it
+        await mcp.release_agent("a2")
+        for _ in range(100):
+            if not conn.alive:
+                break
+            await asyncio.sleep(0.02)
+        assert not conn.alive                  # last user gone → closed
+        assert not mcp._connections
+        await mcp.close()
+    run(main())
