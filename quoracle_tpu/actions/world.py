@@ -105,19 +105,31 @@ async def fetch_web_action(core, router, params: dict) -> dict:
 # call_api (REST / JSON-RPC / GraphQL)
 # ---------------------------------------------------------------------------
 
-def _auth_headers(auth: Optional[dict]) -> dict[str, str]:
+def _auth_headers(auth: Optional[dict],
+                  core: Optional[object] = None) -> dict[str, str]:
     if not auth:
         return {}
     kind = auth.get("type", "bearer")
-    if kind == "bearer":
-        return {"Authorization": f"Bearer {auth.get('token', '')}"}
-    if kind == "basic":
-        cred = f"{auth.get('username', '')}:{auth.get('password', '')}"
-        return {"Authorization":
-                "Basic " + base64.b64encode(cred.encode()).decode()}
-    if kind == "header":
-        return {auth.get("name", "X-Api-Key"): auth.get("value", "")}
-    raise ActionError(f"unknown auth type {kind!r}")
+    if kind == "credential":
+        # stored-credential auth (reference CredentialManager: encrypted
+        # at rest, decrypted on fetch, access audited): the action names a
+        # credential id instead of carrying the secret inline — keys never
+        # pass through the model's context
+        store = getattr(getattr(core, "deps", None), "credentials", None)
+        if store is None:
+            raise ActionError("no credential store is wired")
+        data = store.get(auth.get("id", ""),
+                         agent_id=getattr(core, "agent_id", ""),
+                         action="call_api")
+        if data is None:
+            raise ActionError(
+                f"unknown credential {auth.get('id')!r}")
+        auth = data                            # payload is an auth dict
+    from quoracle_tpu.infra.http import build_auth_headers
+    try:
+        return build_auth_headers(auth)
+    except ValueError as e:
+        raise ActionError(str(e))
 
 
 @register("call_api")
@@ -126,7 +138,7 @@ async def call_api_action(core, router, params: dict) -> dict:
     method = params["method"].upper()
     protocol = params.get("protocol") or "rest"
     headers = {**(params.get("headers") or {}),
-               **_auth_headers(params.get("auth"))}
+               **_auth_headers(params.get("auth"), core)}
     body_param = params.get("body")
     body: Optional[bytes] = None
 

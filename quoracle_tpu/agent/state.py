@@ -96,6 +96,7 @@ class AgentDeps:
     http: Any = None                 # HttpFn transport; None = zero-egress
     ssrf_check: bool = True          # reference web.ex optional SSRF check
     mcp: Any = None                  # MCPManager
+    credentials: Any = None          # CredentialStore (call_api/MCP auth)
     images: Any = None               # ImageBackend
     # test seams (reference injectable consensus_fn / delay_fn)
     consensus_fn: Optional[Callable] = None

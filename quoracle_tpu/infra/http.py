@@ -65,6 +65,24 @@ def check_ssrf(url: str) -> None:
             raise SSRFError(f"{host!r} resolves to non-public {addr}")
 
 
+def build_auth_headers(auth: dict) -> dict[str, str]:
+    """Auth payload dict → HTTP headers — THE one mapping shared by
+    call_api (actions/world.py) and MCP server auth (infra/mcp.py), so a
+    stored credential behaves identically wherever it's used. Raises
+    ValueError for unknown types; callers wrap in their own error kind."""
+    kind = auth.get("type", "bearer")
+    if kind == "bearer":
+        return {"Authorization": f"Bearer {auth.get('token', '')}"}
+    if kind == "basic":
+        import base64
+        cred = f"{auth.get('username', '')}:{auth.get('password', '')}"
+        return {"Authorization":
+                "Basic " + base64.b64encode(cred.encode()).decode()}
+    if kind == "header":
+        return {auth.get("name", "X-Api-Key"): auth.get("value", "")}
+    raise ValueError(f"unknown auth type {kind!r}")
+
+
 class _VerifyingRedirectHandler(urllib.request.HTTPRedirectHandler):
     """Re-run the URL guard on every redirect hop — a public URL 302'ing to
     a loopback/metadata address must not slip past the initial check."""

@@ -38,6 +38,10 @@ class MCPServerConfig:
     url: Optional[str] = None                # http
     headers: dict[str, str] = dataclasses.field(default_factory=dict)
     timeout_s: float = DEFAULT_TIMEOUT_S
+    credential: Optional[str] = None         # CredentialStore id → auth
+                                             # headers resolved at connect
+                                             # (reference: secret templates
+                                             # resolved before connect)
 
     def dedup_key(self) -> str:
         """Connections dedup by what they connect TO, not by name
@@ -51,7 +55,19 @@ class MCPServerConfig:
         return cls(name=name, transport=d.get("transport", "stdio"),
                    command=d.get("command"), url=d.get("url"),
                    headers=d.get("headers") or {},
-                   timeout_s=float(d.get("timeout_s", DEFAULT_TIMEOUT_S)))
+                   timeout_s=float(d.get("timeout_s", DEFAULT_TIMEOUT_S)),
+                   credential=d.get("credential"))
+
+
+def auth_headers_from_credential(data: dict) -> dict[str, str]:
+    """Credential payload → HTTP auth headers (the ONE shared mapping,
+    infra/http.build_auth_headers — call_api and MCP must treat a stored
+    credential identically)."""
+    from quoracle_tpu.infra.http import build_auth_headers
+    try:
+        return build_auth_headers(data)
+    except ValueError as e:
+        raise MCPError(str(e))
 
 
 STDERR_TAIL_LINES = 40             # bounded per-connection error context
@@ -221,12 +237,17 @@ class MCPManager:
     connection_manager.ex + client.ex tool-list caching)."""
 
     def __init__(self, configs: Optional[dict[str, dict]] = None,
-                 http_fn=None):
+                 http_fn=None, credential_resolver=None):
         from quoracle_tpu.infra.http import urllib_http
         self.configs: dict[str, MCPServerConfig] = {
             name: MCPServerConfig.from_dict(name, d)
             for name, d in (configs or {}).items()}
         self._http = http_fn or urllib_http
+        # id -> credential payload dict (persistence.store.CredentialStore
+        # .get); resolved at CONNECT time so rotated credentials take
+        # effect on reconnect without a restart
+        self._resolve_credential = credential_resolver
+        self._bg_tasks: set = set()
         self._connections: dict[str, Any] = {}
         self._lock = asyncio.Lock()              # guards the dicts only
         self._key_locks: dict[str, asyncio.Lock] = {}
@@ -254,7 +275,11 @@ class MCPManager:
                                if hasattr(conn, "_death_note") else "")
                 self._connections.pop(key, None)
                 dead, conn = conn, None
-                asyncio.get_running_loop().create_task(dead.close())
+                # keep a strong reference: the loop holds only a weak one,
+                # and a GC'd close task would leak the defunct child
+                t = asyncio.get_running_loop().create_task(dead.close())
+                self._bg_tasks.add(t)
+                t.add_done_callback(self._bg_tasks.discard)
             if conn is not None:
                 if agent_id:
                     self._users.setdefault(key, set()).add(agent_id)
@@ -266,7 +291,26 @@ class MCPManager:
             async with self._lock:
                 conn = self._connections.get(key)
                 if conn is not None:
+                    # EVERY return path registers the caller, or a
+                    # release_agent for the connection's creator could
+                    # close it under this agent
+                    if agent_id:
+                        self._users.setdefault(key, set()).add(agent_id)
                     return conn
+            if config.credential:
+                if self._resolve_credential is None:
+                    raise MCPError(
+                        f"server {config.name} names credential "
+                        f"{config.credential!r} but no credential store "
+                        f"is wired")
+                data = self._resolve_credential(config.credential)
+                if data is None:
+                    raise MCPError(
+                        f"server {config.name}: credential "
+                        f"{config.credential!r} not found")
+                config = dataclasses.replace(
+                    config, headers={**config.headers,
+                                     **auth_headers_from_credential(data)})
             conn = (_StdioConnection(config)
                     if config.transport == "stdio"
                     else _HttpConnection(config, self._http))

@@ -915,14 +915,16 @@ class GenerateEngine:
                     pick(max_new_tokens, img_idx), None, None,
                     pick(constrain_json, img_idx),
                     pick(action_enums, img_idx),
-                    [images[i] for i in img_idx])
+                    [images[i] for i in img_idx],
+                    pick(initial_json_state, img_idx))
                 res_txt = self.generate(
                     [prompts[i] for i in txt_idx],
                     pick(temperature, txt_idx), pick(top_p, txt_idx),
                     pick(max_new_tokens, txt_idx), None,
                     pick(session_ids, txt_idx),
                     pick(constrain_json, txt_idx),
-                    pick(action_enums, txt_idx), None)
+                    pick(action_enums, txt_idx), None,
+                    pick(initial_json_state, txt_idx))
                 merged: list = [None] * len(prompts)
                 for j, i in enumerate(img_idx):
                     merged[i] = res_img[j]

@@ -91,6 +91,8 @@ class ContinuousBatcher:
                constrain_json: bool = False,
                action_enum: Optional[Sequence[str]] = None) -> Future:
         import time
+        if self._stop:
+            raise RuntimeError("ContinuousBatcher is closed")
         row = _Row(prompt=list(prompt), temperature=temperature,
                    top_p=top_p, max_new=max(1, max_new_tokens),
                    session_id=session_id or self._own_session_id(),
@@ -105,6 +107,21 @@ class ContinuousBatcher:
         self._stop = True
         self._wake.set()
         self._thread.join(timeout=10)
+        # never strand a waiter: live + still-queued rows fail loudly
+        # instead of leaving callers blocked on futures forever
+        err = RuntimeError("ContinuousBatcher closed")
+        leftovers = list(self._live)
+        self._live = []
+        while True:
+            try:
+                leftovers.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for row in leftovers:
+            if not row.future.done():
+                row.future.set_exception(err)
+            if row.owns_session:
+                self.engine.drop_session(row.session_id)
 
     def _own_session_id(self) -> str:
         with self._lock:

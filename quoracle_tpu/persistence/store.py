@@ -148,6 +148,77 @@ class PersistentSecretStore(SecretStore):
 
 
 # ---------------------------------------------------------------------------
+# Credential store
+# ---------------------------------------------------------------------------
+
+
+class CredentialStore:
+    """Encrypted provider-credential records over the ``credentials`` table
+    (reference CredentialManager/TableCredentials: per-model encrypted
+    api_key + endpoint metadata, Cloak-encrypted at rest,
+    models/credential_manager.ex + table_credentials.ex). On-device
+    serving needs no API keys, so here credentials gate the OUTBOUND
+    integrations — ``call_api`` auth and MCP server headers — with the
+    same at-rest encryption and usage-audit treatment secrets get.
+
+    A record's ``data`` is the auth payload (e.g. ``{"type": "bearer",
+    "token": ...}`` or ``{"type": "header", "name": ..., "value": ...}``
+    plus optional endpoint metadata); ``model_spec`` keeps the reference's
+    per-model association for provider-style records."""
+
+    def __init__(self, db: Database):
+        self.db = db
+
+    def put(self, cred_id: str, data: dict,
+            model_spec: Optional[str] = None) -> None:
+        if not cred_id or not isinstance(cred_id, str):
+            raise ValueError("credential id must be a non-empty string")
+        blob, enc = self.db.vault.encrypt(json.dumps(data))
+        self.db.execute(
+            "INSERT OR REPLACE INTO credentials "
+            "(id, model_spec, data, encrypted) VALUES (?,?,?,?)",
+            (cred_id, model_spec, blob, int(enc)))
+
+    def get(self, cred_id: str, *, agent_id: str = "",
+            action: str = "") -> Optional[dict]:
+        row = self.db.query_one("SELECT * FROM credentials WHERE id=?",
+                                (cred_id,))
+        if row is None:
+            return None
+        if row["encrypted"] and not self.db.vault.active:
+            logger.warning("credential %r is encrypted but no encryption "
+                           "key is loaded", cred_id)
+            return None
+        data = json.loads(
+            self.db.vault.decrypt(row["data"], bool(row["encrypted"])))
+        if agent_id:   # audit trail, same table/shape as secret access
+            self.db.execute(
+                "INSERT INTO secret_usage (secret_name, agent_id, action, "
+                "ts) VALUES (?,?,?,?)",
+                (f"credential:{cred_id}", agent_id, action, time.time()))
+        return data
+
+    def for_model(self, model_spec: str) -> Optional[dict]:
+        row = self.db.query_one(
+            "SELECT id FROM credentials WHERE model_spec=?", (model_spec,))
+        return None if row is None else self.get(row["id"])
+
+    def delete(self, cred_id: str) -> bool:
+        row = self.db.query_one("SELECT id FROM credentials WHERE id=?",
+                                (cred_id,))
+        self.db.execute("DELETE FROM credentials WHERE id=?", (cred_id,))
+        return row is not None
+
+    def list(self) -> list[dict]:
+        """Metadata only — never the decrypted payloads."""
+        return [{"id": r["id"], "model_spec": r["model_spec"],
+                 "encrypted": bool(r["encrypted"])}
+                for r in self.db.query(
+                    "SELECT id, model_spec, encrypted FROM credentials "
+                    "ORDER BY id")]
+
+
+# ---------------------------------------------------------------------------
 # Persistence facade
 # ---------------------------------------------------------------------------
 

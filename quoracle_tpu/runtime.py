@@ -102,7 +102,12 @@ class Runtime:
         else:
             from quoracle_tpu.models.images import ProceduralImageBackend
             images = ProceduralImageBackend()
-        self.mcp = MCPManager(self.store.get_setting("mcp_servers") or {})
+        from quoracle_tpu.persistence.store import CredentialStore
+        self.credentials = CredentialStore(self.db)
+        self.mcp = MCPManager(
+            self.store.get_setting("mcp_servers") or {},
+            credential_resolver=lambda cid: self.credentials.get(
+                cid, agent_id="mcp", action="mcp_connect"))
         self.deps = AgentDeps(
             backend=self.backend, registry=self.registry, supervisor=None,
             events=self.events, escrow=self.escrow, costs=self.costs,
@@ -110,7 +115,7 @@ class Runtime:
             persistence=self.store, skills=self.skills,
             http=urllib_http,
             ssrf_check=bool(self.store.get_setting("ssrf_check", True)),
-            mcp=self.mcp, images=images)
+            mcp=self.mcp, images=images, credentials=self.credentials)
         self.supervisor = AgentSupervisor(self.deps)
         self.tasks = TaskManager(self.deps, self.store)
         self.store.attach_bus(self.bus)

@@ -93,6 +93,21 @@ DASHBOARD_HTML = r"""<!doctype html>
         <button onclick="saveSecret()">save</button>
       </div>
     </div>
+    <div>
+      <h2>Credentials <span class="meta">(encrypted; call_api/MCP auth)</span></h2>
+      <div id="st-creds"></div>
+      <div class="row">
+        <input id="cr-id" placeholder="id" style="width:90px">
+        <select id="cr-type" style="width:90px">
+          <option value="bearer">bearer</option>
+          <option value="basic">basic</option>
+          <option value="header">header</option>
+        </select>
+        <input id="cr-val" placeholder="token / user:pass / name=value"
+               type="password" style="width:170px">
+        <button onclick="saveCredential()">save</button>
+      </div>
+    </div>
   </div>
 </div>
 <main>
@@ -178,6 +193,36 @@ async function refreshSettings() {
     `<div class="meta">${esc(x.name)} — ${esc(x.description || "")}
      <a href="#" onclick="delSecret(${jsArg(x.name)});return false">✕</a>
      </div>`).join("") || '<div class="meta">none</div>';
+  const creds = await api("/api/credentials");
+  $("st-creds").innerHTML = creds.map(c =>
+    `<div class="meta">${esc(c.id)}${c.model_spec
+       ? " → " + esc(c.model_spec) : ""} ${c.encrypted ? "🔒" : ""}
+     <a href="#" onclick="delCredential(${jsArg(c.id)});return false">✕</a>
+     </div>`).join("") || '<div class="meta">none</div>';
+}
+
+async function saveCredential() {
+  const type = $("cr-type").value, raw = $("cr-val").value;
+  let data = {type};
+  if (type === "bearer") data.token = raw;
+  else if (type === "basic") {
+    const i = raw.indexOf(":");
+    if (i < 0) return alert("basic credentials need user:password");
+    data.username = raw.slice(0, i); data.password = raw.slice(i + 1);
+  } else {
+    const i = raw.indexOf("=");
+    if (i < 0) return alert("header credentials need name=value");
+    data.name = raw.slice(0, i); data.value = raw.slice(i + 1);
+  }
+  await api("/api/credentials", {method: "POST",
+    body: JSON.stringify({id: $("cr-id").value, data})});
+  $("cr-val").value = "";
+  refreshSettings();
+}
+async function delCredential(id) {
+  await api("/api/credentials/" + encodeURIComponent(id),
+            {method: "DELETE"});
+  refreshSettings();
 }
 async function saveSetting() {
   let v = $("st-val").value;

@@ -311,6 +311,9 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.messages_payload(one("task_id")))
             elif parsed.path == "/api/groves":
                 self._send_json(d.groves_payload())
+            elif parsed.path == "/api/credentials":
+                # metadata only — payloads never leave the vault
+                self._send_json(d.runtime.credentials.list())
             elif parsed.path == "/api/settings":
                 self._send_json(d.settings_payload())
             elif parsed.path == "/api/metrics":
@@ -428,6 +431,16 @@ class _Handler(BaseHTTPRequestHandler):
                 data.update({k: v for k, v in body.items() if k != "name"})
                 d.runtime.store.save_profile(name, data)
                 self._send_json({"name": name, **data}, 201)
+            elif self.path == "/api/credentials":
+                cid = body.get("id")
+                data = body.get("data")
+                if not cid or not isinstance(data, dict):
+                    self._send_json({"error": "id and data{} required"},
+                                    400)
+                    return
+                d.runtime.credentials.put(cid, data,
+                                          model_spec=body.get("model_spec"))
+                self._send_json(d.runtime.credentials.list(), 201)
             elif self.path == "/api/secrets":
                 name = body.get("name")
                 if not name or not isinstance(name, str):
@@ -467,6 +480,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json({"deleted": ok}, 200 if ok else 404)
             elif self.path.startswith("/api/secrets/") and len(parts) == 4:
                 ok = d.runtime.secrets.delete(
+                    urllib.parse.unquote(parts[3]))
+                self._send_json({"deleted": ok}, 200 if ok else 404)
+            elif self.path.startswith("/api/credentials/") and len(parts) == 4:
+                ok = d.runtime.credentials.delete(
                     urllib.parse.unquote(parts[3]))
                 self._send_json({"deleted": ok}, 200 if ok else 404)
             else:

@@ -393,3 +393,34 @@ def test_dashboard_groves_endpoint_and_grove_task_create(tmp_path):
             await server.stop()
             await rt.shutdown()
     asyncio.run(main())
+
+
+def test_dashboard_credentials_api_metadata_only():
+    """Credentials surface (VERDICT r4 item 8): create/list/delete via the
+    API; the decrypted payload never appears in any response."""
+    async def main():
+        rt = Runtime(RuntimeConfig(),
+                     backend=MockBackend(respond=lambda r: j("wait", {})))
+        server = await DashboardServer(rt, port=0).start()
+        base = server.url
+        try:
+            status, made = await http_json(
+                base + "/api/credentials", method="POST",
+                body={"id": "gh", "model_spec": "api:github",
+                      "data": {"type": "bearer", "token": "sekret-tok"}})
+            assert status == 201, made
+            assert "sekret-tok" not in json.dumps(made)
+            status, listed = await http_json(base + "/api/credentials")
+            assert status == 200
+            assert listed[0]["id"] == "gh"
+            assert "sekret-tok" not in json.dumps(listed)
+            # the store itself resolves the payload (for call_api/MCP)
+            assert rt.credentials.get("gh")["token"] == "sekret-tok"
+            status, deleted = await http_json(
+                base + "/api/credentials/gh", method="DELETE")
+            assert status == 200 and deleted["deleted"]
+            assert rt.credentials.get("gh") is None
+        finally:
+            await server.stop()
+            await rt.shutdown()
+    asyncio.run(main())
