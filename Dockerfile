@@ -1,0 +1,62 @@
+# quoracle-tpu — multi-stage build: wheel → minimal runtime.
+#
+# The reference ships an Elixir release image (its Dockerfile builds a
+# BEAM release); the TPU-native equivalent is a Python venv baked from the
+# wheel. CPU image by default — on a TPU VM, build with
+#   --build-arg JAX_EXTRA=tpu
+# to pull the libtpu-enabled jax wheel instead.
+#
+#   docker build -t quoracle-tpu .
+#   docker run -p 8419:8419 -v qt-data:/data \
+#     -e QUORACLE_ENCRYPTION_KEY=$(openssl rand -base64 32) quoracle-tpu
+#
+# The dashboard listens on :8419; state persists in /data/quoracle.db.
+
+ARG PYTHON_VERSION=3.12
+ARG DEBIAN_VERSION=bookworm
+
+# =============================================================================
+# Stage 1: build the wheel + native objects
+# =============================================================================
+FROM python:${PYTHON_VERSION}-slim-${DEBIAN_VERSION} AS builder
+
+RUN apt-get update -y && apt-get install -y --no-install-recommends \
+        build-essential g++ zlib1g-dev \
+    && apt-get clean && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /src
+COPY pyproject.toml README.md ./
+COPY quoracle_tpu quoracle_tpu
+RUN pip install --no-cache-dir build && python -m build --wheel -o /dist
+
+# =============================================================================
+# Stage 2: runtime
+# =============================================================================
+FROM python:${PYTHON_VERSION}-slim-${DEBIAN_VERSION}
+
+# g++ + zlib stay: the native BPE tokenizer / PNG preprocessor compile on
+# first use into the package dir (pure-Python fallbacks exist, but the
+# native path is the product)
+RUN apt-get update -y && apt-get install -y --no-install-recommends \
+        g++ zlib1g-dev curl \
+    && apt-get clean && rm -rf /var/lib/apt/lists/*
+
+ARG JAX_EXTRA=""
+COPY --from=builder /dist/*.whl /tmp/
+RUN pip install --no-cache-dir /tmp/*.whl \
+    && if [ -n "$JAX_EXTRA" ]; then \
+         pip install --no-cache-dir "jax[${JAX_EXTRA}]"; fi \
+    && rm /tmp/*.whl
+
+RUN useradd -m quoracle && mkdir -p /data && chown quoracle /data
+USER quoracle
+VOLUME /data
+EXPOSE 8419
+
+# QUORACLE_ENCRYPTION_KEY gates the at-rest vault (secrets/credentials);
+# QUORACLE_DASHBOARD_TOKEN gates the dashboard when binding non-loopback.
+ENV QUORACLE_DB=/data/quoracle.db
+HEALTHCHECK --interval=30s --timeout=5s \
+    CMD curl -sf http://127.0.0.1:8419/healthz || exit 1
+
+CMD ["sh", "-c", "quoracle-tpu serve --db ${QUORACLE_DB} --host 0.0.0.0 --port 8419"]

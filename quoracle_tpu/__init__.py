@@ -27,4 +27,4 @@ Cardinal architectural rule carried over from the reference (root AGENTS.md:5-33
 explicitly. This is what lets the whole test suite run in parallel.
 """
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"

@@ -83,6 +83,17 @@ FAMILY_CONFIGS = {
                 num_hidden_layers=6, num_attention_heads=8,
                 intermediate_size=2048)),
     },
+    # "small": big enough to LEARN rigid formats (tools/finetune.py closes
+    # the train->serve loop with it on CPU-only hosts), small enough that a
+    # few hundred optimizer steps are minutes, not hours.
+    "small": {
+        "llama": dict(
+            architectures=["LlamaForCausalLM"], vocab_size=2048,
+            hidden_size=256, intermediate_size=1024, num_hidden_layers=4,
+            num_attention_heads=8, num_key_value_heads=4,
+            max_position_embeddings=2048, rope_theta=10000.0,
+            rms_norm_eps=1e-5, hidden_act="silu", tie_word_embeddings=False),
+    },
     "tiny": {
         "llama": dict(
             architectures=["LlamaForCausalLM"], vocab_size=2048,

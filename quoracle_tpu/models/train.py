@@ -39,7 +39,10 @@ def loss_fn(params: dict, cfg: ModelConfig, tokens: jax.Array,
     one code path to maintain and the dry-run exercises the real model.
     """
     B, T = tokens.shape
-    cache = init_cache(cfg, B, T, dtype=jnp.bfloat16)
+    # cache dtype follows the params (bf16 serving-shaped runs, fp32
+    # CPU fine-tuning) — a mixed-dtype cache scatter is a trace error
+    cache = init_cache(cfg, B, T,
+                       dtype=jax.tree.leaves(params)[0].dtype)
     positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
     logits, _ = forward(params, cfg, tokens, positions, cache,
                         write_offset=jnp.zeros((B,), jnp.int32),

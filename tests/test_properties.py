@@ -278,3 +278,582 @@ def test_splice_preserves_text_and_session_prefix(prev, resp_ids, nxt):
     # suffix reproduces the session's own ids; the engine caps reuse at
     # len(prompt)-1 so >= 1 token still runs through prefill.
     assert len(spliced) >= 1                                      # (c)
+
+
+# ---------------------------------------------------------------------------
+# Page-pool invariants (models/generate.SessionStore — VERDICT r4 item 9)
+# ---------------------------------------------------------------------------
+
+from quoracle_tpu.models.generate import PAGE, SessionStore, _Session  # noqa: E402
+
+_pool_ops = st.lists(
+    st.one_of(
+        st.tuples(st.just("store"), st.sampled_from("abcdef"),
+                  st.integers(1, 3)),
+        st.tuples(st.just("drop"), st.sampled_from("abcdef"),
+                  st.just(0)),
+        st.tuples(st.just("scratch"), st.just(""), st.integers(1, 4)),
+    ),
+    min_size=1, max_size=25)
+
+
+def _check_pool_invariants(store: SessionStore, scratch: list[list[int]]):
+    owned = []
+    for key in list(store._sessions):
+        owned.extend(store._sessions[key].pages)
+    for tmp in scratch:
+        owned.extend(tmp)
+    # no page owned twice (across sessions AND scratch allocations)
+    assert len(owned) == len(set(owned))
+    # page 0 is the shared sentinel — never owned, never free
+    assert 0 not in owned and 0 not in store._free
+    # conservation: free + owned = every usable page
+    assert sorted(store._free + owned) == list(range(1, store.n_pages))
+
+
+@given(_pool_ops)
+@settings(max_examples=80)
+def test_page_pool_no_double_ownership_and_conservation(ops):
+    store = SessionStore(max_tokens=6 * PAGE)
+    scratch: list[list[int]] = []
+    for kind, key, n in ops:
+        if kind == "store":
+            pages = store.alloc(n)
+            if pages is not None:
+                # put (not put_raw): replacing a key must release the old
+                # session's unreferenced pages — the leak-safety contract
+                store.put(key, _Session(tokens=[1], pages=pages,
+                                        start_pos=0))
+        elif kind == "drop":
+            store.drop(key)
+        else:
+            tmp = store.alloc(n, evict=False)
+            if tmp is not None:
+                scratch.append(tmp)
+        _check_pool_invariants(store, scratch)
+    for tmp in scratch:                   # call-end: temp pages return
+        store.release(tmp)
+    for key in list(store._sessions):
+        store.drop(key)
+    assert store.free_pages() == store.n_pages - 1
+
+
+@given(st.lists(st.sampled_from("abcdef"), min_size=2, max_size=8,
+                unique=True), st.integers(1, 2))
+@settings(max_examples=60)
+def test_page_pool_eviction_is_lru_and_protect_is_honored(keys, n):
+    store = SessionStore(max_tokens=4 * PAGE)
+    for i, key in enumerate(keys):
+        pages = store.alloc(n, protect=(keys[0],) if i > 0 else ())
+        if pages is None:
+            break
+        store.put_raw(key, _Session(tokens=[1], pages=pages, start_pos=0))
+        store._sessions[key].last_used = i      # deterministic LRU order
+    live = list(store._sessions)
+    # protected first key survives any eviction pressure after its store
+    if keys[0] in live and len(live) >= 2:
+        store.alloc(4, protect=(keys[0],))      # force eviction pressure
+        assert keys[0] in store._sessions
+    # evict=False never touches resident sessions
+    before = set(store._sessions)
+    store.alloc(10, evict=False)
+    assert set(store._sessions) == before
+
+
+@given(st.integers(1, 5), st.integers(1, 5))
+@settings(max_examples=40)
+def test_page_pool_drop_is_idempotent_and_exact(n1, n2):
+    store = SessionStore(max_tokens=12 * PAGE)
+    free0 = store.free_pages()
+    p1 = store.alloc(n1)
+    store.put_raw("x", _Session(tokens=[1], pages=p1, start_pos=0))
+    p2 = store.alloc(n2)
+    store.put_raw("y", _Session(tokens=[1], pages=p2, start_pos=0))
+    store.drop("x")
+    store.drop("x")                       # double drop: no double free
+    assert store.free_pages() == free0 - n2
+    store.drop("y")
+    assert store.free_pages() == free0
+
+
+# ---------------------------------------------------------------------------
+# Splice over multi-byte streams (UTF-8 pocket recovery, ADVICE r3)
+# ---------------------------------------------------------------------------
+
+_uni_texts = st.text(
+    alphabet=st.characters(codec="utf-8", exclude_categories=("Cs",)),
+    min_size=0, max_size=40)
+
+
+@settings(max_examples=60, deadline=None)
+@given(prev=_uni_texts, resp_ids=_gen_ids, nxt=_uni_texts)
+def test_splice_handles_multibyte_streams(prev, resp_ids, nxt):
+    """Same contract as the ASCII property, over full unicode — token
+    boundaries routinely cut multi-byte chars here, so the bisection's
+    pocket recovery is what keeps reuse maximal."""
+    from quoracle_tpu.models.generate import _lcp, splice_session_prompt
+    from quoracle_tpu.models.tokenizer import ByteTokenizer
+    tok = ByteTokenizer()
+    sess = tok.encode(prev, add_bos=True) + list(resp_ids)
+    plain = tok.encode(prev + tok.decode(resp_ids) + nxt, add_bos=True)
+    spliced = splice_session_prompt(tok, sess, plain)
+    if spliced is None:
+        return
+    assert tok.decode_raw(spliced) == tok.decode_raw(plain)
+    k = _lcp(sess, spliced)
+    assert k >= _lcp(sess, plain)
+    assert spliced[:k] == sess[:k]
+
+
+# ---------------------------------------------------------------------------
+# Output scrubber (infra/security.scrub_output)
+# ---------------------------------------------------------------------------
+
+_secret_vals = st.text(alphabet=st.characters(min_codepoint=33,
+                                              max_codepoint=126),
+                       min_size=8, max_size=24)
+
+
+@given(st.dictionaries(st.sampled_from(["k1", "k2", "k3"]), _secret_vals,
+                       min_size=1, max_size=3),
+       st.text(max_size=80), st.text(max_size=40))
+@settings(max_examples=80)
+def test_scrubber_removes_values_and_is_idempotent(secrets, pre, post):
+    from quoracle_tpu.infra.security import scrub_output
+    text = pre + " ".join(secrets.values()) + post
+    result = {"stdout": text, "nested": [text, {"deep": text}]}
+    scrubbed = stable_dumps(scrub_output(result, secrets))
+    for name, val in secrets.items():
+        assert val not in scrubbed or any(
+            val in other and other != val
+            for other in secrets.values())       # overlapping-value case
+        assert val not in pre + post or True
+    # idempotent: scrubbing the scrubbed result changes nothing
+    once = scrub_output(result, secrets)
+    twice = scrub_output(once, secrets)
+    assert stable_dumps(once) == stable_dumps(twice)
+
+
+@given(st.text(max_size=120))
+@settings(max_examples=60)
+def test_scrubber_without_matches_is_identity(text):
+    from quoracle_tpu.infra.security import scrub_output
+    secrets = {"name": "zq8#VeryUnlikelySubstring#8qz"}
+    if secrets["name"] in text:
+        return
+    result = {"out": text}
+    assert scrub_output(result, secrets) == result
+
+
+# ---------------------------------------------------------------------------
+# NO_EXECUTE fencing (infra/injection)
+# ---------------------------------------------------------------------------
+
+@given(st.text(max_size=120))
+@settings(max_examples=80)
+def test_wrap_untrusted_always_yields_exactly_one_live_tag_pair(text):
+    from quoracle_tpu.infra.injection import contains_tag, wrap_untrusted
+    wrapped = wrap_untrusted(text, tag_id="fixedtag")
+    # the wrap's own fence is present…
+    assert '<NO_EXECUTE id="fixedtag">' in wrapped
+    assert "</NO_EXECUTE>" in wrapped
+    # …and the INTERIOR carries no live tag (pre-existing ones are broken)
+    interior = wrapped.split('<NO_EXECUTE id="fixedtag">\n', 1)[1]
+    interior = interior.rsplit("</NO_EXECUTE>", 1)[0]
+    assert not contains_tag(interior)
+
+
+@given(st.text(max_size=80))
+@settings(max_examples=60)
+def test_wrap_untrusted_preserves_benign_content(text):
+    from quoracle_tpu.infra.injection import contains_tag, wrap_untrusted
+    if contains_tag(text):
+        return
+    wrapped = wrap_untrusted(text, tag_id="t")
+    assert text in wrapped                 # benign payloads pass verbatim
+
+
+# ---------------------------------------------------------------------------
+# Escrow conservation under CONCURRENT spawn/dismiss/adjust
+# ---------------------------------------------------------------------------
+
+@given(seed=st.integers(0, 2**31))
+@settings(max_examples=15, deadline=None)
+def test_escrow_conserves_under_concurrent_mutation(seed):
+    """4 threads hammer one parent escrow with lock/spend/adjust/release;
+    at quiescence the ledger must balance exactly (the Escrow's lock is
+    the defense; this is the reference's race-test discipline applied to
+    money, SURVEY §5)."""
+    import random as _random
+    import threading
+    from quoracle_tpu.infra.budget import BudgetError as BE
+    limit = Decimal("1000")
+    esc = Escrow()
+    esc.register("root", mode="root", limit=limit)
+
+    def worker(wid: int):
+        rng = _random.Random(seed + wid)
+        for i in range(25):
+            cid = f"w{wid}-c{i}"
+            try:
+                esc.lock_for_child("root", cid, Decimal(rng.randint(1, 40)))
+            except BE:
+                continue
+            if rng.random() < 0.5:
+                esc.record_spend(cid, Decimal(rng.randint(0, 20)))
+            if rng.random() < 0.3:
+                try:
+                    esc.adjust_child("root", cid,
+                                     Decimal(rng.randint(1, 30)))
+                except BE:
+                    pass
+            esc.release_child(cid)
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    root = esc.get("root")
+    assert root.committed == ZERO                 # everyone released
+    assert root.available + root.spent == limit   # not a cent lost/minted
+    assert ZERO <= root.spent <= limit
+
+
+# ---------------------------------------------------------------------------
+# JSON utils (consensus/json_utils)
+# ---------------------------------------------------------------------------
+
+_json_vals = st.recursive(
+    st.one_of(st.none(), st.booleans(), st.integers(-999, 999),
+              st.text(max_size=12)),
+    lambda inner: st.one_of(
+        st.lists(inner, max_size=4),
+        st.dictionaries(st.text(min_size=1, max_size=6), inner, max_size=4)),
+    max_leaves=12)
+
+
+@given(_json_vals)
+@settings(max_examples=80)
+def test_stable_dumps_is_key_order_invariant(value):
+    import json as _json
+    from quoracle_tpu.consensus.json_utils import stable_dumps as sd
+
+    def shuffle(v):
+        if isinstance(v, dict):
+            items = [(k, shuffle(x)) for k, x in reversed(list(v.items()))]
+            return dict(items)
+        if isinstance(v, list):
+            return [shuffle(x) for x in v]
+        return v
+    assert sd(value) == sd(shuffle(value))
+    # and the dump is loadable back to an equivalent value
+    assert sd(_json.loads(sd(value))) == sd(value)
+
+
+@given(st.dictionaries(st.text(min_size=1, max_size=6),
+                       st.one_of(st.integers(-99, 99), st.text(max_size=8)),
+                       min_size=1, max_size=4),
+       st.text(max_size=30), st.text(max_size=30))
+@settings(max_examples=80)
+def test_extract_json_finds_object_amid_junk(obj, pre, post):
+    import json as _json
+    from quoracle_tpu.consensus.json_utils import extract_json, stable_dumps as sd
+    if "{" in pre or "}" in pre:         # junk braces legitimately confuse
+        return
+    text = pre + _json.dumps(obj) + post
+    got = extract_json(text)
+    assert got is not None
+    assert sd(got) == sd(obj)
+
+
+# ---------------------------------------------------------------------------
+# Grammar table (models/constrained): dead-end freedom on random walks
+# ---------------------------------------------------------------------------
+
+import functools  # noqa: E402
+
+
+@functools.lru_cache(maxsize=2)
+def _grammar_table(enum):
+    from quoracle_tpu.models.constrained import JsonTokenTable
+    from quoracle_tpu.models.tokenizer import ByteTokenizer
+    tok = ByteTokenizer()
+    return JsonTokenTable.for_tokenizer(tok, tok.vocab_size, tok.eos_id,
+                                        action_enum=enum)
+
+
+@given(st.integers(0, 2**31), st.sampled_from([None, ("alpha", "beta")]))
+@settings(max_examples=60, deadline=None)
+def test_grammar_random_walks_never_dead_end(seed, enum):
+    """From the start state, repeatedly taking any random ALLOWED token
+    must always leave at least one allowed continuation (or reach an
+    accept state where eos self-loops) — the by-construction guarantee
+    that constrained decoding cannot paint itself into a corner."""
+    import random as _random
+    import numpy as np
+    tt = _grammar_table(enum)
+    table = np.asarray(tt.table)
+    rng = _random.Random(seed)
+    state = tt.start_state
+    for _ in range(40):
+        allowed = np.nonzero(table[state] >= 0)[0]
+        assert len(allowed) > 0              # never a dead end
+        tok = int(rng.choice(allowed))
+        state = int(table[state, tok])
+
+
+# ---------------------------------------------------------------------------
+# Vault (persistence/db): at-rest encryption roundtrip
+# ---------------------------------------------------------------------------
+
+@given(st.text(max_size=200), st.text(min_size=1, max_size=30))
+@settings(max_examples=60)
+def test_vault_roundtrip_and_ciphertext_opacity(plaintext, key):
+    from quoracle_tpu.persistence.db import Vault
+    v = Vault(key=key)
+    blob, enc = v.encrypt(plaintext)
+    assert v.decrypt(blob, enc) == plaintext
+    if enc and len(plaintext) >= 4:
+        assert plaintext.encode() not in blob     # never plaintext-at-rest
+    # a different key cannot decrypt (AES-GCM authenticates)
+    if enc:
+        other = Vault(key=key + "x")
+        try:
+            assert other.decrypt(blob, True) != plaintext
+        except Exception:
+            pass                                   # auth failure = correct
+
+
+def test_vault_without_key_is_plaintext_passthrough():
+    from quoracle_tpu.persistence.db import Vault
+    v = Vault(key="")
+    blob, enc = v.encrypt("hello")
+    assert (blob, enc) == (b"hello", False)
+
+
+# ---------------------------------------------------------------------------
+# Byte tokenizer: lossless roundtrip
+# ---------------------------------------------------------------------------
+
+@given(st.text(alphabet=st.characters(codec="utf-8",
+                                      exclude_categories=("Cs",)),
+               max_size=120))
+@settings(max_examples=80)
+def test_byte_tokenizer_roundtrip_lossless(text):
+    from quoracle_tpu.models.tokenizer import ByteTokenizer
+    tok = ByteTokenizer()
+    assert tok.decode(tok.encode(text)) == text
+    # ids stay within the declared vocab
+    assert all(0 <= i < tok.vocab_size for i in tok.encode(text))
+
+
+# ---------------------------------------------------------------------------
+# Grove scoring (governance/bench_scoring.score)
+# ---------------------------------------------------------------------------
+
+@given(st.dictionaries(st.sampled_from("ABCDEFGHIJ"),
+                       st.one_of(st.none(), st.sampled_from("ABCDEFGHIJ"),
+                                 st.integers(0, 9)),
+                       min_size=0, max_size=10))
+@settings(max_examples=40, deadline=None)
+def test_score_accuracy_bounds_and_answer_accounting(answers):
+    import json as _json
+    import tempfile
+    from quoracle_tpu.governance.bench_scoring import score
+    with tempfile.TemporaryDirectory() as ws, \
+            tempfile.TemporaryDirectory() as grove:
+        import os as _os
+        _os.makedirs(_os.path.join(grove, "data"))
+        qs = [{"id": f"q{i}", "question": "?", "subject": "s",
+               "answer": k, "options": {}}
+              for i, k in enumerate("ABCDEFGHIJ")]
+        with open(_os.path.join(grove, "data", "questions.jsonl"), "w") as f:
+            for q in qs:
+                f.write(_json.dumps(q) + "\n")
+        ad = _os.path.join(ws, "runs", "r", "answers")
+        _os.makedirs(ad)
+        for i, k in enumerate("ABCDEFGHIJ"):
+            if k in answers and answers[k] is not None:
+                with open(_os.path.join(ad, f"q{i}.json"), "w") as f:
+                    _json.dump({"answer": answers[k]}, f)
+        res = score(ws, "r", grove,
+                    lambda q, got: isinstance(got, str)
+                    and got.strip().upper()[:1] == q["answer"],
+                    "subject", "per_subject")
+        assert 0 <= res["correct"] <= res["answered"] <= res["total"] == 10
+        assert res["accuracy"] == res["correct"] / 10
+
+
+# ---------------------------------------------------------------------------
+# TTL cache (utils/cache)
+# ---------------------------------------------------------------------------
+
+@given(st.lists(st.tuples(st.text(min_size=1, max_size=6),
+                          st.integers(-99, 99)),
+                min_size=1, max_size=30),
+       st.integers(2, 8))
+@settings(max_examples=60)
+def test_ttl_cache_bounded_and_last_write_wins(pairs, cap):
+    from quoracle_tpu.utils.cache import TTLCache
+    c = TTLCache(max_entries=cap, ttl_s=3600)
+    latest = {}
+    for k, v in pairs:
+        c.put(k, v)
+        latest[k] = v
+    assert len(c) <= cap                          # hard bound
+    for k in list(latest)[-cap:]:
+        got = c.get(k)
+        assert got is None or got == latest[k]    # never a stale value
+
+
+# ---------------------------------------------------------------------------
+# normalize_json_value (consensus/json_utils)
+# ---------------------------------------------------------------------------
+
+@given(_json_vals)
+@settings(max_examples=80)
+def test_normalize_json_is_idempotent(value):
+    from quoracle_tpu.consensus.json_utils import normalize_json_value as nj
+    once = nj(value)
+    assert nj(once) == once
+
+
+# ---------------------------------------------------------------------------
+# html → markdown: no live tags survive
+# ---------------------------------------------------------------------------
+
+@given(st.lists(st.tuples(st.sampled_from(["p", "b", "i", "h1", "li"]),
+                          st.text(alphabet=st.characters(
+                              min_codepoint=32, max_codepoint=126,
+                              exclude_characters="<>&"), max_size=20)),
+                min_size=1, max_size=6))
+@settings(max_examples=60)
+def test_html_to_markdown_strips_all_tags(parts):
+    from quoracle_tpu.utils.html_md import html_to_markdown
+    html = "".join(f"<{t}>{txt}</{t}>" for t, txt in parts)
+    md = html_to_markdown(f"<html><body>{html}</body></html>")
+    assert "<" not in md or not any(
+        f"<{t}>" in md for t, _ in parts)         # no live element tags
+    for _, txt in parts:
+        if txt.strip():
+            assert txt.strip().split()[0] in md   # content survives
+
+
+# ---------------------------------------------------------------------------
+# wrap_action_result: the untrusted set is always fenced
+# ---------------------------------------------------------------------------
+
+@given(st.sampled_from(["fetch_web", "call_api", "call_mcp",
+                        "execute_shell"]),
+       st.text(max_size=60))
+@settings(max_examples=60)
+def test_untrusted_action_results_are_always_fenced(action, text):
+    from quoracle_tpu.infra.injection import (
+        UNTRUSTED_ACTIONS, wrap_action_result,
+    )
+    out = wrap_action_result(action, text)
+    if action in UNTRUSTED_ACTIONS:
+        assert "<NO_EXECUTE" in out and "</NO_EXECUTE>" in out
+    else:
+        assert out == text
+
+
+@given(st.sampled_from(["todo", "orient", "wait"]), st.text(max_size=60))
+@settings(max_examples=40)
+def test_trusted_action_results_pass_through(action, text):
+    from quoracle_tpu.infra.injection import (
+        UNTRUSTED_ACTIONS, wrap_action_result,
+    )
+    if action in UNTRUSTED_ACTIONS:
+        return
+    assert wrap_action_result(action, text) == text
+
+
+# ---------------------------------------------------------------------------
+# Credential store: roundtrip + metadata opacity (VERDICT r4 item 8)
+# ---------------------------------------------------------------------------
+
+@given(st.dictionaries(st.sampled_from(["type", "token", "username",
+                                        "password", "name", "value"]),
+                       st.text(min_size=1, max_size=20), min_size=1,
+                       max_size=4))
+@settings(max_examples=40)
+def test_credential_store_roundtrip_property(data):
+    from quoracle_tpu.persistence.db import Database
+    from quoracle_tpu.persistence.store import CredentialStore
+    db = Database(":memory:", encryption_key="prop-key")
+    store = CredentialStore(db)
+    store.put("c1", data, model_spec="m")
+    assert store.get("c1") == data
+    meta = stable_dumps(store.list())
+    for v in data.values():
+        if len(v) >= 4:
+            assert v not in meta                  # metadata leaks nothing
+    db.close()
+
+
+# ---------------------------------------------------------------------------
+# Temperature descent (consensus/temperature)
+# ---------------------------------------------------------------------------
+
+@given(st.sampled_from(["xla:llama-1b", "xla:gemma-1b", "xla:tiny"]),
+       st.integers(1, 10), st.integers(1, 8))
+@settings(max_examples=80)
+def test_temperature_descent_monotone_and_bounded(spec, rnd, max_rounds):
+    from quoracle_tpu.consensus.temperature import (
+        model_ceiling, model_floor, temperature_for_round,
+    )
+    t = temperature_for_round(spec, rnd, max_rounds)
+    t_next = temperature_for_round(spec, rnd + 1, max_rounds)
+    assert model_floor(spec) <= t <= model_ceiling(spec)
+    assert t_next <= t                       # never heats up across rounds
+    # round 1 starts at the ceiling
+    assert temperature_for_round(spec, 1, max_rounds) == model_ceiling(spec)
+
+
+# ---------------------------------------------------------------------------
+# Action parser (consensus/parser): valid proposals roundtrip
+# ---------------------------------------------------------------------------
+
+@given(st.sampled_from(["wait", "orient", "todo", "send_message"]),
+       st.dictionaries(st.sampled_from(["target", "content", "items"]),
+                       st.text(max_size=15), max_size=2),
+       st.text(max_size=30),
+       st.text(max_size=20), st.text(max_size=20))
+@settings(max_examples=60)
+def test_parser_roundtrips_valid_json_amid_prose(action, params, reasoning,
+                                                 pre, post):
+    import json as _json
+    from quoracle_tpu.consensus.parser import ActionProposal, parse_response
+    if "{" in pre or "}" in pre:
+        return
+    payload = {"action": action, "params": params,
+               "reasoning": reasoning, "wait": False}
+    out = parse_response("m", pre + _json.dumps(payload) + post)
+    assert isinstance(out, ActionProposal)
+    assert out.action == action
+    assert out.params == params
+
+
+# ---------------------------------------------------------------------------
+# Token budget (context/token_manager.dynamic_max_tokens)
+# ---------------------------------------------------------------------------
+
+@given(st.integers(0, 4000), st.integers(1, 2048))
+@settings(max_examples=80, deadline=None)
+def test_dynamic_max_tokens_floor_and_ceiling(input_tokens, output_limit):
+    from quoracle_tpu.context.token_manager import TokenManager
+    from quoracle_tpu.models.config import OUTPUT_FLOOR
+    from quoracle_tpu.models.runtime import MockBackend
+    tm = TokenManager(MockBackend())
+    spec = MockBackend.DEFAULT_POOL[0]
+    out = tm.dynamic_max_tokens(spec, input_tokens, output_limit)
+    window = tm.context_limit(spec)
+    if out is None:
+        # refused only when the remaining room is under the floor
+        assert window - tm.margin * input_tokens < min(OUTPUT_FLOOR,
+                                                       output_limit)
+    else:
+        assert 1 <= out <= output_limit
+        assert out <= window
