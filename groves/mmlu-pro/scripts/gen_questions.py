@@ -1,0 +1,475 @@
+#!/usr/bin/env python
+"""Offline MMLU-Pro-format question generator (VERDICT r4 item 3).
+
+The reference's grove runs against the public 12,032-question MMLU-Pro set
+downloaded at runtime (/root/reference/priv/groves/mmlu-pro/GROVE.md:4-8);
+this host has no network, so workload-scale data is GENERATED here instead:
+deterministic (seeded) templates across the same 14 subject categories,
+each question carrying a provably correct key — computational subjects
+compute the answer, knowledge subjects draw from small embedded fact
+tables. That makes the set suitable for both of the grove's jobs:
+
+  * throughput workload — realistic prompt shapes at >=1,000-question
+    scale for the continuous batcher (run_tpu_throughput.py);
+  * accuracy lifecycle — train-on-subset finetuning (tools/finetune.py
+    --target mmlu) has a real key to memorize and be scored against.
+
+Every question: 10 options A-J, answer letter placed by seeded RNG,
+numeric distractors generated near the key and deduplicated. Output is
+data/questions_full.jsonl (the 24 hand-written questions.jsonl stays as
+the smoke subset).
+
+    python groves/mmlu-pro/scripts/gen_questions.py \
+        [--n 1200] [--seed 7] [--out ../data/questions_full.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import random
+
+LETTERS = tuple("ABCDEFGHIJ")
+
+# ---------------------------------------------------------------------------
+# Distractor helpers
+# ---------------------------------------------------------------------------
+
+
+def _fmt(x) -> str:
+    if isinstance(x, float):
+        if x == int(x) and abs(x) < 1e12:
+            return str(int(x))
+        return f"{x:.4g}"
+    return str(x)
+
+
+def numeric_options(rng: random.Random, key, *, spread=None) -> dict:
+    """10 options around a numeric key, deduplicated, key at a random
+    letter."""
+    vals = {_fmt(key)}
+    mags = spread or [1, 2, 3, 5, 10, -1, -2, -3, 0.5, 1.5, 2.5]
+    tries = 0
+    while len(vals) < 10 and tries < 200:
+        tries += 1
+        m = rng.choice(mags)
+        if isinstance(key, float) and key != int(key):
+            cand = key + m * max(0.1, abs(key) * 0.1)
+            cand = round(cand, 3)
+        else:
+            base = int(key)
+            step = max(1, abs(base) // 8)
+            cand = base + int(m * step)
+        vals.add(_fmt(cand))
+    i = 1
+    while len(vals) < 10:                      # pathological keys (0, tiny)
+        vals.add(_fmt(int(key) + 10 + i)); i += 1
+    others = [v for v in vals if v != _fmt(key)]
+    rng.shuffle(others)
+    slot = rng.randrange(10)
+    opts, oi = {}, 0
+    for j, letter in enumerate(LETTERS):
+        if j == slot:
+            opts[letter] = _fmt(key)
+        else:
+            opts[letter] = others[oi]; oi += 1
+    return {"options": opts, "answer": LETTERS[slot]}
+
+
+def choice_options(rng: random.Random, key: str, pool: list[str]) -> dict:
+    """Key + 9 distractors drawn from a categorical pool."""
+    distract = [p for p in pool if p != key]
+    rng.shuffle(distract)
+    picked = distract[:9]
+    while len(picked) < 9:                     # small pools: pad variants
+        picked.append(f"none of the above ({len(picked)})")
+    slot = rng.randrange(10)
+    opts, oi = {}, 0
+    for j, letter in enumerate(LETTERS):
+        if j == slot:
+            opts[letter] = key
+        else:
+            opts[letter] = picked[oi]; oi += 1
+    return {"options": opts, "answer": LETTERS[slot]}
+
+
+# ---------------------------------------------------------------------------
+# Per-subject template banks. Each template fn(rng) -> (question, key) or
+# (question, key, pool) for categorical.
+# ---------------------------------------------------------------------------
+
+
+def t_math(rng):
+    k = rng.randrange(6)
+    if k == 0:
+        a, e, m = rng.randrange(2, 9), rng.randrange(5, 40), rng.choice([5, 7, 11, 13])
+        return (f"What is the remainder when {a}^{e} is divided by {m}?",
+                pow(a, e, m))
+    if k == 1:
+        n = rng.randrange(5, 15)
+        return (f"What is the sum of the interior angles of a convex "
+                f"{n}-gon, in degrees?", (n - 2) * 180)
+    if k == 2:
+        n, r = rng.randrange(6, 12), rng.randrange(2, 4)
+        return (f"How many ways can you choose {r} items from {n} distinct "
+                f"items (order irrelevant)?", math.comb(n, r))
+    if k == 3:
+        a, d, n = rng.randrange(1, 10), rng.randrange(2, 8), rng.randrange(8, 25)
+        return (f"What is the sum of the first {n} terms of the arithmetic "
+                f"sequence starting at {a} with common difference {d}?",
+                n * (2 * a + (n - 1) * d) // 2)
+    if k == 4:
+        x, y = rng.randrange(12, 60), rng.randrange(8, 50)
+        return (f"What is the greatest common divisor of {x * 6} and {y * 6}?",
+                math.gcd(x * 6, y * 6))
+    a, b = rng.randrange(2, 9), rng.randrange(2, 9)
+    c = rng.randrange(1, 12)
+    return (f"If f(x) = {a}x^2 + {b}x, what is f'({c})?", 2 * a * c + b)
+
+
+def t_physics(rng):
+    k = rng.randrange(5)
+    if k == 0:
+        u, a, t = rng.randrange(0, 20), rng.randrange(1, 8), rng.randrange(2, 9)
+        return (f"A body starts at {u} m/s and accelerates uniformly at "
+                f"{a} m/s^2 for {t} s. What is its final speed in m/s?",
+                u + a * t)
+    if k == 1:
+        v, r = rng.randrange(6, 48, 6), rng.choice([2, 3, 4, 6, 8])
+        return (f"A resistor of {r} ohms carries a current driven by a "
+                f"{v} V supply. What is the current in amperes?", v / r)
+    if k == 2:
+        m, v = rng.randrange(2, 12), rng.randrange(2, 10)
+        return (f"What is the kinetic energy in joules of a {m} kg mass "
+                f"moving at {v} m/s?", m * v * v / 2)
+    if k == 3:
+        f, lam = rng.randrange(2, 20), rng.randrange(2, 15)
+        return (f"A wave has frequency {f} Hz and wavelength {lam} m. "
+                f"What is its speed in m/s?", f * lam)
+    m, vol = rng.randrange(10, 200, 10), rng.randrange(2, 20)
+    return (f"An object has mass {m} g and volume {vol} cm^3. What is its "
+            f"density in g/cm^3?", round(m / vol, 3))
+
+
+def t_chemistry(rng):
+    masses = {"H": 1, "C": 12, "N": 14, "O": 16, "Na": 23, "S": 32, "Cl": 35.5}
+    k = rng.randrange(3)
+    if k == 0:
+        formulas = {
+            "H2O": 18, "CO2": 44, "CH4": 16, "NH3": 17, "NaCl": 58.5,
+            "H2SO4": 98, "C2H6": 30, "NaOH": 40, "C6H12O6": 180,
+            "N2O": 44.0, "SO2": 64, "C2H5OH": 46,
+        }
+        f, m = rng.choice(list(formulas.items()))
+        return (f"Using atomic masses H=1, C=12, N=14, O=16, Na=23, S=32, "
+                f"Cl=35.5, what is the molar mass of {f} in g/mol?", m)
+    if k == 1:
+        n = rng.randrange(1, 9)
+        return (f"What is the pH of a 10^-{n} M solution of a strong "
+                f"monoprotic acid (assume complete dissociation, no water "
+                f"autoionization correction)?", n)
+    sym, z = rng.choice([("Na", 11), ("Cl", 17), ("O", 8), ("C", 6),
+                         ("N", 7), ("S", 16), ("K", 19), ("Ca", 20)])
+    return (f"How many protons does a neutral atom of {sym} have?", z)
+
+
+def t_cs(rng):
+    k = rng.randrange(4)
+    if k == 0:
+        n = rng.randrange(17, 255)
+        return (f"What is the decimal value of the binary number "
+                f"{bin(n)[2:]}?", n)
+    if k == 1:
+        a, b = rng.randrange(8, 64), rng.randrange(8, 64)
+        op, fn = rng.choice([("AND", int.__and__), ("OR", int.__or__),
+                             ("XOR", int.__xor__)])
+        return (f"What is {a} {op} {b} (bitwise, decimal operands and "
+                f"result)?", fn(a, b))
+    if k == 2:
+        depth = rng.randrange(3, 8)
+        return (f"How many nodes does a complete binary tree of depth "
+                f"{depth} have (root at depth 0, all levels full)?",
+                2 ** (depth + 1) - 1)
+    n = rng.randrange(5, 60)
+    return (f"How many comparisons does binary search need in the worst "
+            f"case on a sorted array of {n} elements "
+            f"(ceil(log2(n+1)))?", math.ceil(math.log2(n + 1)))
+
+
+def t_economics(rng):
+    k = rng.randrange(3)
+    if k == 0:
+        p0, p1 = rng.randrange(20, 80), 0
+        p1 = p0 + rng.choice([5, 10, 15, 20, 25])
+        return (f"A price rises from ${p0} to ${p1}. What is the percentage "
+                f"increase?", round((p1 - p0) / p0 * 100, 2))
+    if k == 1:
+        p, r, t = rng.choice([1000, 2000, 5000]), rng.randrange(2, 10), rng.randrange(2, 5)
+        return (f"What is the value of ${p} after {t} years at {r}% "
+                f"compound annual interest, in dollars (rounded to the "
+                f"nearest dollar)?", round(p * (1 + r / 100) ** t))
+    dq, dp = rng.randrange(10, 40, 5), rng.randrange(5, 25, 5)
+    return (f"Quantity demanded falls {dq}% when price rises {dp}%. What "
+            f"is the absolute price elasticity of demand?",
+            round(dq / dp, 2))
+
+
+def t_engineering(rng):
+    k = rng.randrange(3)
+    if k == 0:
+        r1, r2 = rng.choice([4, 6, 8, 10, 12]), rng.choice([4, 6, 12, 20])
+        return (f"Two resistors of {r1} and {r2} ohms are in series. What "
+                f"is the total resistance in ohms?", r1 + r2)
+    if k == 1:
+        v, i = rng.randrange(12, 240, 12), rng.randrange(2, 12)
+        return (f"A device draws {i} A at {v} V. What is its power "
+                f"consumption in watts?", v * i)
+    t1, t2 = rng.randrange(10, 40, 5), rng.randrange(41, 90, 7)
+    return (f"A gear with {t1} teeth drives a gear with {t2} teeth. If the "
+            f"driver spins at {t2 * 10} rpm, what is the driven gear's "
+            f"speed in rpm (t1*rpm/t2)?", round(t1 * (t2 * 10) / t2))
+
+
+def t_business(rng):
+    k = rng.randrange(3)
+    if k == 0:
+        c, m = rng.randrange(20, 200, 10), rng.choice([20, 25, 40, 50, 60])
+        return (f"A product costs ${c} and is sold with a {m}% markup on "
+                f"cost. What is the selling price in dollars?",
+                round(c * (1 + m / 100), 2))
+    if k == 1:
+        fixed = rng.choice([1000, 2400, 6000, 9000])
+        price, var = rng.randrange(20, 60, 5), rng.randrange(5, 19)
+        return (f"Fixed costs are ${fixed}; each unit sells for ${price} "
+                f"with variable cost ${var}. How many whole units must be "
+                f"sold to break even (round up)?",
+                math.ceil(fixed / (price - var)))
+    gain, cost = rng.randrange(200, 900, 50), rng.choice([1000, 2000, 2500, 4000])
+    return (f"An investment of ${cost} returns ${cost + gain}. What is the "
+            f"ROI as a percentage?", round(gain / cost * 100, 2))
+
+
+def t_health(rng):
+    k = rng.randrange(2)
+    if k == 0:
+        w, h = rng.randrange(50, 110, 5), rng.choice([1.5, 1.6, 1.7, 1.8, 1.9, 2.0])
+        return (f"What is the BMI of a person weighing {w} kg at height "
+                f"{h} m (kg/m^2, rounded to one decimal)?",
+                round(w / (h * h), 1))
+    dose, w = rng.choice([2, 5, 10, 15]), rng.randrange(10, 90, 5)
+    return (f"A drug is dosed at {dose} mg per kg of body weight. What "
+            f"total dose in mg does a {w} kg patient receive?", dose * w)
+
+
+def t_biology(rng):
+    k = rng.randrange(3)
+    if k == 0:
+        n, t = rng.choice([10, 20, 50, 100]), rng.randrange(2, 8)
+        return (f"A bacterial population of {n} cells doubles every hour. "
+                f"How many cells after {t} hours?", n * 2 ** t)
+    if k == 1:
+        return ("In a monohybrid cross of two heterozygotes (Aa x Aa), "
+                "what percentage of offspring are expected to show the "
+                "recessive phenotype?", 25)
+    pairs = rng.choice([4, 8, 12, 23])
+    return (f"An organism has {pairs} pairs of homologous chromosomes. How "
+            f"many chromosomes are in one of its somatic cells?", pairs * 2)
+
+
+_PSYCH = [("classical conditioning", "Ivan Pavlov"),
+          ("operant conditioning", "B. F. Skinner"),
+          ("the hierarchy of needs", "Abraham Maslow"),
+          ("psychoanalysis", "Sigmund Freud"),
+          ("stages of cognitive development", "Jean Piaget"),
+          ("observational learning (Bobo doll)", "Albert Bandura"),
+          ("the eight stages of psychosocial development", "Erik Erikson"),
+          ("obedience-to-authority experiments", "Stanley Milgram"),
+          ("the Stanford prison experiment", "Philip Zimbardo"),
+          ("client-centered therapy", "Carl Rogers"),
+          ("attachment styles in infants", "Mary Ainsworth"),
+          ("multiple intelligences", "Howard Gardner")]
+
+
+def t_psychology(rng):
+    concept, who = rng.choice(_PSYCH)
+    if rng.random() < 0.5:
+        pool = [w for _, w in _PSYCH]
+        return (f"Which psychologist is most associated with {concept}?",
+                who, pool)
+    pool = [c for c, _ in _PSYCH]
+    return (f"{who} is most associated with which of the following?",
+            concept, pool)
+
+
+_HISTORY = [("the year the Berlin Wall fell", "1989"),
+            ("the year World War I began", "1914"),
+            ("the year World War II ended", "1945"),
+            ("the year of the French Revolution's storming of the Bastille", "1789"),
+            ("the year the Declaration of Independence was signed", "1776"),
+            ("the year the Roman Empire's western half fell", "476"),
+            ("the year Columbus first crossed the Atlantic", "1492"),
+            ("the year the Magna Carta was sealed", "1215"),
+            ("the year the Soviet Union dissolved", "1991"),
+            ("the year the Norman conquest of England occurred", "1066"),
+            ("the year the United Nations was founded", "1945"),
+            ("the year the Treaty of Versailles was signed", "1919")]
+
+
+def t_history(rng):
+    what, year = rng.choice(_HISTORY)
+    if rng.random() < 0.5:
+        pool = sorted({y for _, y in _HISTORY})
+        return (f"What is {what}?", year, pool)
+    # reverse direction only where the year is unique in the bank
+    years = [y for _, y in _HISTORY]
+    uniq = [(w, y) for w, y in _HISTORY if years.count(y) == 1]
+    what, year = rng.choice(uniq)
+    pool = [w.replace("the year ", "") for w, y in uniq]
+    key = what.replace("the year ", "")
+    return (f"Which of these events happened in {year}?", key, pool)
+
+
+_LAW = [("the burden of proof in a criminal trial",
+         "beyond a reasonable doubt"),
+        ("the burden of proof in a civil trial",
+         "preponderance of the evidence"),
+        ("a contract's required exchange of value", "consideration"),
+        ("the doctrine that courts follow precedent", "stare decisis"),
+        ("a false spoken statement harming reputation", "slander"),
+        ("a false written statement harming reputation", "libel"),
+        ("the right against self-incrimination in the US constitution",
+         "the Fifth Amendment"),
+        ("the power of courts to strike down unconstitutional laws",
+         "judicial review"),
+        ("a court order compelling or forbidding an act", "injunction"),
+        ("the party who initiates a civil lawsuit", "the plaintiff")]
+
+
+def t_law(rng):
+    what, term = rng.choice(_LAW)
+    if rng.random() < 0.5:
+        pool = [t for _, t in _LAW]
+        return (f"Which term describes {what}?", term, pool)
+    pool = [w for w, _ in _LAW]
+    return (f"In law, '{term}' refers to which of the following?",
+            what, pool)
+
+
+_PHIL = [("the categorical imperative", "Immanuel Kant"),
+         ("utilitarianism's greatest-happiness principle", "John Stuart Mill"),
+         ("the theory of Forms", "Plato"),
+         ("virtue ethics grounded in the golden mean", "Aristotle"),
+         ("'I think, therefore I am'", "Rene Descartes"),
+         ("the social contract with a sovereign Leviathan", "Thomas Hobbes"),
+         ("the veil of ignorance", "John Rawls"),
+         ("existentialism's 'existence precedes essence'", "Jean-Paul Sartre"),
+         ("the will to power and the Ubermensch", "Friedrich Nietzsche"),
+         ("empiricism's tabula rasa", "John Locke"),
+         ("falsifiability as the mark of science", "Karl Popper"),
+         ("the problem of induction", "David Hume")]
+
+
+def t_philosophy(rng):
+    concept, who = rng.choice(_PHIL)
+    if rng.random() < 0.5:
+        pool = [w for _, w in _PHIL]
+        return (f"Which philosopher is most associated with {concept}?",
+                who, pool)
+    pool = [c for c, _ in _PHIL]
+    return (f"{who} is most associated with which of the following?",
+            concept, pool)
+
+
+def t_other(rng):
+    k = rng.randrange(3)
+    if k == 0:
+        start, step = rng.randrange(1, 10), rng.randrange(2, 9)
+        seq = [start + i * step for i in range(4)]
+        return (f"What is the next number in the sequence "
+                f"{', '.join(map(str, seq))}, ...?", start + 4 * step)
+    if k == 1:
+        a, r = rng.randrange(1, 5), rng.choice([2, 3])
+        seq = [a * r ** i for i in range(4)]
+        return (f"What is the next number in the geometric sequence "
+                f"{', '.join(map(str, seq))}, ...?", a * r ** 4)
+    h, m = rng.randrange(1, 12), rng.choice([15, 20, 30, 45, 40])
+    total = (h * 60 + m)
+    return (f"How many minutes are there in {h} hours and {m} minutes?",
+            total)
+
+
+SUBJECTS = {
+    "math": t_math, "physics": t_physics, "chemistry": t_chemistry,
+    "computer science": t_cs, "economics": t_economics,
+    "engineering": t_engineering, "business": t_business,
+    "health": t_health, "biology": t_biology, "psychology": t_psychology,
+    "history": t_history, "law": t_law, "philosophy": t_philosophy,
+    "other": t_other,
+}
+
+
+def generate(n: int, seed: int) -> list[dict]:
+    """Round-robin over subjects; knowledge-table subjects have finite
+    template spaces (10-24 distinct questions each), so a subject that
+    fails to produce a fresh question MISS_CAP times in a row is retired
+    and the computational subjects (unbounded parameter spaces) absorb the
+    remainder — mirroring MMLU-Pro's own skew toward quantitative
+    subjects."""
+    MISS_CAP = 60
+    rng = random.Random(seed)
+    active = list(SUBJECTS)
+    misses = {s: 0 for s in active}
+    out, seen = [], set()
+    qid = 0
+    i = 0
+    while len(out) < n and active:
+        subj = active[i % len(active)]
+        res = SUBJECTS[subj](rng)
+        if len(res) == 3:
+            question, key, pool = res
+            packed = choice_options(rng, str(key), [str(p) for p in pool])
+        else:
+            question, key = res
+            packed = numeric_options(rng, key)
+        dedup = (subj, question)
+        if dedup in seen:
+            misses[subj] += 1
+            if misses[subj] >= MISS_CAP:
+                active.remove(subj)
+            else:
+                i += 1
+            continue
+        misses[subj] = 0
+        seen.add(dedup)
+        qid += 1
+        out.append({"id": f"g{qid:05d}", "subject": subj,
+                    "question": question, **packed})
+        i += 1
+    if len(out) < n:
+        raise SystemExit(f"template space exhausted at {len(out)} < {n}")
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=1200)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "data",
+        "questions_full.jsonl"))
+    args = ap.parse_args()
+    qs = generate(args.n, args.seed)
+    with open(args.out, "w") as f:
+        for q in qs:
+            f.write(json.dumps(q) + "\n")
+    subj_counts = {}
+    for q in qs:
+        subj_counts[q["subject"]] = subj_counts.get(q["subject"], 0) + 1
+    print(json.dumps({"written": len(qs), "out": os.path.abspath(args.out),
+                      "subjects": subj_counts}))
+
+
+if __name__ == "__main__":
+    main()

@@ -225,29 +225,115 @@ async def call_mcp_action(core, router, params: dict) -> dict:
 # answer_engine
 # ---------------------------------------------------------------------------
 
+ANSWER_SOURCE_CHARS = 8_000      # per-source extraction cap
+ANSWER_CONTEXT_CHARS = 28_000    # whole grounding block cap
+_HREF = None                     # compiled lazily (regex import cost)
+
+
+def _extract_result_links(html: str, base_url: str,
+                          max_links: int) -> list[dict]:
+    """Top-k result links from a search page: absolute http(s) hrefs (plus
+    relative ones joined against the search URL), same-host navigation
+    links dropped, deduped in page order, anchor text kept as the source
+    title. Regex extraction — the HTTP seam's test fakes and real search
+    pages both serve plain anchors."""
+    global _HREF
+    import re
+    import urllib.parse
+    if _HREF is None:
+        _HREF = re.compile(
+            r'<a\s[^>]*href=["\']([^"\']+)["\'][^>]*>(.*?)</a>',
+            re.IGNORECASE | re.DOTALL)
+    search_host = urllib.parse.urlparse(base_url).netloc
+    out, seen = [], set()
+    for href, anchor in _HREF.findall(html):
+        # keep fragment-bearing result links; the fragment itself is
+        # stripped (same page) so #-variants dedupe together
+        url, _ = urllib.parse.urldefrag(
+            urllib.parse.urljoin(base_url, href.strip()))
+        parsed = urllib.parse.urlparse(url)
+        if parsed.scheme not in ("http", "https"):
+            continue
+        if parsed.netloc == search_host or not parsed.netloc:
+            continue                      # search-engine nav/self links
+        if url in seen:
+            continue
+        seen.add(url)
+        title = re.sub(r"<[^>]+>", "", anchor).strip()[:200]
+        out.append({"url": url, "title": title})
+        if len(out) >= max_links:
+            break
+    return out
+
+
 @register("answer_engine")
 async def answer_engine_action(core, router, params: dict) -> dict:
-    """Grounded Q&A: optionally fetch search context through the HTTP seam,
-    then answer with the designated on-device answer model. The reference
-    delegates grounding to a hosted model's built-in search
-    (answer_engine.ex:1-52); on-device the grounding context is explicit."""
+    """Grounded Q&A with PER-SOURCE extraction and citations (reference
+    answer_engine.ex:1-52 — provider-side search grounding with source
+    metadata): the search template's result page yields top-k result
+    URLs, each is fetched CONCURRENTLY and extracted to markdown, the
+    numbered source sections ground the on-device answer model, and the
+    result carries per-source citation metadata. A search page with no
+    extractable result links degrades to the old single-context mode
+    (the page itself as grounding)."""
     from quoracle_tpu.models.runtime import QueryRequest
     query = params["query"]
     deps = core.deps
-    sources: list[str] = []
+    sources: list[dict] = []
     context = ""
+    numbered_grounding = False      # context holds "[n] ..." sections
     search_url = None
+    max_sources = 3
     if deps.persistence is not None:
         search_url = deps.persistence.get_setting("answer_engine_search_url")
+        try:
+            max_sources = int(deps.persistence.get_setting(
+                "answer_engine_max_sources") or 3)
+        except (TypeError, ValueError):
+            max_sources = 3
     if search_url and deps.http is not None:
         import urllib.parse
         url = search_url.replace("{query}", urllib.parse.quote(query))
         try:
             resp = await _http(core, url, timeout_s=20)
-            context = truncate_output(html_to_markdown(resp.text()), 20_000)
-            sources.append(url)
+            page = resp.text() if resp.status < 400 else ""
         except Exception:
-            context = ""
+            page = ""
+        links = (_extract_result_links(page, url, max_sources)
+                 if page else [])
+
+        async def fetch_one(link: dict) -> Optional[str]:
+            try:
+                r = await _http(core, link["url"], timeout_s=15)
+                if r.status >= 400:
+                    return None
+                body = r.text()
+                if "html" in r.content_type or body.lstrip()[:1] == "<":
+                    body = html_to_markdown(body)
+                return truncate_output(body, ANSWER_SOURCE_CHARS)
+            except Exception:
+                return None
+
+        if links:
+            extracts = await asyncio.gather(*(fetch_one(l) for l in links))
+            blocks = []
+            for i, (link, text) in enumerate(zip(links, extracts), 1):
+                fetched = text is not None
+                sources.append({"index": i, "url": link["url"],
+                                "title": link["title"], "fetched": fetched})
+                if fetched:
+                    head = f"[{i}] {link['title'] or link['url']} " \
+                           f"({link['url']})"
+                    blocks.append(f"{head}\n{text}")
+            context = truncate_output("\n\n".join(blocks),
+                                      ANSWER_CONTEXT_CHARS)
+            numbered_grounding = bool(blocks)
+        if not context and page:
+            # no result links (or every fetch failed): the search page
+            # itself is the grounding, as before
+            context = truncate_output(html_to_markdown(page), 20_000)
+            sources = [{"index": 1, "url": url, "title": "search results",
+                        "fetched": True}]
     answer_model = None
     if deps.persistence is not None:
         answer_model = deps.persistence.get_setting("answer_engine_model")
@@ -256,7 +342,10 @@ async def answer_engine_action(core, router, params: dict) -> dict:
     prompt = "Answer the question concisely and factually."
     if params.get("focus"):
         prompt += f" Focus: {params['focus']}."
-    user = (f"{context}\n\nQuestion: {query}" if context
+    if numbered_grounding:
+        prompt += (" Ground the answer in the numbered sources and cite "
+                   "them inline as [n].")
+    user = (f"Sources:\n{context}\n\nQuestion: {query}" if context
             else f"Question: {query}")
     loop = asyncio.get_running_loop()
     results = await loop.run_in_executor(None, lambda: deps.backend.query([

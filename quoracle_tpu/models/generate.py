@@ -596,6 +596,13 @@ class GenerateEngine:
         # The paged steps donate the pool buffers; calls that touch the pool
         # must serialize (concurrent members use separate engines).
         self._paged_lock = threading.Lock()
+        # Grammar-table cache has its OWN lock so sessionless calls (image
+        # rows, models/runtime.py) can run concurrently with the continuous
+        # batcher's sessioned chunks without serializing on _paged_lock —
+        # the cache dict (build/evict) is their only shared mutable state.
+        # Order: _paged_lock → _grammar_lock (sessioned path), never
+        # reversed.
+        self._grammar_lock = threading.Lock()
         # Resident-size thresholds (max prompt tokens in the batch) for the
         # DIRECT (ragged-kernel) paged decode and paged PREFILL. These are
         # MEASURED gates, not constants: where the kernels win depends on
@@ -1481,7 +1488,14 @@ class GenerateEngine:
         action enums present in the batch (None = plain JSON); returns
         (stacked table, {enum: start-state offset into it}). Single-grammar
         batches (the common case) hit a per-enum device cache; mixed
-        batches additionally cache the stacked result."""
+        batches additionally cache the stacked result. Guarded by
+        _grammar_lock: sessionless image calls share this cache with the
+        batcher thread's sessioned chunks (dict eviction mid-read would
+        corrupt)."""
+        with self._grammar_lock:
+            return self._json_table_device_impl(enum_set)
+
+    def _json_table_device_impl(self, enum_set: tuple):
         from quoracle_tpu.models.constrained import JsonTokenTable
         if not hasattr(self, "_json_cache"):
             self._json_cache: dict = {}

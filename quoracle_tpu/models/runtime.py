@@ -355,6 +355,13 @@ class TPUBackend(ModelBackend):
                 etok = get_tokenizer(espec)
             self.embedder = EmbeddingEncoder(ecfg, eparams, etok)
 
+    def close(self) -> None:
+        """Stop the continuous batcher threads (no-op otherwise). Queued
+        rows fail loudly rather than stranding waiters — scheduler.close()
+        semantics."""
+        for cb in self._cbatchers.values():
+            cb.close()
+
     # -- ModelBackend --
 
     def query(self, requests: Sequence[QueryRequest]) -> list[QueryResult]:
@@ -497,18 +504,19 @@ class TPUBackend(ModelBackend):
                 from concurrent.futures import Future
                 f = Future()
                 try:
-                    # sessionless image calls skip generate()'s internal
-                    # serialization; take the engine's paged lock so this
-                    # call can't race the batcher thread's sessioned
-                    # generates on shared engine state (grammar cache,
-                    # phase stats)
-                    with engine._paged_lock:
-                        g = engine.generate(
-                            [r["prompt"]], temperature=r["temperature"],
-                            top_p=r["top_p"], max_new_tokens=r["budget"],
-                            constrain_json=[r["constrain_json"]],
-                            action_enums=[r["action_enum"]],
-                            images=[r["image"]])[0]
+                    # Sessionless image calls never touch the page pool
+                    # (generate.py: paged stays False without session_ids)
+                    # and the grammar cache now has its own lock
+                    # (_grammar_lock), so a long VLM round runs WITHOUT
+                    # engine._paged_lock — holding it here stalled every
+                    # concurrent text agent's sessioned chunks for the
+                    # whole image generate (ADVICE r4).
+                    g = engine.generate(
+                        [r["prompt"]], temperature=r["temperature"],
+                        top_p=r["top_p"], max_new_tokens=r["budget"],
+                        constrain_json=[r["constrain_json"]],
+                        action_enums=[r["action_enum"]],
+                        images=[r["image"]])[0]
                     f.set_result(g)
                 except Exception as e:    # noqa: BLE001 — per-row capture
                     f.set_exception(e)

@@ -99,6 +99,16 @@ class ContinuousBatcher:
                    constrain=constrain_json, action_enum=action_enum,
                    future=Future(), t_submit=time.monotonic())
         row.owns_session = session_id is None
+        # Per-row admission check: an over-window prompt must fail ONLY
+        # its own future — inside a shared chunk the engine's
+        # ContextOverflowError would poison every live row's in-flight
+        # work (the engine applies the same bound at generate()).
+        if len(row.prompt) >= self.engine.max_seq:
+            from quoracle_tpu.models.generate import ContextOverflowError
+            row.future.set_exception(ContextOverflowError(
+                f"prompt of {len(row.prompt)} tokens >= max_seq "
+                f"{self.engine.max_seq} for model {self.engine.cfg.name}"))
+            return row.future
         self._queue.put(row)
         self._wake.set()
         return row.future
@@ -107,11 +117,20 @@ class ContinuousBatcher:
         self._stop = True
         self._wake.set()
         self._thread.join(timeout=10)
-        # never strand a waiter: live + still-queued rows fail loudly
-        # instead of leaving callers blocked on futures forever
+        if self._thread.is_alive():
+            # mid-chunk device call still running; give it one longer
+            # grace period — touching _live while the worker owns it
+            # would race its set_result calls (InvalidStateError)
+            self._thread.join(timeout=50)
+        # never strand a waiter: still-queued rows fail loudly instead of
+        # leaving callers blocked on futures forever. LIVE rows are failed
+        # by the worker's own exit cleanup (it owns _live); only a worker
+        # confirmed dead can't do that, so take over just in that case.
         err = RuntimeError("ContinuousBatcher closed")
-        leftovers = list(self._live)
-        self._live = []
+        leftovers = []
+        if not self._thread.is_alive():
+            leftovers = list(self._live)
+            self._live = []
         while True:
             try:
                 leftovers.append(self._queue.get_nowait())
@@ -146,17 +165,43 @@ class ContinuousBatcher:
                 self._wake.clear()
                 continue
             try:
-                self._step()
-            except Exception as e:        # noqa: BLE001 — fail the rows,
-                for row in self._live:    # not the loop
-                    if not row.future.done():
-                        row.future.set_exception(e)
-                    if row.owns_session:
-                        self.engine.drop_session(row.session_id)
-                self._live = []
+                self._live = self._step(self._live)
+            except Exception:             # noqa: BLE001 — isolate, don't
+                self._live = self._isolate_failure(self._live)  # nuke all
+        # worker exit (close()): the worker owns _live, so it fails any
+        # remaining rows itself — close() only takes over when this
+        # thread is confirmed dead
+        err = RuntimeError("ContinuousBatcher closed")
+        for row in self._live:
+            if not row.future.done():
+                row.future.set_exception(err)
+            if row.owns_session:
+                self.engine.drop_session(row.session_id)
+        self._live = []
 
-    def _step(self) -> None:
-        rows = self._live
+    def _isolate_failure(self, rows: list) -> list:
+        """A shared chunk raised. One poisoned row must not discard every
+        other agent's partial work: rerun each row as its own single-row
+        chunk — rows that fail alone get THEIR error, the rest survive
+        with their emitted state intact. Engine-wide failures (device
+        dead) fail every row with its own raise, same end state as the
+        old all-rows-fail path."""
+        survivors: list = []
+        for row in rows:
+            if row.future.done():
+                # _step resolved this row (and dropped its session) before
+                # the exception hit a later row — nothing left to rerun
+                continue
+            try:
+                survivors.extend(self._step([row]))
+            except Exception as e:        # noqa: BLE001 — per-row capture
+                if not row.future.done():
+                    row.future.set_exception(e)
+                if row.owns_session:
+                    self.engine.drop_session(row.session_id)
+        return survivors
+
+    def _step(self, rows: list) -> list:
         prompts = [r.prompt + r.emitted for r in rows]
         budgets = [min(self.chunk, r.max_new - len(r.emitted))
                    for r in rows]
@@ -190,18 +235,19 @@ class ContinuousBatcher:
                             >= self.engine.max_seq - 1))
             if finished:
                 import time
-                row.future.set_result(GenResult(
-                    token_ids=list(row.emitted),
-                    text=self.engine.tokenizer.decode(row.emitted),
-                    n_prompt_tokens=len(row.prompt),
-                    n_gen_tokens=len(row.emitted),
-                    latency_s=time.monotonic() - row.t_submit,
-                    finish_reason=res.finish_reason,
-                    n_cached_tokens=row.n_cached_first,
-                    json_state=res.json_state,
-                ))
+                if not row.future.done():   # close() may have failed it
+                    row.future.set_result(GenResult(
+                        token_ids=list(row.emitted),
+                        text=self.engine.tokenizer.decode(row.emitted),
+                        n_prompt_tokens=len(row.prompt),
+                        n_gen_tokens=len(row.emitted),
+                        latency_s=time.monotonic() - row.t_submit,
+                        finish_reason=res.finish_reason,
+                        n_cached_tokens=row.n_cached_first,
+                        json_state=res.json_state,
+                    ))
                 if row.owns_session:
                     self.engine.drop_session(row.session_id)
             else:
                 still.append(row)
-        self._live = still
+        return still

@@ -82,6 +82,56 @@ def pool_submeshes(
     return meshes
 
 
+V5E_HBM_BYTES = 16 * 1024 ** 3          # 16 GiB per v5e chip (public spec)
+POOL_TAIL_RESERVE = 1.25 * 1024 ** 3    # activations + compiled programs +
+                                        # grammar tables + fragmentation
+
+
+def pool_sizing(pool: Sequence[str], n_devices: int = 8,
+                hbm_per_chip: int = V5E_HBM_BYTES,
+                dtype_bytes: int = 2) -> dict:
+    """Explicit HBM budget for a model pool on a v5e sub-mesh partition
+    (VERDICT r4 item 4): per member — chips (= recommended_tp), bf16
+    weight bytes per chip, the page-pool bytes left after the tail
+    reserve, and how many resident KV tokens that pool holds. The
+    placement is the SURVEY §7 hard-part-1 design: a static partition of
+    the slice, one contiguous tp sub-mesh per member.
+
+    Returns {"members": [...], "chips_used", "fits", "hbm_per_chip"};
+    ``fits`` is False when the pool needs more chips than the slice has
+    or any member's weights alone exceed its chips' HBM.
+    """
+    from quoracle_tpu.models.config import get_model_config
+    members, used, fits = [], 0, True
+    for spec in pool:
+        cfg = get_model_config(spec)
+        tp = _largest_tp_divisor(cfg.n_kv_heads,
+                                 max(1, cfg.recommended_tp))
+        weights = cfg.n_params * dtype_bytes
+        w_per_chip = weights / tp
+        page_pool = hbm_per_chip - w_per_chip - POOL_TAIL_RESERVE
+        kv_tok = cfg.kv_bytes_per_token(tp, dtype_bytes)
+        resident = int(page_pool // kv_tok) if page_pool > 0 else 0
+        m_fits = page_pool > 0
+        fits = fits and m_fits
+        used += tp
+        members.append({
+            "model": cfg.name, "tp": tp, "chips": tp,
+            "params_b": round(cfg.n_params / 1e9, 2),
+            "weights_gb_per_chip": round(w_per_chip / 1024 ** 3, 2),
+            "page_pool_gb_per_chip": round(max(0.0, page_pool) / 1024 ** 3,
+                                           2),
+            "kv_bytes_per_token_per_chip": kv_tok,
+            "resident_kv_tokens": resident,
+            "fits": m_fits,
+        })
+    fits = fits and used <= n_devices
+    return {"members": members, "chips_used": used,
+            "n_devices": n_devices, "fits": fits,
+            "hbm_per_chip_gb": round(hbm_per_chip / 1024 ** 3, 2),
+            "tail_reserve_gb": round(POOL_TAIL_RESERVE / 1024 ** 3, 2)}
+
+
 def _largest_tp_divisor(n_kv_heads: int, tp_size: int) -> int:
     d = min(n_kv_heads, tp_size)
     while n_kv_heads % d or tp_size % d:

@@ -199,9 +199,18 @@ class CredentialStore:
         return data
 
     def for_model(self, model_spec: str) -> Optional[dict]:
-        row = self.db.query_one(
-            "SELECT id FROM credentials WHERE model_spec=?", (model_spec,))
-        return None if row is None else self.get(row["id"])
+        rows = self.db.query(
+            "SELECT id FROM credentials WHERE model_spec=? ORDER BY id",
+            (model_spec,))
+        if not rows:
+            return None
+        if len(rows) > 1:
+            # no UNIQUE constraint on model_spec — deterministic pick
+            # (lowest id) instead of whichever row the engine returns first
+            logger.warning(
+                "%d credentials registered for model_spec=%r; using %r",
+                len(rows), model_spec, rows[0]["id"])
+        return self.get(rows[0]["id"])
 
     def delete(self, cred_id: str) -> bool:
         row = self.db.query_one("SELECT id FROM credentials WHERE id=?",

@@ -253,6 +253,71 @@ def test_answer_engine_uses_designated_model():
     run(main())
 
 
+def test_answer_engine_multi_source_grounding():
+    """Search template → top-k result links → per-source fetch + extract →
+    numbered citations in the grounding prompt and per-source metadata in
+    the result (reference answer_engine.ex:1-52 source extraction)."""
+    async def main():
+        search_html = (
+            '<div class="r"><a href="https://a.example/page">Alpha '
+            'doc</a></div>'
+            '<a href="/internal">nav</a>'                 # same-host: drop
+            '<a href="https://b.example/post">Beta <b>post</b></a>'
+            '<a href="https://a.example/page">Alpha doc</a>'  # dupe: drop
+            '<a href="https://c.example/x">Gamma</a>')
+        http = FakeHttp({
+            "https://search.example/?q=why%20is%20the%20sky%20blue":
+                (200, "text/html", search_html),
+            "https://a.example/page":
+                (200, "text/html", "<p>Rayleigh scattering explains "
+                                   "it.</p>"),
+            "https://b.example/post":
+                (200, "text/html", "<p>Blue light scatters more.</p>"),
+            # c.example missing → that fetch fails, source marked
+            # fetched=false, answer still assembles from the other two
+        })
+        seen_prompts = []
+
+        def respond(r):
+            joined = "\n".join(str(m.get("content", ""))
+                               for m in r.messages)
+            if "Answer the question" in joined:
+                seen_prompts.append(joined)
+                return "Rayleigh scattering [1][2]."
+            if '"answer"' in joined:
+                return j("wait", {})
+            return j("answer_engine", {"query": "why is the sky blue"})
+
+        from quoracle_tpu.persistence.db import Database
+        from quoracle_tpu.persistence.store import Persistence
+        store = Persistence(Database(":memory:"))
+        store.set_setting("answer_engine_search_url",
+                          "https://search.example/?q={query}")
+        backend = MockBackend(respond=respond)
+        core, text = await run_one_action(backend, http=http,
+                                          persistence=store)
+        assert "Rayleigh scattering [1][2]." in text
+        # per-source citation metadata in the action result (the history
+        # entry is NO_EXECUTE-fenced — parse the JSON inside the fence)
+        fenced = first_result(core).content
+        result = json.loads(
+            fenced.split("\n", 2)[2].rsplit("</NO_EXECUTE>", 1)[0])["result"]
+        srcs = {s["url"]: s for s in result["sources"]}
+        assert srcs["https://a.example/page"]["fetched"] is True
+        assert srcs["https://a.example/page"]["title"] == "Alpha doc"
+        assert srcs["https://b.example/post"]["fetched"] is True
+        assert srcs["https://b.example/post"]["title"] == "Beta post"
+        assert srcs["https://c.example/x"]["fetched"] is False
+        assert [s["index"] for s in result["sources"]] == [1, 2, 3]
+        # the model saw numbered source sections with both extracts
+        grounding = seen_prompts[0]
+        assert "[1] Alpha doc (https://a.example/page)" in grounding
+        assert "Rayleigh scattering explains" in grounding
+        assert "[2] Beta post (https://b.example/post)" in grounding
+        assert "cite" in grounding or "[n]" in grounding
+    run(main())
+
+
 def test_generate_images_procedural(tmp_path):
     async def main():
         backend = scripted(

@@ -1,0 +1,73 @@
+#!/bin/bash
+# Relay poller (VERDICT r4 item 1): poll the loopback relay all round; the
+# moment the chip answers, run the paged calibration sweep + the full bench
+# and write the artifacts immediately so a later relay death can't erase them.
+#
+# Log: /root/repo/RELAY_POLL_r05.log (one line per probe; goes into the
+# BENCH artifact if the relay never answers).
+# Success artifacts: /root/repo/BENCH_r05_live.json, QUORACLE_PAGED_CALIB
+# at /root/repo/calib_v5e.json, FINETUNE at 1b scale if time permits.
+
+cd /root/repo
+LOG=RELAY_POLL_r05.log
+PORTS="8082 8083 8087 8092"
+
+probe_ports() {
+    for p in $PORTS; do
+        if timeout 2 bash -c "echo > /dev/tcp/127.0.0.1/$p" 2>/dev/null; then
+            return 0
+        fi
+    done
+    return 1
+}
+
+echo "$(date -u +%FT%TZ) poller start (pid $$)" >> "$LOG"
+while true; do
+    if probe_ports; then
+        echo "$(date -u +%FT%TZ) relay port OPEN — probing device" >> "$LOG"
+        # Confirm the device actually answers (a listening port is necessary
+        # but not sufficient), using bench.py's own SIGTERM-safe probe.
+        if timeout 400 python - >> "$LOG" 2>&1 <<'EOF'
+import sys
+sys.path.insert(0, "/root/repo")
+import bench
+p = bench.probe_device(300.0)
+print("device probe:", p)
+sys.exit(0 if p.get("ok") else 1)
+EOF
+        then
+            echo "$(date -u +%FT%TZ) DEVICE LIVE — running calibration + bench" >> "$LOG"
+            timeout 2400 python -m quoracle_tpu.tools.calibrate_paged \
+                --out /root/repo/calib_v5e.json >> "$LOG" 2>&1 \
+                && echo "$(date -u +%FT%TZ) calibration written" >> "$LOG" \
+                || echo "$(date -u +%FT%TZ) calibration FAILED (continuing to bench)" >> "$LOG"
+            export QUORACLE_PAGED_CALIB=/root/repo/calib_v5e.json
+            timeout 5400 python bench.py > /root/repo/BENCH_r05_live.json 2>> "$LOG"
+            echo "$(date -u +%FT%TZ) bench rc=$? artifact=BENCH_r05_live.json" >> "$LOG"
+            if python - <<'EOF'
+import json
+d = json.load(open("/root/repo/BENCH_r05_live.json"))
+ok = (not d.get("device_unavailable")) and d.get("value")
+raise SystemExit(0 if ok else 1)
+EOF
+            then
+                echo "$(date -u +%FT%TZ) BENCH SUCCESS — chip-verified record captured" >> "$LOG"
+                cd /root/repo && git add BENCH_r05_live.json calib_v5e.json RELAY_POLL_r05.log 2>/dev/null
+                git -c user.name=distsys-graft -c user.email=graft@localhost \
+                    commit -m "Chip-verified BENCH_r05_live artifact captured by relay poller" >> "$LOG" 2>&1
+                # Keep polling in case a later, longer window allows a rerun?
+                # No: record is in. Switch to slow heartbeat so a 1b finetune
+                # could be run manually; exit the hot loop.
+                echo "$(date -u +%FT%TZ) poller entering idle heartbeat" >> "$LOG"
+                while true; do sleep 3600; echo "$(date -u +%FT%TZ) heartbeat (record already captured)" >> "$LOG"; done
+            else
+                echo "$(date -u +%FT%TZ) bench artifact not clean; will retry next poll" >> "$LOG"
+            fi
+        else
+            echo "$(date -u +%FT%TZ) port open but device probe failed" >> "$LOG"
+        fi
+    else
+        echo "$(date -u +%FT%TZ) relay dead (all ports closed)" >> "$LOG"
+    fi
+    sleep 570
+done
