@@ -1,0 +1,244 @@
+#!/usr/bin/env python
+"""Offline LiveBench-format task generator (VERDICT r4 item 3, LiveBench
+half). The reference runs ~1,150 public LiveBench questions across 6
+categories (/root/reference/README.md:550); this host has no network, so
+workload-scale data is generated: deterministic seeded templates per
+category, every task scoreable by score_run.py's mechanical graders
+(exact / numeric / checks — no LLM judges). Coding tasks EXECUTE their
+program at generation time, so the key is ground truth by construction.
+
+    python groves/livebench/scripts/gen_questions.py \
+        [--n 1152] [--seed 11] [--out ../data/questions_full.jsonl]
+"""
+
+from __future__ import annotations
+
+import argparse
+import calendar
+import json
+import math
+import os
+import random
+
+# ---------------------------------------------------------------------------
+# category template banks: fn(rng) -> dict(task=..., answer_type=..., ...)
+# ---------------------------------------------------------------------------
+
+
+def t_math(rng: random.Random) -> dict:
+    k = rng.randrange(6)
+    if k == 0:
+        a, b = rng.randrange(12, 99), rng.randrange(12, 99)
+        return _num(f"Compute {a} * {b}. Answer with the number only.",
+                    a * b)
+    if k == 1:
+        a, b = rng.randrange(6, 40), rng.randrange(6, 40)
+        return _num(f"What is the least common multiple of {a} and {b}? "
+                    f"Answer with the number only.", math.lcm(a, b))
+    if k == 2:
+        w = rng.randrange(3, 15)
+        h = rng.randrange(3, 15)
+        return _num(f"A rectangle has perimeter {2 * (w + h)} and width "
+                    f"{w}. What is its area? Answer with the number only.",
+                    w * h)
+    if k == 3:
+        a, ea, b, eb = rng.randrange(2, 6), rng.randrange(3, 9), \
+            rng.randrange(2, 6), rng.randrange(2, 6)
+        return _num(f"What is {a}^{ea} - {b}^{eb}? Answer with the number "
+                    f"only.", a ** ea - b ** eb)
+    if k == 4:
+        n = rng.randrange(10, 60)
+        return _num(f"What is the sum of the first {n} positive integers? "
+                    f"Answer with the number only.", n * (n + 1) // 2)
+    n, d = rng.randrange(30, 200), rng.choice([4, 5, 8, 10, 20, 25])
+    return _num(f"What is {n * d} divided by {d}? Answer with the number "
+                f"only.", n)
+
+
+_SNIPPETS = [
+    lambda rng: f"print(len('abc' * {rng.randrange(2, 7)}))",
+    lambda rng: f"print(sum(range({rng.randrange(4, 12)})))",
+    lambda rng: (lambda a, b: f"print({a} // {b} + {a} % {b})")(
+        rng.randrange(17, 60), rng.randrange(3, 9)),
+    lambda rng: (lambda w: f"print('{w}'[::-1])")(
+        rng.choice(["stream", "packet", "tensor", "kernel", "buffer",
+                    "column", "socket", "thread"])),
+    lambda rng: (lambda n: f"print(len([x for x in range({n}) "
+                           f"if x % 3 == 0]))")(rng.randrange(7, 30)),
+    lambda rng: (lambda w, i, j: f"print('{w}'[{i}:{j}])")(
+        rng.choice(["consensus", "benchmark", "pipeline", "scheduler"]),
+        rng.randrange(0, 3), rng.randrange(4, 8)),
+    lambda rng: (lambda a: f"print(max({a}))")(
+        sorted(rng.sample(range(1, 99), 5))),
+    lambda rng: (lambda s: f"print('-'.join('{s}'.split('o')))")(
+        rng.choice(["protocol", "topology", "monotonic", "orchestrator"])),
+]
+
+
+def t_coding(rng: random.Random) -> dict:
+    src = rng.choice(_SNIPPETS)(rng)
+    # ground truth by construction: run the template we just authored
+    import io
+    import contextlib
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        exec(src, {})                               # noqa: S102 — own template
+    out = buf.getvalue().strip()
+    return {"task": f"What does this Python program print? {src} "
+                    f"Answer with the exact output only.",
+            "answer_type": "exact", "answer": out}
+
+
+_DAYS = list(calendar.day_name)
+
+
+def t_reasoning(rng: random.Random) -> dict:
+    k = rng.randrange(3)
+    if k == 0:
+        start, step = rng.randrange(2, 9), rng.randrange(3, 9)
+        seq = [start + i * step for i in range(4)]
+        return _num(f"What number comes next: "
+                    f"{', '.join(map(str, seq))}? Answer with the number "
+                    f"only.", start + 4 * step)
+    if k == 1:
+        d, n = rng.randrange(7), rng.randrange(3, 25)
+        return {"task": f"If today is {_DAYS[d]}, what day of the week is "
+                        f"it in {n} days? Answer with the day name only.",
+                "answer_type": "exact", "answer": _DAYS[(d + n) % 7]}
+    names = rng.sample(["Ava", "Ben", "Cal", "Dia", "Eli"], 3)
+    a, b, c = names
+    return {"task": f"{a} is taller than {b}. {b} is taller than {c}. "
+                    f"Who is the shortest? Answer with the name only.",
+            "answer_type": "exact", "answer": c}
+
+
+_WORDS = ["algorithm", "consensus", "benchmark", "hierarchy", "latency",
+          "throughput", "gradient", "attention", "tokenizer", "pipeline",
+          "scheduler", "topology", "allocator", "checkpoint", "manifest",
+          "quorum", "replica", "shard", "vector", "matrix"]
+
+
+def t_language(rng: random.Random) -> dict:
+    k = rng.randrange(3)
+    if k == 0:
+        w = rng.choice(_WORDS)
+        return _num(f"How many vowels (a, e, i, o, u) are in the word "
+                    f"'{w}'? Answer with the number only.",
+                    sum(ch in "aeiou" for ch in w))
+    if k == 1:
+        w = rng.choice(_WORDS)
+        return {"task": f"Spell the word '{w}' backwards. Answer with the "
+                        f"reversed word only, in lowercase.",
+                "answer_type": "exact", "answer": w[::-1]}
+    ws = rng.sample(_WORDS, 4)
+    return {"task": f"Which of these words comes first alphabetically: "
+                    f"{', '.join(ws)}? Answer with the word only.",
+            "answer_type": "exact", "answer": min(ws)}
+
+
+def t_data_analysis(rng: random.Random) -> dict:
+    n = rng.randrange(5, 9)
+    vals = [rng.randrange(10, 99) for _ in range(n)]
+    rows = "; ".join(f"row{i + 1}={v}" for i, v in enumerate(vals))
+    k = rng.randrange(3)
+    if k == 0:
+        return _num(f"Given the values {rows}: what is the maximum value? "
+                    f"Answer with the number only.", max(vals))
+    if k == 1:
+        return _num(f"Given the values {rows}: what is the sum of all "
+                    f"values? Answer with the number only.", sum(vals))
+    cut = rng.randrange(30, 80)
+    return _num(f"Given the values {rows}: how many values are strictly "
+                f"greater than {cut}? Answer with the number only.",
+                sum(v > cut for v in vals))
+
+
+_TOPICS = ["the ocean", "a forest", "winter mornings", "a busy market",
+           "distant mountains", "a quiet library", "city lights",
+           "a thunderstorm", "fresh bread", "an old bridge"]
+_MUSTS = ["blue", "quiet", "warm", "vast", "bright", "soft", "old",
+          "fresh", "deep", "still"]
+
+
+def t_instruction_following(rng: random.Random) -> dict:
+    k = rng.randrange(3)
+    topic = rng.choice(_TOPICS)
+    if k == 0:
+        n = rng.randrange(3, 8)
+        return {"task": f"Describe {topic} in exactly {n} words.",
+                "answer_type": "checks",
+                "checks": [{"type": "word_count", "n": n}]}
+    if k == 1:
+        word = rng.choice(_MUSTS)
+        return {"task": f"Write one sentence about {topic} that contains "
+                        f"the word '{word}'.",
+                "answer_type": "checks",
+                "checks": [{"type": "contains", "text": word},
+                           {"type": "max_words", "n": 30}]}
+    return {"task": f"Describe {topic} in one sentence using no digits.",
+            "answer_type": "checks",
+            "checks": [{"type": "no_digits"},
+                       {"type": "max_words", "n": 40}]}
+
+
+def _num(task: str, answer) -> dict:
+    return {"task": task, "answer_type": "numeric", "answer": str(answer)}
+
+
+CATEGORIES = {
+    "math": t_math, "coding": t_coding, "reasoning": t_reasoning,
+    "language": t_language, "data_analysis": t_data_analysis,
+    "instruction_following": t_instruction_following,
+}
+
+
+def generate(n: int, seed: int) -> list[dict]:
+    rng = random.Random(seed)
+    cats = list(CATEGORIES)
+    out, seen = [], set()
+    misses = {c: 0 for c in cats}
+    active = list(cats)
+    i = 0
+    qid = 0
+    while len(out) < n and active:
+        cat = active[i % len(active)]
+        q = CATEGORIES[cat](rng)
+        key = (cat, q["task"])
+        if key in seen:
+            misses[cat] += 1
+            if misses[cat] >= 80:
+                active.remove(cat)
+            else:
+                i += 1
+            continue
+        misses[cat] = 0
+        seen.add(key)
+        qid += 1
+        out.append({"id": f"lbg{qid:05d}", "category": cat, **q})
+        i += 1
+    if len(out) < n:
+        raise SystemExit(f"template space exhausted at {len(out)} < {n}")
+    return out
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--n", type=int, default=1152)
+    ap.add_argument("--seed", type=int, default=11)
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "data",
+        "questions_full.jsonl"))
+    args = ap.parse_args()
+    qs = generate(args.n, args.seed)
+    with open(args.out, "w") as f:
+        for q in qs:
+            f.write(json.dumps(q) + "\n")
+    counts = {}
+    for q in qs:
+        counts[q["category"]] = counts.get(q["category"], 0) + 1
+    print(json.dumps({"written": len(qs), "out": os.path.abspath(args.out),
+                      "categories": counts}))
+
+
+if __name__ == "__main__":
+    main()

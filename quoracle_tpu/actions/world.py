@@ -304,6 +304,14 @@ async def answer_engine_action(core, router, params: dict) -> dict:
 
         async def fetch_one(link: dict) -> Optional[str]:
             try:
+                if core.deps.ssrf_check:
+                    # result links are CONTENT-DERIVED (a hostile search
+                    # page could point at link-local metadata endpoints) —
+                    # explicit pre-flight like fetch_web's, off-loop (DNS);
+                    # _http only re-checks redirects for the default
+                    # transport, so this guard must not depend on it
+                    await asyncio.get_running_loop().run_in_executor(
+                        None, check_ssrf, link["url"])
                 r = await _http(core, link["url"], timeout_s=15)
                 if r.status >= 400:
                     return None

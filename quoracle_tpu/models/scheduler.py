@@ -110,6 +110,22 @@ class ContinuousBatcher:
                 f"{self.engine.max_seq} for model {self.engine.cfg.name}"))
             return row.future
         self._queue.put(row)
+        if self._stop:
+            # close() raced this submit: its drain may have run before our
+            # put landed, stranding the row. Take over the drain — the
+            # done() guards make this safe against the worker having
+            # admitted the row first.
+            err = RuntimeError("ContinuousBatcher is closed")
+            while True:
+                try:
+                    r2 = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if not r2.future.done():
+                    r2.future.set_exception(err)
+                if r2.owns_session:
+                    self.engine.drop_session(r2.session_id)
+            return row.future
         self._wake.set()
         return row.future
 

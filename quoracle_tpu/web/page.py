@@ -57,6 +57,11 @@ DASHBOARD_HTML = r"""<!doctype html>
 <header>
   <h1>quoracle-tpu</h1>
   <span class="status" id="status">connecting…</span>
+  <nav style="display:flex;gap:10px">
+    <a href="/logs" style="color:#9ecbff">logs</a>
+    <a href="/mailbox" style="color:#9ecbff">mailbox</a>
+    <a href="/telemetry" style="color:#9ecbff">telemetry</a>
+  </nav>
   <button id="settings-btn" style="margin-left:auto"
           onclick="toggleSettings()">settings</button>
 </header>

@@ -2,6 +2,11 @@
 
 Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
   GET  /                    dashboard page (3-panel parity)
+  GET  /logs                standalone cross-task log view (LogViewLive)
+  GET  /mailbox             standalone cross-task mailbox + agent panel
+                            (MailboxLive)
+  GET  /telemetry           dev telemetry page (LiveDashboard equivalent,
+                            router.ex:42-50)
   GET  /healthz             health check (reference HealthController)
   GET  /events              SSE: every bus broadcast as one JSON event
   GET  /api/status          runtime summary
@@ -147,6 +152,20 @@ class DashboardServer:
             "ORDER BY id DESC LIMIT ?2", (agent_id, limit))
         return [dict(r) for r in reversed(rows)]
 
+    def logs_joined_payload(self, task_id: Optional[str],
+                            level: Optional[str],
+                            limit: int = 300) -> list[dict]:
+        """Cross-task log rows: logs carry only agent_id, so the task
+        association joins through the agents table (the /logs standalone
+        view's read model — reference LogViewLive)."""
+        rows = self.runtime.db.query(
+            "SELECT l.*, a.task_id AS task_id FROM logs l "
+            "LEFT JOIN agents a ON l.agent_id = a.agent_id "
+            "WHERE (?1 IS NULL OR a.task_id=?1) "
+            "AND (?2 IS NULL OR l.level=?2) "
+            "ORDER BY l.id DESC LIMIT ?3", (task_id, level, limit))
+        return [dict(r) for r in reversed(rows)]
+
     def messages_payload(self, task_id: Optional[str],
                          limit: int = 100) -> list[dict]:
         rows = self.runtime.db.query(
@@ -263,6 +282,14 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def _send_html(self, html_text: str, status: int = 200) -> None:
+        body = html_text.encode()
+        self.send_response(status)
+        self.send_header("content-type", "text/html; charset=utf-8")
+        self.send_header("content-length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def _read_body(self) -> dict:
         length = int(self.headers.get("content-length") or 0)
         if not length:
@@ -291,12 +318,21 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             if parsed.path == "/":
-                body = DASHBOARD_HTML.encode()
-                self.send_response(200)
-                self.send_header("content-type", "text/html; charset=utf-8")
-                self.send_header("content-length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self._send_html(DASHBOARD_HTML)
+            elif parsed.path == "/logs":
+                from quoracle_tpu.web import views
+                self._send_html(views.logs_page(
+                    d.tasks_payload(),
+                    d.logs_joined_payload(one("task_id"), one("level")),
+                    one("task_id"), one("level")))
+            elif parsed.path == "/mailbox":
+                from quoracle_tpu.web import views
+                self._send_html(views.mailbox_page(
+                    d.tasks_payload(), d.agents_payload(one("task_id")),
+                    d.messages_payload(one("task_id")), one("task_id")))
+            elif parsed.path == "/telemetry":
+                from quoracle_tpu.web import views
+                self._send_html(views.telemetry_page(d.metrics_payload()))
             elif parsed.path == "/healthz":
                 self._send_json({"status": "ok"})
             elif parsed.path == "/api/status":

@@ -1,0 +1,169 @@
+"""Standalone server-rendered dashboard views: /logs, /mailbox, /telemetry.
+
+The reference ships dedicated cross-task pages alongside the main SPA —
+LogViewLive + MailboxLive (reference lib/quoracle_web/router.ex:22-32) and
+the dev LiveDashboard telemetry page (router.ex:42-50). This image has no
+JS engine or browser, so these views are rendered SERVER-SIDE to complete
+HTML documents: the DOM a test can parse and assert on directly
+(tests/test_dashboard_dom.py), and a no-JS fallback surface for operators.
+
+Each page is a pure function of read-model payloads → HTML string; the
+HTTP handler (web/server.py) routes GET /logs, /mailbox, /telemetry here.
+"""
+
+from __future__ import annotations
+
+import html
+import time
+from typing import Any, Optional
+
+_STYLE = """
+  :root { color-scheme: dark; }
+  body { margin: 0; font: 13px/1.5 ui-monospace, Menlo, monospace;
+         background: #14161a; color: #d6d8dd; }
+  header { display: flex; gap: 16px; align-items: baseline;
+           padding: 10px 16px; border-bottom: 1px solid #2a2d33; }
+  header h1 { font-size: 14px; margin: 0; color: #fff; }
+  header a { color: #9ecbff; text-decoration: none; }
+  main { padding: 12px 16px; }
+  table { border-collapse: collapse; width: 100%; margin-bottom: 16px; }
+  th, td { text-align: left; padding: 4px 10px 4px 0; vertical-align: top;
+           border-bottom: 1px solid #1c1f24; }
+  th { color: #8b8f98; font-weight: 600; text-transform: uppercase;
+       font-size: 11px; letter-spacing: .06em; }
+  .lvl-error { color: #ff9a9a; }
+  .lvl-warning { color: #ffd28a; }
+  .lvl-decision { color: #9ecbff; }
+  .meta { color: #8b8f98; }
+  .aid { color: #b7e3a8; }
+  .from { color: #d9b8ff; }
+  .todo-done { text-decoration: line-through; color: #8b8f98; }
+  form.filter { display: flex; gap: 8px; margin-bottom: 12px; }
+  select, input, button { font: inherit; background: #1a1d22;
+    color: #d6d8dd; border: 1px solid #2a2d33; border-radius: 6px;
+    padding: 4px 8px; }
+  .card { background: #1a1d22; border-radius: 8px; padding: 8px 12px;
+          margin-bottom: 8px; }
+"""
+
+
+def _e(x: Any) -> str:
+    return html.escape(str(x if x is not None else ""))
+
+
+def _ts(ts: Optional[float]) -> str:
+    if not ts:
+        return ""
+    return time.strftime("%H:%M:%S", time.localtime(ts))
+
+
+def _page(title: str, body: str, refresh: int = 5) -> str:
+    return (f"<!doctype html><html lang=\"en\"><head>"
+            f"<meta charset=\"utf-8\"><title>{_e(title)}</title>"
+            f"<meta http-equiv=\"refresh\" content=\"{refresh}\">"
+            f"<style>{_STYLE}</style></head><body>"
+            f"<header><h1>quoracle-tpu</h1>"
+            f"<a href=\"/\">dashboard</a><a href=\"/logs\">logs</a>"
+            f"<a href=\"/mailbox\">mailbox</a>"
+            f"<a href=\"/telemetry\">telemetry</a>"
+            f"<span class=\"meta\">{_e(title)}</span></header>"
+            f"<main>{body}</main></body></html>")
+
+
+def _task_strip(tasks: list[dict], selected: Optional[str],
+                base_path: str) -> str:
+    """Cross-task header table: id, status, live agents, cost roll-up,
+    with filter links. Shared by /logs and /mailbox."""
+    rows = "".join(
+        f"<tr class=\"task-row\" data-task=\"{_e(t['id'])}\">"
+        f"<td><a href=\"{base_path}?task_id={_e(t['id'])}\">{_e(t['id'])}"
+        f"</a></td><td>{_e(t.get('status'))}</td>"
+        f"<td>{_e(t.get('live_agents', 0))}</td>"
+        f"<td class=\"task-cost\">{_e(t.get('cost'))}</td></tr>"
+        for t in tasks)
+    sel = (f"<p class=\"meta\">filtered to task "
+           f"<b>{_e(selected)}</b> — <a href=\"{base_path}\">all tasks"
+           f"</a></p>" if selected else "")
+    return (f"<table id=\"tasks\"><tr><th>task</th><th>status</th>"
+            f"<th>agents</th><th>cost</th></tr>{rows}</table>{sel}")
+
+
+def logs_page(tasks: list[dict], logs: list[dict],
+              task_id: Optional[str], level: Optional[str]) -> str:
+    """Cross-task log view (reference LogViewLive): every agent's durable
+    logs, joined to their task, filterable by task and level."""
+    rows = "".join(
+        f"<tr class=\"log-row lvl-{_e(r.get('level'))}\">"
+        f"<td class=\"meta\">{_ts(r.get('ts'))}</td>"
+        f"<td>{_e(r.get('task_id'))}</td>"
+        f"<td class=\"aid\">{_e(r.get('agent_id'))}</td>"
+        f"<td class=\"lvl-{_e(r.get('level'))}\">{_e(r.get('level'))}</td>"
+        f"<td>{_e(r.get('message'))}</td></tr>"
+        for r in logs)
+    body = (_task_strip(tasks, task_id, "/logs")
+            + f"<table id=\"logs\"><tr><th>time</th><th>task</th>"
+              f"<th>agent</th><th>level</th><th>message</th></tr>"
+              f"{rows}</table>"
+            + (f"<p class=\"meta\">level filter: {_e(level)}</p>"
+               if level else ""))
+    return _page("logs", body)
+
+
+def mailbox_page(tasks: list[dict], agents: list[dict],
+                 messages: list[dict], task_id: Optional[str]) -> str:
+    """Cross-task mailbox (reference MailboxLive) extended with the agent
+    panel: per-agent cards carry live todos and the cost roll-up the SPA's
+    badges show — the server-rendered DOM a test asserts against."""
+    cards = []
+    for a in agents:
+        todos = "".join(
+            f"<li class=\"todo{' todo-done' if t.get('done') else ''}\">"
+            f"{_e(t.get('task'))}</li>"
+            for t in (a.get("todos") or []))
+        budget = a.get("budget") or {}
+        cards.append(
+            f"<div class=\"card agent-card\" "
+            f"data-agent=\"{_e(a['agent_id'])}\">"
+            f"<span class=\"aid\">{_e(a['agent_id'])}</span> "
+            f"<span class=\"meta\">profile={_e(a.get('profile'))} "
+            f"node={_e(a.get('grove_node'))} "
+            f"pending={_e(a.get('pending_actions'))}</span> "
+            f"<span class=\"agent-cost\">cost={_e(a.get('cost'))}</span>"
+            + (f" <span class=\"meta\">budget avail="
+               f"{_e(budget.get('available'))}</span>" if budget else "")
+            + (f"<ul class=\"todos\">{todos}</ul>" if todos else "")
+            + "</div>")
+    msgs = "".join(
+        f"<div class=\"card msg\"><span class=\"from\">"
+        f"{_e(r.get('sender'))}</span> "
+        f"<span class=\"meta\">{_ts(r.get('ts'))} "
+        f"{_e(r.get('message_type'))} → {_e(r.get('targets'))}</span>"
+        f"<div>{_e(r.get('content'))}</div></div>"
+        for r in messages)
+    body = (_task_strip(tasks, task_id, "/mailbox")
+            + f"<h2 class=\"meta\">agents</h2><div id=\"agents\">"
+              f"{''.join(cards)}</div>"
+            + f"<h2 class=\"meta\">messages</h2><div id=\"messages\">"
+              f"{msgs}</div>")
+    return _page("mailbox", body)
+
+
+def telemetry_page(metrics: dict) -> str:
+    """Dev telemetry view (reference LiveDashboard at /dev/dashboard):
+    the /api/metrics snapshot as readable tables."""
+    def table(title: str, d: dict) -> str:
+        rows = "".join(
+            f"<tr><td class=\"meta\">{_e(k)}</td><td>{_e(v)}</td></tr>"
+            for k, v in sorted(d.items()))
+        return (f"<h2 class=\"meta\">{_e(title)}</h2>"
+                f"<table class=\"metrics\" data-section=\"{_e(title)}\">"
+                f"{rows}</table>")
+    sections = []
+    flat = {}
+    for key, val in metrics.items():
+        if isinstance(val, dict):
+            sections.append(table(key, val))
+        else:
+            flat[key] = val
+    body = (table("runtime", flat) if flat else "") + "".join(sections)
+    return _page("telemetry", body, refresh=10)

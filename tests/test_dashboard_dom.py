@@ -1,0 +1,288 @@
+"""DOM-level dashboard tests (VERDICT r4 item 5).
+
+No browser or JS engine exists in this image, so the DOM under test is the
+server-rendered standalone views (/logs, /mailbox, /telemetry —
+web/views.py): a grove task is started through the SAME API call the SPA's
+new-task modal posts, and the resulting pages are parsed into an element
+tree with html.parser — assertions run against real DOM structure (nodes,
+classes, data attributes), not substring greps.
+
+The SPA's client-side JS can't execute here; its regression net is the
+contract test at the bottom: every element id the JS looks up must exist
+in the page markup, and every API path it fetches must be a route the
+server serves — the two ways the 441-line page actually breaks.
+"""
+
+import asyncio
+import json
+import re
+import time
+import urllib.request
+from html.parser import HTMLParser
+
+from quoracle_tpu.models.runtime import MockBackend
+from quoracle_tpu.runtime import Runtime, RuntimeConfig
+from quoracle_tpu.web import DashboardServer
+from quoracle_tpu.web.page import DASHBOARD_HTML
+
+POOL = MockBackend.DEFAULT_POOL
+
+
+def j(action, params=None, wait=False):
+    return json.dumps({"action": action, "params": params or {},
+                       "reasoning": "t", "wait": wait})
+
+
+# ---------------------------------------------------------------------------
+# Minimal DOM: parse HTML into a navigable element tree (stdlib only)
+# ---------------------------------------------------------------------------
+
+class Node:
+    def __init__(self, tag, attrs):
+        self.tag = tag
+        self.attrs = dict(attrs)
+        self.children: list = []
+        self.text = ""
+
+    @property
+    def classes(self):
+        return (self.attrs.get("class") or "").split()
+
+    def all_text(self) -> str:
+        return self.text + "".join(c.all_text() for c in self.children)
+
+    def find_all(self, tag=None, cls=None, **data):
+        out = []
+        stack = list(self.children)
+        while stack:
+            n = stack.pop(0)
+            ok = ((tag is None or n.tag == tag)
+                  and (cls is None or cls in n.classes)
+                  and all(n.attrs.get(k.replace("_", "-")) == v
+                          for k, v in data.items()))
+            if ok:
+                out.append(n)
+            stack = n.children + stack
+        return out
+
+    def find(self, tag=None, cls=None, **data):
+        found = self.find_all(tag, cls, **data)
+        return found[0] if found else None
+
+
+VOID = {"meta", "br", "hr", "img", "input", "link"}
+
+
+class DomParser(HTMLParser):
+    def __init__(self):
+        super().__init__()
+        self.root = Node("#root", [])
+        self.stack = [self.root]
+
+    def handle_starttag(self, tag, attrs):
+        node = Node(tag, attrs)
+        self.stack[-1].children.append(node)
+        if tag not in VOID:
+            self.stack.append(node)
+
+    def handle_endtag(self, tag):
+        for i in range(len(self.stack) - 1, 0, -1):
+            if self.stack[i].tag == tag:
+                del self.stack[i:]
+                break
+
+    def handle_data(self, data):
+        self.stack[-1].text += data
+
+
+def dom(html_text: str) -> Node:
+    p = DomParser()
+    p.feed(html_text)
+    return p.root
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+async def fetch(url: str) -> str:
+    def call():
+        with urllib.request.urlopen(url, timeout=10) as r:
+            return r.read().decode()
+    return await asyncio.get_running_loop().run_in_executor(None, call)
+
+
+async def post(url: str, body: dict):
+    def call():
+        req = urllib.request.Request(
+            url, method="POST", data=json.dumps(body).encode(),
+            headers={"content-type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return r.status, json.loads(r.read())
+    return await asyncio.get_running_loop().run_in_executor(None, call)
+
+
+async def until(cond, timeout=15.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError("condition not met")
+
+
+# ---------------------------------------------------------------------------
+# the VERDICT criterion: grove task from the browser → live todos + cost
+# roll-up, asserted on DOM
+# ---------------------------------------------------------------------------
+
+def test_grove_task_shows_todos_and_costs_in_dom(tmp_path):
+    import sys
+    sys.path.insert(0, "tests")
+    from test_governance_grove import write_grove
+
+    async def main():
+        grove_dir, _ws = write_grove(tmp_path, confinement_mode="warn")
+
+        def respond(r):
+            joined = "\n".join(str(m.get("content", ""))
+                               for m in r.messages)
+            if "ui-grove-run" in joined and "dom-todo-alpha" not in joined:
+                return j("todo", {"items": [
+                    {"task": "dom-todo-alpha", "done": False},
+                    {"task": "dom-todo-beta", "done": True}]})
+            if "dom-todo-alpha" in joined and "ui-manual-cost" not in joined:
+                # drive the cost pipeline the way an agent does (MockBackend
+                # queries are free): record_cost → CostRecorder → roll-up
+                return j("record_cost", {"amount": 0.25,
+                                         "description": "ui-manual-cost"})
+            return j("wait", {})
+
+        rt = Runtime(RuntimeConfig(groves_dir=str(tmp_path)),
+                     backend=MockBackend(respond=respond))
+        server = await DashboardServer(rt, port=0).start()
+        base = server.url
+        try:
+            status, made = await post(base + "/api/tasks", {
+                "description": "ui-grove-run", "grove": str(grove_dir),
+                "model_pool": list(POOL)})
+            assert status == 201
+            task_id = made["task_id"]
+            await until(lambda: rt.registry.all() and any(
+                a.core.ctx.todos for a in rt.registry.all()))
+            # costs recorded for the consensus rounds
+            await until(lambda: any(
+                float(rt.costs.total_for(a.agent_id)) > 0
+                for a in rt.registry.all()))
+
+            # ---- /mailbox DOM: agent card with live todos + cost ----
+            page = dom(await fetch(base + f"/mailbox?task_id={task_id}"))
+            cards = page.find_all(cls="agent-card")
+            assert cards, "no agent cards rendered"
+            root_card = cards[0]
+            todo_items = root_card.find_all("li", cls="todo")
+            texts = [t.all_text().strip() for t in todo_items]
+            assert "dom-todo-alpha" in texts
+            assert "dom-todo-beta" in texts
+            done = [t for t in todo_items if "todo-done" in t.classes]
+            assert [t.all_text().strip() for t in done] == ["dom-todo-beta"]
+            cost_span = root_card.find(cls="agent-cost")
+            assert cost_span is not None
+            cost_val = float(cost_span.all_text().split("=", 1)[1])
+            assert cost_val > 0, "agent card cost roll-up not positive"
+
+            # ---- task strip: cost roll-up + live agent count ----
+            task_rows = page.find_all(cls="task-row")
+            row = next(r for r in task_rows
+                       if r.attrs.get("data-task") == task_id)
+            cost_cell = row.find(cls="task-cost")
+            assert float(cost_cell.all_text()) > 0
+
+            # ---- /logs DOM: decision logs joined to the task ----
+            logs = dom(await fetch(base + f"/logs?task_id={task_id}"))
+            log_rows = logs.find_all(cls="log-row")
+            assert log_rows, "no log rows rendered"
+            assert any(task_id in r.all_text() for r in log_rows)
+            # level filter narrows the DOM
+            only_dec = dom(await fetch(
+                base + f"/logs?task_id={task_id}&level=decision"))
+            dec_rows = only_dec.find_all(cls="log-row")
+            assert dec_rows and all("lvl-decision" in r.classes
+                                    for r in dec_rows)
+
+            # ---- /telemetry DOM: metric tables render ----
+            tele = dom(await fetch(base + "/telemetry"))
+            assert tele.find_all(cls="metrics"), "no metric tables"
+        finally:
+            await server.stop()
+            await rt.shutdown()
+    asyncio.run(main())
+
+
+def test_mailbox_dom_shows_task_messages(tmp_path):
+    """A user message posted from the browser prompts the agent to reply
+    with send_message (announcement) — the task_message event lands in the
+    durable mailbox and the /mailbox DOM shows it with its sender."""
+    async def main():
+        def respond(r):
+            joined = "\n".join(str(m.get("content", ""))
+                               for m in r.messages)
+            if ("hello-from-the-mailbox" in joined
+                    and "mailbox-reply-mark" not in joined):
+                return j("send_message", {"target": "announcement",
+                                          "content": "mailbox-reply-mark"})
+            return j("wait", {})
+        rt = Runtime(RuntimeConfig(), backend=MockBackend(respond=respond))
+        server = await DashboardServer(rt, port=0).start()
+        base = server.url
+        try:
+            status, made = await post(base + "/api/tasks", {
+                "description": "mailbox dom", "model_pool": list(POOL)})
+            assert status == 201
+            root = made["root_agent"]
+            await until(lambda: rt.registry.all())
+            status, _ = await post(base + "/api/messages", {
+                "agent_id": root, "content": "hello-from-the-mailbox"})
+            await until(lambda: rt.db.query(
+                "SELECT 1 FROM messages WHERE content LIKE "
+                "'%mailbox-reply-mark%'"))
+            page = dom(await fetch(base + "/mailbox"))
+            msgs = page.find_all(cls="msg")
+            target = [m for m in msgs
+                      if "mailbox-reply-mark" in m.all_text()]
+            assert target, "agent reply not rendered in mailbox DOM"
+            sender = target[0].find(cls="from")
+            assert sender is not None and sender.all_text().strip() == root
+        finally:
+            await server.stop()
+            await rt.shutdown()
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# SPA contract: JS element ids + API routes must exist
+# ---------------------------------------------------------------------------
+
+def test_spa_js_dom_and_api_contract():
+    """The page's JS breaks in two ways this harness can catch without a
+    JS engine: a getElementById for an id the markup no longer has, or a
+    fetch of an API path the server no longer routes. Both are extracted
+    from the real page source and checked against the real artifacts."""
+    markup, _, script = DASHBOARD_HTML.partition("<script>")
+    looked_up = set(re.findall(r'\$\("([a-zA-Z0-9_-]+)"\)', script))
+    assert looked_up, "no $(id) lookups found — extraction broken?"
+    declared = set(re.findall(r'id="([a-zA-Z0-9_-]+)"', markup))
+    # ids the JS creates dynamically before looking them up
+    dynamic = set(re.findall(r'id="([a-zA-Z0-9_-]+)"', script)) | \
+        set(re.findall(r"\.id\s*=\s*\"([a-zA-Z0-9_-]+)\"", script))
+    missing = looked_up - declared - dynamic
+    assert not missing, f"JS looks up ids missing from markup: {missing}"
+
+    import inspect
+
+    from quoracle_tpu.web import server as server_mod
+    handler_src = inspect.getsource(server_mod)
+    for path in set(re.findall(r'api\("(/[a-z/]+)"', script)):
+        assert f'"{path}"' in handler_src or \
+            f'"{path}/' in handler_src or path in handler_src, \
+            f"SPA fetches {path} but the server never routes it"

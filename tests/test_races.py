@@ -1,0 +1,262 @@
+"""Race tests (VERDICT r4 item 6): the concurrency seams the reference's
+AGENTS.md race catalog warns about, driven with real actors/threads.
+
+  * pause vs in-flight action — a task pause arriving while a shell
+    command runs must stop the tree cleanly AND reap the OS process
+    (reference task_restorer.ex:31-80 + router.ex:182-217 kill-port-first)
+  * dismiss vs in-flight shell — terminate_agent mid-command kills the
+    whole process group (router.ex terminate semantics)
+  * concurrent escrow conservation — spawn/adjust/spend/dismiss hammered
+    from threads must conserve the parent ledger exactly (reference
+    escrow.ex atomicity through the parent GenServer; here the Escrow
+    lock IS the serialization point)
+  * bus subscriber death — a raising handler must never break delivery to
+    other subscribers or the broadcaster (reference safe_broadcast,
+    agent_events.ex:21-29)
+"""
+
+import asyncio
+import json
+import subprocess
+import threading
+import time
+from decimal import Decimal
+
+from quoracle_tpu.infra.budget import BudgetError, Escrow
+from quoracle_tpu.infra.bus import AgentEvents, EventBus
+from quoracle_tpu.models.runtime import MockBackend
+from quoracle_tpu.runtime import Runtime, RuntimeConfig
+
+POOL = MockBackend.DEFAULT_POOL
+
+
+def j(action, params=None, wait=False):
+    return json.dumps({"action": action, "params": params or {},
+                       "reasoning": "t", "wait": wait})
+
+
+async def until(cond, timeout=15.0):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError("condition not met")
+
+
+def pgrep(marker: str) -> list[str]:
+    out = subprocess.run(["pgrep", "-f", marker], capture_output=True,
+                         text=True)
+    return [l for l in out.stdout.split() if l.strip()]
+
+
+# ---------------------------------------------------------------------------
+# pause vs in-flight action
+# ---------------------------------------------------------------------------
+
+def test_pause_races_in_flight_shell_action():
+    marker = "sleep 37.31"
+
+    async def main():
+        fired: set = set()      # "command_id" appears in the SYSTEM PROMPT
+                                # (schema docs) — fire once per model instead
+
+        def respond(r):
+            joined = "\n".join(str(m.get("content", ""))
+                               for m in r.messages)
+            if "race-pause-task" in joined and r.model_spec not in fired:
+                fired.add(r.model_spec)
+                return j("execute_shell", {"command": marker})
+            return j("wait", {})
+
+        rt = Runtime(RuntimeConfig(), backend=MockBackend(respond=respond))
+        tid, root = await rt.tasks.create_task(
+            "race-pause-task", model_pool=list(POOL))
+        # the command is live and the action's router is registered
+        await until(lambda: root.shell_routers)
+        assert pgrep(marker), "shell process not started"
+        # pause races the running command
+        stopped = await rt.tasks.pause_task(tid)
+        assert stopped >= 1
+        assert rt.store.get_task(tid)["status"] == "paused"
+        assert not rt.registry.agents_for_task(tid)
+        # the OS process group was reaped, not orphaned
+        await until(lambda: not pgrep(marker), timeout=10)
+        # restore rebuilds the tree; the revived agent is idle and intact
+        revived = await rt.tasks.restore_task(tid)
+        assert revived == 1
+        assert rt.store.get_task(tid)["status"] == "running"
+        assert rt.registry.agents_for_task(tid)
+        await rt.shutdown()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# dismiss / terminate vs in-flight shell
+# ---------------------------------------------------------------------------
+
+def test_terminate_agent_mid_shell_kills_process_group():
+    marker = "sleep 41.17"
+
+    async def main():
+        fired: set = set()
+
+        def respond(r):
+            joined = "\n".join(str(m.get("content", ""))
+                               for m in r.messages)
+            if "race-term-task" in joined and r.model_spec not in fired:
+                fired.add(r.model_spec)
+                # sh spawns sleep as a CHILD — a lone kill of the shell
+                # would orphan it; only a group kill passes this test
+                return j("execute_shell", {"command": f"{marker} & wait"})
+            return j("wait", {})
+
+        rt = Runtime(RuntimeConfig(), backend=MockBackend(respond=respond))
+        tid, root = await rt.tasks.create_task(
+            "race-term-task", model_pool=list(POOL))
+        await until(lambda: root.shell_routers)
+        assert pgrep(marker)
+        await rt.supervisor.terminate_agent(root.agent_id)
+        assert not rt.registry.agents_for_task(tid)
+        await until(lambda: not pgrep(marker), timeout=10)
+        await rt.shutdown()
+
+    asyncio.run(main())
+
+
+# ---------------------------------------------------------------------------
+# concurrent escrow conservation
+# ---------------------------------------------------------------------------
+
+def test_escrow_concurrent_spawn_adjust_dismiss_conservation():
+    """8 threads × 25 cycles of lock → adjust ↑ → spend → adjust ↓(bounded)
+    → release on ONE parent ledger. Afterward: zero committed, spent equals
+    the exact sum of child spends, available is the exact remainder — and
+    no interleaving may ever overdraw the limit (BudgetError is the only
+    acceptable refusal)."""
+    esc = Escrow()
+    LIMIT = Decimal("100")
+    esc.register("parent", mode="root", limit=LIMIT)
+    N_THREADS, N_CYCLES = 8, 25
+    SPEND = Decimal("0.03")
+    errors: list = []
+    spent_total = [Decimal(0)]
+    spent_lock = threading.Lock()
+
+    def worker(t: int) -> None:
+        try:
+            for i in range(N_CYCLES):
+                cid = f"c{t}-{i}"
+                try:
+                    esc.lock_for_child("parent", cid, Decimal("1.0"))
+                except BudgetError:
+                    continue        # transient exhaustion is legal
+                try:
+                    esc.adjust_child("parent", cid, Decimal("1.5"))
+                except BudgetError:
+                    pass            # raise refused under contention: fine
+                esc.record_spend(cid, SPEND)
+                try:
+                    esc.adjust_child("parent", cid, Decimal("0.5"))
+                except BudgetError:
+                    errors.append(f"shrink above floor refused for {cid}")
+                esc.release_child(cid)
+                with spent_lock:
+                    spent_total[0] += SPEND
+        except Exception as e:      # noqa: BLE001 — collected, not raised
+            errors.append(f"{t}: {e!r}")
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(N_THREADS)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+    assert not errors, errors
+    parent = esc.get("parent")
+    assert parent.committed == Decimal(0), parent.snapshot()
+    assert parent.spent == spent_total[0], parent.snapshot()
+    assert parent.available == LIMIT - spent_total[0]
+    # ledger holds no orphaned children
+    assert esc.child_allocation("c0-0") is None
+
+
+def test_escrow_overdraw_impossible_under_contention():
+    """With limit N and children of 1.0, at most floor(N) concurrent locks
+    may EVER succeed; total committed never exceeds the limit at any
+    observation point."""
+    esc = Escrow()
+    esc.register("parent", mode="root", limit=Decimal("5"))
+    granted: list = []
+    over: list = []
+    barrier = threading.Barrier(10)
+
+    def worker(t: int) -> None:
+        barrier.wait()
+        try:
+            esc.lock_for_child("parent", f"k{t}", Decimal("1.0"))
+            granted.append(t)
+            snap = esc.get("parent")
+            if snap.committed > Decimal("5"):
+                over.append(str(snap.snapshot()))
+        except BudgetError:
+            pass
+
+    threads = [threading.Thread(target=worker, args=(t,))
+               for t in range(10)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=30)
+    assert len(granted) == 5, f"granted {len(granted)} of limit 5"
+    assert not over, over
+    assert esc.get("parent").available == Decimal(0)
+
+
+# ---------------------------------------------------------------------------
+# bus subscriber death
+# ---------------------------------------------------------------------------
+
+def test_bus_subscriber_death_does_not_break_delivery():
+    bus = EventBus()
+    got: list = []
+
+    def dying(topic, event):
+        raise RuntimeError("subscriber crashed")
+
+    bus.subscribe("agents:lifecycle", dying)
+    bus.subscribe("agents:lifecycle", lambda t, e: got.append(e))
+    bus.subscribe("*", dying)                       # wildcard dies too
+    events = AgentEvents(bus)
+    for i in range(5):
+        events.agent_spawned(f"a{i}", None, "t1")   # must not raise
+    assert len(got) == 5
+    assert [e["agent_id"] for e in got] == [f"a{i}" for i in range(5)]
+
+
+def test_bus_subscriber_death_does_not_kill_agents():
+    """A dying UI handler on the lifecycle topic must not disturb a live
+    agent tree (reference safe_broadcast rescue)."""
+    async def main():
+        def respond(r):
+            joined = "\n".join(str(m.get("content", ""))
+                               for m in r.messages)
+            if "bus-death-task" in joined and "done-mark" not in joined:
+                return j("todo", {"items": [{"task": "done-mark"}]})
+            return j("wait", {})
+
+        rt = Runtime(RuntimeConfig(), backend=MockBackend(respond=respond))
+
+        def dying(topic, event):
+            raise RuntimeError("UI died")
+
+        rt.bus.subscribe("*", dying)
+        tid, root = await rt.tasks.create_task(
+            "bus-death-task", model_pool=list(POOL))
+        await until(lambda: root.ctx.todos)
+        assert root.ctx.todos[0]["task"] == "done-mark"
+        assert rt.registry.agents_for_task(tid)
+        await rt.shutdown()
+
+    asyncio.run(main())

@@ -51,7 +51,8 @@ def first_result(core):
 
 
 async def run_one_action(backend, **deps_over):
-    deps = AgentDeps.for_tests(backend, ssrf_check=False, **deps_over)
+    deps_over.setdefault("ssrf_check", False)
+    deps = AgentDeps.for_tests(backend, **deps_over)
     sup = AgentSupervisor(deps)
     core = await sup.start_agent(AgentConfig(
         agent_id="agent-w", task_id="t1", model_pool=list(POOL)))
@@ -315,6 +316,51 @@ def test_answer_engine_multi_source_grounding():
         assert "Rayleigh scattering explains" in grounding
         assert "[2] Beta post (https://b.example/post)" in grounding
         assert "cite" in grounding or "[n]" in grounding
+    run(main())
+
+
+def test_answer_engine_ssrf_guards_content_derived_links():
+    """Result links come from page CONTENT (untrusted): with the SSRF
+    guard on, a link-local metadata URL in the search results must not be
+    fetched, while public sources still ground the answer."""
+    async def main():
+        search_html = (
+            '<a href="http://169.254.169.254/latest/meta-data/">evil</a>'
+            '<a href="http://8.8.8.8/page">Fine page</a>')
+        http = FakeHttp({
+            "https://search.example/?q=q": (200, "text/html", search_html),
+            "http://169.254.169.254": (200, "text/plain", "SECRET-CREDS"),
+            "http://8.8.8.8/page": (200, "text/html", "<p>useful</p>"),
+        })
+
+        def respond(r):
+            joined = "\n".join(str(m.get("content", ""))
+                               for m in r.messages)
+            if "Answer the question" in joined:
+                assert "SECRET-CREDS" not in joined
+                return "grounded answer"
+            if '"answer"' in joined:
+                return j("wait", {})
+            return j("answer_engine", {"query": "q"})
+
+        from quoracle_tpu.persistence.db import Database
+        from quoracle_tpu.persistence.store import Persistence
+        store = Persistence(Database(":memory:"))
+        store.set_setting("answer_engine_search_url",
+                          "https://search.example/?q={query}")
+        backend = MockBackend(respond=respond)
+        core, text = await run_one_action(backend, http=http,
+                                          persistence=store,
+                                          ssrf_check=True)
+        fenced = first_result(core).content
+        result = json.loads(
+            fenced.split("\n", 2)[2].rsplit("</NO_EXECUTE>", 1)[0])["result"]
+        srcs = {s["url"]: s for s in result["sources"]}
+        assert srcs["http://169.254.169.254/latest/meta-data/"][
+            "fetched"] is False
+        assert srcs["http://8.8.8.8/page"]["fetched"] is True
+        # the blocked fetch never went out on the wire
+        assert not any("169.254" in r["url"] for r in http.requests)
     run(main())
 
 
