@@ -20,13 +20,33 @@ _flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
                 os.environ.get("XLA_FLAGS", ""))
 os.environ["XLA_FLAGS"] = (
     _flags.strip() + " --xla_force_host_platform_device_count=8").strip()
-# hermetic tests: never write the persistent compilation cache
-# (utils/compile_cache.py honors this before touching jax.config)
-os.environ.setdefault("QUORACLE_XLA_CACHE", "off")
+# Suite-wide persistent compilation cache in a TEMP dir (VERDICT r4
+# item 6): dozens of test files build their own GenerateEngine over the
+# same tiny configs, and each construction recompiles identical
+# (prefill, decode) HLO — the persistent cache dedupes those across
+# files, processes, AND xdist workers (JAX's cache writes are atomic
+# renames, safe under -n). Hermetic for the USER (never touches
+# ~/.cache); QUORACLE_XLA_CACHE=off still disables outright.
+import tempfile
+
+if os.environ.get("QUORACLE_XLA_CACHE", "").lower() not in ("off", "none",
+                                                            "0"):
+    # FORCE the temp path (don't setdefault): a developer's exported
+    # QUORACLE_XLA_CACHE pointing at the real ~/.cache must not be
+    # polluted with hundreds of tiny-test-model entries. Only an explicit
+    # "off" passes through. Per-uid suffix: the shared temp dir is
+    # world-writable — a fixed name would collide across users and let
+    # one user plant cache entries another's tests would load.
+    os.environ["QUORACLE_XLA_CACHE"] = os.path.join(
+        tempfile.gettempdir(), f"quoracle-test-xla-cache-{os.getuid()}")
 
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+from quoracle_tpu.utils.compile_cache import enable_compilation_cache  # noqa: E402
+
+enable_compilation_cache()
 
 import pytest  # noqa: E402
 
