@@ -661,6 +661,55 @@ def _run(args, payload: dict, deadline_at: float) -> None:
     if cfg6:
         log(f"config6: {cfg6}")
 
+    def speculative_config():
+        # config 7: speculative decoding CEILING on the first member.
+        # Self-draft (draft == target) makes acceptance ~total, isolating
+        # the mechanism's hardware question: how much faster is one
+        # K-token verify chunk than K single-token decode steps on this
+        # deployment. Batch-1 decode streams full weights per token
+        # (decode roofline above); the verify chunk reads them once per K
+        # tokens — but costs ~2 host dispatches per round where the
+        # vanilla decode scan is ONE dispatch per 128 tokens, so on a
+        # relay-dispatch deployment the measurement decides which effect
+        # dominates (models/speculative.py; realized speedup with a real
+        # trained draft = this ceiling x its acceptance rate).
+        from quoracle_tpu.models.speculative import SpeculativeDecoder
+        eng = backend.engines[pool[0]]
+        tok = eng.tokenizer
+        dec = SpeculativeDecoder(eng.cfg, eng.params, eng.cfg, eng.params,
+                                 tok, k=6, max_seq=eng.max_seq)
+        prompt = tok.encode(TASKS[0], add_bos=True)
+        eng.generate([prompt], temperature=0.0, max_new_tokens=MAX_NEW)
+        dec.generate(prompt, temperature=0.0,
+                     max_new_tokens=MAX_NEW)          # compile warmup
+        van_ms, spec_ms, acc, tpr = [], [], [], []
+        for _ in range(3):
+            t0 = time.monotonic()
+            r = eng.generate([prompt], temperature=0.0,
+                             max_new_tokens=MAX_NEW)[0]
+            van_ms.append((time.monotonic() - t0) * 1000
+                          / max(1, r.n_gen_tokens))
+            t0 = time.monotonic()
+            s = dec.generate(prompt, temperature=0.0,
+                             max_new_tokens=MAX_NEW)
+            spec_ms.append((time.monotonic() - t0) * 1000
+                           / max(1, s.n_gen_tokens))
+            acc.append(s.acceptance_rate)
+            tpr.append(s.tokens_per_round)
+        return {
+            "vanilla_ms_per_token": statistics.median(van_ms),
+            "speculative_ms_per_token": statistics.median(spec_ms),
+            "ceiling_speedup": statistics.median(van_ms)
+            / max(1e-9, statistics.median(spec_ms)),
+            "acceptance_rate": statistics.median(acc),
+            "tokens_per_round": statistics.median(tpr),
+            "k": 6,
+        }
+
+    cfg7 = guard("config7", speculative_config)
+    if cfg7:
+        log(f"config7: {cfg7}")
+
     def vision_config():
         # config 5: vision pool — free the trio's HBM first (weights + KV
         # page pools), then serve llama + the VLM checkpoint with an
@@ -755,6 +804,18 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             "config5_p50_ms": round(cfg5["p50_round_ms"], 1),
             "config5_steady_tps": round(cfg5["steady_tokens_per_sec"], 1),
         })
+    if cfg7:
+        payload.update({
+            "config7_speculative_ceiling": round(
+                cfg7["ceiling_speedup"], 2),
+            "config7_vanilla_ms_per_token": round(
+                cfg7["vanilla_ms_per_token"], 2),
+            "config7_spec_ms_per_token": round(
+                cfg7["speculative_ms_per_token"], 2),
+            "config7_acceptance": round(cfg7["acceptance_rate"], 3),
+            "config7_tokens_per_round": round(
+                cfg7["tokens_per_round"], 2),
+        })
     if cfg6:
         payload.update({
             "config6_p50_ms": round(cfg6["p50_round_ms"], 1),
@@ -769,7 +830,8 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             payload["config6_p50_vs_config1"] = round(
                 cfg6["p50_round_ms"] / max(1e-9, cfg1["p50_round_ms"]), 2)
     log(json.dumps({"config1": cfg1, "config2": cfg2, "config3": cfg3,
-                    "config4": cfg4, "config5": cfg5, "config6": cfg6},
+                    "config4": cfg4, "config5": cfg5, "config6": cfg6,
+                    "config7": cfg7},
                    indent=1, default=str))
     payload.update({
         "cycles": N_CYCLES,

@@ -1,0 +1,319 @@
+"""Speculative decoding: a small draft model proposes K tokens, the
+target model verifies them in ONE chunk forward.
+
+No reference counterpart (the reference never executes attention,
+SURVEY §2.8) — this is a TPU-first throughput feature aimed squarely at
+the measured bottleneck: BASELINE.md's decode roofline shows batch-1
+decode streams the member's full bf16 weights from HBM per token (~47%
+of v5e peak bandwidth, compute nearly idle). Verifying K draft tokens in
+one target pass reads the weights ONCE for K positions — the accepted-
+token rate converts memory-bound decode steps into one compute-denser
+chunk, exactly the regime the MXU wants.
+
+Algorithm (leapfrog variant, no bonus token — keeps draft and target
+caches in lockstep):
+
+  invariant   ctx = prompt + emitted; BOTH caches hold KV for ctx[:-1];
+              ``pending`` = ctx[-1], not yet forwarded by either model.
+  propose     draft runs a K-step scan from ``pending``: d_1..d_K with
+              per-step draft probs q_i  (draft cache advances K steps,
+              through d_{K-1}).
+  verify      target runs ONE chunk [pending, d_1..d_{K-1}] → logits
+              p_1..p_K (p_i is the target distribution that d_i was
+              proposed against; target cache advances the same K steps).
+  accept      greedy rows: d_i accepted while d_i == argmax(p_i).
+              sampled rows: d_i accepted with prob min(1, p_i[d_i] /
+              q_i[d_i]); on rejection the correction token is drawn from
+              the residual max(0, p_i - q_i) renormalized — the
+              standard rejection-sampling construction, which preserves
+              the target model's output distribution exactly
+              (PAPERS.md speculative-decoding literature; re-derived
+              here, no code reused).
+  commit      j accepted → emit d_1..d_j (+ the correction token when
+              j < K); roll BOTH caches back to len(ctx')-1 by shrinking
+              ``lens`` (KV past lens is masked by attention, later
+              writes overwrite it in place); pending' = d_K on full
+              accept else the correction token.
+
+Greedy (temperature 0) output is bit-identical to vanilla decode: every
+accepted d_i equals argmax(p_i) and every correction IS argmax(p_i).
+tests/test_speculative.py asserts equality against GenerateEngine.
+
+v1 scope: batch 1, dense cache (no sessions/pages), text-only, no
+grammar constraint, full attention (no sliding window). The draft and
+target MUST share one tokenizer/vocab — verified at construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from quoracle_tpu.models.config import ModelConfig
+from quoracle_tpu.models.generate import prefill
+from quoracle_tpu.models.sampling import sample_tokens
+from quoracle_tpu.models.transformer import (
+    KVCache, forward_hidden, init_cache, project_logits,
+)
+
+
+def _round_up(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult
+
+
+@dataclasses.dataclass
+class SpecResult:
+    token_ids: list
+    text: str
+    n_prompt_tokens: int
+    n_gen_tokens: int
+    latency_s: float
+    finish_reason: str
+    rounds: int                  # speculative rounds executed
+    drafted: int                 # draft tokens proposed in total
+    accepted: int                # draft tokens accepted in total
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted / max(1, self.drafted)
+
+    @property
+    def tokens_per_round(self) -> float:
+        return self.n_gen_tokens / max(1, self.rounds)
+
+
+class SpeculativeDecoder:
+    """Draft/verify decoder over two models sharing one tokenizer.
+
+    ``target_cfg``/``draft_cfg`` + params are the same structures
+    GenerateEngine serves; K is the draft length per round. Construct
+    once per (target, draft) pair — the three jits (two prefills, the
+    draft scan, the verify chunk) compile per cache-length bucket and
+    are reused across calls.
+    """
+
+    def __init__(self, target_cfg: ModelConfig, target_params: dict,
+                 draft_cfg: ModelConfig, draft_params: dict,
+                 tokenizer, *, k: int = 6, max_seq: int = 2048,
+                 seed: int = 0, cache_dtype=jnp.bfloat16):
+        assert target_cfg.vocab_size == draft_cfg.vocab_size, \
+            "draft and target must share one tokenizer/vocab"
+        assert target_cfg.sliding_window is None \
+            and draft_cfg.sliding_window is None, \
+            "speculative v1 requires full attention (no sliding window)"
+        self.tc, self.tp = target_cfg, target_params
+        self.dc, self.dp = draft_cfg, draft_params
+        self.tokenizer = tokenizer
+        self.k = int(k)
+        self.max_seq = max_seq
+        self.cache_dtype = cache_dtype
+        self._rng = jax.random.PRNGKey(seed)
+        self._build()
+
+    # ------------------------------------------------------------------
+
+    def _build(self) -> None:
+        K = self.k
+
+        @functools.partial(jax.jit, static_argnames=("cache_len", "which"))
+        def _prefill(params, tokens, lens, cache_len: int, which: str):
+            cfg = self.tc if which == "t" else self.dc
+            cache = init_cache(cfg, 1, cache_len, dtype=self.cache_dtype)
+            return prefill(params, cfg, tokens, lens, cache)
+
+        @jax.jit
+        def _draft_scan(params, cache: KVCache, pending, rng, temperature,
+                        top_p):
+            """K autoregressive draft steps from ``pending``.
+
+            Returns (d_tokens [K], q_probs [K, V], cache'): step i
+            forwards the previous token (pending for i=0), samples d_i
+            from the draft distribution q_i. The cache advances K
+            positions — through d_{K-1} — matching the target's verify
+            chunk exactly (module docstring invariant)."""
+            cfg = self.dc
+
+            def step(carry, _):
+                cache, tok, rng = carry
+                pos = cache.lens[:, None]
+                hidden, cache = forward_hidden(
+                    params, cfg, tok[:, None], pos, cache,
+                    write_offset=cache.lens, kv_lens=cache.lens + 1)
+                cache = cache._replace(lens=cache.lens + 1)
+                logits = project_logits(params, cfg, hidden)[:, 0, :]
+                logits = logits.astype(jnp.float32)
+                rng, ks = jax.random.split(rng)
+                nxt = sample_tokens(logits, ks, temperature, top_p)
+                q = jax.nn.softmax(
+                    logits / jnp.maximum(temperature, 1e-6)[:, None],
+                    axis=-1)
+                # greedy rows draft greedily: q as one-hot keeps the
+                # acceptance rule exact (accept iff d_i == argmax p_i)
+                q = jnp.where(
+                    (temperature <= 0)[:, None],
+                    jax.nn.one_hot(nxt, logits.shape[-1]), q)
+                return (cache, nxt, rng), (nxt[0], q[0])
+
+            (cache, _, rng), (toks, qs) = jax.lax.scan(
+                step, (cache, pending, rng), None, length=K)
+            return toks, qs, cache
+
+        @jax.jit
+        def _verify_chunk(params, cache: KVCache, chunk, temperature):
+            """One target pass over [pending, d_1..d_{K-1}] → p_1..p_K
+            (full per-position distributions) with the cache advanced K
+            positions."""
+            cfg = self.tc
+            T = K
+            lens0 = cache.lens
+            positions = (lens0[:, None]
+                         + jnp.arange(T, dtype=jnp.int32)[None, :])
+            hidden, cache = forward_hidden(
+                params, cfg, chunk[None, :], positions, cache,
+                write_offset=lens0, kv_lens=lens0 + T)
+            cache = cache._replace(lens=lens0 + T)
+            logits = project_logits(params, cfg, hidden)[0].astype(
+                jnp.float32)                                     # [K, V]
+            probs = jax.nn.softmax(
+                logits / jnp.maximum(temperature, 1e-6)[:, None], axis=-1)
+            greedy_probs = jax.nn.one_hot(
+                jnp.argmax(logits, axis=-1), logits.shape[-1])
+            probs = jnp.where((temperature <= 0)[:, None],
+                              greedy_probs, probs)
+            return probs, cache
+
+        self._prefill = _prefill
+        self._draft_scan = _draft_scan
+        self._verify_chunk = _verify_chunk
+
+    def next_rng(self) -> jax.Array:
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    # ------------------------------------------------------------------
+
+    def generate(self, prompt, *, max_new_tokens: int = 128,
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 rng: Optional[jax.Array] = None) -> SpecResult:
+        t0 = time.monotonic()
+        K = self.k
+        prompt = list(prompt)
+        assert prompt, "empty prompt"
+        assert len(prompt) + max_new_tokens < self.max_seq, \
+            f"prompt {len(prompt)} + max_new {max_new_tokens} >= " \
+            f"max_seq {self.max_seq}"
+        assert temperature <= 0 or top_p >= 1.0, \
+            ("speculative v1 supports top_p only in greedy mode: the "
+             "acceptance test needs q to be the ACTUAL proposal "
+             "distribution, and the nucleus mask is not applied to q")
+        rng = rng if rng is not None else self.next_rng()
+        rng_np = np.random.default_rng(int(jax.random.bits(rng) & 0x7fffffff))
+        temp = jnp.asarray([float(temperature)], jnp.float32)
+        topp = jnp.asarray([float(top_p)], jnp.float32)
+
+        cache_len = _round_up(len(prompt) + max_new_tokens + K + 1, 128)
+        pad = _round_up(len(prompt), 64)
+        toks = np.zeros((1, pad), np.int32)
+        toks[0, :len(prompt)] = prompt
+        lens = jnp.asarray([len(prompt)], jnp.int32)
+        # Both caches prefill ctx[:-1] = prompt minus its last token, so
+        # the invariant (pending un-forwarded) holds from the start.
+        # Prefill with full prompt length then roll lens back one: the
+        # last column's KV is simply overwritten by the first chunk.
+        tlogits, tcache = self._prefill(self.tp, jnp.asarray(toks), lens,
+                                        cache_len, "t")
+        _, dcache = self._prefill(self.dp, jnp.asarray(toks), lens,
+                                  cache_len, "d")
+        tcache = tcache._replace(lens=lens - 1)
+        dcache = dcache._replace(lens=lens - 1)
+        pending = jnp.asarray([prompt[-1]], jnp.int32)
+
+        stops = {self.tc.eos_token_id, *self.tc.stop_token_ids}
+        emitted: list[int] = []
+        rounds = drafted = accepted_total = 0
+        finish = "length"
+        while len(emitted) < max_new_tokens:
+            rounds += 1
+            rng, kd = jax.random.split(rng)
+            d_toks, q_probs, dcache = self._draft_scan(
+                self.dp, dcache, pending, kd, temp, topp)
+            chunk = jnp.concatenate([pending, d_toks[:-1]])
+            p_probs, tcache = self._verify_chunk(self.tp, tcache, chunk,
+                                                 jnp.broadcast_to(temp, (K,)))
+            d = np.asarray(d_toks)
+            q = np.asarray(q_probs)
+            p = np.asarray(p_probs)
+            drafted += K
+
+            j = 0
+            correction: Optional[int] = None
+            while j < K:
+                di = int(d[j])
+                if temperature <= 0:
+                    ok = di == int(np.argmax(p[j]))
+                else:
+                    ok = rng_np.random() < min(
+                        1.0, float(p[j, di]) / max(float(q[j, di]), 1e-20))
+                if not ok:
+                    residual = np.maximum(p[j] - q[j], 0.0)
+                    tot = residual.sum()
+                    if temperature <= 0 or tot <= 0:
+                        correction = int(np.argmax(p[j]))
+                    else:
+                        correction = int(rng_np.choice(
+                            residual.shape[0], p=residual / tot))
+                    break
+                j += 1
+            accepted_total += j
+
+            new_tokens = [int(x) for x in d[:j]]
+            if correction is not None:
+                new_tokens.append(correction)
+            # commit: truncate at stop/max_new, roll caches to ctx'[:-1].
+            # The budget cut applies FIRST — a stop token that lands just
+            # past max_new is cut away and must report "length", exactly
+            # as vanilla decode's row_limit would (engine parity).
+            cut = len(new_tokens)
+            stop_at = None
+            for idx, t in enumerate(new_tokens):
+                if t in stops:
+                    stop_at = idx
+                    cut = idx + 1
+                    break
+            room = max_new_tokens - len(emitted)
+            cut = min(cut, room)
+            if stop_at is not None and stop_at < cut:
+                finish = "stop"
+            new_tokens = new_tokens[:cut]
+            emitted.extend(new_tokens)
+            if finish == "stop" or len(emitted) >= max_new_tokens:
+                break
+            # lens' = len(ctx') - 1; ctx' grew by len(new_tokens)
+            ctx_len = len(prompt) + len(emitted)
+            new_lens = jnp.asarray([ctx_len - 1], jnp.int32)
+            tcache = tcache._replace(lens=new_lens)
+            dcache = dcache._replace(lens=new_lens)
+            pending = jnp.asarray([emitted[-1]], jnp.int32)
+
+        # engine parity: the terminal stop token is popped from the output
+        # (generate.py result assembly does the same)
+        if emitted and emitted[-1] in stops:
+            emitted.pop()
+            finish = "stop"
+        return SpecResult(
+            token_ids=emitted,
+            text=self.tokenizer.decode(emitted),
+            n_prompt_tokens=len(prompt),
+            n_gen_tokens=len(emitted),
+            latency_s=time.monotonic() - t0,
+            finish_reason=finish,
+            rounds=rounds,
+            drafted=drafted,
+            accepted=accepted_total,
+        )

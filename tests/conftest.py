@@ -34,11 +34,21 @@ if os.environ.get("QUORACLE_XLA_CACHE", "").lower() not in ("off", "none",
     # FORCE the temp path (don't setdefault): a developer's exported
     # QUORACLE_XLA_CACHE pointing at the real ~/.cache must not be
     # polluted with hundreds of tiny-test-model entries. Only an explicit
-    # "off" passes through. Per-uid suffix: the shared temp dir is
-    # world-writable — a fixed name would collide across users and let
-    # one user plant cache entries another's tests would load.
-    os.environ["QUORACLE_XLA_CACHE"] = os.path.join(
-        tempfile.gettempdir(), f"quoracle-test-xla-cache-{os.getuid()}")
+    # "off" passes through. The dir must be OWNED by us, mode 0700: /tmp's
+    # sticky bit stops deletion, not creation — another user could
+    # pre-create a predictable path and plant compiled-executable cache
+    # entries this process would load. Refuse a foreign dir (cache off).
+    _cache = os.path.join(tempfile.gettempdir(),
+                          f"quoracle-test-xla-cache-{os.getuid()}")
+    try:
+        os.makedirs(_cache, mode=0o700, exist_ok=True)
+        _st = os.stat(_cache)
+        if _st.st_uid != os.getuid():
+            raise PermissionError(f"{_cache} owned by uid {_st.st_uid}")
+        os.chmod(_cache, 0o700)
+        os.environ["QUORACLE_XLA_CACHE"] = _cache
+    except OSError:
+        os.environ["QUORACLE_XLA_CACHE"] = "off"
 
 import jax  # noqa: E402
 
