@@ -101,7 +101,7 @@ class SpeculativeDecoder:
     def __init__(self, target_cfg: ModelConfig, target_params: dict,
                  draft_cfg: ModelConfig, draft_params: dict,
                  tokenizer, *, k: int = 6, max_seq: int = 2048,
-                 seed: int = 0, cache_dtype=jnp.bfloat16):
+                 seed: int = 0, cache_dtype=None):
         assert target_cfg.vocab_size == draft_cfg.vocab_size, \
             "draft and target must share one tokenizer/vocab"
         assert target_cfg.sliding_window is None \
@@ -112,7 +112,13 @@ class SpeculativeDecoder:
         self.tokenizer = tokenizer
         self.k = int(k)
         self.max_seq = max_seq
-        self.cache_dtype = cache_dtype
+        # match each model's params dtype like GenerateEngine does — a
+        # bf16 cache under fp32 params trips lax.scatter's dtype check in
+        # the KV write
+        self.t_cache_dtype = (cache_dtype if cache_dtype is not None
+                              else jax.tree.leaves(target_params)[0].dtype)
+        self.d_cache_dtype = (cache_dtype if cache_dtype is not None
+                              else jax.tree.leaves(draft_params)[0].dtype)
         self._rng = jax.random.PRNGKey(seed)
         self._build()
 
@@ -124,7 +130,8 @@ class SpeculativeDecoder:
         @functools.partial(jax.jit, static_argnames=("cache_len", "which"))
         def _prefill(params, tokens, lens, cache_len: int, which: str):
             cfg = self.tc if which == "t" else self.dc
-            cache = init_cache(cfg, 1, cache_len, dtype=self.cache_dtype)
+            dt = self.t_cache_dtype if which == "t" else self.d_cache_dtype
+            cache = init_cache(cfg, 1, cache_len, dtype=dt)
             return prefill(params, cfg, tokens, lens, cache)
 
         @jax.jit

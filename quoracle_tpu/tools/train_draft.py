@@ -1,0 +1,173 @@
+"""Train a REAL draft model and measure true speculative acceptance.
+
+Bench config 7 measures the self-draft CEILING (how much faster one
+K-token verify chunk is than K decode steps); this tool supplies the
+other factor of the realized speedup — the ACCEPTANCE RATE of an actual
+small draft — by training a tiny-scale model on the same format corpus
+the target was fine-tuned on (tools/finetune.py --target format) and
+running speculative decoding target×draft on held-out tasks.
+
+Tokenizer identity: the draft MUST share the target's token ids.
+make_checkpoint's BPE training is deterministic in (corpus, vocab_size),
+and "small" (the finetune target) and "tiny" (the draft) both use vocab
+2048 over the same default corpus — the tool asserts byte-identical
+tokenizer.json rather than trusting that.
+
+    env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+        python -m quoracle_tpu.tools.train_draft --steps 400 \
+        --out-artifact SPECULATIVE_r05.json
+
+Prereq: checkpoints/finetune-format/{base,tuned} from a prior
+`tools/finetune.py --target format` run (the tool errors with the
+command if missing).
+"""
+
+from __future__ import annotations
+
+import argparse
+import filecmp
+import json
+import os
+import shutil
+import statistics
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=400)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=256)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--corpus-size", type=int, default=2000)
+    ap.add_argument("--k", type=int, default=6)
+    ap.add_argument("--n-eval", type=int, default=12)
+    ap.add_argument("--max-new", type=int, default=96)
+    ap.add_argument("--workdir", default=None)
+    ap.add_argument("--out-artifact", default=None)
+    args = ap.parse_args()
+
+    def log(msg):
+        print(msg, file=sys.stderr, flush=True)
+
+    from quoracle_tpu.utils.compile_cache import enable_compilation_cache
+    enable_compilation_cache()
+
+    import numpy as np
+
+    from quoracle_tpu.models.loader import (
+        export_hf_checkpoint, load_params, register_hf_checkpoint,
+        to_device,
+    )
+    from quoracle_tpu.models.make_checkpoint import make_checkpoint
+    from quoracle_tpu.models.speculative import SpeculativeDecoder
+    from quoracle_tpu.models.tokenizer import HFAutoTokenizer
+    from quoracle_tpu.tools.finetune import (
+        SYSTEM, _format_sample, build_format_corpus, train,
+    )
+
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    work = args.workdir or os.path.join(repo, "checkpoints",
+                                        "finetune-format")
+    target_base = os.path.join(work, "base")
+    target_tuned = os.path.join(work, "tuned")
+    for d in (target_base, target_tuned):
+        if not os.path.isdir(d):
+            raise SystemExit(
+                f"missing {d}; run `python -m quoracle_tpu.tools.finetune "
+                f"--target format` first")
+
+    # --- draft base: tiny scale, byte-identical tokenizer ---------------
+    draft_base = make_checkpoint(os.path.join(work, "draft-base"),
+                                 family="llama", scale="tiny",
+                                 seed=args.seed + 7)
+    for f in ("tokenizer.json",):
+        a = os.path.join(target_base, f)
+        b = os.path.join(draft_base, f)
+        if not filecmp.cmp(a, b, shallow=False):
+            # deterministic BPE means this should never happen; if the
+            # corpora ever diverge, copying restores id identity
+            log(f"tokenizer {f} differs; copying target's into draft")
+            shutil.copy(a, b)
+    tok = HFAutoTokenizer(target_tuned)
+
+    # --- train the draft on the SAME corpus -----------------------------
+    rows = build_format_corpus(tok, tok.eos_id, args.corpus_size,
+                               args.seed, args.seq)
+    log(f"corpus: {len(rows)} rows; training tiny draft "
+        f"{args.steps} steps")
+    dcfg, dstate = train(draft_base, rows, args.steps, args.batch,
+                         args.seq, args.lr, args.seed, log)
+    draft_tuned = export_hf_checkpoint(
+        dstate.params, dcfg, os.path.join(work, "draft-tuned"), draft_base)
+    log(f"exported draft to {draft_tuned}")
+
+    # --- speculative target x draft on held-out tasks -------------------
+    tcfg = register_hf_checkpoint(target_tuned, name="spec-ft-target")
+    tparams = to_device(load_params(target_tuned, tcfg, dtype=np.float32))
+    dcfg2 = register_hf_checkpoint(draft_tuned, name="spec-ft-draft")
+    dparams = to_device(load_params(draft_tuned, dcfg2, dtype=np.float32))
+
+    from quoracle_tpu.models.generate import GenerateEngine
+    eng = GenerateEngine(tcfg, tparams, tok, max_seq=1024,
+                         prompt_buckets=(64, 128, 256))
+    dec = SpeculativeDecoder(tcfg, tparams, dcfg2, dparams, tok,
+                             k=args.k, max_seq=1024)
+
+    import random
+    rng = random.Random(args.seed + 1)           # disjoint: held-out tasks
+    acc, tpr, van_ms, spec_ms, equal = [], [], [], [], 0
+    for i in range(args.n_eval):
+        task, _ = _format_sample(rng)
+        prompt = tok.encode_chat([
+            {"role": "system", "content": SYSTEM},
+            {"role": "user", "content": task}])
+        t0 = time.monotonic()
+        want = eng.generate([prompt], temperature=0.0,
+                            max_new_tokens=args.max_new)[0]
+        van = time.monotonic() - t0
+        t0 = time.monotonic()
+        got = dec.generate(prompt, temperature=0.0,
+                           max_new_tokens=args.max_new)
+        spc = time.monotonic() - t0
+        if i > 0:                    # first call pays the spec compiles
+            van_ms.append(van * 1000 / max(1, want.n_gen_tokens))
+            spec_ms.append(spc * 1000 / max(1, got.n_gen_tokens))
+        acc.append(got.acceptance_rate)
+        tpr.append(got.tokens_per_round)
+        equal += int(got.token_ids == want.token_ids)
+        log(f"task {i}: accept {got.accepted}/{got.drafted} "
+            f"tokens/round {got.tokens_per_round:.2f} "
+            f"equal={got.token_ids == want.token_ids}")
+
+    payload = {
+        "metric": "speculative_trained_draft",
+        "value": round(statistics.median(acc), 4),
+        "unit": "acceptance_rate",
+        "k": args.k,
+        "tokens_per_round_p50": round(statistics.median(tpr), 2),
+        "greedy_equal": f"{equal}/{args.n_eval}",
+        "target": "finetune-format/tuned (small, ~7M)",
+        "draft": "finetune-format/draft-tuned (tiny, ~0.6M)",
+        "draft_steps": args.steps,
+        "n_eval_heldout": args.n_eval,
+        "cpu_vanilla_ms_per_token_p50": round(
+            statistics.median(van_ms), 2) if van_ms else None,
+        "cpu_spec_ms_per_token_p50": round(
+            statistics.median(spec_ms), 2) if spec_ms else None,
+        "note": ("held-out format tasks, greedy; realized chip speedup = "
+                 "bench config7 ceiling x this acceptance; CPU ms are "
+                 "smoke (compute-bound host, see BASELINE.md config 7)"),
+    }
+    line = json.dumps(payload)
+    print(line)
+    if args.out_artifact:
+        with open(args.out_artifact, "w") as f:
+            f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
