@@ -84,6 +84,21 @@ def prefill(params: dict, cfg: ModelConfig, tokens: jax.Array,
                          ring=ring, input_embeds=input_embeds)
 
 
+def grammar_mask(logits: jax.Array, jstate: jax.Array,
+                 json_table: jax.Array, eos_id: int) -> jax.Array:
+    """THE grammar mask — every constrained decode path (gather decode,
+    direct paged decode, speculative draft + verify) calls this one
+    implementation so they can never drift on dead-end or unconstrained
+    handling. logits [B, V], jstate [B]; jstate < 0 = unconstrained row;
+    a dead-end state (vocab gap: no token allowed) permits eos so the row
+    stops instead of sampling an all -inf distribution."""
+    allowed = json_table[jnp.clip(jstate, 0, None)] >= 0       # [B, V]
+    none_ok = ~jnp.any(allowed, axis=-1, keepdims=True)
+    eos_hot = (jnp.arange(logits.shape[-1]) == eos_id)[None, :]
+    allowed = allowed | (none_ok & eos_hot) | (jstate < 0)[:, None]
+    return jnp.where(allowed, logits, NEG_INF_LOGITS)
+
+
 def _sampling_fns(json_table: Optional[jax.Array], eos_id: int,
                   stop_ids: tuple):
     """The stop/grammar closures shared by decode() and decode_paged() —
@@ -99,13 +114,7 @@ def _sampling_fns(json_table: Optional[jax.Array], eos_id: int,
     def mask_logits(logits, jstate):
         if not constrained:
             return logits
-        allowed = json_table[jnp.clip(jstate, 0, None)] >= 0   # [B, V]
-        # dead-end safety: if no token is allowed (vocab gap), permit eos
-        # so the row stops instead of sampling from an all -inf row
-        none_ok = ~jnp.any(allowed, axis=-1, keepdims=True)
-        eos_hot = (jnp.arange(logits.shape[-1]) == eos_id)[None, :]
-        allowed = allowed | (none_ok & eos_hot) | (jstate < 0)[:, None]
-        return jnp.where(allowed, logits, NEG_INF_LOGITS)
+        return grammar_mask(logits, jstate, json_table, eos_id)
 
     def advance(jstate, tok, done):
         if not constrained:

@@ -39,9 +39,17 @@ Greedy (temperature 0) output is bit-identical to vanilla decode: every
 accepted d_i equals argmax(p_i) and every correction IS argmax(p_i).
 tests/test_speculative.py asserts equality against GenerateEngine.
 
-v1 scope: batch 1, dense cache (no sessions/pages), text-only, no
-grammar constraint, full attention (no sliding window). The draft and
-target MUST share one tokenizer/vocab — verified at construction.
+Grammar-constrained speculation is supported (``constrain_json`` /
+``action_enum``): the draft proposes under the SAME token-DFA mask the
+engine decodes with (models/constrained.py) — the proposal distribution
+is the masked one, so acceptance math stays exact — and the verify pass
+masks p_i with the state in effect at that position (host table walk).
+This is what makes speculation applicable to the production consensus
+workload, which always decodes constrained action JSON.
+
+v1 scope: batch 1, dense cache (no sessions/pages), text-only, full
+attention (no sliding window). The draft and target MUST share one
+tokenizer/vocab — verified at construction.
 """
 
 from __future__ import annotations
@@ -56,7 +64,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from quoracle_tpu.models.config import ModelConfig
-from quoracle_tpu.models.generate import prefill
+from quoracle_tpu.models.generate import grammar_mask, prefill
 from quoracle_tpu.models.sampling import sample_tokens
 from quoracle_tpu.models.transformer import (
     KVCache, forward_hidden, init_cache, project_logits,
@@ -134,20 +142,28 @@ class SpeculativeDecoder:
             cache = init_cache(cfg, 1, cache_len, dtype=dt)
             return prefill(params, cfg, tokens, lens, cache)
 
-        @jax.jit
+        eos_id = self.tc.eos_token_id
+        # generate.grammar_mask IS the engine's mask — one implementation,
+        # zero drift (the bit-exactness guarantee depends on it)
+        _mask = functools.partial(grammar_mask, eos_id=eos_id)
+
+        @functools.partial(jax.jit, static_argnames=("constrained",))
         def _draft_scan(params, cache: KVCache, pending, rng, temperature,
-                        top_p):
+                        top_p, json_table, jstate0,
+                        constrained: bool = False):
             """K autoregressive draft steps from ``pending``.
 
             Returns (d_tokens [K], q_probs [K, V], cache'): step i
             forwards the previous token (pending for i=0), samples d_i
-            from the draft distribution q_i. The cache advances K
+            from the draft distribution q_i — grammar-masked when
+            ``constrained`` (the proposal distribution IS the masked one,
+            so acceptance math stays exact). The cache advances K
             positions — through d_{K-1} — matching the target's verify
             chunk exactly (module docstring invariant)."""
             cfg = self.dc
 
             def step(carry, _):
-                cache, tok, rng = carry
+                cache, tok, rng, jstate = carry
                 pos = cache.lens[:, None]
                 hidden, cache = forward_hidden(
                     params, cfg, tok[:, None], pos, cache,
@@ -155,6 +171,8 @@ class SpeculativeDecoder:
                 cache = cache._replace(lens=cache.lens + 1)
                 logits = project_logits(params, cfg, hidden)[:, 0, :]
                 logits = logits.astype(jnp.float32)
+                if constrained:
+                    logits = _mask(logits, jstate, json_table)
                 rng, ks = jax.random.split(rng)
                 nxt = sample_tokens(logits, ks, temperature, top_p)
                 q = jax.nn.softmax(
@@ -165,17 +183,27 @@ class SpeculativeDecoder:
                 q = jnp.where(
                     (temperature <= 0)[:, None],
                     jax.nn.one_hot(nxt, logits.shape[-1]), q)
-                return (cache, nxt, rng), (nxt[0], q[0])
+                if constrained:
+                    jstate = jnp.where(
+                        jstate >= 0,
+                        json_table[jnp.clip(jstate, 0, None),
+                                   nxt].astype(jnp.int32), jstate)
+                return (cache, nxt, rng, jstate), (nxt[0], q[0])
 
-            (cache, _, rng), (toks, qs) = jax.lax.scan(
-                step, (cache, pending, rng), None, length=K)
+            (cache, _, rng, _), (toks, qs) = jax.lax.scan(
+                step, (cache, pending, rng, jstate0), None, length=K)
             return toks, qs, cache
 
-        @jax.jit
-        def _verify_chunk(params, cache: KVCache, chunk, temperature):
+        @functools.partial(jax.jit, static_argnames=("constrained",))
+        def _verify_chunk(params, cache: KVCache, chunk, temperature,
+                          json_table, jstate0, constrained: bool = False):
             """One target pass over [pending, d_1..d_{K-1}] → p_1..p_K
             (full per-position distributions) with the cache advanced K
-            positions."""
+            positions. Under constraint the per-position grammar states
+            are walked IN-DEVICE from ``jstate0`` over the draft tokens
+            (chunk[1:]) — no host sync sits between the draft scan and
+            this dispatch — and the mask applied to p_i equals the one
+            the vanilla engine would apply at that position."""
             cfg = self.tc
             T = K
             lens0 = cache.lens
@@ -187,6 +215,15 @@ class SpeculativeDecoder:
             cache = cache._replace(lens=lens0 + T)
             logits = project_logits(params, cfg, hidden)[0].astype(
                 jnp.float32)                                     # [K, V]
+            if constrained:
+                def adv(s, tok):
+                    nxt = json_table[jnp.clip(s, 0, None),
+                                     tok].astype(jnp.int32)
+                    s2 = jnp.where(s >= 0, nxt, s)
+                    return s2, s2
+                _, rest = jax.lax.scan(adv, jstate0[0], chunk[1:])
+                jstates = jnp.concatenate([jstate0, rest])       # [K]
+                logits = _mask(logits, jstates, json_table)
             probs = jax.nn.softmax(
                 logits / jnp.maximum(temperature, 1e-6)[:, None], axis=-1)
             greedy_probs = jax.nn.one_hot(
@@ -199,6 +236,31 @@ class SpeculativeDecoder:
         self._draft_scan = _draft_scan
         self._verify_chunk = _verify_chunk
 
+    def _grammar(self, action_enum) -> tuple:
+        """(numpy table, start_state, device table) per enum, cached. One
+        DFA serves both models — they share the tokenizer by contract.
+        Key is normalized (sorted, deduped — CharDFA normalizes the enum
+        internally, so permutations build byte-identical tables) and the
+        cache is BOUNDED: device tables are states × vocab int16, tens of
+        MB at large vocabs, and varied capability sets must not
+        accumulate until HBM OOM (same rationale as the engine's
+        _json_table_device eviction)."""
+        if not hasattr(self, "_grammar_cache"):
+            self._grammar_cache = {}
+        key = tuple(sorted(set(action_enum))) if action_enum else None
+        if key not in self._grammar_cache:
+            from quoracle_tpu.models.constrained import JsonTokenTable
+            tt = JsonTokenTable.for_tokenizer(
+                self.tokenizer, self.tc.vocab_size, self.tc.eos_token_id,
+                extra_stop_ids=tuple(self.tc.stop_token_ids),
+                action_enum=list(action_enum) if action_enum else None)
+            for old in list(self._grammar_cache)[:max(
+                    0, len(self._grammar_cache) - 3)]:
+                del self._grammar_cache[old]     # keep newest 3 + this
+            self._grammar_cache[key] = (tt.table, tt.start_state,
+                                        jnp.asarray(tt.table))
+        return self._grammar_cache[key]
+
     def next_rng(self) -> jax.Array:
         self._rng, k = jax.random.split(self._rng)
         return k
@@ -207,6 +269,8 @@ class SpeculativeDecoder:
 
     def generate(self, prompt, *, max_new_tokens: int = 128,
                  temperature: float = 0.0, top_p: float = 1.0,
+                 constrain_json: bool = False,
+                 action_enum=None,
                  rng: Optional[jax.Array] = None) -> SpecResult:
         t0 = time.monotonic()
         K = self.k
@@ -223,6 +287,12 @@ class SpeculativeDecoder:
         rng_np = np.random.default_rng(int(jax.random.bits(rng) & 0x7fffffff))
         temp = jnp.asarray([float(temperature)], jnp.float32)
         topp = jnp.asarray([float(top_p)], jnp.float32)
+        if constrain_json:
+            tbl_np, start_state, tbl_dev = self._grammar(action_enum)
+            jstate = start_state
+        else:
+            tbl_np, jstate = None, -1
+            tbl_dev = jnp.zeros((1, self.tc.vocab_size), jnp.int16)
 
         cache_len = _round_up(len(prompt) + max_new_tokens + K + 1, 128)
         pad = _round_up(len(prompt), 64)
@@ -245,14 +315,25 @@ class SpeculativeDecoder:
         emitted: list[int] = []
         rounds = drafted = accepted_total = 0
         finish = "length"
+        def host_advance(s: int, tok: int) -> int:
+            if not constrain_json or s < 0:
+                return s
+            return int(tbl_np[s, tok])
+
         while len(emitted) < max_new_tokens:
             rounds += 1
             rng, kd = jax.random.split(rng)
+            jstate0 = jnp.asarray([jstate], jnp.int32)
             d_toks, q_probs, dcache = self._draft_scan(
-                self.dp, dcache, pending, kd, temp, topp)
+                self.dp, dcache, pending, kd, temp, topp,
+                tbl_dev, jstate0, constrained=constrain_json)
             chunk = jnp.concatenate([pending, d_toks[:-1]])
-            p_probs, tcache = self._verify_chunk(self.tp, tcache, chunk,
-                                                 jnp.broadcast_to(temp, (K,)))
+            # verify dispatches on DEVICE values only (the per-position
+            # grammar states walk in-device from jstate0) — no host sync
+            # sits between the draft scan and the target chunk
+            p_probs, tcache = self._verify_chunk(
+                self.tp, tcache, chunk, jnp.broadcast_to(temp, (K,)),
+                tbl_dev, jstate0, constrained=constrain_json)
             d = np.asarray(d_toks)
             q = np.asarray(q_probs)
             p = np.asarray(p_probs)
@@ -299,6 +380,8 @@ class SpeculativeDecoder:
                 finish = "stop"
             new_tokens = new_tokens[:cut]
             emitted.extend(new_tokens)
+            for t in new_tokens:
+                jstate = host_advance(jstate, t)
             if finish == "stop" or len(emitted) >= max_new_tokens:
                 break
             # lens' = len(ctx') - 1; ctx' grew by len(new_tokens)

@@ -120,6 +120,43 @@ def test_stop_token_truncates(models, target_engine):
     assert got.finish_reason == want.finish_reason
 
 
+def test_constrained_greedy_equals_vanilla_constrained(models,
+                                                       target_engine):
+    """Grammar-masked speculation must match the engine's constrained
+    greedy decode token for token — the draft proposes under the same
+    token-DFA mask and the verify pass re-applies it per position."""
+    tok = ByteTokenizer()
+    spec = make_spec(models, k=4)
+    enum = ("wait", "todo", "send_message")
+    for text in ("emit an action", "respond with json"):
+        prompt = tok.encode(text, add_bos=True)
+        want = target_engine.generate(
+            [prompt], temperature=0.0, max_new_tokens=48,
+            constrain_json=[True], action_enums=[enum])[0]
+        got = spec.generate(prompt, temperature=0.0, max_new_tokens=48,
+                            constrain_json=True, action_enum=enum)
+        assert got.token_ids == want.token_ids, (
+            f"constrained spec diverged for {text!r}:\n"
+            f" want {tok.decode(want.token_ids)!r}\n"
+            f"  got {tok.decode(got.token_ids)!r}")
+        assert got.finish_reason == want.finish_reason
+        # the output really is grammar-shaped
+        assert got.text.lstrip().startswith("{")
+
+
+def test_constrained_plain_json_no_enum(models, target_engine):
+    tok = ByteTokenizer()
+    spec = make_spec(models, k=3)
+    prompt = tok.encode("plain json please", add_bos=True)
+    want = target_engine.generate([prompt], temperature=0.0,
+                                  max_new_tokens=32,
+                                  constrain_json=[True])[0]
+    got = spec.generate(prompt, temperature=0.0, max_new_tokens=32,
+                        constrain_json=True)
+    assert got.token_ids == want.token_ids
+    assert got.text.lstrip().startswith("{")
+
+
 def test_vocab_mismatch_rejected(models):
     tp, dp = models
     bad = ModelConfig(name="bad-draft", vocab_size=256, dim=48, n_layers=2,
