@@ -64,7 +64,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from quoracle_tpu.models.config import ModelConfig
-from quoracle_tpu.models.generate import grammar_mask, prefill
+from quoracle_tpu.models.generate import (
+    grammar_mask, prefill, prefill_chunk,
+)
 from quoracle_tpu.models.sampling import sample_tokens
 from quoracle_tpu.models.transformer import (
     KVCache, forward_hidden, init_cache, project_logits,
@@ -86,6 +88,7 @@ class SpecResult:
     rounds: int                  # speculative rounds executed
     drafted: int                 # draft tokens proposed in total
     accepted: int                # draft tokens accepted in total
+    n_cached_tokens: int = 0     # session-resident prefix reused
 
     @property
     def acceptance_rate(self) -> float:
@@ -141,6 +144,17 @@ class SpeculativeDecoder:
             dt = self.t_cache_dtype if which == "t" else self.d_cache_dtype
             cache = init_cache(cfg, 1, cache_len, dtype=dt)
             return prefill(params, cfg, tokens, lens, cache)
+
+        @functools.partial(jax.jit, static_argnames=("which",))
+        def _extend(params, cache: KVCache, tokens, chunk_lens,
+                    which: str):
+            """Session resume: forward a right-padded suffix chunk on top
+            of the resident prefix (prefill_chunk at prefix = cache.lens)
+            — the speculative counterpart of the engine's token-splice."""
+            cfg = self.tc if which == "t" else self.dc
+            _, cache = prefill_chunk(params, cfg, tokens, cache.lens,
+                                     chunk_lens, cache)
+            return cache
 
         eos_id = self.tc.eos_token_id
         # generate.grammar_mask IS the engine's mask — one implementation,
@@ -233,8 +247,10 @@ class SpeculativeDecoder:
             return probs, cache
 
         self._prefill = _prefill
+        self._extend = _extend
         self._draft_scan = _draft_scan
         self._verify_chunk = _verify_chunk
+        self._sessions: dict = {}
 
     def _grammar(self, action_enum) -> tuple:
         """(numpy table, start_state, device table) per enum, cached. One
@@ -267,10 +283,14 @@ class SpeculativeDecoder:
 
     # ------------------------------------------------------------------
 
+    def drop_session(self, session_id: str) -> None:
+        self._sessions.pop(session_id, None)
+
     def generate(self, prompt, *, max_new_tokens: int = 128,
                  temperature: float = 0.0, top_p: float = 1.0,
                  constrain_json: bool = False,
                  action_enum=None,
+                 session_id: Optional[str] = None,
                  rng: Optional[jax.Array] = None) -> SpecResult:
         t0 = time.monotonic()
         K = self.k
@@ -294,21 +314,68 @@ class SpeculativeDecoder:
             tbl_np, jstate = None, -1
             tbl_dev = jnp.zeros((1, self.tc.vocab_size), jnp.int16)
 
-        cache_len = _round_up(len(prompt) + max_new_tokens + K + 1, 128)
-        pad = _round_up(len(prompt), 64)
-        toks = np.zeros((1, pad), np.int32)
-        toks[0, :len(prompt)] = prompt
-        lens = jnp.asarray([len(prompt)], jnp.int32)
-        # Both caches prefill ctx[:-1] = prompt minus its last token, so
-        # the invariant (pending un-forwarded) holds from the start.
-        # Prefill with full prompt length then roll lens back one: the
-        # last column's KV is simply overwritten by the first chunk.
-        tlogits, tcache = self._prefill(self.tp, jnp.asarray(toks), lens,
-                                        cache_len, "t")
-        _, dcache = self._prefill(self.dp, jnp.asarray(toks), lens,
-                                  cache_len, "d")
-        tcache = tcache._replace(lens=lens - 1)
-        dcache = dcache._replace(lens=lens - 1)
+        # --- cache resolution: session resume or fresh prefill ----------
+        # Session resume (speculative counterpart of the engine's token
+        # splice): caches hold ctx[:-1] of the PRIOR call's prompt +
+        # response; a new prompt that cleanly extends ctx forwards only
+        # the suffix — a refinement round re-prefills template glue, not
+        # the conversation — then decode speculates as usual.
+        n_cached = 0
+        sess = self._sessions.get(session_id) if session_id else None
+        need = len(prompt) + max_new_tokens + K + 1
+        if sess is not None:
+            ctx = sess["ctx"]
+            lcp = 0
+            for a, b in zip(ctx, prompt):
+                if a != b:
+                    break
+                lcp += 1
+            suffix = prompt[len(ctx) - 1:-1]
+            # dynamic_update_slice CLAMPS out-of-range starts — an
+            # overrunning chunk would silently shift left over valid
+            # prefix KV, so BOTH the decode chunks (need, which includes
+            # K+1) and the 64-padded extend chunk must provably fit
+            fits = (need <= sess["cache_len"]
+                    and (len(ctx) - 1 + _round_up(max(1, len(suffix)), 64)
+                         <= sess["cache_len"]))
+            if lcp == len(ctx) and len(prompt) >= len(ctx) and fits:
+                tcache, dcache = sess["t"], sess["d"]
+                n_cached = len(ctx)
+                # forward ctx[-1] .. prompt[-2] so caches hold prompt[:-1]
+                if suffix:
+                    pad = _round_up(len(suffix), 64)
+                    sf = np.zeros((1, pad), np.int32)
+                    sf[0, :len(suffix)] = suffix
+                    cl = jnp.asarray([len(suffix)], jnp.int32)
+                    tcache = self._extend(self.tp, tcache,
+                                          jnp.asarray(sf), cl, "t")
+                    dcache = self._extend(self.dp, dcache,
+                                          jnp.asarray(sf), cl, "d")
+            else:
+                sess = None                      # diverged or outgrown
+                self._sessions.pop(session_id, None)
+        if sess is None:
+            # session caches carry decode slack (K+1) plus the extend
+            # pad overhang (63) ABOVE max_seq — see the clamp note above
+            cache_len = (_round_up(self.max_seq + K + 64, 128)
+                         if session_id else _round_up(need, 128))
+            pad = _round_up(len(prompt), 64)
+            toks = np.zeros((1, pad), np.int32)
+            toks[0, :len(prompt)] = prompt
+            lens = jnp.asarray([len(prompt)], jnp.int32)
+            # Both caches prefill ctx[:-1] = prompt minus its last token,
+            # so the invariant (pending un-forwarded) holds from the
+            # start. Prefill with full prompt length then roll lens back
+            # one: the last column's KV is simply overwritten by the
+            # first chunk.
+            _, tcache = self._prefill(self.tp, jnp.asarray(toks), lens,
+                                      cache_len, "t")
+            _, dcache = self._prefill(self.dp, jnp.asarray(toks), lens,
+                                      cache_len, "d")
+            tcache = tcache._replace(lens=lens - 1)
+            dcache = dcache._replace(lens=lens - 1)
+        else:
+            cache_len = sess["cache_len"]
         pending = jnp.asarray([prompt[-1]], jnp.int32)
 
         stops = {self.tc.eos_token_id, *self.tc.stop_token_ids}
@@ -396,6 +463,24 @@ class SpeculativeDecoder:
         if emitted and emitted[-1] in stops:
             emitted.pop()
             finish = "stop"
+        if session_id and emitted:
+            # store at the invariant: caches hold ctx'[:-1]. Committed
+            # tokens' KV is valid through ctx'-2 (a trailing correction's
+            # position is excluded by the -1; rejected drafts past it are
+            # masked and later overwritten in place).
+            ctx_out = prompt + emitted
+            norm = jnp.asarray([len(ctx_out) - 1], jnp.int32)
+            # LRU, not FIFO: pop-then-reinsert moves a re-stored session
+            # to the end, so the hot session is never the eviction victim
+            self._sessions.pop(session_id, None)
+            for old in list(self._sessions)[:max(
+                    0, len(self._sessions) - 7)]:
+                self._sessions.pop(old)          # bound: newest 7 + this
+            self._sessions[session_id] = {
+                "t": tcache._replace(lens=norm),
+                "d": dcache._replace(lens=norm),
+                "ctx": ctx_out, "cache_len": cache_len,
+            }
         return SpecResult(
             token_ids=emitted,
             text=self.tokenizer.decode(emitted),
@@ -406,4 +491,5 @@ class SpeculativeDecoder:
             rounds=rounds,
             drafted=drafted,
             accepted=accepted_total,
+            n_cached_tokens=n_cached,
         )

@@ -47,6 +47,9 @@ def main() -> None:
     ap.add_argument("--max-new", type=int, default=96)
     ap.add_argument("--workdir", default=None)
     ap.add_argument("--out-artifact", default=None)
+    ap.add_argument("--skip-train", action="store_true",
+                    help="reuse an existing draft-tuned checkpoint and "
+                         "only run the acceptance measurement")
     args = ap.parse_args()
 
     def log(msg):
@@ -95,15 +98,30 @@ def main() -> None:
     tok = HFAutoTokenizer(target_tuned)
 
     # --- train the draft on the SAME corpus -----------------------------
-    rows = build_format_corpus(tok, tok.eos_id, args.corpus_size,
-                               args.seed, args.seq)
-    log(f"corpus: {len(rows)} rows; training tiny draft "
-        f"{args.steps} steps")
-    dcfg, dstate = train(draft_base, rows, args.steps, args.batch,
-                         args.seq, args.lr, args.seed, log)
-    draft_tuned = export_hf_checkpoint(
-        dstate.params, dcfg, os.path.join(work, "draft-tuned"), draft_base)
-    log(f"exported draft to {draft_tuned}")
+    draft_tuned = os.path.join(work, "draft-tuned")
+    meta_path = os.path.join(work, "draft-meta.json")
+    if args.skip_train and os.path.isdir(draft_tuned):
+        log(f"reusing existing draft at {draft_tuned}")
+        try:                  # the artifact records the ACTUAL provenance
+            with open(meta_path) as f:
+                trained_steps = json.load(f).get("steps")
+        except OSError:
+            trained_steps = None
+    else:
+        rows = build_format_corpus(tok, tok.eos_id, args.corpus_size,
+                                   args.seed, args.seq)
+        log(f"corpus: {len(rows)} rows; training tiny draft "
+            f"{args.steps} steps")
+        dcfg, dstate = train(draft_base, rows, args.steps, args.batch,
+                             args.seq, args.lr, args.seed, log)
+        draft_tuned = export_hf_checkpoint(
+            dstate.params, dcfg, draft_tuned, draft_base)
+        log(f"exported draft to {draft_tuned}")
+        trained_steps = args.steps
+        with open(meta_path, "w") as f:
+            json.dump({"steps": trained_steps,
+                       "corpus_size": args.corpus_size,
+                       "seed": args.seed}, f)
 
     # --- speculative target x draft on held-out tasks -------------------
     tcfg = register_hf_checkpoint(target_tuned, name="spec-ft-target")
@@ -120,6 +138,8 @@ def main() -> None:
     import random
     rng = random.Random(args.seed + 1)           # disjoint: held-out tasks
     acc, tpr, van_ms, spec_ms, equal = [], [], [], [], 0
+    con_acc, con_tpr, con_equal = [], [], 0
+    enum = ("todo", "send_message", "wait", "execute_shell", "spawn_child")
     for i in range(args.n_eval):
         task, _ = _format_sample(rng)
         prompt = tok.encode_chat([
@@ -142,6 +162,20 @@ def main() -> None:
         log(f"task {i}: accept {got.accepted}/{got.drafted} "
             f"tokens/round {got.tokens_per_round:.2f} "
             f"equal={got.token_ids == want.token_ids}")
+        # grammar-constrained variant — the production consensus shape
+        cwant = eng.generate([prompt], temperature=0.0,
+                             max_new_tokens=args.max_new,
+                             constrain_json=[True],
+                             action_enums=[enum])[0]
+        cgot = dec.generate(prompt, temperature=0.0,
+                            max_new_tokens=args.max_new,
+                            constrain_json=True, action_enum=enum)
+        con_acc.append(cgot.acceptance_rate)
+        con_tpr.append(cgot.tokens_per_round)
+        con_equal += int(cgot.token_ids == cwant.token_ids)
+        log(f"task {i} constrained: accept {cgot.accepted}/{cgot.drafted}"
+            f" tokens/round {cgot.tokens_per_round:.2f} "
+            f"equal={cgot.token_ids == cwant.token_ids}")
 
     payload = {
         "metric": "speculative_trained_draft",
@@ -150,9 +184,15 @@ def main() -> None:
         "k": args.k,
         "tokens_per_round_p50": round(statistics.median(tpr), 2),
         "greedy_equal": f"{equal}/{args.n_eval}",
+        "constrained_acceptance_p50": round(
+            statistics.median(con_acc), 4),
+        "constrained_tokens_per_round_p50": round(
+            statistics.median(con_tpr), 2),
+        "constrained_greedy_equal": f"{con_equal}/{args.n_eval}",
+        "constrained_enum": list(enum),
         "target": "finetune-format/tuned (small, ~7M)",
         "draft": "finetune-format/draft-tuned (tiny, ~0.6M)",
-        "draft_steps": args.steps,
+        "draft_steps": trained_steps,
         "n_eval_heldout": args.n_eval,
         "cpu_vanilla_ms_per_token_p50": round(
             statistics.median(van_ms), 2) if van_ms else None,

@@ -157,6 +157,61 @@ def test_constrained_plain_json_no_enum(models, target_engine):
     assert got.text.lstrip().startswith("{")
 
 
+def test_session_resume_splices_and_matches_fresh(models, target_engine):
+    """Speculative sessions: a refinement-shaped second round (prior
+    prompt + response + new message) reuses the resident prefix — only
+    the glue forwards — and its output is identical to a fresh
+    speculative run AND to vanilla engine decode."""
+    tok = ByteTokenizer()
+    spec = make_spec(models, k=4)
+    p1 = tok.encode("round one prompt", add_bos=True)
+    r1 = spec.generate(p1, temperature=0.0, max_new_tokens=24,
+                       session_id="s")
+    assert r1.n_cached_tokens == 0
+    p2 = p1 + r1.token_ids + tok.encode(" refine the answer")
+    r2 = spec.generate(p2, temperature=0.0, max_new_tokens=24,
+                       session_id="s")
+    assert r2.n_cached_tokens == len(p1) + len(r1.token_ids)
+    fresh = make_spec(models, k=4).generate(p2, temperature=0.0,
+                                            max_new_tokens=24)
+    assert r2.token_ids == fresh.token_ids, "session resume diverged"
+    want = target_engine.generate([p2], temperature=0.0,
+                                  max_new_tokens=24)[0]
+    assert r2.token_ids == want.token_ids
+    # a divergent prompt drops the session and runs fresh, correctly
+    p3 = tok.encode("completely different task", add_bos=True)
+    r3 = spec.generate(p3, temperature=0.0, max_new_tokens=12,
+                       session_id="s")
+    assert r3.n_cached_tokens == 0
+    want3 = target_engine.generate([p3], temperature=0.0,
+                                   max_new_tokens=12)[0]
+    assert r3.token_ids == want3.token_ids
+    spec.drop_session("s")
+    assert "s" not in spec._sessions
+
+
+def test_session_resume_constrained(models, target_engine):
+    """Sessions compose with the grammar: each round's JSON block starts
+    at the grammar start state while the KV prefix splices."""
+    tok = ByteTokenizer()
+    spec = make_spec(models, k=4)
+    enum = ("wait", "todo")
+    p1 = tok.encode("emit action one", add_bos=True)
+    r1 = spec.generate(p1, temperature=0.0, max_new_tokens=32,
+                       constrain_json=True, action_enum=enum,
+                       session_id="cs")
+    p2 = p1 + r1.token_ids + tok.encode(" now refine")
+    r2 = spec.generate(p2, temperature=0.0, max_new_tokens=32,
+                       constrain_json=True, action_enum=enum,
+                       session_id="cs")
+    assert r2.n_cached_tokens == len(p1) + len(r1.token_ids)
+    want = target_engine.generate([p2], temperature=0.0,
+                                  max_new_tokens=32, constrain_json=[True],
+                                  action_enums=[enum])[0]
+    assert r2.token_ids == want.token_ids
+    assert r2.text.lstrip().startswith("{")
+
+
 def test_vocab_mismatch_rejected(models):
     tp, dp = models
     bad = ModelConfig(name="bad-draft", vocab_size=256, dim=48, n_layers=2,
