@@ -286,7 +286,8 @@ class TPUBackend(ModelBackend):
                  submeshes: Optional[Sequence] = None,
                  overlap: bool = True,
                  continuous: bool = False, continuous_chunk: int = 32,
-                 continuous_slots: int = 8):
+                 continuous_slots: int = 8,
+                 draft_map: Optional[dict] = None, draft_k: int = 6):
         """``submeshes``: one jax Mesh per pool member (parallel.mesh.
         pool_submeshes) — each member's engine serves tp-sharded on its own
         chips, and ``overlap`` runs members concurrently from host threads
@@ -309,11 +310,9 @@ class TPUBackend(ModelBackend):
         self.engines: dict[str, GenerateEngine] = dict(engines or {})
         self.overlap = overlap
         init_fn = init_params_fn or init_params
-        for i, spec in enumerate(self.pool):
-            if spec in self.engines:
-                continue
+
+        def build_engine(spec: str, i: int, mesh=None) -> GenerateEngine:
             cfg = get_model_config(spec)
-            mesh = submeshes[i % len(submeshes)] if submeshes else None
             if cfg.checkpoint_path:
                 # Real weights: HF safetensors → stacked pytree
                 # (models/loader.py); the catalog entry carries the path
@@ -327,20 +326,55 @@ class TPUBackend(ModelBackend):
                     params = to_device(params)
             else:
                 params = init_fn(cfg, jax.random.PRNGKey(seed + i))
-            self.engines[spec] = GenerateEngine(
-                cfg, params, get_tokenizer(spec), seed=seed + i, mesh=mesh)
+            return GenerateEngine(cfg, params, get_tokenizer(spec),
+                                  seed=seed + i, mesh=mesh)
 
-        # One baton batcher per member: concurrent agents' rounds coalesce
-        self._batchers = {spec: _MemberBatcher(e)
-                          for spec, e in self.engines.items()}
+        for i, spec in enumerate(self.pool):
+            if spec in self.engines:
+                continue
+            mesh = submeshes[i % len(submeshes)] if submeshes else None
+            self.engines[spec] = build_engine(spec, i, mesh)
+
+        # Speculative serving (models/speculative.py): draft_map routes a
+        # member's ELIGIBLE queries (single row, text-only, greedy or
+        # top_p=1 sampling) through draft-K/verify-one-chunk decoding —
+        # output stays token-exact at temperature 0. Draft engines load
+        # like members but never serve as pool members themselves.
+        self._spec_decoders: dict = {}
+        if draft_map:
+            if continuous:
+                # the continuous path returns before the speculative
+                # branch — silently loading draft weights that can never
+                # serve would be paid-for dead memory
+                raise ValueError("draft_map is not supported with "
+                                 "continuous=True (decode-level batching "
+                                 "already amortizes weight streaming)")
+            from quoracle_tpu.models.speculative import SpeculativeDecoder
+            for j, (tspec, dspec) in enumerate(sorted(draft_map.items())):
+                if tspec not in self.engines:
+                    raise KeyError(f"draft_map target {tspec!r} is not in "
+                                   f"the pool")
+                if dspec not in self.engines:
+                    self.engines[dspec] = build_engine(
+                        dspec, len(self.pool) + 100 + j)
+                te, de = self.engines[tspec], self.engines[dspec]
+                self._spec_decoders[tspec] = SpeculativeDecoder(
+                    te.cfg, te.params, de.cfg, de.params, te.tokenizer,
+                    k=draft_k, max_seq=te.max_seq)
+
+        # One baton batcher per POOL member (draft engines never serve
+        # directly): concurrent agents' rounds coalesce
+        self._batchers = {spec: _MemberBatcher(self.engines[spec])
+                          for spec in self.pool}
         self.continuous = continuous
         self._cbatchers = {}
         if continuous:
             from quoracle_tpu.models.scheduler import ContinuousBatcher
             self._cbatchers = {
-                spec: ContinuousBatcher(e, chunk=continuous_chunk,
+                spec: ContinuousBatcher(self.engines[spec],
+                                        chunk=continuous_chunk,
                                         max_slots=continuous_slots)
-                for spec, e in self.engines.items()}
+                for spec in self.pool}
 
         if embedder is not None:
             self.embedder = embedder
@@ -397,7 +431,9 @@ class TPUBackend(ModelBackend):
         """One pool member's slice of the round. Writes into disjoint
         ``results`` positions — safe from concurrent member threads."""
         engine = self.engines.get(spec)
-        if engine is None:
+        if engine is None or spec not in self._batchers:
+            # not a pool member — includes draft engines, which load into
+            # self.engines but never serve directly
             for i in idxs:
                 results[i] = QueryResult(
                     model_spec=spec, error=f"unknown model {spec!r}",
@@ -429,6 +465,11 @@ class TPUBackend(ModelBackend):
                     # assistant text would break the token match at the
                     # previous prompt's end (generate.splice_session_prompt).
                     sess_toks = engine.session_tokens(r.session_id)
+                    if not sess_toks and spec in self._spec_decoders:
+                        # speculative sessions live in the decoder, not
+                        # the engine — splice against ITS resident ids
+                        sess_toks = self._spec_decoders[
+                            spec].session_tokens(r.session_id)
                     if sess_toks:
                         spliced = splice_session_prompt(
                             engine.tokenizer, sess_toks, ids)
@@ -464,6 +505,48 @@ class TPUBackend(ModelBackend):
         if self.continuous:
             self._query_member_continuous(spec, rows, live_idxs, results,
                                           t0)
+            return
+        dec = self._spec_decoders.get(spec)
+        if (dec is not None and len(rows) == 1
+                and rows[0]["image"] is None
+                and (rows[0]["temperature"] <= 0
+                     or rows[0]["top_p"] >= 1.0)
+                # TRY-acquire: under concurrent agents the member
+                # batcher's cross-agent coalescing beats serialized
+                # speculation (batched decode already amortizes weight
+                # streaming) — contention falls through to the baton
+                # path; an uncontended single agent speculates
+                and dec.lock.acquire(blocking=False)):
+            r0 = rows[0]
+            i0 = live_idxs[0]
+            cfg = engine.cfg
+            try:
+                g = dec.generate(
+                    r0["prompt"], temperature=r0["temperature"],
+                    top_p=r0["top_p"], max_new_tokens=r0["budget"],
+                    constrain_json=bool(r0["constrain_json"]),
+                    action_enum=r0["action_enum"],
+                    session_id=r0["session_id"])
+            except ContextOverflowError as e:
+                results[i0] = QueryResult(model_spec=spec,
+                                          error=f"context_overflow: {e}")
+                return
+            except Exception as e:    # noqa: BLE001 — row-level error
+                results[i0] = QueryResult(model_spec=spec,
+                                          error=f"generate failed: {e}")
+                return
+            finally:
+                dec.lock.release()
+            latency_ms = (time.monotonic() - t0) * 1000
+            cost = (g.n_prompt_tokens * cfg.input_cost_per_mtok
+                    + g.n_gen_tokens * cfg.output_cost_per_mtok) / 1e6
+            results[i0] = QueryResult(
+                model_spec=spec, text=g.text,
+                usage=Usage(g.n_prompt_tokens, g.n_gen_tokens, cost),
+                latency_ms=latency_ms,
+                # draft/verify interleave: a prefill/decode split is not
+                # meaningful (same convention as continuous mode)
+                prefill_ms=0.0, decode_ms=0.0)
             return
         # The member's baton batcher may merge these rows with concurrent
         # agents' rounds into one generate.
@@ -559,6 +642,12 @@ class TPUBackend(ModelBackend):
                 # generates — a bare store drop could free pages a running
                 # batch still references
                 engine.drop_session(session_id)
+        for spec, dec in self._spec_decoders.items():
+            if keep is None or spec in keep:
+                # speculative sessions hold two full-size dense caches —
+                # a dead session must not wait for LRU eviction, and a
+                # reused id must not splice against the stale ctx
+                dec.drop_session(session_id)
 
     def count_tokens(self, model_spec: str, text: str) -> int:
         return self.engines[model_spec].tokenizer.count(text)

@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
 import time
 from typing import Optional
 
@@ -131,6 +132,10 @@ class SpeculativeDecoder:
         self.d_cache_dtype = (cache_dtype if cache_dtype is not None
                               else jax.tree.leaves(draft_params)[0].dtype)
         self._rng = jax.random.PRNGKey(seed)
+        # NOT thread-safe: sessions/caches/rng mutate per call. Callers
+        # that share a decoder serialize through this lock (TPUBackend
+        # try-acquires it and falls back to batched vanilla on contention)
+        self.lock = threading.Lock()
         self._build()
 
     # ------------------------------------------------------------------
@@ -284,7 +289,16 @@ class SpeculativeDecoder:
     # ------------------------------------------------------------------
 
     def drop_session(self, session_id: str) -> None:
-        self._sessions.pop(session_id, None)
+        with self.lock:
+            self._sessions.pop(session_id, None)
+
+    def session_tokens(self, session_id: str) -> Optional[list]:
+        """The session's resident conversation ids, or None — mirrors
+        GenerateEngine.session_tokens so callers can splice prompts
+        against whichever store holds the session."""
+        with self.lock:
+            s = self._sessions.get(session_id)
+            return list(s["ctx"]) if s else None
 
     def generate(self, prompt, *, max_new_tokens: int = 128,
                  temperature: float = 0.0, top_p: float = 1.0,

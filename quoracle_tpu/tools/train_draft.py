@@ -105,7 +105,7 @@ def main() -> None:
         try:                  # the artifact records the ACTUAL provenance
             with open(meta_path) as f:
                 trained_steps = json.load(f).get("steps")
-        except OSError:
+        except (OSError, ValueError):      # missing OR corrupt meta
             trained_steps = None
     else:
         rows = build_format_corpus(tok, tok.eos_id, args.corpus_size,
