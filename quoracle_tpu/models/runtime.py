@@ -516,14 +516,22 @@ class TPUBackend(ModelBackend):
                 # speculation (batched decode already amortizes weight
                 # streaming) — contention falls through to the baton
                 # path; an uncontended single agent speculates
+                # the decoder asserts prompt + max_new < max_seq (its
+                # dense cache sizing); the OUTPUT_FLOOR-inflated budget
+                # must be clamped like generate.py's per-row limits, and
+                # a prompt leaving <1 token of room falls through to the
+                # baton path's proper context_overflow handling
+                and len(rows[0]["prompt"]) + 1 < engine.max_seq
                 and dec.lock.acquire(blocking=False)):
             r0 = rows[0]
             i0 = live_idxs[0]
             cfg = engine.cfg
+            budget = min(r0["budget"],
+                         engine.max_seq - len(r0["prompt"]) - 1)
             try:
                 g = dec.generate(
                     r0["prompt"], temperature=r0["temperature"],
-                    top_p=r0["top_p"], max_new_tokens=r0["budget"],
+                    top_p=r0["top_p"], max_new_tokens=budget,
                     constrain_json=bool(r0["constrain_json"]),
                     action_enum=r0["action_enum"],
                     session_id=r0["session_id"])

@@ -203,10 +203,11 @@ class Runtime:
         return self.supervisor.live_agents()
 
     def default_pool(self) -> list[str]:
-        """The pool used when a task names neither pool nor profile: every
-        model the backend actually serves."""
+        """The pool used when a task names neither pool nor profile: the
+        backend's POOL members — engines can also hold speculative draft
+        models, which never serve directly."""
         if isinstance(self.backend, TPUBackend):
-            return list(self.backend.engines)
+            return list(self.backend.pool)
         return list(MockBackend.DEFAULT_POOL)
 
     def list_groves(self) -> list:

@@ -7,6 +7,8 @@ Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
                             (MailboxLive)
   GET  /telemetry           dev telemetry page (LiveDashboard equivalent,
                             router.ex:42-50)
+  GET  /settings            read-only settings audit view
+                            (SecretManagementLive; mutations via the API)
   GET  /healthz             health check (reference HealthController)
   GET  /events              SSE: every bus broadcast as one JSON event
   GET  /api/status          runtime summary
@@ -333,6 +335,10 @@ class _Handler(BaseHTTPRequestHandler):
             elif parsed.path == "/telemetry":
                 from quoracle_tpu.web import views
                 self._send_html(views.telemetry_page(d.metrics_payload()))
+            elif parsed.path == "/settings":
+                from quoracle_tpu.web import views
+                self._send_html(views.settings_page(
+                    d.settings_payload(), d.runtime.credentials.list()))
             elif parsed.path == "/healthz":
                 self._send_json({"status": "ok"})
             elif parsed.path == "/api/status":

@@ -57,6 +57,14 @@ def _ts(ts: Optional[float]) -> str:
     return time.strftime("%H:%M:%S", time.localtime(ts))
 
 
+def _kv_rows(d: dict) -> str:
+    """Sorted key/value 2-column rows — the one dict-table renderer
+    (/settings and /telemetry both build on it)."""
+    return "".join(
+        f"<tr><td class=\"meta\">{_e(k)}</td><td>{_e(v)}</td></tr>"
+        for k, v in sorted(d.items()))
+
+
 def _page(title: str, body: str, refresh: int = 5) -> str:
     return (f"<!doctype html><html lang=\"en\"><head>"
             f"<meta charset=\"utf-8\"><title>{_e(title)}</title>"
@@ -66,6 +74,7 @@ def _page(title: str, body: str, refresh: int = 5) -> str:
             f"<a href=\"/\">dashboard</a><a href=\"/logs\">logs</a>"
             f"<a href=\"/mailbox\">mailbox</a>"
             f"<a href=\"/telemetry\">telemetry</a>"
+            f"<a href=\"/settings\">settings</a>"
             f"<span class=\"meta\">{_e(title)}</span></header>"
             f"<main>{body}</main></body></html>")
 
@@ -148,16 +157,59 @@ def mailbox_page(tasks: list[dict], agents: list[dict],
     return _page("mailbox", body)
 
 
+def settings_page(payload: dict, credentials: list[dict]) -> str:
+    """Read-only standalone settings view (reference /settings route,
+    SecretManagementLive): system settings, profiles, secret NAMES,
+    credential metadata, served model catalog. Mutations stay on the
+    SPA/API — this page is the at-a-glance audit surface."""
+    def kv_table(tid: str, d: dict) -> str:
+        rows = _kv_rows(d)
+        return (f"<table id=\"{_e(tid)}\">{rows}</table>"
+                if rows else "<p class=\"meta\">none</p>")
+
+    profiles = "".join(
+        f"<div class=\"card profile\" data-profile=\"{_e(n)}\">"
+        f"<b>{_e(n)}</b> <span class=\"meta\">{_e(p)}</span></div>"
+        for n, p in sorted((payload.get("profiles") or {}).items()))
+    secrets = "".join(
+        f"<li class=\"secret\">{_e(s.get('name'))} "
+        f"<span class=\"meta\">{_e(s.get('description'))}</span></li>"
+        for s in sorted(payload.get("secrets") or [],
+                        key=lambda s: s.get("name", "")))
+    creds = "".join(
+        f"<tr class=\"credential\"><td>{_e(c.get('id'))}</td>"
+        f"<td>{_e(c.get('model_spec'))}</td>"
+        f"<td>{_e(bool(c.get('encrypted')))}</td></tr>"
+        for c in credentials)
+    body = (
+        "<h2 class=\"meta\">system settings</h2>"
+        + kv_table("settings", payload.get("settings") or {})
+        + "<h2 class=\"meta\">profiles</h2>"
+        + (profiles or "<p class=\"meta\">none</p>")
+        + "<h2 class=\"meta\">secrets (names only — values never leave "
+          "the vault)</h2>"
+        + (f"<ul id=\"secrets\">{secrets}</ul>" if secrets
+           else "<p class=\"meta\">none</p>")
+        + "<h2 class=\"meta\">credentials (metadata only)</h2>"
+        + (f"<table id=\"credentials\"><tr><th>id</th><th>model_spec</th>"
+           f"<th>encrypted</th></tr>{creds}</table>" if creds
+           else "<p class=\"meta\">none</p>")
+        + "<h2 class=\"meta\">served models</h2>"
+        + "<ul id=\"models\">"
+        + "".join(f"<li>{_e(m)}</li>"
+                  for m in payload.get("models") or []) + "</ul>"
+        + f"<p class=\"meta\">default pool: "
+          f"{_e(payload.get('default_pool'))}</p>")
+    return _page("settings", body, refresh=15)
+
+
 def telemetry_page(metrics: dict) -> str:
     """Dev telemetry view (reference LiveDashboard at /dev/dashboard):
     the /api/metrics snapshot as readable tables."""
     def table(title: str, d: dict) -> str:
-        rows = "".join(
-            f"<tr><td class=\"meta\">{_e(k)}</td><td>{_e(v)}</td></tr>"
-            for k, v in sorted(d.items()))
         return (f"<h2 class=\"meta\">{_e(title)}</h2>"
                 f"<table class=\"metrics\" data-section=\"{_e(title)}\">"
-                f"{rows}</table>")
+                f"{_kv_rows(d)}</table>")
     sections = []
     flat = {}
     for key, val in metrics.items():

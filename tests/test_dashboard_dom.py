@@ -213,6 +213,15 @@ def test_grove_task_shows_todos_and_costs_in_dom(tmp_path):
             # ---- /telemetry DOM: metric tables render ----
             tele = dom(await fetch(base + "/telemetry"))
             assert tele.find_all(cls="metrics"), "no metric tables"
+
+            # ---- /settings DOM: read-only audit view ----
+            rt.secrets.put("dom-secret", "never-shown-value")
+            st = dom(await fetch(base + "/settings"))
+            models_list = st.find("ul", **{"id": "models"})
+            assert models_list is not None and models_list.find_all("li")
+            secret_items = st.find_all(cls="secret")
+            assert any("dom-secret" in s.all_text() for s in secret_items)
+            assert "never-shown-value" not in st.all_text()
         finally:
             await server.stop()
             await rt.shutdown()

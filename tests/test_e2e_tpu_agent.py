@@ -83,6 +83,53 @@ def test_agent_decides_and_executes_on_tpu_backend():
     asyncio.run(asyncio.wait_for(main(), 900))
 
 
+def test_agent_decides_over_speculative_backend():
+    """The full production path with speculation ON: agent → consensus →
+    TPUBackend(draft_map) → grammar-constrained SPECULATIVE generate →
+    parser → validator → decision → executed result. tiny drafts for
+    tiny targets (self-geometry, random weights — acceptance is
+    whatever it is; correctness must hold regardless)."""
+    async def main():
+        backend = TPUBackend(["xla:tiny"],
+                             draft_map={"xla:tiny": "xla:tiny"},
+                             draft_k=3)
+        assert backend._spec_decoders
+        deps = AgentDeps.for_tests(backend)
+        sup = AgentSupervisor(deps)
+        base = filter_actions(list(ACTIONS), [], ())
+        config = AgentConfig(
+            agent_id="agent-e2e-spec", task_id="task-spec",
+            model_pool=["xla:tiny"],
+            capability_groups=[],
+            forbidden_actions=tuple(a for a in base if a != "wait"),
+            max_refinement_rounds=2,
+        )
+        core = await sup.start_agent(config)
+        core._system_prompt = (
+            "You are an agent. Respond ONLY with a JSON object "
+            '{"action": "wait", "params": {}}.')
+        core.post({"type": "user_message", "from": "user",
+                   "content": "decide your next action"})
+
+        def decided():
+            h = core.ctx.history("xla:tiny")
+            return any(e.kind == DECISION for e in h) and \
+                any(e.kind == RESULT for e in h)
+        await until(decided)
+
+        history = core.ctx.history("xla:tiny")
+        decision = next(e for e in history if e.kind == DECISION)
+        assert decision.content["action"] == "wait"
+        # the round was actually served SPECULATIVELY: the decoder holds
+        # the agent's session (the engine path would hold it instead)
+        dec = backend._spec_decoders["xla:tiny"]
+        assert dec._sessions, "speculative path was never taken"
+        await sup.terminate_agent("agent-e2e-spec")
+        # teardown clears decoder sessions too
+        assert not any("agent-e2e-spec" in sid for sid in dec._sessions)
+    asyncio.run(asyncio.wait_for(main(), 900))
+
+
 def test_pause_restore_on_tpu_backend(tmp_path):
     """Checkpoint/resume depth on the REAL backend: an agent that decided
     and executed on XLA models pauses, restores into a fresh runtime stack,

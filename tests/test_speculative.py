@@ -245,6 +245,21 @@ def test_backend_draft_map_serves_speculatively(tmp_path):
             f"xla:{tcfg.name}", msgs, temperature=0.0, max_tokens=32,
             constrain_json=True, session_id=session)])[0]
 
+    # draft engines load but are NOT servable pool members: direct
+    # queries error cleanly, and pool-derived surfaces (Runtime
+    # default_pool, metrics) must use .pool, not .engines
+    assert f"xla:{dcfg.name}" in spec.engines
+    assert f"xla:{dcfg.name}" not in spec.pool
+    bad = spec.query([QueryRequest(f"xla:{dcfg.name}",
+                                   msgs1, max_tokens=8)])[0]
+    assert not bad.ok and bad.permanent_error
+    # a prompt with <1 token of room falls through to the baton path's
+    # context_overflow (the decoder's assert must not surface)
+    long_prompt = [{"role": "user", "content": "x " * 3000}]
+    over = spec.query([QueryRequest(f"xla:{tcfg.name}", long_prompt,
+                                    max_tokens=8)])[0]
+    assert not over.ok and "context_overflow" in (over.error or "")
+
     want = ask(vanilla, msgs1)
     got = ask(spec, msgs1)
     assert got.ok and want.ok
