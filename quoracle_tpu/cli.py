@@ -51,6 +51,18 @@ def _attach_printer(rt: Runtime) -> None:
     rt.bus.subscribe(TOPIC_ACTIONS, _print_event)
 
 
+
+
+def _parse_drafts(drafts) -> dict:
+    """--draft TARGET=DRAFT (repeatable) -> draft_map dict."""
+    out = {}
+    for item in drafts or []:
+        target, sep, draft = item.partition("=")
+        if not sep or not target or not draft:
+            raise SystemExit(f"--draft expects TARGET=DRAFT, got {item!r}")
+        out[target] = draft
+    return out
+
 async def cmd_run(args: argparse.Namespace) -> int:
     pool = args.pool.split(",") if args.pool else None
     rt = Runtime(RuntimeConfig(db_path=args.db, backend=args.backend,
@@ -59,7 +71,8 @@ async def cmd_run(args: argparse.Namespace) -> int:
                                image_backend=args.image_backend,
                                coordinator_address=args.coordinator,
                                num_processes=args.num_processes,
-                               process_id=args.process_id))
+                               process_id=args.process_id,
+                               draft_map=_parse_drafts(args.drafts) or None))
     _attach_printer(rt)
     if pool is None and args.profile is None:
         pool = rt.default_pool()
@@ -84,7 +97,8 @@ async def cmd_resume(args: argparse.Namespace) -> int:
                                image_backend=args.image_backend,
                                coordinator_address=args.coordinator,
                                num_processes=args.num_processes,
-                               process_id=args.process_id))
+                               process_id=args.process_id,
+                               draft_map=_parse_drafts(args.drafts) or None))
     _attach_printer(rt)
     result = await rt.boot()
     print(json.dumps(result), flush=True)
@@ -106,7 +120,8 @@ async def cmd_serve(args: argparse.Namespace) -> int:
         image_backend=args.image_backend,
         coordinator_address=args.coordinator,
         num_processes=args.num_processes,
-        process_id=args.process_id))
+        process_id=args.process_id,
+        draft_map=_parse_drafts(args.drafts) or None))
     # Validate host/token BEFORE boot so a refused bind exits with a clean
     # message instead of a traceback over a half-started runtime.
     try:
@@ -161,6 +176,11 @@ def build_parser() -> argparse.ArgumentParser:
                         default="procedural",
                         help="generate_images backend: placeholder PNGs or "
                              "the on-device diffusion model")
+        sp.add_argument("--draft", action="append", dest="drafts",
+                        metavar="TARGET=DRAFT",
+                        help="speculative serving: draft model spec for a "
+                             "pool member, e.g. xla:llama-1b=xla:draft "
+                             "(repeatable; models/speculative.py)")
         sp.add_argument("--coordinator", dest="coordinator", default=None,
                         help="multi-host: coordinator address "
                              "(host:port) to join the JAX distributed "

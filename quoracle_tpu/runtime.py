@@ -58,6 +58,11 @@ class RuntimeConfig:
     # models/diffusion.py — the TPU-native analog of the reference's hosted
     # image models, image_query.ex:1-12).
     image_backend: str = "procedural"
+    # Speculative serving (models/speculative.py): {target_spec:
+    # draft_spec} — eligible member queries draft-K/verify-one-chunk;
+    # drafts load like members but never serve directly. Also settable
+    # via the DB setting "draft_map" (dashboard /api/settings).
+    draft_map: Optional[dict] = None
     # Multi-host: join the JAX distributed system before building the
     # backend (parallel/distributed.init_process). On TPU pods the three
     # values are usually auto-detected — set coordinator_address (and
@@ -120,18 +125,19 @@ class Runtime:
         self.tasks = TaskManager(self.deps, self.store)
         self.store.attach_bus(self.bus)
 
-    @staticmethod
-    def _build_backend(config: RuntimeConfig) -> ModelBackend:
+    def _build_backend(self, config: RuntimeConfig) -> ModelBackend:
+        # instance method: the draft_map fallback reads the DB settings
+        # (self.store is constructed before the backend)
         if config.backend != "tpu":
-            if (config.checkpoints or config.tp
+            if (config.checkpoints or config.tp or config.draft_map
                     or config.coordinator_address or config.num_processes
                     or config.process_id is not None):
                 # Silent fallback to mock would make the user believe their
-                # checkpoint (or cluster) is serving while scripted
-                # responses come back.
+                # checkpoint (or cluster, or speculative draft) is serving
+                # while scripted responses come back.
                 raise ValueError(
-                    "--checkpoint/--tp/--coordinator/--num-processes/"
-                    "--process-id require --backend tpu "
+                    "--checkpoint/--tp/--draft/--coordinator/"
+                    "--num-processes/--process-id require --backend tpu "
                     f"(backend is {config.backend!r})")
             return MockBackend()
         from quoracle_tpu.utils.compile_cache import (
@@ -177,9 +183,16 @@ class Runtime:
             from quoracle_tpu.parallel.mesh import pool_submeshes
             submeshes = pool_submeshes(len(pool), tp=config.tp,
                                        devices=jax.local_devices())
+        draft_map = (config.draft_map
+                     or self.store.get_setting("draft_map"))
+        if draft_map and not isinstance(draft_map, dict):
+            logger.warning("ignoring non-dict draft_map setting %r",
+                           draft_map)
+            draft_map = None
         return TPUBackend(pool, seed=config.seed,
                           embed_model=config.embed_model,
-                          submeshes=submeshes)
+                          submeshes=submeshes,
+                          draft_map=draft_map or None)
 
     async def boot(self) -> dict:
         """Boot-time revival of persisted running tasks (reference
