@@ -285,6 +285,32 @@ def test_backend_draft_map_serves_speculatively(tmp_path):
     spec.close()
 
 
+def test_property_greedy_equality_random_shapes(models, target_engine):
+    """Randomized edge shapes (seeded, not hypothesis — each case costs a
+    device call): prompt lengths down to 1, K from 1 up, max_new down to
+    1, random token ids. Greedy speculation must match vanilla decode on
+    every one — the shapes most likely to break the splice/rollback
+    arithmetic are exactly the tiny ones."""
+    import random
+    rng = random.Random(20260730)
+    spec_by_k = {}
+    for case in range(12):
+        k = rng.choice([1, 2, 3, 5, 8])
+        n_prompt = rng.choice([1, 2, 3, 7, 19, 40])
+        max_new = rng.choice([1, 2, 5, 17, 32])
+        prompt = [rng.randrange(4, TARGET.vocab_size)
+                  for _ in range(n_prompt)]
+        want = target_engine.generate([prompt], temperature=0.0,
+                                      max_new_tokens=max_new)[0]
+        dec = spec_by_k.setdefault(k, make_spec(models, k=k))
+        got = dec.generate(prompt, temperature=0.0,
+                           max_new_tokens=max_new)
+        assert got.token_ids == want.token_ids, (
+            f"case {case}: k={k} n_prompt={n_prompt} max_new={max_new}")
+        assert got.finish_reason == want.finish_reason, (
+            f"case {case}: k={k} n_prompt={n_prompt} max_new={max_new}")
+
+
 def test_vocab_mismatch_rejected(models):
     tp, dp = models
     bad = ModelConfig(name="bad-draft", vocab_size=256, dim=48, n_layers=2,
