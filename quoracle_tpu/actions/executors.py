@@ -28,10 +28,14 @@ import uuid
 from decimal import Decimal
 from typing import Any, Awaitable, Callable, Optional
 
+import logging
+
 from quoracle_tpu.actions.schema import (
     batchable_async_actions, batchable_sync_actions,
 )
 from quoracle_tpu.infra.budget import BudgetError
+
+logger = logging.getLogger(__name__)
 
 Executor = Callable[[Any, Any, dict], Awaitable[dict]]
 
@@ -284,6 +288,10 @@ SPAWN_MAX_RETRIES = 3        # reference spawn.ex:412-433
 SPAWN_RETRY_DELAY_S = 0.2
 
 
+SPAWN_FIELD_SUMMARIZE_TOKENS = 2000   # per-field threshold (reference
+                                      # config_builder pre-summarization)
+
+
 def _compose_initial_message(params: dict) -> str:
     return "\n\n".join(
         f"[{label}]\n{params[key]}" for label, key in (
@@ -292,6 +300,60 @@ def _compose_initial_message(params: dict) -> str:
             ("IMMEDIATE CONTEXT", "immediate_context"),
             ("APPROACH GUIDANCE", "approach_guidance"),
         ))
+
+
+async def _summarize_spawn_fields(core, params: dict) -> dict:
+    """Pre-summarize OVERSIZED spawn fields through the configured
+    summarization model before the child inherits them (reference
+    spawn/config_builder.ex maybe_pre_summarize_entry + the
+    summarization_model setting): a parent that pastes its whole
+    conversation into immediate_context must not start the child at the
+    edge of its window. Failures keep the original text — degraded, never
+    blocking (the reference's fallback-artifact behavior)."""
+    deps = core.deps
+    model = None
+    if deps.persistence is not None:
+        model = deps.persistence.get_setting("summarization_model")
+    model = model or core.config.model_pool[0]
+    out = dict(params)
+    loop = asyncio.get_running_loop()
+    for key in ("task_description", "success_criteria",
+                "immediate_context", "approach_guidance",
+                "global_context"):
+        text = out.get(key)
+        if not isinstance(text, str):
+            continue
+        from quoracle_tpu.models.runtime import QueryRequest
+        try:
+            # count INSIDE the guard: a misconfigured summarization_model
+            # (unknown spec) must degrade, not kill the spawn task
+            n = deps.token_manager.count(model, text)
+            if n <= SPAWN_FIELD_SUMMARIZE_TOKENS:
+                continue
+            res = (await loop.run_in_executor(None, lambda: deps.backend.query([
+                QueryRequest(model, [
+                    {"role": "system",
+                     "content": "Condense the following context for a "
+                                "sub-agent. Keep every concrete fact, "
+                                "path, and constraint; drop narration."},
+                    {"role": "user", "content": text}],
+                    temperature=0.2, max_tokens=1024)])))[0]
+            if res.ok and res.text.strip():
+                out[key] = res.text.strip()
+                if res.usage and res.usage.cost:
+                    from quoracle_tpu.infra.costs import CostEntry
+                    deps.costs.record(CostEntry(
+                        agent_id=core.agent_id,
+                        task_id=core.config.task_id,
+                        amount=Decimal(str(res.usage.cost)),
+                        cost_type="model", model_spec=model,
+                        input_tokens=res.usage.prompt_tokens,
+                        output_tokens=res.usage.completion_tokens,
+                        description=f"spawn field summarization: {key}"))
+        except Exception:             # noqa: BLE001 — degrade, don't block
+            logger.warning("spawn field summarization failed for %s",
+                           key, exc_info=True)
+    return out
 
 
 @register("spawn_child")
@@ -361,71 +423,108 @@ async def spawn_child_action(core, router, params: dict) -> dict:
     inherited = accumulate_constraints(core.config.accumulated_constraints,
                                        core.config.own_constraints)
     inherited += tuple(extra_constraints)
-    fields = child_fields_from_spawn(params)
-    cfg = AgentConfig(
-        agent_id=child_id,
-        task_id=core.config.task_id,
-        parent_id=core.agent_id,
-        model_pool=(resolved.model_pool if resolved else None)
-                    or list(core.config.model_pool),
-        profile=profile,
-        capability_groups=(resolved.capability_groups
-                           if resolved is not None
-                           and resolved.capability_groups is not None
-                           else core.config.capability_groups),
-        forbidden_actions=tuple(sorted(forbidden)),
-        max_refinement_rounds=core.config.max_refinement_rounds,
-        field_system_prompt=compose_field_prompt(fields, inherited),
-        own_constraints=params.get("constraints"),
-        accumulated_constraints=inherited,
-        profile_names=core.config.profile_names,
-        grove_path=core.config.grove_path,
-        grove_node=child_node,
-        governance_docs=governance_docs,
-        active_skills=child_skills,
-        budget_mode="allocated" if allocated is not None else "na",
-        budget_limit=allocated,
-        working_dir=core.config.working_dir,
-    )
-    initial_message = _compose_initial_message(params)
 
-    async def do_spawn() -> None:
-        last_err: Optional[Exception] = None
-        for attempt in range(SPAWN_MAX_RETRIES):
-            # Re-check right before registering: terminate_tree may have
-            # flagged the parent between the sync check above and this task
-            # running (the spawn/dismiss race, reference core.ex:213-220).
-            if registry.dismissing(core.agent_id) \
-                    or registry.lookup(core.agent_id) is None:
-                last_err = RuntimeError("parent dismissed during spawn")
-                break
-            try:
-                child = await deps.supervisor.start_agent(cfg)
-                if registry.dismissing(core.agent_id) \
-                        or registry.lookup(core.agent_id) is None:
-                    # Parent was torn down after tree collection: this child
-                    # escaped the BFS, so reap it here — the subtree must
-                    # not grow during dismissal.
-                    await deps.supervisor.terminate_tree(
-                        child_id, by=core.agent_id, reason="parent dismissed")
-                    last_err = RuntimeError("parent dismissed during spawn")
-                    break
-                # UI learns about the child before any blocking waits
-                # (reference spawn.ex:264-272 broadcast-first ordering).
-                child.post({"type": "user_message",
-                            "content": initial_message,
-                            "from": core.agent_id})
-                core.post({"type": "child_spawned", "child_id": child_id,
-                           "profile": profile})
-                return
-            except Exception as e:                    # noqa: BLE001
-                last_err = e
-                await asyncio.sleep(SPAWN_RETRY_DELAY_S * (attempt + 1))
+    def build_cfg(p: dict) -> AgentConfig:
+        # built from the (possibly summarized) params so an oversized
+        # global_context doesn't reach the child's system prompt verbatim
+        fields = child_fields_from_spawn(p)
+        return AgentConfig(
+            agent_id=child_id,
+            task_id=core.config.task_id,
+            parent_id=core.agent_id,
+            model_pool=(resolved.model_pool if resolved else None)
+                        or list(core.config.model_pool),
+            profile=profile,
+            capability_groups=(resolved.capability_groups
+                               if resolved is not None
+                               and resolved.capability_groups is not None
+                               else core.config.capability_groups),
+            forbidden_actions=tuple(sorted(forbidden)),
+            max_refinement_rounds=core.config.max_refinement_rounds,
+            field_system_prompt=compose_field_prompt(fields, inherited),
+            own_constraints=p.get("constraints"),
+            accumulated_constraints=inherited,
+            profile_names=core.config.profile_names,
+            grove_path=core.config.grove_path,
+            grove_node=child_node,
+            governance_docs=governance_docs,
+            active_skills=child_skills,
+            budget_mode="allocated" if allocated is not None else "na",
+            budget_limit=allocated,
+            working_dir=core.config.working_dir,
+        )
+
+    def _release_escrow() -> None:
         if allocated is not None:
             try:
                 deps.escrow.release_child(child_id)
             except (BudgetError, KeyError):
                 pass
+
+    async def do_spawn() -> None:
+        last_err: Optional[Exception] = None
+        try:
+            # dismissing check FIRST: no paid summarization call for a
+            # child that will never spawn (the spawn/dismiss race,
+            # reference core.ex:213-220)
+            if registry.dismissing(core.agent_id) \
+                    or registry.lookup(core.agent_id) is None:
+                last_err = RuntimeError("parent dismissed during spawn")
+            else:
+                try:
+                    # oversized fields summarize INSIDE the background
+                    # task — an LLM call must not delay the spawn
+                    # action's immediate return
+                    sum_params = await _summarize_spawn_fields(core,
+                                                               params)
+                except Exception:     # noqa: BLE001 — degrade, never block
+                    logger.warning("spawn field summarization failed",
+                                   exc_info=True)
+                    sum_params = params
+                cfg = build_cfg(sum_params)
+                initial_message = _compose_initial_message(sum_params)
+                for attempt in range(SPAWN_MAX_RETRIES):
+                    # Re-check right before registering: terminate_tree
+                    # may have flagged the parent while this task ran.
+                    if registry.dismissing(core.agent_id) \
+                            or registry.lookup(core.agent_id) is None:
+                        last_err = RuntimeError(
+                            "parent dismissed during spawn")
+                        break
+                    try:
+                        child = await deps.supervisor.start_agent(cfg)
+                        if registry.dismissing(core.agent_id) \
+                                or registry.lookup(core.agent_id) is None:
+                            # Parent was torn down after tree collection:
+                            # this child escaped the BFS, so reap it here
+                            # — the subtree must not grow during
+                            # dismissal.
+                            await deps.supervisor.terminate_tree(
+                                child_id, by=core.agent_id,
+                                reason="parent dismissed")
+                            last_err = RuntimeError(
+                                "parent dismissed during spawn")
+                            break
+                        # UI learns about the child before any blocking
+                        # waits (reference spawn.ex:264-272).
+                        child.post({"type": "user_message",
+                                    "content": initial_message,
+                                    "from": core.agent_id})
+                        core.post({"type": "child_spawned",
+                                   "child_id": child_id,
+                                   "profile": profile})
+                        return
+                    except Exception as e:            # noqa: BLE001
+                        last_err = e
+                        await asyncio.sleep(
+                            SPAWN_RETRY_DELAY_S * (attempt + 1))
+        except asyncio.CancelledError:
+            # core teardown cancels background tasks — the escrow must
+            # not stay committed to a child that never spawned (the
+            # summarization call widened this window to seconds)
+            _release_escrow()
+            raise
+        _release_escrow()
         core.post({"type": "spawn_failed", "child_id": child_id,
                    "reason": f"{type(last_err).__name__}: {last_err}"})
 

@@ -351,6 +351,43 @@ def test_spawn_child_message_dismiss_flow():
     run(main())
 
 
+def test_spawn_oversized_field_is_presummarized():
+    """An immediate_context past the per-field token threshold is
+    condensed through the summarization model BEFORE the child inherits
+    it (reference spawn/config_builder.ex pre-summarization); failures
+    would degrade to the original text, success replaces it."""
+    async def main():
+        blob = "conversation history line. " * 1600   # ≫ 2000 mock tokens
+        child_msgs: list = []
+
+        def respond(r):
+            joined = "\n".join(str(m.get("content", ""))
+                               for m in r.messages)
+            if "Condense the following context" in joined:
+                return "SUMMARY-MARK: child must fix the parser."
+            if "spawn-the-child" in joined and "child_spawned" not in joined:
+                return j("spawn_child", spawn_params(
+                    task_description="fix it",
+                    immediate_context=blob,
+                    approach_guidance="carefully"))
+            if "[IMMEDIATE CONTEXT]" in joined:       # the child's view
+                child_msgs.append(joined)
+            return j("wait", {})
+
+        backend = MockBackend(respond=respond)
+        deps, sup = make_env(backend)
+        core = await sup.start_agent(root_config())
+        core.post({"type": "user_message", "content": "spawn-the-child",
+                   "from": "user"})
+        await until(lambda: child_msgs, timeout=15)
+        assert "SUMMARY-MARK" in child_msgs[0]
+        assert blob not in child_msgs[0]
+        # the short fields were left verbatim
+        assert "[APPROACH GUIDANCE]\ncarefully" in child_msgs[0]
+        await sup.terminate_agent("agent-root")
+    run(main())
+
+
 def test_spawn_requires_budget_when_parent_budgeted():
     async def main():
         backend = scripted(
