@@ -315,29 +315,27 @@ async def _summarize_spawn_fields(core, params: dict) -> dict:
     if deps.persistence is not None:
         model = deps.persistence.get_setting("summarization_model")
     model = model or core.config.model_pool[0]
+    from quoracle_tpu.models.runtime import QueryRequest
     out = dict(params)
     loop = asyncio.get_running_loop()
-    for key in ("task_description", "success_criteria",
-                "immediate_context", "approach_guidance",
-                "global_context"):
-        text = out.get(key)
-        if not isinstance(text, str):
-            continue
-        from quoracle_tpu.models.runtime import QueryRequest
+
+    async def summarize_one(key: str, text: str) -> None:
         try:
             # count INSIDE the guard: a misconfigured summarization_model
             # (unknown spec) must degrade, not kill the spawn task
             n = deps.token_manager.count(model, text)
             if n <= SPAWN_FIELD_SUMMARIZE_TOKENS:
-                continue
-            res = (await loop.run_in_executor(None, lambda: deps.backend.query([
-                QueryRequest(model, [
-                    {"role": "system",
-                     "content": "Condense the following context for a "
-                                "sub-agent. Keep every concrete fact, "
-                                "path, and constraint; drop narration."},
-                    {"role": "user", "content": text}],
-                    temperature=0.2, max_tokens=1024)])))[0]
+                return
+            res = (await loop.run_in_executor(
+                None, lambda: deps.backend.query([
+                    QueryRequest(model, [
+                        {"role": "system",
+                         "content": "Condense the following context for a "
+                                    "sub-agent. Keep every concrete fact, "
+                                    "path, and constraint; drop "
+                                    "narration."},
+                        {"role": "user", "content": text}],
+                        temperature=0.2, max_tokens=1024)])))[0]
             if res.ok and res.text.strip():
                 out[key] = res.text.strip()
                 if res.usage and res.usage.cost:
@@ -353,6 +351,15 @@ async def _summarize_spawn_fields(core, params: dict) -> dict:
         except Exception:             # noqa: BLE001 — degrade, don't block
             logger.warning("spawn field summarization failed for %s",
                            key, exc_info=True)
+
+    # concurrent: the spawn waits for the SLOWEST oversized field, not
+    # the sum (the backend's batcher may even coalesce the queries)
+    await asyncio.gather(*(
+        summarize_one(key, out[key])
+        for key in ("task_description", "success_criteria",
+                    "immediate_context", "approach_guidance",
+                    "global_context")
+        if isinstance(out.get(key), str)))
     return out
 
 

@@ -105,7 +105,29 @@ class AgentCore:
         self._wait_token = 0
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._system_prompt: Optional[str] = None
-        self._reflect_fn = make_reflect_fn(deps.backend)
+        def _reflection_cost(model_spec, usage):
+            # budgeted agents must see reflection + pre-summarization
+            # spend (the reference routes condensation costs through the
+            # same recorder as consensus queries)
+            if not usage.cost:
+                return
+            from decimal import Decimal
+
+            from quoracle_tpu.infra.costs import CostEntry
+            deps.costs.record(CostEntry(
+                agent_id=self.agent_id, task_id=config.task_id,
+                amount=Decimal(str(usage.cost)), cost_type="model",
+                model_spec=model_spec,
+                input_tokens=usage.prompt_tokens,
+                output_tokens=usage.completion_tokens,
+                description="condensation reflection"))
+
+        self._reflect_fn = make_reflect_fn(
+            deps.backend,
+            summarization_model_fn=(
+                (lambda: deps.persistence.get_setting("summarization_model"))
+                if deps.persistence is not None else None),
+            cost_fn=_reflection_cost)
 
         # Grove enforcement: explicit override (tests) or resolved from the
         # manifest path this agent was spawned with.

@@ -36,8 +36,24 @@ logger = logging.getLogger(__name__)
 ReflectFn = Callable[[str, list[HistoryEntry]], Reflection]
 
 
-def make_reflect_fn(backend) -> ReflectFn:
-    return lambda model_spec, entries: reflect(backend, model_spec, entries)
+def make_reflect_fn(backend, summarization_model_fn=None,
+                    cost_fn=None) -> ReflectFn:
+    """``summarization_model_fn`` resolves the configured summarization
+    model LAZILY per reflection (the DB setting can change at runtime) —
+    guarded: a transient DB error must degrade to the default model, not
+    break reflect()'s never-raises progress guarantee. ``cost_fn(model,
+    usage)`` records reflection + pre-summarization spend."""
+    def fn(model_spec, entries):
+        sm = None
+        if summarization_model_fn is not None:
+            try:
+                sm = summarization_model_fn()
+            except Exception:         # noqa: BLE001 — settings read only
+                logger.warning("summarization_model lookup failed",
+                               exc_info=True)
+        return reflect(backend, model_spec, entries,
+                       summarization_model=sm, cost_fn=cost_fn)
+    return fn
 
 
 @dataclasses.dataclass

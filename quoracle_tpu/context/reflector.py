@@ -86,14 +86,95 @@ def _parse(raw: str) -> Optional[Reflection]:
     return Reflection(lessons=lessons, state=state, summary_text=summary)
 
 
+def _truncate_to_budget(backend: ModelBackend, count_spec: str,
+                        text: str, budget: int) -> str:
+    """Keep the newest tail, RE-COUNTED against the token budget —
+    char-based keeps alone overflow on token-dense text (CJK, emoji)."""
+    keep = max(1000, budget * 3)              # optimistic chars-per-token
+    t = "[earlier history truncated for reflection]\n" + text[-keep:]
+    while backend.count_tokens(count_spec, t) > budget and keep > 500:
+        keep //= 2
+        t = "[earlier history truncated for reflection]\n" + text[-keep:]
+    return t
+
+
+def _shrink_history(backend: ModelBackend, sum_model: str,
+                    count_spec: str, text: str, budget: int,
+                    depth: int = 0,
+                    state: Optional[dict] = None,
+                    cost_fn=None) -> str:
+    """Pre-summarize an over-budget reflection input (reference
+    condensation.ex maybe_pre_summarize_entry → recursive_summarize): a
+    single giant entry — a pasted log, a huge shell result — must not
+    make the reflection query itself overflow. Recursive halving through
+    the summarization model, depth-capped. The FIRST summarizer failure
+    marks the model dead for the rest of this shrink (``state``): a down
+    endpoint must not absorb an exponential cascade of doomed calls in
+    the consensus worker — everything after degrades to token-counted
+    tail truncation. Never raises."""
+    state = state if state is not None else {"dead": False}
+    if backend.count_tokens(count_spec, text) <= budget:
+        return text
+    if depth >= 3 or state["dead"]:
+        return _truncate_to_budget(backend, count_spec, text, budget)
+    cut = text.rfind("\n", 0, len(text) // 2)
+    cut = cut if cut > 0 else len(text) // 2
+    halves = (text[:cut], text[cut:])
+    out = []
+    for half in halves:
+        piece = None
+        if not state["dead"]:
+            try:
+                r = backend.query([QueryRequest(
+                    model_spec=sum_model, messages=[
+                        {"role": "system",
+                         "content": "Condense this conversation excerpt. "
+                                    "Keep every concrete fact, decision, "
+                                    "and constraint; drop narration."},
+                        {"role": "user", "content": half}],
+                    temperature=0.2, max_tokens=1024)])[0]
+                if r.ok and r.text.strip():
+                    piece = r.text.strip()
+                    if cost_fn is not None and r.usage:
+                        cost_fn(sum_model, r.usage)
+                else:
+                    state["dead"] = True
+                    logger.warning(
+                        "reflection pre-summarization failed (%s); "
+                        "degrading to truncation", r.error)
+            except Exception:                 # noqa: BLE001 — degrade
+                state["dead"] = True
+                logger.warning("reflection pre-summarization failed",
+                               exc_info=True)
+        if piece is None:
+            piece = _truncate_to_budget(backend, count_spec, half,
+                                        budget // 2)
+        out.append(piece)
+    return _shrink_history(backend, sum_model, count_spec,
+                           "\n\n".join(out), budget, depth + 1,
+                           state=state, cost_fn=cost_fn)
+
+
 def reflect(backend: ModelBackend, model_spec: str,
             entries: Sequence[HistoryEntry],
-            max_retries: int = MAX_RETRIES) -> Reflection:
+            max_retries: int = MAX_RETRIES,
+            summarization_model: Optional[str] = None,
+            cost_fn=None) -> Reflection:
     """Run reflection over the entries being condensed. Never raises: on
     persistent malformed output returns an empty Reflection with a generic
     summary so condensation still makes progress (the reference's progress
-    guarantee, agent AGENTS.md:19)."""
+    guarantee, agent AGENTS.md:19). Inputs past half the model's window
+    pre-summarize through ``summarization_model`` (reference
+    condensation.ex pre-summarization; default: the reflecting model).
+    ``cost_fn(model_spec, usage)`` records every paid query — the
+    reflection itself and any pre-summarization — into the caller's cost
+    pipeline (budgeted agents must see this spend)."""
     history_text = _render_history(entries)
+    budget = max(2048, backend.context_window(model_spec) // 2)
+    if backend.count_tokens(model_spec, history_text) > budget:
+        history_text = _shrink_history(
+            backend, summarization_model or model_spec, model_spec,
+            history_text, budget, cost_fn=cost_fn)
     messages = [
         {"role": "system", "content": REFLECTION_SYSTEM_PROMPT},
         {"role": "user", "content":
@@ -110,6 +191,8 @@ def reflect(backend: ModelBackend, model_spec: str,
             model_spec=model_spec, messages=messages, temperature=0.3,
             max_tokens=REFLECTION_MAX_OUTPUT_TOKENS)])
         res = results[0]
+        if res.ok and cost_fn is not None and res.usage:
+            cost_fn(model_spec, res.usage)
         if not res.ok:
             last_error = f"query failed: {res.error}"
             logger.warning("reflection query failed for %s: %s", model_spec, res.error)

@@ -228,6 +228,63 @@ def test_reflector_retries_then_gives_up():
     assert r.lessons == [] and "reflection unavailable" in r.summary_text
 
 
+def test_reflector_presummarizes_oversized_history():
+    """A giant entry (pasted log) pre-summarizes through the
+    summarization model BEFORE the reflection query (reference
+    condensation.ex maybe_pre_summarize_entry) — the reflection prompt
+    must carry the condensed text, not overflow."""
+    from quoracle_tpu.context.reflector import reflect
+    from quoracle_tpu.models.runtime import MockBackend
+    good = ('{"lessons": [{"type": "factual", "content": "l"}], '
+            '"state": [{"summary": "fine"}]}')
+    seen = {"condense": 0, "reflect_prompts": []}
+
+    def respond(r):
+        joined = "\n".join(str(m.get("content", "")) for m in r.messages)
+        if "Condense this conversation excerpt" in joined:
+            seen["condense"] += 1
+            assert r.model_spec == "mock:summarizer"
+            return "CONDENSED-PIECE"
+        seen["reflect_prompts"].append(joined)
+        return good
+
+    backend = MockBackend(respond=respond,
+                          context_window_tokens=4096)   # budget 2048
+    blob = "log line with details. " * 3000             # ≫ 2048 tokens
+    r = reflect(backend, "mock:m", [HistoryEntry(USER, blob)],
+                summarization_model="mock:summarizer")
+    assert r.state == ["fine"]
+    assert seen["condense"] >= 2                        # both halves
+    assert "CONDENSED-PIECE" in seen["reflect_prompts"][0]
+    assert blob not in seen["reflect_prompts"][0]
+    # small histories skip the pre-summarization entirely
+    seen["condense"] = 0
+    reflect(backend, "mock:m", [HistoryEntry(USER, "short")],
+            summarization_model="mock:summarizer")
+    assert seen["condense"] == 0
+
+
+def test_reflector_presummarize_failure_degrades_to_truncation():
+    from quoracle_tpu.context.reflector import reflect
+    from quoracle_tpu.models.runtime import MockBackend
+    good = '{"lessons": [], "state": [{"summary": "ok"}]}'
+    prompts = []
+
+    def respond(r):
+        joined = "\n".join(str(m.get("content", "")) for m in r.messages)
+        if "Condense this conversation excerpt" in joined:
+            return "__error__"                          # summarizer dead
+        prompts.append(joined)
+        return good
+
+    backend = MockBackend(respond=respond, context_window_tokens=4096)
+    blob = "x" * 200_000
+    r = reflect(backend, "mock:m", [HistoryEntry(USER, blob)])
+    assert r.state == ["ok"]                            # still reflected
+    assert "truncated for reflection" in prompts[0]
+    assert len(prompts[0]) < len(blob)
+
+
 def test_lesson_prune_ties_keep_newest():
     import numpy as np
     from quoracle_tpu.context.history import Lesson
