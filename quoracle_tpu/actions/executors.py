@@ -326,6 +326,15 @@ async def _summarize_spawn_fields(core, params: dict) -> dict:
             n = deps.token_manager.count(model, text)
             if n <= SPAWN_FIELD_SUMMARIZE_TOKENS:
                 return
+            # the summarizer's own window bounds one query: clamp the
+            # input to its newest tail rather than sending an overflow
+            # the degrade-guard would swallow (leaving the child with the
+            # full oversized field — the outcome this function prevents)
+            cap = max(1024, deps.backend.context_window(model) - 1200)
+            while deps.token_manager.count(model, text) > cap \
+                    and len(text) > 2000:
+                text = "[earlier context truncated]\n" \
+                    + text[-(len(text) * 2 // 3):]
             res = (await loop.run_in_executor(
                 None, lambda: deps.backend.query([
                     QueryRequest(model, [

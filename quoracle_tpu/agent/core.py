@@ -111,9 +111,6 @@ class AgentCore:
             # same recorder as consensus queries)
             if not usage.cost:
                 return
-            from decimal import Decimal
-
-            from quoracle_tpu.infra.costs import CostEntry
             deps.costs.record(CostEntry(
                 agent_id=self.agent_id, task_id=config.task_id,
                 amount=Decimal(str(usage.cost)), cost_type="model",

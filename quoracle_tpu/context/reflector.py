@@ -115,15 +115,28 @@ def _shrink_history(backend: ModelBackend, sum_model: str,
     state = state if state is not None else {"dead": False}
     if backend.count_tokens(count_spec, text) <= budget:
         return text
-    if depth >= 3 or state["dead"]:
+    if depth >= 4 or state["dead"]:
         return _truncate_to_budget(backend, count_spec, text, budget)
+    # the SUMMARIZER'S window bounds what one query can take — a half
+    # sized by the reflecting model's budget can dwarf a small
+    # summarization model; such halves split further BEFORE querying
+    # instead of burning a doomed overflow call
+    try:
+        sum_cap = max(1024, backend.context_window(sum_model) - 1200)
+    except Exception:                 # noqa: BLE001 — unknown spec
+        sum_cap = budget
     cut = text.rfind("\n", 0, len(text) // 2)
     cut = cut if cut > 0 else len(text) // 2
     halves = (text[:cut], text[cut:])
     out = []
     for half in halves:
         piece = None
-        if not state["dead"]:
+        if (not state["dead"]
+                and backend.count_tokens(count_spec, half) > sum_cap):
+            piece = _shrink_history(backend, sum_model, count_spec, half,
+                                    budget // 2, depth + 1, state=state,
+                                    cost_fn=cost_fn)
+        elif not state["dead"]:
             try:
                 r = backend.query([QueryRequest(
                     model_spec=sum_model, messages=[
