@@ -357,6 +357,10 @@ class _Session:
     pages: list[int]
     start_pos: int = 0
     last_used: float = 0.0
+    # synthetic donor-prefix marker (cross-session prefix sharing): the
+    # pages belong to ANOTHER session; _run_paged refcount-acquires them
+    # before using them as this row's dst prefix
+    shared_prefix: bool = False
 
     @property
     def resident_len(self) -> int:
@@ -380,6 +384,10 @@ class SessionStore:
         self.lock = threading.RLock()
         self._sessions: dict[str, _Session] = {}
         self._free: list[int] = list(range(self.n_pages - 1, 0, -1))
+        # Page refcounts (cross-session PREFIX SHARING): a page referenced
+        # by several sessions frees only when the last reference releases.
+        # Absent key = 1 (every allocated page starts singly-owned).
+        self._refs: dict[int, int] = {}
         # device pool arrays live on the engine (self.k/self.v set there);
         # the store only manages ids.
         self.k: Optional[jax.Array] = None
@@ -420,11 +428,57 @@ class SessionStore:
             return [self._free.pop() for _ in range(n)]
 
     def _release(self, pages: list[int]) -> None:
-        self._free.extend(p for p in pages if p != 0)
+        for p in pages:
+            if p == 0:
+                continue
+            c = self._refs.get(p, 1) - 1
+            if c <= 0:
+                self._refs.pop(p, None)
+                self._free.append(p)
+            else:
+                self._refs[p] = c
 
     def release(self, pages: list[int]) -> None:
         with self.lock:
             self._release(pages)
+
+    def acquire(self, pages: list[int]) -> None:
+        """Add a reference to already-allocated pages (prefix sharing:
+        an adopter holds the donor's prefix pages alive past the donor's
+        own drop/eviction)."""
+        with self.lock:
+            for p in pages:
+                if p != 0:
+                    self._refs[p] = self._refs.get(p, 1) + 1
+
+    def find_prefix_donor(self, tokens: Sequence[int],
+                          max_reuse: int) -> Optional["_Session"]:
+        """Cross-session prefix sharing (SURVEY §7 hard part 2's "system
+        prompt cache", the vLLM automatic-prefix-caching analog): find
+        the resident session with the longest PAGE-ALIGNED common token
+        prefix — agents of one config share their system prompt
+        verbatim, so a freshly spawned agent's first prefill can adopt
+        those pages read-only instead of recomputing them. Alignment is
+        a correctness requirement: the boundary page is partially filled
+        by the donor, and the adopter's own suffix must never write into
+        a shared page. Returns a synthetic marker session (donor's
+        prefix tokens + page ids, shared_prefix=True) or None."""
+        with self.lock:
+            best: Optional[_Session] = None
+            best_len = 0
+            for s in self._sessions.values():
+                if s.start_pos != 0:
+                    continue            # trimmed windows don't compose
+                l = min(_lcp(s.tokens, tokens), max_reuse)
+                aligned = (l // self.page) * self.page
+                if aligned >= self.page and aligned > best_len:
+                    best, best_len = s, aligned
+            if best is None:
+                return None
+            npg = best_len // self.page
+            return _Session(tokens=list(best.tokens[:best_len]),
+                            pages=list(best.pages[:npg]),
+                            start_pos=0, shared_prefix=True)
 
     def put(self, key: str, sess: _Session) -> None:
         """Replace a session, releasing any of the old session's pages the
@@ -605,6 +659,10 @@ class GenerateEngine:
         # The paged steps donate the pool buffers; calls that touch the pool
         # must serialize (concurrent members use separate engines).
         self._paged_lock = threading.Lock()
+        # Cross-session prefix sharing (SessionStore.find_prefix_donor):
+        # ON by default for full-attention models; the windowed check
+        # lives at the adoption site. Tests flip it off to compare.
+        self.prefix_sharing = True
         # Grammar-table cache has its OWN lock so sessionless calls (image
         # rows, models/runtime.py) can run concurrently with the continuous
         # batcher's sessioned chunks without serializing on _paged_lock —
@@ -1081,6 +1139,26 @@ class GenerateEngine:
                 paged = True
                 s = self.sessions.get(sid)
                 if s is None:
+                    # Cross-session prefix sharing: a NEW session whose
+                    # prompt starts with another resident session's
+                    # page-aligned prefix (same system prompt across the
+                    # tree's agents) adopts those pages read-only —
+                    # _run_paged refcount-acquires them and uses them as
+                    # this row's dst prefix, so only the suffix prefills.
+                    if (self.prefix_sharing
+                            and self.cfg.sliding_window is None
+                            # VLM engines: identical placeholder token
+                            # ids can front DIFFERENT images — adopting
+                            # another session's prefix KV would condition
+                            # on the wrong image (the digest-keyed
+                            # session safeguard, models/runtime.py)
+                            and self.cfg.vision is None):
+                        d = self.sessions.find_prefix_donor(
+                            prompts[i], len(prompts[i]) - 1)
+                        if d is not None:
+                            sess_rows[i] = d
+                            reuse_abs[i] = len(d.tokens)
+                            kv_off_host[i] = 0
                     continue
                 # ≥1 suffix token must run to produce last-position logits
                 p = min(_lcp(s.tokens, prompts[i]), len(prompts[i]) - 1)
@@ -1307,7 +1385,19 @@ class GenerateEngine:
                       and not getattr(self, "_force_gather_decode", False)
                       and max(len(p) for p in prompts)
                       >= self.direct_decode_min_tokens)
+        adopted_release: list[list[int]] = [[] for _ in range(n)]
+        partial_swap = [False]      # a swapped boundary page forces the
+                                    # gather prefill (see below)
         with st.lock:   # one allocation transaction for the batch
+            # Refcount-acquire every adopted donor prefix FIRST: an alloc
+            # below may LRU-evict the donor mid-transaction, and the
+            # adopted pages must survive until this call's steps have
+            # consumed (or stored) them.
+            for i in range(n):
+                s = sess_rows[i]
+                if s is not None and s.shared_prefix:
+                    st.acquire(s.pages)
+                    adopted_release[i] = list(s.pages)
             for i in range(n):
                 s = sess_rows[i]
                 if s is not None:
@@ -1323,22 +1413,67 @@ class GenerateEngine:
                 # put_raw replacing the session must not leak them.
                 stored = st._sessions.get(store_sids[i])
                 old = list(stored.pages) if stored is not None else []
+                if (stored is None and s is not None and s.shared_prefix):
+                    # adopted prefix pages become this row's dst prefix:
+                    # the scatter rewrites them with byte-identical values
+                    # (the gathered prefix), and the stored session then
+                    # OWNS the reference acquired above
+                    old = list(s.pages)
+                    adopted_release[i] = []
                 # resident pages past the table width can't be rewritten
                 # this call: release them after the batch runs
                 spills[i], old = old[maxp:], old[:maxp]
+                # SHARED pages are writable only inside the row's
+                # identical-prefix region (the scatter rewrites that part
+                # with the gathered, byte-identical values). A shared page
+                # past it — a diverged/condensed conversation whose prefix
+                # shrank below a page some adopter still reads — would be
+                # rewritten with DIFFERENT values (the gather-path scatter
+                # writes EVERY dst slot): swap ones this call needs for
+                # fresh pages, and drop ones past ``need`` from dst
+                # entirely (they would only be garbage-scattered and then
+                # released at store-back).
+                pre_buf = reuse_abs[i] - kv_off_host[i]
+                safe_full = pre_buf // page
                 need_tokens = min(
-                    (reuse_abs[i] - kv_off_host[i]) + len(suffixes[i])
-                    + int(limits[i]), maxp * page)
+                    pre_buf + len(suffixes[i]) + int(limits[i]),
+                    maxp * page)
                 need = -(-need_tokens // page)
-                if len(old) < need:
-                    extra = st.alloc(need - len(old), protect=protect)
+                tail_shared = [pg for j, pg in enumerate(old)
+                               if j >= need and st._refs.get(pg, 1) > 1]
+                if tail_shared:
+                    old = [pg for j, pg in enumerate(old)
+                           if not (j >= need
+                                   and st._refs.get(pg, 1) > 1)]
+                shared_beyond = [j for j, pg in enumerate(old)
+                                 if safe_full <= j < need
+                                 and st._refs.get(pg, 1) > 1]
+                # Swapping the PARTIALLY-reused boundary page leaves a
+                # dst hole the direct-prefill path would never fill (it
+                # writes only chunk positions >= pre_buf; the gather
+                # scatter covers everything) — force the gather prefill
+                # for this batch when that happens.
+                if any(j == safe_full and pre_buf % page
+                       for j in shared_beyond):
+                    partial_swap[0] = True
+                n_extra = max(0, need - len(old)) + len(shared_beyond)
+                if n_extra:
+                    extra = st.alloc(n_extra, protect=protect)
                     if extra is None:
                         # pool exhausted even after eviction: serve the
-                        # row without storing (old session stays valid)
+                        # row without storing (old session stays valid).
+                        # An adopted prefix reverts to read-only use: its
+                        # reference releases after the steps run.
                         store_sids[i] = None
                         spills[i] = []
+                        if s is not None and s.shared_prefix:
+                            adopted_release[i] = list(s.pages)
                         continue
+                    for j in shared_beyond:
+                        st._release([old[j]])   # our ref; adopters keep
+                        old[j] = extra.pop()
                     old = old + extra
+                st._release(tail_shared)        # our refs; adopters keep
                 dst_lists[i] = old
                 dst[i, :len(old)] = old
             if use_direct:
@@ -1385,7 +1520,10 @@ class GenerateEngine:
             # TEMP pages — its prefix would never reach dst; gather
             # handles that batch instead.
             and all(sess_rows[i] is None or dst_lists[i] is not None
-                    for i in range(n)))
+                    for i in range(n))
+            # a swapped shared BOUNDARY page left a dst hole only the
+            # full gather scatter fills (prefix sharing divergence)
+            and not partial_swap[0])
 
         if use_direct_pre:
             n_tok = st.n_pages * page
@@ -1488,6 +1626,12 @@ class GenerateEngine:
         for tmp in temp_lists:
             if tmp:
                 st.release(tmp)
+        # adopted-prefix references that no stored session took over
+        # (read-only adoption, or a declined store) release now — the
+        # steps above have consumed the pages
+        for pages in adopted_release:
+            if pages:
+                st.release(pages)
         return out, n_emitted, jstate_f, t_prefill, now
 
     def _json_table_device(self, enum_set: tuple):

@@ -340,3 +340,149 @@ def test_drop_session_frees_engine_state():
     assert len(backend.engines["xla:tiny"].sessions) == 1
     backend.drop_session("gone")
     assert len(backend.engines["xla:tiny"].sessions) == 0
+
+
+# ---------------------------------------------------------------------------
+# Cross-session prefix sharing (SURVEY §7 hard part 2: system-prompt cache)
+# ---------------------------------------------------------------------------
+
+SHARED_SYS = "system: " + "policy rules apply here. " * 7   # > 1 page
+
+
+def test_cross_session_prefix_sharing_token_exact():
+    """A NEW session whose prompt starts with another session's
+    page-aligned prefix adopts those pages: the first prefill skips the
+    shared system prompt, and greedy output is identical to a
+    sharing-disabled engine."""
+    eng = make_engine()
+    plain = make_engine()
+    plain.prefix_sharing = False
+    pa = enc(SHARED_SYS + "user: task alpha")
+    pb = enc(SHARED_SYS + "user: task beta")
+    ra = eng.generate([pa], temperature=0.0, max_new_tokens=10,
+                      session_ids=["a"])
+    assert ra[0].n_cached_tokens == 0           # first agent: no donor
+    rb = eng.generate([pb], temperature=0.0, max_new_tokens=10,
+                      session_ids=["b"])
+    assert rb[0].n_cached_tokens >= 128, \
+        "adoption did not reuse the page-aligned shared prefix"
+    want = plain.generate([pb], temperature=0.0, max_new_tokens=10,
+                          session_ids=["b2"])
+    assert rb[0].token_ids == want[0].token_ids, \
+        "prefix-shared decode diverged from the sharing-disabled engine"
+
+
+def test_prefix_sharing_survives_donor_drop_and_frees_pages():
+    """Refcounts: dropping the DONOR must not free pages an adopter still
+    reads; dropping everyone returns the pool to baseline."""
+    eng = make_engine()
+    plain = make_engine()
+    plain.prefix_sharing = False
+    baseline = eng.sessions.free_pages()
+    pa = enc(SHARED_SYS + "user: task alpha")
+    pb = enc(SHARED_SYS + "user: task beta")
+    eng.generate([pa], temperature=0.0, max_new_tokens=8,
+                 session_ids=["a"])
+    rb = eng.generate([pb], temperature=0.0, max_new_tokens=8,
+                      session_ids=["b"])
+    assert rb[0].n_cached_tokens >= 128
+    eng.drop_session("a")                        # donor gone, pages shared
+    # the adopter continues its conversation on the adopted prefix
+    pb2 = pb + rb[0].token_ids + enc(" more")[1:]
+    rb2 = eng.generate([pb2], temperature=0.0, max_new_tokens=8,
+                       session_ids=["b"])
+    want = plain.generate([pb], temperature=0.0, max_new_tokens=8,
+                          session_ids=["w"])
+    pw2 = pb + want[0].token_ids + enc(" more")[1:]
+    want2 = plain.generate([pw2], temperature=0.0, max_new_tokens=8,
+                           session_ids=["w"])
+    assert rb2[0].token_ids == want2[0].token_ids
+    eng.drop_session("b")
+    assert eng.sessions.free_pages() == baseline, \
+        "shared pages leaked or double-freed"
+
+
+def test_prefix_sharing_donor_divergence_does_not_corrupt_adopter():
+    """A donor whose conversation diverges (condensation) rewrites its
+    dst pages — shared pages beyond the identical-prefix region must be
+    swapped for fresh ones so the adopter's KV stays intact."""
+    eng = make_engine()
+    plain = make_engine()
+    plain.prefix_sharing = False
+    pa = enc(SHARED_SYS + "user: task alpha")
+    pb = enc(SHARED_SYS + "user: task beta")
+    eng.generate([pa], temperature=0.0, max_new_tokens=8,
+                 session_ids=["a"])
+    rb = eng.generate([pb], temperature=0.0, max_new_tokens=8,
+                      session_ids=["b"])
+    assert rb[0].n_cached_tokens >= 128
+    # donor DIVERGES: same session id, totally different prompt (its old
+    # pages become dst for different content)
+    eng.generate([enc("user: condensed fresh start after reflection")],
+                 temperature=0.0, max_new_tokens=8, session_ids=["a"])
+    # the adopter's next round must still read CORRECT prefix KV
+    pb2 = pb + rb[0].token_ids + enc(" go on")[1:]
+    rb2 = eng.generate([pb2], temperature=0.0, max_new_tokens=8,
+                       session_ids=["b"])
+    want = plain.generate([pb], temperature=0.0, max_new_tokens=8,
+                          session_ids=["w"])
+    pw2 = pb + want[0].token_ids + enc(" go on")[1:]
+    want2 = plain.generate([pw2], temperature=0.0, max_new_tokens=8,
+                           session_ids=["w"])
+    assert rb2[0].token_ids == want2[0].token_ids, \
+        "donor divergence corrupted the adopter's shared prefix"
+
+
+def test_prefix_sharing_divergence_under_direct_paths():
+    """Prefix sharing + FORCED direct paged prefill/decode + donor
+    divergence at a non-page-aligned reuse point: the swapped boundary
+    page leaves a dst hole only the gather scatter fills, so the batch
+    must fall back to gather prefill — output stays token-exact with a
+    sharing-disabled gather engine, and the donor's NEXT round (reading
+    its stored pages) stays intact too."""
+    def forced(eng):
+        eng.direct_decode_min_tokens = 0
+        eng.direct_prefill_min_tokens = 0
+        return eng
+
+    eng = forced(make_engine())
+    plain = make_engine()
+    plain.prefix_sharing = False
+    plain._force_gather_decode = True
+
+    pa = enc(SHARED_SYS + "user: task alpha")
+    pb = enc(SHARED_SYS + "user: task beta")
+    ra = eng.generate([pa], temperature=0.0, max_new_tokens=8,
+                      session_ids=["a"])
+    rb = eng.generate([pb], temperature=0.0, max_new_tokens=8,
+                      session_ids=["b"])
+    assert rb[0].n_cached_tokens >= 128
+    # donor diverges at a MID-PAGE point: common prefix with its resident
+    # tokens ends inside a shared page (reuse % page != 0)
+    pa_div = pa[:150] + enc("user: different continuation")[1:]
+    ra2 = eng.generate([pa_div], temperature=0.0, max_new_tokens=8,
+                       session_ids=["a"])
+    want_div = plain.generate([pa_div], temperature=0.0, max_new_tokens=8,
+                              session_ids=["w1"])
+    assert ra2[0].token_ids == want_div[0].token_ids, \
+        "boundary-page swap corrupted the DONOR's own round"
+    # donor continues on its stored (post-divergence) pages
+    pa3 = pa_div + ra2[0].token_ids + enc(" next")[1:]
+    ra3 = eng.generate([pa3], temperature=0.0, max_new_tokens=8,
+                       session_ids=["a"])
+    pw3 = pa_div + want_div[0].token_ids + enc(" next")[1:]
+    want3 = plain.generate([pw3], temperature=0.0, max_new_tokens=8,
+                           session_ids=["w1"])
+    assert ra3[0].token_ids == want3[0].token_ids, \
+        "donor's stored pages hold wrong KV after the boundary swap"
+    # and the ADOPTER's shared prefix is still intact
+    pb2 = pb + rb[0].token_ids + enc(" more")[1:]
+    rb2 = eng.generate([pb2], temperature=0.0, max_new_tokens=8,
+                       session_ids=["b"])
+    wb = plain.generate([pb], temperature=0.0, max_new_tokens=8,
+                        session_ids=["w2"])
+    pwb2 = pb + wb[0].token_ids + enc(" more")[1:]
+    wb2 = plain.generate([pwb2], temperature=0.0, max_new_tokens=8,
+                         session_ids=["w2"])
+    assert rb2[0].token_ids == wb2[0].token_ids, \
+        "donor divergence corrupted the adopter under direct paths"

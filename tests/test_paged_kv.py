@@ -82,7 +82,11 @@ def test_eviction_recycles_lru_session_pages():
     # pool holds at most 4 pages of sessions; the oldest evicted
     live = [k for k in ("a", "b", "c") if eng.sessions.get(k) is not None]
     assert "c" in live and len(live) <= 4
-    total_pages = sum(len(eng.sessions.get(k).pages) for k in live)
+    # DISTINCT pages: identical prompts share prefix pages across
+    # sessions (cross-session prefix sharing), so physical occupancy —
+    # the pool invariant this test guards — is the set, not the sum
+    total_pages = len({p for k in live
+                       for p in eng.sessions.get(k).pages})
     assert total_pages <= 4
 
 
