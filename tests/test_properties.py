@@ -857,3 +857,58 @@ def test_dynamic_max_tokens_floor_and_ceiling(input_tokens, output_limit):
     else:
         assert 1 <= out <= output_limit
         assert out <= window
+
+
+# ---------------------------------------------------------------------------
+# SessionStore page accounting under prefix sharing (no device needed)
+# ---------------------------------------------------------------------------
+
+@given(st.lists(st.tuples(st.sampled_from(["new", "adopt", "drop"]),
+                          st.integers(0, 5), st.integers(1, 3)),
+                min_size=1, max_size=40))
+@settings(max_examples=60, deadline=None)
+def test_session_store_refcount_conservation(ops):
+    """Random create/adopt/drop sequences: pages are conserved exactly —
+    free + (distinct held) == total, every session's pages stay allocated
+    while referenced, and dropping everything returns the pool to full.
+    This is the accounting backbone of cross-session prefix sharing."""
+    from quoracle_tpu.models.generate import SessionStore, _Session
+    store = SessionStore(max_tokens=16 * 128)        # 16 usable pages
+    total_free = store.free_pages()
+    sessions: dict[str, list[int]] = {}
+    counter = [0]
+
+    for op, target, npages in ops:
+        if op == "new":
+            pages = store.alloc(npages, protect=tuple(sessions))
+            if pages is None:
+                continue
+            sid = f"s{counter[0]}"; counter[0] += 1
+            store.put_raw(sid, _Session(tokens=list(range(npages * 128)),
+                                        pages=pages))
+            sessions[sid] = pages
+        elif op == "adopt" and sessions:
+            donor = sorted(sessions)[target % len(sessions)]
+            prefix = sessions[donor][:npages]
+            if not prefix:
+                continue
+            store.acquire(prefix)
+            sid = f"s{counter[0]}"; counter[0] += 1
+            store.put_raw(sid, _Session(
+                tokens=list(range(len(prefix) * 128)), pages=list(prefix)))
+            sessions[sid] = list(prefix)
+        elif op == "drop" and sessions:
+            sid = sorted(sessions)[target % len(sessions)]
+            store.drop(sid)
+            del sessions[sid]
+        # invariant: free + DISTINCT held pages == total pool
+        held = {p for pages in sessions.values() for p in pages}
+        assert store.free_pages() + len(held) == total_free, \
+            (store.free_pages(), len(held), total_free)
+        # no held page is ever on the free list
+        assert not (held & set(store._free))
+
+    for sid in list(sessions):
+        store.drop(sid)
+    assert store.free_pages() == total_free
+    assert not store._refs
