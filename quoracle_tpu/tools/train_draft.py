@@ -43,6 +43,10 @@ def main() -> None:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--corpus-size", type=int, default=2000)
     ap.add_argument("--k", type=int, default=6)
+    ap.add_argument("--k-sweep", default=None,
+                    help="comma-separated extra K values to sweep (each "
+                         "measured on the same held-out tasks, "
+                         "unconstrained greedy)")
     ap.add_argument("--n-eval", type=int, default=12)
     ap.add_argument("--max-new", type=int, default=96)
     ap.add_argument("--workdir", default=None)
@@ -177,6 +181,30 @@ def main() -> None:
             f" tokens/round {cgot.tokens_per_round:.2f} "
             f"equal={cgot.token_ids == cwant.token_ids}")
 
+    k_sweep = {}
+    if args.k_sweep:
+        for kk in [int(x) for x in args.k_sweep.split(",") if x.strip()]:
+            if kk == args.k:
+                continue
+            dk = SpeculativeDecoder(tcfg, tparams, dcfg2, dparams, tok,
+                                    k=kk, max_seq=1024)
+            rng_k = random.Random(args.seed + 1)
+            a_list, t_list = [], []
+            for _ in range(args.n_eval):
+                task, _ = _format_sample(rng_k)
+                prompt = tok.encode_chat([
+                    {"role": "system", "content": SYSTEM},
+                    {"role": "user", "content": task}])
+                g = dk.generate(prompt, temperature=0.0,
+                                max_new_tokens=args.max_new)
+                a_list.append(g.acceptance_rate)
+                t_list.append(g.tokens_per_round)
+            k_sweep[str(kk)] = {
+                "acceptance_p50": round(statistics.median(a_list), 4),
+                "tokens_per_round_p50": round(statistics.median(t_list),
+                                              2)}
+            log(f"k={kk}: acceptance {k_sweep[str(kk)]}")
+
     payload = {
         "metric": "speculative_trained_draft",
         "value": round(statistics.median(acc), 4),
@@ -190,6 +218,7 @@ def main() -> None:
             statistics.median(con_tpr), 2),
         "constrained_greedy_equal": f"{con_equal}/{args.n_eval}",
         "constrained_enum": list(enum),
+        "k_sweep": k_sweep or None,
         "target": "finetune-format/tuned (small, ~7M)",
         "draft": "finetune-format/draft-tuned (tiny, ~0.6M)",
         "draft_steps": trained_steps,
