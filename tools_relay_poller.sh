@@ -52,7 +52,12 @@ raise SystemExit(0 if ok else 1)
 EOF
             then
                 echo "$(date -u +%FT%TZ) BENCH SUCCESS — chip-verified record captured" >> "$LOG"
-                cd /root/repo && git add BENCH_r05_live.json calib_v5e.json RELAY_POLL_r05.log 2>/dev/null
+                timeout 1800 python -m quoracle_tpu.tools.bench_longctx \
+                    --resident 16384 --rounds 3 \
+                    > /root/repo/LONGCTX_r05.json 2>> "$LOG" \
+                    && echo "$(date -u +%FT%TZ) longctx captured" >> "$LOG" \
+                    || echo "$(date -u +%FT%TZ) longctx FAILED (bench record already safe)" >> "$LOG"
+                cd /root/repo && git add BENCH_r05_live.json calib_v5e.json LONGCTX_r05.json RELAY_POLL_r05.log 2>/dev/null
                 git -c user.name=distsys-graft -c user.email=graft@localhost \
                     commit -m "Chip-verified BENCH_r05_live artifact captured by relay poller" >> "$LOG" 2>&1
                 # Keep polling in case a later, longer window allows a rerun?
