@@ -841,6 +841,9 @@ def _run(args, payload: dict, deadline_at: float) -> None:
         "sessions": True,
         "checkpoints": True,
         "overlapped_members": True,
+        # r5: cross-session prefix sharing is live — config 3's agents
+        # adopt each other's system-prompt KV (shows up as residency)
+        "prefix_sharing": True,
     })
 
 
