@@ -185,6 +185,11 @@ def test_dashboard_auth_token_gates_mutations(monkeypatch):
             assert status == 200
             status, _ = await http_json(base + "/api/status")
             assert status == 401
+            # the standalone views carry full transcripts/settings —
+            # gated like the API reads
+            for path in ("/logs", "/mailbox", "/telemetry", "/settings"):
+                status, _ = await http_json(base + path)
+                assert status == 401, f"{path} not token-gated"
             # POST without token → 401
             status, _ = await http_json(base + "/api/messages",
                                         method="POST",
