@@ -166,10 +166,11 @@ class SpeculativeDecoder:
         # zero drift (the bit-exactness guarantee depends on it)
         _mask = functools.partial(grammar_mask, eos_id=eos_id)
 
-        @functools.partial(jax.jit, static_argnames=("constrained",))
+        @functools.partial(jax.jit,
+                           static_argnames=("constrained", "greedy"))
         def _draft_scan(params, cache: KVCache, pending, rng, temperature,
                         top_p, json_table, jstate0,
-                        constrained: bool = False):
+                        constrained: bool = False, greedy: bool = False):
             """K autoregressive draft steps from ``pending``.
 
             Returns (d_tokens [K], q_probs [K, V], cache'): step i
@@ -194,14 +195,19 @@ class SpeculativeDecoder:
                     logits = _mask(logits, jstate, json_table)
                 rng, ks = jax.random.split(rng)
                 nxt = sample_tokens(logits, ks, temperature, top_p)
-                q = jax.nn.softmax(
-                    logits / jnp.maximum(temperature, 1e-6)[:, None],
-                    axis=-1)
-                # greedy rows draft greedily: q as one-hot keeps the
-                # acceptance rule exact (accept iff d_i == argmax p_i)
-                q = jnp.where(
-                    (temperature <= 0)[:, None],
-                    jax.nn.one_hot(nxt, logits.shape[-1]), q)
+                if greedy:
+                    # acceptance needs no proposal distribution: the host
+                    # compares token ids — skip the [V] softmax entirely
+                    q = jnp.zeros((1, 1), jnp.float32)
+                else:
+                    q = jax.nn.softmax(
+                        logits / jnp.maximum(temperature, 1e-6)[:, None],
+                        axis=-1)
+                    # greedy rows draft greedily: q as one-hot keeps the
+                    # acceptance rule exact (accept iff d_i == argmax p_i)
+                    q = jnp.where(
+                        (temperature <= 0)[:, None],
+                        jax.nn.one_hot(nxt, logits.shape[-1]), q)
                 if constrained:
                     jstate = jnp.where(
                         jstate >= 0,
@@ -213,9 +219,11 @@ class SpeculativeDecoder:
                 step, (cache, pending, rng, jstate0), None, length=K)
             return toks, qs, cache
 
-        @functools.partial(jax.jit, static_argnames=("constrained",))
+        @functools.partial(jax.jit,
+                           static_argnames=("constrained", "greedy"))
         def _verify_chunk(params, cache: KVCache, chunk, temperature,
-                          json_table, jstate0, constrained: bool = False):
+                          json_table, jstate0, constrained: bool = False,
+                          greedy: bool = False):
             """One target pass over [pending, d_1..d_{K-1}] → p_1..p_K
             (full per-position distributions) with the cache advanced K
             positions. Under constraint the per-position grammar states
@@ -243,13 +251,20 @@ class SpeculativeDecoder:
                 _, rest = jax.lax.scan(adv, jstate0[0], chunk[1:])
                 jstates = jnp.concatenate([jstate0, rest])       # [K]
                 logits = _mask(logits, jstates, json_table)
-            probs = jax.nn.softmax(
-                logits / jnp.maximum(temperature, 1e-6)[:, None], axis=-1)
-            greedy_probs = jax.nn.one_hot(
-                jnp.argmax(logits, axis=-1), logits.shape[-1])
-            probs = jnp.where((temperature <= 0)[:, None],
-                              greedy_probs, probs)
-            return probs, cache
+            argmax_ids = jnp.argmax(logits, axis=-1)         # [K]
+            if greedy:
+                # the [K, V] probs would be a dead jit output the compiler
+                # must still write to HBM — drop it in the hot greedy path
+                probs = jnp.zeros((1, 1), jnp.float32)
+            else:
+                probs = jax.nn.softmax(
+                    logits / jnp.maximum(temperature, 1e-6)[:, None],
+                    axis=-1)
+                greedy_probs = jax.nn.one_hot(argmax_ids,
+                                              logits.shape[-1])
+                probs = jnp.where((temperature <= 0)[:, None],
+                                  greedy_probs, probs)
+            return probs, argmax_ids, cache
 
         self._prefill = _prefill
         self._extend = _extend
@@ -407,17 +422,29 @@ class SpeculativeDecoder:
             jstate0 = jnp.asarray([jstate], jnp.int32)
             d_toks, q_probs, dcache = self._draft_scan(
                 self.dp, dcache, pending, kd, temp, topp,
-                tbl_dev, jstate0, constrained=constrain_json)
+                tbl_dev, jstate0, constrained=constrain_json,
+                greedy=temperature <= 0)
             chunk = jnp.concatenate([pending, d_toks[:-1]])
             # verify dispatches on DEVICE values only (the per-position
             # grammar states walk in-device from jstate0) — no host sync
             # sits between the draft scan and the target chunk
-            p_probs, tcache = self._verify_chunk(
+            p_probs, p_am, tcache = self._verify_chunk(
                 self.tp, tcache, chunk, jnp.broadcast_to(temp, (K,)),
-                tbl_dev, jstate0, constrained=constrain_json)
+                tbl_dev, jstate0, constrained=constrain_json,
+                greedy=temperature <= 0)
             d = np.asarray(d_toks)
-            q = np.asarray(q_probs)
-            p = np.asarray(p_probs)
+            if temperature <= 0:
+                # greedy needs only the [K] argmax ids — accepted drafts
+                # equal them and corrections ARE them. The [K, V] prob
+                # tensors never materialize host-side (at 128k vocab
+                # that's megabytes per round through the dispatch
+                # channel).
+                pam = np.asarray(p_am)
+                q = p = None
+            else:
+                q = np.asarray(q_probs)
+                p = np.asarray(p_probs)
+                pam = None
             drafted += K
 
             j = 0
@@ -425,18 +452,20 @@ class SpeculativeDecoder:
             while j < K:
                 di = int(d[j])
                 if temperature <= 0:
-                    ok = di == int(np.argmax(p[j]))
+                    ok = di == int(pam[j])
                 else:
                     ok = rng_np.random() < min(
                         1.0, float(p[j, di]) / max(float(q[j, di]), 1e-20))
                 if not ok:
-                    residual = np.maximum(p[j] - q[j], 0.0)
-                    tot = residual.sum()
-                    if temperature <= 0 or tot <= 0:
-                        correction = int(np.argmax(p[j]))
+                    if temperature <= 0:
+                        correction = int(pam[j])
                     else:
-                        correction = int(rng_np.choice(
-                            residual.shape[0], p=residual / tot))
+                        residual = np.maximum(p[j] - q[j], 0.0)
+                        tot = residual.sum()
+                        correction = (int(np.argmax(p[j])) if tot <= 0
+                                      else int(rng_np.choice(
+                                          residual.shape[0],
+                                          p=residual / tot)))
                     break
                 j += 1
             accepted_total += j
