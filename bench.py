@@ -108,8 +108,15 @@ def log(msg: str) -> None:
 RELAY_PROBE_PORTS = (8082, 8083, 8087, 8092)
 
 PROBE_CODE = r"""
-import json, sys
+import json, os, sys
 import jax
+if os.environ.get("BENCH_SMOKE") == "1":
+    # --smoke probes the CPU platform. The env-var route does not work:
+    # this image's sitecustomize re-pins JAX_PLATFORMS to the tunnel
+    # backend at interpreter startup (before this code), and a wedged
+    # relay then hangs even a CPU-intended init. config.update wins
+    # because backends init lazily (same trick as tests/conftest.py).
+    jax.config.update("jax_platforms", "cpu")
 d = jax.devices()
 import jax.numpy as jnp
 x = jnp.ones((128, 128), jnp.bfloat16)
@@ -140,13 +147,22 @@ def relay_dead() -> bool:
     return True
 
 
-def probe_device(timeout_s: float) -> dict:
+def probe_device(timeout_s: float, smoke: bool = False) -> dict:
     """jax.devices() + a tiny matmul in a subprocess so a wedged chip lease
     cannot hang the bench. SIGTERM (never SIGKILL first — a SIGKILLed
-    chip-holder wedges the lease for tens of minutes) with escalation."""
+    chip-holder wedges the lease for tens of minutes) with escalation.
+
+    ``smoke`` pins the probe subprocess to the CPU platform. The flag is
+    passed EXPLICITLY through the subprocess env (never read from the
+    ambient environment) so a stale BENCH_SMOKE export can't make a real
+    bench run "probe" the CPU and then hang on a wedged relay."""
+    env = dict(os.environ)
+    env.pop("BENCH_SMOKE", None)
+    if smoke:
+        env["BENCH_SMOKE"] = "1"
     p = subprocess.Popen([sys.executable, "-c", PROBE_CODE],
                          stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-                         text=True)
+                         text=True, env=env)
     try:
         out, err = p.communicate(timeout=timeout_s)
     except subprocess.TimeoutExpired:
@@ -525,14 +541,20 @@ def _run(args, payload: dict, deadline_at: float) -> None:
     """The measurement flow; fills ``payload`` incrementally so the caller
     can emit a partial artifact on any failure."""
     probe_budget = min(300.0, max(60.0, deadline_at - time.monotonic()))
-    if relay_dead():
+    if args.smoke:
+        # CPU smoke must run even while the relay is wedged: pin this
+        # process to the CPU platform before any jax backend initializes
+        # (the probe subprocess gets the same pin via probe_device(smoke=)).
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    elif relay_dead():
         payload.update(device_unavailable=True,
                        error="loopback relay dead: no relay port accepts "
                              "connections; chip unreachable in this "
                              "container (NOTES_r03.md postmortem)")
         log(payload["error"])
         return
-    probe = probe_device(probe_budget)
+    probe = probe_device(probe_budget, smoke=args.smoke)
     if not probe.get("ok"):
         payload.update(device_unavailable=True, error=probe.get("error"))
         log(payload["error"])
@@ -593,10 +615,27 @@ def _run(args, payload: dict, deadline_at: float) -> None:
     # first seen mid-measurement costs a 15-20s XLA compile inside a
     # measured round (the per-round medians below are robust to stragglers,
     # but covering the buckets up front keeps the tail honest too).
+    #
+    # ALL first compiles run one member at a time, with a log line around
+    # each: the r5 relay wedge (RELAY_POLL_r05.log, 03:58 UTC) hit inside
+    # the first overlapped 3-member warmup round — three threads issuing
+    # their initial big-graph compile RPCs concurrently over the relay —
+    # and left no indication of which member died. The serial loop covers
+    # every measured bucket per member (full growing-conversation cycle,
+    # longest task, config 3's batch-of-3 rows); serializing costs nothing
+    # (compiles dominate; overlap saves no compile time) and makes any
+    # failure point visible. The single overlapped cycle after it then
+    # exercises the measured overlap path with every graph already cached.
     t0 = time.monotonic()
+    for m in pool:
+        log(f"warmup compile [{m}] ...")
+        t1 = time.monotonic()
+        run_cycle(backend, [m], f"warmup-{m}", TASKS[0])
+        run_cycle(backend, [m], f"warmup2-{m}", max(TASKS, key=len))
+        run_cycle(backend, [m], f"warmup3-{m}", TASKS[0], n_agents=3,
+                  rounds=1)
+        log(f"warmup compile [{m}] ok in {time.monotonic() - t1:.1f}s")
     run_cycle(backend, pool, "warmup", TASKS[0])
-    run_cycle(backend, pool, "warmup2", max(TASKS, key=len))
-    run_cycle(backend, pool, "warmup3", TASKS[0], n_agents=3, rounds=1)
     log(f"warmup (compiles) {time.monotonic() - t0:.1f}s")
 
     if args.profile:

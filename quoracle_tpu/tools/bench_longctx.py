@@ -53,7 +53,8 @@ def peak_hbm_gb() -> float | None:
     return round(peak / 1e9, 3) if peak else None
 
 
-def build_engine(resident: int, rounds: int, new_tokens: int, scale: str):
+def build_engine(resident: int, rounds: int, new_tokens: int, scale: str,
+                 session_max_bytes: int = 8 << 30):
     from quoracle_tpu.models.config import register_model
     from quoracle_tpu.models.generate import GenerateEngine
     from quoracle_tpu.models.loader import (
@@ -75,7 +76,7 @@ def build_engine(resident: int, rounds: int, new_tokens: int, scale: str):
     eng = GenerateEngine(
         cfg, params, tok, max_seq=max_seq,
         prompt_buckets=(256, 1024, resident, max_seq),
-        session_max_bytes=8 << 30)
+        session_max_bytes=session_max_bytes)
     return eng, tok
 
 

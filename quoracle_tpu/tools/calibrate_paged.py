@@ -22,6 +22,7 @@ where peak HBM matters more than p50.
 from __future__ import annotations
 
 import argparse
+import gc
 import json
 import sys
 
@@ -64,12 +65,23 @@ def main() -> None:
         # fresh engine PER size: one engine sized for sweep[-1] would
         # bucket-pad mid-sweep gather rounds to the largest size,
         # inflating gather ~sweep[-1]/resident× and writing gates that
-        # enable the direct paths where properly-bucketed gather wins
+        # enable the direct paths where properly-bucketed gather wins.
+        # Pool budget 2 GiB (not the serving default 8 GiB): the session
+        # only ever holds ~resident+rounds·new tokens, and two engines
+        # briefly coexist between sweep sizes — 1b weights + a 32·max_seq
+        # token pool each OOMed a 16 GB v5e at the 4096 step.
         eng, tok = build_engine(resident, args.rounds, args.new_tokens,
-                                args.scale)
+                                args.scale, session_max_bytes=2 << 30)
         by_size[resident] = measure_paths(
             eng, tok, resident, args.rounds, args.new_tokens)
-        del eng
+        # Free this size's weights + pool BEFORE the next build: the jit
+        # caches keep executables (and through them donated-buffer aliases)
+        # alive past `del`, and GC alone is too lazy to beat the next
+        # engine's allocation to the HBM.
+        del eng, tok
+        gc.collect()
+        jax.clear_caches()
+        gc.collect()
 
     def crossover(path: str):
         for resident in sweep:

@@ -1,0 +1,12 @@
+#!/bin/bash
+# One-shot: calibration sweep + full bench on the live chip, commit immediately.
+cd /root/repo
+LOG=RELAY_POLL_r05.log
+echo "$(date -u +%FT%TZ) direct run: device confirmed live (probe ok)" >> "$LOG"
+timeout 2400 python -m quoracle_tpu.tools.calibrate_paged \
+    --out /root/repo/calib_v5e.json >> "$LOG" 2>&1 \
+    && echo "$(date -u +%FT%TZ) calibration written" >> "$LOG" \
+    || echo "$(date -u +%FT%TZ) calibration FAILED (continuing to bench)" >> "$LOG"
+export QUORACLE_PAGED_CALIB=/root/repo/calib_v5e.json
+timeout 5400 python bench.py > /root/repo/BENCH_r05_live.json 2>> "$LOG"
+echo "$(date -u +%FT%TZ) bench rc=$? artifact=BENCH_r05_live.json" >> "$LOG"

@@ -1,12 +1,16 @@
 #!/bin/bash
 # Relay poller (VERDICT r4 item 1): poll the loopback relay all round; the
-# moment the chip answers, run the paged calibration sweep + the full bench
-# and write the artifacts immediately so a later relay death can't erase them.
+# moment the chip answers, run the full bench and write the artifact
+# immediately so a later relay death can't erase it. Calibration and the
+# long-context sweep run AFTER the bench record is safe: in the 03:45 UTC
+# r5 window calibration ran first, OOMed mid-sweep (since fixed), and the
+# relay wedged before bench.py got a single config out — the primary
+# record must never queue behind a bonus measurement again.
 #
 # Log: /root/repo/RELAY_POLL_r05.log (one line per probe; goes into the
 # BENCH artifact if the relay never answers).
-# Success artifacts: /root/repo/BENCH_r05_live.json, QUORACLE_PAGED_CALIB
-# at /root/repo/calib_v5e.json, FINETUNE at 1b scale if time permits.
+# Success artifacts: /root/repo/BENCH_r05_live.json, then calib_v5e.json
+# (QUORACLE_PAGED_CALIB gates) + LONGCTX_r05.json as bonus captures.
 
 cd /root/repo
 LOG=RELAY_POLL_r05.log
@@ -36,12 +40,7 @@ print("device probe:", p)
 sys.exit(0 if p.get("ok") else 1)
 EOF
         then
-            echo "$(date -u +%FT%TZ) DEVICE LIVE — running calibration + bench" >> "$LOG"
-            timeout 2400 python -m quoracle_tpu.tools.calibrate_paged \
-                --out /root/repo/calib_v5e.json >> "$LOG" 2>&1 \
-                && echo "$(date -u +%FT%TZ) calibration written" >> "$LOG" \
-                || echo "$(date -u +%FT%TZ) calibration FAILED (continuing to bench)" >> "$LOG"
-            export QUORACLE_PAGED_CALIB=/root/repo/calib_v5e.json
+            echo "$(date -u +%FT%TZ) DEVICE LIVE — running bench (record first)" >> "$LOG"
             timeout 5400 python bench.py > /root/repo/BENCH_r05_live.json 2>> "$LOG"
             echo "$(date -u +%FT%TZ) bench rc=$? artifact=BENCH_r05_live.json" >> "$LOG"
             if python - <<'EOF'
@@ -52,17 +51,22 @@ raise SystemExit(0 if ok else 1)
 EOF
             then
                 echo "$(date -u +%FT%TZ) BENCH SUCCESS — chip-verified record captured" >> "$LOG"
+                git add BENCH_r05_live.json RELAY_POLL_r05.log 2>/dev/null
+                git -c user.name=distsys-graft -c user.email=graft@localhost \
+                    commit -m "Chip-verified BENCH_r05_live artifact captured by relay poller" >> "$LOG" 2>&1
+                # Bonus captures now that the record is safe.
+                timeout 2400 python -m quoracle_tpu.tools.calibrate_paged \
+                    --out /root/repo/calib_v5e.json >> "$LOG" 2>&1 \
+                    && echo "$(date -u +%FT%TZ) calibration written" >> "$LOG" \
+                    || echo "$(date -u +%FT%TZ) calibration FAILED (bench record already safe)" >> "$LOG"
                 timeout 1800 python -m quoracle_tpu.tools.bench_longctx \
                     --resident 16384 --rounds 3 \
                     > /root/repo/LONGCTX_r05.json 2>> "$LOG" \
                     && echo "$(date -u +%FT%TZ) longctx captured" >> "$LOG" \
                     || echo "$(date -u +%FT%TZ) longctx FAILED (bench record already safe)" >> "$LOG"
-                cd /root/repo && git add BENCH_r05_live.json calib_v5e.json LONGCTX_r05.json RELAY_POLL_r05.log 2>/dev/null
+                git add calib_v5e.json LONGCTX_r05.json RELAY_POLL_r05.log 2>/dev/null
                 git -c user.name=distsys-graft -c user.email=graft@localhost \
-                    commit -m "Chip-verified BENCH_r05_live artifact captured by relay poller" >> "$LOG" 2>&1
-                # Keep polling in case a later, longer window allows a rerun?
-                # No: record is in. Switch to slow heartbeat so a 1b finetune
-                # could be run manually; exit the hot loop.
+                    commit -m "Post-bench chip captures: paged-gate calibration + long-context sweep" >> "$LOG" 2>&1
                 echo "$(date -u +%FT%TZ) poller entering idle heartbeat" >> "$LOG"
                 while true; do sleep 3600; echo "$(date -u +%FT%TZ) heartbeat (record already captured)" >> "$LOG"; done
             else
