@@ -155,3 +155,100 @@ def test_row_at_context_edge_retires_without_poisoning_batch():
     # edge row stopped at the window, neighbor unharmed
     assert len(edge) + len(ge.token_ids) <= 128
     assert go.n_gen_tokens >= 1
+
+
+def test_over_window_submit_fails_only_its_future():
+    """ADVICE r4 #1 regression: a directly-submitted prompt >= max_seq must
+    fail ITS OWN future at admission (ContextOverflowError) while a
+    concurrent normal row completes — one bad agent must never poison the
+    other agents' in-flight rows in a shared chunk."""
+    from quoracle_tpu.models.generate import ContextOverflowError
+    eng = make_engine(max_seq=128, prompt_buckets=(32, 64, 128))
+    tok = ByteTokenizer()
+    cb = ContinuousBatcher(eng, chunk=8)
+    try:
+        ok_row = cb.submit(enc("user: hello"), temperature=0.0,
+                           max_new_tokens=8)
+        bad = cb.submit(tok.encode("y" * 400, add_bos=True),
+                        temperature=0.0, max_new_tokens=8)
+        try:
+            bad.result(10)
+            raise AssertionError("over-window submit must fail")
+        except ContextOverflowError:
+            pass
+        good = ok_row.result(240)
+    finally:
+        cb.close()
+    assert good.n_gen_tokens >= 1
+
+
+def test_close_mid_chunk_leaves_no_stranded_future():
+    """ADVICE r4 #2 regression: close() while the worker is mid-chunk must
+    not race the worker's set_result (InvalidStateError) — every submitted
+    future ends DONE (result or clean failure), never stranded, and a
+    post-close submit fails loudly."""
+    eng = make_engine(max_seq=256, prompt_buckets=(32, 64, 128))
+    cb = ContinuousBatcher(eng, chunk=4)
+    futs = [cb.submit(enc(f"user: task {i}"), temperature=0.0,
+                      max_new_tokens=64) for i in range(3)]
+    # let the worker pick the rows up and enter a device chunk
+    time.sleep(0.3)
+    cb.close()
+    for f in futs:
+        try:
+            r = f.result(120)          # done: finished result...
+            assert r.n_gen_tokens >= 0
+        except RuntimeError as e:      # ...or the documented close failure
+            assert "closed" in str(e).lower()
+    try:
+        cb.submit(enc("user: late"), temperature=0.0, max_new_tokens=4)
+        raise AssertionError("submit after close must fail")
+    except RuntimeError:
+        pass
+
+
+def test_credential_duplicate_model_spec_is_deterministic(caplog):
+    """ADVICE r4 #4 regression: two credentials for one model_spec resolve
+    to the lowest id (stable across engines/plans) and WARN about the
+    duplicate instead of silently picking an arbitrary row."""
+    import logging
+
+    from quoracle_tpu.persistence.db import Database
+    from quoracle_tpu.persistence.store import CredentialStore
+    db = Database(":memory:", encryption_key="unit-test-key")
+    store = CredentialStore(db)
+    store.put("b-second", {"type": "bearer", "token": "tok-b"},
+              model_spec="api:svc")
+    store.put("a-first", {"type": "bearer", "token": "tok-a"},
+              model_spec="api:svc")
+    with caplog.at_level(logging.WARNING):
+        data = store.for_model("api:svc")
+    assert data["token"] == "tok-a"            # lowest id wins, always
+    assert any("credentials" in r.message and "api:svc" in r.message
+               for r in caplog.records)
+
+
+def test_sessionless_generate_runs_without_paged_lock():
+    """ADVICE r4 #3 regression: image rows in continuous mode call the
+    engine directly and SESSIONLESS — that call must not need
+    engine._paged_lock (the grammar cache has its own lock), or a long
+    VLM round would stall every concurrent text agent's sessioned chunks
+    for its whole duration. Holding the lock here and completing anyway
+    proves the sessionless path never touches it."""
+    import threading
+
+    eng = make_engine(max_seq=128, prompt_buckets=(32, 64, 128))
+    done = threading.Event()
+    out = {}
+
+    def run():
+        out["r"] = eng.generate([enc("user: describe")], temperature=0.0,
+                                max_new_tokens=8, constrain_json=[True])[0]
+        done.set()
+
+    with eng._paged_lock:                   # a text agent mid-chunk
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        assert done.wait(120), \
+            "sessionless generate blocked on engine._paged_lock"
+    assert out["r"].n_gen_tokens >= 1
