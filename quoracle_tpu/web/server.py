@@ -16,6 +16,7 @@ Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
   GET  /api/tasks           tasks + live agent counts
   GET  /api/agents?task_id  agent tree with budget/cost/todo state
   GET  /api/logs?agent_id   durable logs (newest last)
+  GET  /api/history?agent_id  ring-buffer mount replay (EventHistory)
   GET  /api/messages?task_id  task mailbox
   POST /api/tasks           {description?, model_pool?, profile?, budget?, grove?}
   POST /api/tasks/<id>/pause | /resume
@@ -153,6 +154,23 @@ class DashboardServer:
             "SELECT * FROM logs WHERE (?1 IS NULL OR agent_id=?1) "
             "ORDER BY id DESC LIMIT ?2", (agent_id, limit))
         return [dict(r) for r in reversed(rows)]
+
+    def history_payload(self, agent_id: Optional[str]) -> dict:
+        """Mount replay straight from the in-memory ring buffers
+        (infra/event_history.py) — the recent-events snapshot a freshly
+        opened view renders BEFORE its SSE subscription starts delivering,
+        exactly the reference's LiveView mount replay
+        (reference ui/event_history.ex:17-20). Durable tables cover deep
+        history; this covers the live tail without a DB round-trip."""
+        h = self.runtime.history
+        payload = {
+            "lifecycle": h.replay_lifecycle(),
+            "actions": h.replay_actions(),
+        }
+        if agent_id:
+            payload["logs"] = h.replay_logs(agent_id)
+            payload["messages"] = h.replay_messages(agent_id)
+        return payload
 
     def logs_joined_payload(self, task_id: Optional[str],
                             level: Optional[str],
@@ -349,6 +367,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.agents_payload(one("task_id")))
             elif parsed.path == "/api/logs":
                 self._send_json(d.logs_payload(one("agent_id")))
+            elif parsed.path == "/api/history":
+                self._send_json(d.history_payload(one("agent_id")))
             elif parsed.path == "/api/messages":
                 self._send_json(d.messages_payload(one("task_id")))
             elif parsed.path == "/api/groves":

@@ -429,3 +429,44 @@ def test_dashboard_credentials_api_metadata_only():
             await server.stop()
             await rt.shutdown()
     asyncio.run(main())
+
+
+def test_history_endpoint_serves_ring_buffer_mount_replay():
+    """/api/history replays EventHistory's in-memory ring buffers — the
+    recent-events snapshot a freshly opened view renders before its SSE
+    subscription delivers (reference LiveView mount replay,
+    ui/event_history.ex:17-20). Events already broadcast BEFORE this
+    request must come back without any DB involvement."""
+    async def main():
+        def respond(r):
+            return j("todo", {"items": [{"task": "history-probe"}]})
+        rt = Runtime(RuntimeConfig(), backend=MockBackend(respond=respond))
+        server = await DashboardServer(rt, port=0).start()
+        base = server.url
+        try:
+            status, created = await http_json(
+                base + "/api/tasks", "POST",
+                {"description": "history replay task",
+                 "model_pool": list(POOL)})
+            assert status == 201
+            root_id = created["root_agent"]
+            await until(lambda: rt.history.replay_lifecycle())
+
+            status, hist = await http_json(base + "/api/history")
+            assert status == 200
+            assert any(e.get("event") == "agent_spawned"
+                       and e.get("agent_id") == root_id
+                       for e in hist["lifecycle"])
+            # consensus decisions flow through the actions ring
+            await until(lambda: rt.history.replay_actions())
+            status, hist = await http_json(
+                base + f"/api/history?agent_id={root_id}")
+            assert status == 200
+            assert hist["actions"]              # decision/action events
+            assert "logs" in hist and "messages" in hist
+            # per-agent ring captured the agent's own broadcasts
+            assert isinstance(hist["logs"], list)
+        finally:
+            await server.stop()
+            await rt.shutdown()
+    asyncio.run(asyncio.wait_for(main(), 60))
