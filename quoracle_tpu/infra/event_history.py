@@ -33,6 +33,7 @@ class EventHistory:
         self._messages: dict[str, deque] = {}
         self._lifecycle: deque = deque(maxlen=max_logs)
         self._actions: deque = deque(maxlen=max_logs)
+        self._tasks: set[str] = set()
         self._lock = threading.Lock()
         self._subs: list[Subscription] = [
             bus.subscribe(TOPIC_LIFECYCLE, self._on_lifecycle),
@@ -55,6 +56,10 @@ class EventHistory:
 
     def track_task(self, task_id: str) -> None:
         from quoracle_tpu.infra.bus import topic_task_messages
+        with self._lock:
+            if task_id in self._tasks:
+                return
+            self._tasks.add(task_id)
         self._subs.append(self.bus.subscribe(
             topic_task_messages(task_id), self._on_task_message))
 
@@ -63,6 +68,13 @@ class EventHistory:
             self._lifecycle.append(event)
         if event.get("event") == "agent_spawned":
             self.track_agent(event["agent_id"])
+        elif (event.get("event") == "task_status_changed"
+              and event.get("status") == "running"):
+            # create_task and restore both announce "running" — the task's
+            # mailbox ring starts capturing from the same broadcast the
+            # dashboard learns the task exists from (no runtime call site
+            # needed; mirrors agent auto-tracking above).
+            self.track_task(event["task_id"])
 
     def _on_action(self, topic: str, event: dict) -> None:
         with self._lock:

@@ -155,7 +155,8 @@ class DashboardServer:
             "ORDER BY id DESC LIMIT ?2", (agent_id, limit))
         return [dict(r) for r in reversed(rows)]
 
-    def history_payload(self, agent_id: Optional[str]) -> dict:
+    def history_payload(self, agent_id: Optional[str],
+                        task_id: Optional[str] = None) -> dict:
         """Mount replay straight from the in-memory ring buffers
         (infra/event_history.py) — the recent-events snapshot a freshly
         opened view renders BEFORE its SSE subscription starts delivering,
@@ -169,7 +170,10 @@ class DashboardServer:
         }
         if agent_id:
             payload["logs"] = h.replay_logs(agent_id)
-            payload["messages"] = h.replay_messages(agent_id)
+        if agent_id or task_id:
+            # task mailbox broadcasts ring-key by sender agent_id when the
+            # message carries one, else by task_id (event_history.py)
+            payload["messages"] = h.replay_messages(agent_id or task_id)
         return payload
 
     def logs_joined_payload(self, task_id: Optional[str],
@@ -368,7 +372,8 @@ class _Handler(BaseHTTPRequestHandler):
             elif parsed.path == "/api/logs":
                 self._send_json(d.logs_payload(one("agent_id")))
             elif parsed.path == "/api/history":
-                self._send_json(d.history_payload(one("agent_id")))
+                self._send_json(d.history_payload(one("agent_id"),
+                                                  one("task_id")))
             elif parsed.path == "/api/messages":
                 self._send_json(d.messages_payload(one("task_id")))
             elif parsed.path == "/api/groves":

@@ -439,7 +439,11 @@ def test_history_endpoint_serves_ring_buffer_mount_replay():
     request must come back without any DB involvement."""
     async def main():
         def respond(r):
-            return j("todo", {"items": [{"task": "history-probe"}]})
+            joined = "\n".join(str(m.get("content", "")) for m in r.messages)
+            if "history-probe" in joined:
+                return j("wait", {})
+            return j("send_message", {"target": "announcement",
+                                      "content": "history-probe"})
         rt = Runtime(RuntimeConfig(), backend=MockBackend(respond=respond))
         server = await DashboardServer(rt, port=0).start()
         base = server.url
@@ -466,6 +470,15 @@ def test_history_endpoint_serves_ring_buffer_mount_replay():
             assert "logs" in hist and "messages" in hist
             # per-agent ring captured the agent's own broadcasts
             assert isinstance(hist["logs"], list)
+            # the task mailbox ring auto-tracks from the "running"
+            # broadcast: the announcement lands under the task key
+            task_id = created["task_id"]
+            await until(lambda: rt.history.replay_messages(task_id))
+            status, hist = await http_json(
+                base + f"/api/history?task_id={task_id}")
+            assert status == 200
+            assert any("history-probe" in str(m)
+                       for m in hist["messages"])
         finally:
             await server.stop()
             await rt.shutdown()
