@@ -650,3 +650,46 @@ def test_spawn_dismiss_race_leaves_no_orphan():
         await until(lambda: not deps.registry.all(), timeout=10)
 
     run(main())
+
+
+def test_spawn_failure_retries_then_notifies_parent(monkeypatch):
+    """Reference spawn.ex:412-433 + :319-331 parity: when the background
+    spawn task keeps failing, it retries SPAWN_MAX_RETRIES times with
+    backoff and then posts spawn_failed to the parent — whose next
+    consensus cycle sees the failure (rendered as "Spawning child ...
+    FAILED: <reason>. You may retry or re-plan.") — and the child never
+    registers."""
+    from quoracle_tpu.actions import executors as ex
+
+    monkeypatch.setattr(ex, "SPAWN_RETRY_DELAY_S", 0.01)
+    def respond(r):
+        joined = "\n".join(str(m.get("content", "")) for m in r.messages)
+        if "FAILED: RuntimeError: child boom" in joined:
+            return j("todo", {"items": [{"task": "saw-spawn-failure"}]})
+        if '"agent_id"' in joined:                # spawn result ack
+            return WAIT_FOREVER
+        return j("spawn_child", spawn_params())
+
+    async def main():
+        backend = MockBackend(respond=respond)
+        deps, sup = make_env(backend)
+        root = await sup.start_agent(root_config())
+        calls = []
+        orig = sup.start_agent
+
+        async def failing(cfg, *a, **k):
+            calls.append(cfg.agent_id)
+            raise RuntimeError("child boom")
+
+        sup.start_agent = failing
+        root.post({"type": "user_message", "content": "please spawn",
+                   "from": "user"})
+        # the parent's reaction to spawn_failed is observable as a todo
+        await until(lambda: any("saw-spawn-failure" in str(t)
+                                for t in root.ctx.todos), timeout=20)
+        assert len(calls) == ex.SPAWN_MAX_RETRIES
+        # the failed child never registered anywhere
+        assert all(deps.registry.lookup(cid) is None for cid in calls)
+        sup.start_agent = orig
+        await sup.terminate_tree(root.agent_id, by="test", reason="done")
+    asyncio.run(asyncio.wait_for(main(), 60))
