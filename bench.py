@@ -466,6 +466,18 @@ def base_payload() -> dict:
         "config6_n_agents": None,
         "config6_tps_vs_config1": None,
         "config6_p50_vs_config1": None,
+        # config 8 — radix prefix cache (models/prefix_cache.py): K-row
+        # consensus-style fan-out (shared prompt, distinct suffixes).
+        # rows2k_prefill << rows2k_prompt is the cache working: rows 2..K
+        # prefilled only their suffix. config8_prefix_cache carries the
+        # engine's cumulative hit/miss/evict/COW counters.
+        "config8_prefix_rows": None,
+        "config8_row1_prefill_tokens": None,
+        "config8_rows2k_prefill_tokens": None,
+        "config8_rows2k_prompt_tokens": None,
+        "config8_prefix_cache_hits": None,
+        "config8_prefix_cache_hit_tokens": None,
+        "config8_prefix_cache": None,
         "cycles": None,
         "rounds_per_cycle": None,
         "max_new_tokens": None,
@@ -749,6 +761,63 @@ def _run(args, payload: dict, deadline_at: float) -> None:
     if cfg7:
         log(f"config7: {cfg7}")
 
+    def prefix_cache_config():
+        # config 8: RADIX PREFIX CACHE (models/prefix_cache.py) on the
+        # consensus fan-out shape — K fresh agents share one built
+        # system+task prompt and differ only in a short per-agent suffix,
+        # each under its own session, all in ONE batched query. The
+        # engine's intra-batch wave split prefills the shared prefix once
+        # (row 1); rows 2..K adopt the freshly cached pages and prefill
+        # only their suffix. Reported numbers are per-row prefilled-token
+        # counts (prompt - cached) plus the cache's own hit/miss/evict
+        # counter deltas, so the artifact shows the reuse directly.
+        from quoracle_tpu.models.runtime import QueryRequest
+        member = pool[0]
+        eng = backend.engines[member]
+        K = 3
+        system = ("You are an autonomous agent in a recursive agent tree. "
+                  "Decide your next action. Respond ONLY with a JSON "
+                  'object {"action": ..., "params": {...}, "reasoning": '
+                  '..., "wait": false}. Available actions: send_message, '
+                  "todo, wait, orient, spawn_child, execute_shell, "
+                  "file_read, file_write, fetch_web, call_api, "
+                  "batch_sync, dismiss_child. " + TASKS[0])
+        before = dict(eng.sessions.prefix_cache.stats())
+        reqs = [QueryRequest(
+            model_spec=member,
+            messages=[{"role": "system", "content": system},
+                      {"role": "user",
+                       "content": f"[agent {k}] {TASKS[(k + 1) % len(TASKS)]}"}],
+            temperature=0.0, max_tokens=MAX_NEW,
+            session_id=f"pc8-a{k}", constrain_json=True)
+            for k in range(K)]
+        results = backend.query(reqs)
+        for r in results:
+            assert r.ok, f"config8 row failed: {r.error}"
+        after = eng.sessions.prefix_cache.stats()
+        for k in range(K):
+            backend.drop_session(f"pc8-a{k}")
+        rows = [{"prompt_tokens": r.usage.prompt_tokens,
+                 "cached_tokens": r.cached_tokens,
+                 "prefilled_tokens": r.usage.prompt_tokens
+                 - r.cached_tokens} for r in results]
+        return {
+            "rows": rows,
+            "n_rows": K,
+            "row1_prefill_tokens": rows[0]["prefilled_tokens"],
+            "rows2k_prefill_tokens": sum(r["prefilled_tokens"]
+                                         for r in rows[1:]),
+            "rows2k_prompt_tokens": sum(r["prompt_tokens"]
+                                        for r in rows[1:]),
+            "cache_delta": {k: after[k] - before.get(k, 0)
+                            for k in after},
+            "cache_stats": after,
+        }
+
+    cfg8 = guard("config8", prefix_cache_config)
+    if cfg8:
+        log(f"config8: {cfg8}")
+
     def vision_config():
         # config 5: vision pool — free the trio's HBM first (weights + KV
         # page pools), then serve llama + the VLM checkpoint with an
@@ -868,9 +937,23 @@ def _run(args, payload: dict, deadline_at: float) -> None:
                 / max(1e-9, cfg1["steady_tokens_per_sec"]), 2)
             payload["config6_p50_vs_config1"] = round(
                 cfg6["p50_round_ms"] / max(1e-9, cfg1["p50_round_ms"]), 2)
+    if cfg8:
+        payload.update({
+            "config8_prefix_rows": cfg8["n_rows"],
+            "config8_row1_prefill_tokens": cfg8["row1_prefill_tokens"],
+            "config8_rows2k_prefill_tokens":
+                cfg8["rows2k_prefill_tokens"],
+            "config8_rows2k_prompt_tokens":
+                cfg8["rows2k_prompt_tokens"],
+            "config8_prefix_cache_hits":
+                cfg8["cache_delta"].get("hits", 0),
+            "config8_prefix_cache_hit_tokens":
+                cfg8["cache_delta"].get("hit_tokens", 0),
+            "config8_prefix_cache": cfg8["cache_stats"],
+        })
     log(json.dumps({"config1": cfg1, "config2": cfg2, "config3": cfg3,
                     "config4": cfg4, "config5": cfg5, "config6": cfg6,
-                    "config7": cfg7},
+                    "config7": cfg7, "config8": cfg8},
                    indent=1, default=str))
     payload.update({
         "cycles": N_CYCLES,

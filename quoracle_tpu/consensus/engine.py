@@ -74,6 +74,10 @@ class ConsensusOutcome:
     decode_ms: float = 0.0
     prompt_tokens: int = 0
     completion_tokens: int = 0
+    # Prompt tokens served from resident KV (session resume + radix
+    # prefix-cache hits) instead of re-prefilled, summed over all rounds
+    # and members — the per-turn view of the serving layer's reuse.
+    cached_tokens: int = 0
     cost: float = 0.0
     embed_texts: int = 0
     bug_reports: list[tuple[str, str]] = dataclasses.field(default_factory=list)
@@ -138,7 +142,8 @@ class ConsensusEngine:
                 "round": round_num, "clusters": len(clusters),
                 "responses": len(proposals), "majority": majority is not None,
                 "prefill_ms": round(outcome.prefill_ms, 1),
-                "decode_ms": round(outcome.decode_ms, 1)})
+                "decode_ms": round(outcome.decode_ms, 1),
+                "cached_tokens": outcome.cached_tokens})
             # force_reflection: a round-1 majority is not accepted as-is; the
             # pool reviews once before committing (reference consensus.ex
             # single-model/force_reflection refinement, :304-329).
@@ -222,6 +227,7 @@ class ConsensusEngine:
             outcome.cost += res.usage.cost
             outcome.prefill_ms += getattr(res, "prefill_ms", 0.0)
             outcome.decode_ms += getattr(res, "decode_ms", 0.0)
+            outcome.cached_tokens += getattr(res, "cached_tokens", 0)
             if not res.ok:
                 failures.append(ModelFailure(res.model_spec, res.error))
                 continue

@@ -101,6 +101,11 @@ class EventBus:
 
 TOPIC_LIFECYCLE = "agents:lifecycle"
 TOPIC_ACTIONS = "actions:all"
+# Serving telemetry (no reference analog — the reference never executes
+# attention): per-query-round engine phase timings + radix prefix-cache
+# hit/miss/evict counters (models/prefix_cache.py), broadcast by
+# TPUBackend.attach_bus consumers and ring-buffered by EventHistory.
+TOPIC_SERVING = "serving:metrics"
 
 
 def topic_agent_state(agent_id: str) -> str:

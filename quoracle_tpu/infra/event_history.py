@@ -12,7 +12,9 @@ import threading
 from collections import deque
 from typing import Optional
 
-from quoracle_tpu.infra.bus import EventBus, Subscription, TOPIC_ACTIONS, TOPIC_LIFECYCLE
+from quoracle_tpu.infra.bus import (
+    EventBus, Subscription, TOPIC_ACTIONS, TOPIC_LIFECYCLE, TOPIC_SERVING,
+)
 
 MAX_LOGS_PER_AGENT = 100      # reference ui/event_history.ex:17-20
 MAX_MESSAGES_PER_AGENT = 50
@@ -33,35 +35,44 @@ class EventHistory:
         self._messages: dict[str, deque] = {}
         self._lifecycle: deque = deque(maxlen=max_logs)
         self._actions: deque = deque(maxlen=max_logs)
+        self._serving: deque = deque(maxlen=max_logs)
         self._tasks: set[str] = set()
         self._lock = threading.Lock()
+        self._closed = False
         self._subs: list[Subscription] = [
             bus.subscribe(TOPIC_LIFECYCLE, self._on_lifecycle),
             bus.subscribe(TOPIC_ACTIONS, self._on_action),
+            bus.subscribe(TOPIC_SERVING, self._on_serving),
         ]
 
     # Agent log/message topics are per-agent; the runtime calls track_agent
     # when an agent spawns so its topics are captured from the start.
+    # Subscribe-and-append runs UNDER the lock (ADVICE r5): bus handlers
+    # fire on arbitrary broadcasting threads, and a track racing close()
+    # must neither mutate _subs mid-iteration nor leak a subscription past
+    # the closed flag. (bus.subscribe takes only the bus's own lock, and
+    # broadcast holds no lock while running handlers, so the ordering
+    # self._lock -> bus._lock cannot invert.)
     def track_agent(self, agent_id: str) -> None:
         from quoracle_tpu.infra.bus import topic_agent_logs, topic_agent_state
         with self._lock:
-            if agent_id in self._logs:
+            if self._closed or agent_id in self._logs:
                 return
             self._logs[agent_id] = deque(maxlen=self.max_logs)
             self._messages[agent_id] = deque(maxlen=self.max_messages)
-        self._subs.append(self.bus.subscribe(
-            topic_agent_logs(agent_id), self._on_agent_event))
-        self._subs.append(self.bus.subscribe(
-            topic_agent_state(agent_id), self._on_agent_event))
+            self._subs.append(self.bus.subscribe(
+                topic_agent_logs(agent_id), self._on_agent_event))
+            self._subs.append(self.bus.subscribe(
+                topic_agent_state(agent_id), self._on_agent_event))
 
     def track_task(self, task_id: str) -> None:
         from quoracle_tpu.infra.bus import topic_task_messages
         with self._lock:
-            if task_id in self._tasks:
+            if self._closed or task_id in self._tasks:
                 return
             self._tasks.add(task_id)
-        self._subs.append(self.bus.subscribe(
-            topic_task_messages(task_id), self._on_task_message))
+            self._subs.append(self.bus.subscribe(
+                topic_task_messages(task_id), self._on_task_message))
 
     def _on_lifecycle(self, topic: str, event: dict) -> None:
         with self._lock:
@@ -88,13 +99,24 @@ class EventHistory:
             buf = self._logs.setdefault(agent_id, deque(maxlen=self.max_logs))
             buf.append(event)
 
-    def _on_task_message(self, topic: str, event: dict) -> None:
-        # topic is "tasks:<id>:messages"
-        agent_id = (event.get("message") or {}).get("agent_id") or event.get("task_id")
+    def _on_serving(self, topic: str, event: dict) -> None:
         with self._lock:
-            buf = self._messages.setdefault(
-                agent_id, deque(maxlen=self.max_messages))
-            buf.append(event)
+            self._serving.append(event)
+
+    def _on_task_message(self, topic: str, event: dict) -> None:
+        # topic is "tasks:<id>:messages". Ring under the TASK key always
+        # (the mailbox replay), and ALSO under the SENDER when the message
+        # names one — executors emit the sender as 'from' (ADVICE r5: keying
+        # on 'agent_id' alone left the agent-keyed ring permanently empty).
+        msg = event.get("message") or {}
+        sender = msg.get("agent_id") or msg.get("from")
+        task_id = event.get("task_id")
+        with self._lock:
+            keys = {k for k in (task_id, sender) if k}
+            for key in keys:
+                buf = self._messages.setdefault(
+                    key, deque(maxlen=self.max_messages))
+                buf.append(event)
 
     # -- replay ------------------------------------------------------------
     def replay_logs(self, agent_id: str) -> list[dict]:
@@ -113,7 +135,17 @@ class EventHistory:
         with self._lock:
             return list(self._actions)
 
+    def replay_serving(self) -> list[dict]:
+        """Recent serving rounds (phase timings + prefix-cache counters)."""
+        with self._lock:
+            return list(self._serving)
+
     def close(self) -> None:
-        for sub in self._subs:
+        # swap the list out under the lock: a concurrent track_* sees
+        # _closed and subscribes nothing, and nothing mutates the list we
+        # iterate (ADVICE r5)
+        with self._lock:
+            self._closed = True
+            subs, self._subs = self._subs, []
+        for sub in subs:
             sub.unsubscribe()
-        self._subs.clear()

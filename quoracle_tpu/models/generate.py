@@ -388,6 +388,12 @@ class SessionStore:
         # by several sessions frees only when the last reference releases.
         # Absent key = 1 (every allocated page starts singly-owned).
         self._refs: dict[int, int] = {}
+        # Radix prefix cache (models/prefix_cache.py): page-aligned token
+        # blocks -> pool pages, holding its own reference on each, so
+        # cached prefixes outlive the session that prefilled them. The
+        # engine feeds it at store-back and consults it for new sessions.
+        from quoracle_tpu.models.prefix_cache import RadixPrefixCache
+        self.prefix_cache = RadixPrefixCache(self)
         # device pool arrays live on the engine (self.k/self.v set there);
         # the store only manages ids.
         self.k: Optional[jax.Array] = None
@@ -409,23 +415,53 @@ class SessionStore:
 
         ``evict=False`` takes only from the free list: TEMP allocations
         (direct-decode scratch for sessionless rows) must never destroy
-        other agents' resident sessions for pages that die at call end —
-        the caller falls back to the gather decode instead."""
+        other agents' resident sessions — or thrash the prefix cache — for
+        pages that die at call end; the caller falls back to the gather
+        decode instead.
+
+        Eviction order: RADIX-CACHE LEAVES first (a cached-but-unreferenced
+        prefix is recomputable; a resident session is another agent's live
+        state), then LRU sessions. Attainability is counted exactly per
+        page refcount — a page shared with a protected session, an
+        in-flight adopter, or a cache node that cannot strip does NOT free
+        when its victim releases it, so it must not be counted (the old
+        len(pages) sum overcounted shared pages)."""
         with self.lock:
             if not evict:
                 if n > len(self._free):
                     return None
                 return [self._free.pop() for _ in range(n)]
             victims = [k for k in self._sessions if k not in protect]
-            attainable = len(self._free) + sum(
-                len(self._sessions[k].pages) for k in victims)
-            if n > attainable:
+            if n > self._attainable(victims):
                 return None
             while len(self._free) < n:
+                if self.prefix_cache.evict(n - len(self._free)):
+                    continue
+                if not victims:
+                    break        # _attainable guarantees this can't happen
                 lru = min(victims, key=lambda k: self._sessions[k].last_used)
                 victims.remove(lru)
                 self._release(self._sessions.pop(lru).pages)
+            if len(self._free) < n:       # defensive: accounting drift
+                return None
             return [self._free.pop() for _ in range(n)]
+
+    def _attainable(self, victims: list) -> int:
+        """Exact count of pages reachable by evicting ``victims`` and then
+        stripping freeable prefix-cache leaves: free list + cache pages
+        whose every non-tree reference a victim would release + victim
+        pages (outside the cache) all of whose references victims hold."""
+        import collections
+        released: collections.Counter = collections.Counter()
+        for k in victims:
+            for p in self._sessions[k].pages:
+                if p:
+                    released[p] += 1
+        n_tree = self.prefix_cache.evictable_after(released)
+        extra = sum(1 for p, c in released.items()
+                    if not self.prefix_cache.holds(p)
+                    and c >= self._refs.get(p, 1))
+        return len(self._free) + n_tree + extra
 
     def _release(self, pages: list[int]) -> None:
         for p in pages:
@@ -451,34 +487,34 @@ class SessionStore:
                 if p != 0:
                     self._refs[p] = self._refs.get(p, 1) + 1
 
-    def find_prefix_donor(self, tokens: Sequence[int],
-                          max_reuse: int) -> Optional["_Session"]:
+    def match_prefix(self, tokens: Sequence[int],
+                     max_reuse: int) -> Optional["_Session"]:
         """Cross-session prefix sharing (SURVEY §7 hard part 2's "system
-        prompt cache", the vLLM automatic-prefix-caching analog): find
-        the resident session with the longest PAGE-ALIGNED common token
-        prefix — agents of one config share their system prompt
-        verbatim, so a freshly spawned agent's first prefill can adopt
-        those pages read-only instead of recomputing them. Alignment is
-        a correctness requirement: the boundary page is partially filled
-        by the donor, and the adopter's own suffix must never write into
-        a shared page. Returns a synthetic marker session (donor's
-        prefix tokens + page ids, shared_prefix=True) or None."""
+        prompt cache", the vLLM automatic-prefix-caching analog), served
+        by the RADIX PREFIX CACHE: the longest PAGE-ALIGNED cached token
+        prefix of ``tokens`` — agents of one config share their system
+        prompt verbatim, so a freshly spawned agent's first prefill can
+        adopt those pages read-only instead of recomputing them, and the
+        tree's own page references mean the prefix stays adoptable after
+        the session that prefilled it dies. Alignment is a correctness
+        requirement: the boundary page may be partially filled by the
+        donor, and the adopter's own suffix must never write into a
+        shared page. Returns a synthetic marker session (cached prefix
+        tokens + page ids, shared_prefix=True) or None."""
         with self.lock:
-            best: Optional[_Session] = None
-            best_len = 0
-            for s in self._sessions.values():
-                if s.start_pos != 0:
-                    continue            # trimmed windows don't compose
-                l = min(_lcp(s.tokens, tokens), max_reuse)
-                aligned = (l // self.page) * self.page
-                if aligned >= self.page and aligned > best_len:
-                    best, best_len = s, aligned
-            if best is None:
+            pages, matched = self.prefix_cache.match(tokens, max_reuse)
+            if matched < self.page:
                 return None
-            npg = best_len // self.page
-            return _Session(tokens=list(best.tokens[:best_len]),
-                            pages=list(best.pages[:npg]),
-                            start_pos=0, shared_prefix=True)
+            return _Session(tokens=list(tokens[:matched]),
+                            pages=pages, start_pos=0, shared_prefix=True)
+
+    def insert_prefix(self, tokens: Sequence[int],
+                      pages: Sequence[int]) -> int:
+        """Feed a freshly stored session's full pages into the radix
+        cache (the engine calls this at store-back for full-attention,
+        non-VLM sessions with start_pos == 0)."""
+        with self.lock:
+            return self.prefix_cache.insert(tokens, pages)
 
     def put(self, key: str, sess: _Session) -> None:
         """Replace a session, releasing any of the old session's pages the
@@ -659,9 +695,11 @@ class GenerateEngine:
         # The paged steps donate the pool buffers; calls that touch the pool
         # must serialize (concurrent members use separate engines).
         self._paged_lock = threading.Lock()
-        # Cross-session prefix sharing (SessionStore.find_prefix_donor):
-        # ON by default for full-attention models; the windowed check
-        # lives at the adoption site. Tests flip it off to compare.
+        # Cross-session prefix sharing (SessionStore.match_prefix, backed
+        # by the radix prefix cache in models/prefix_cache.py): ON by
+        # default for full-attention models; the windowed check lives at
+        # the adoption site. Tests flip it off to compare. The flag gates
+        # both cache lookups and store-back inserts.
         self.prefix_sharing = True
         # Grammar-table cache has its OWN lock so sessionless calls (image
         # rows, models/runtime.py) can run concurrently with the continuous
@@ -1052,6 +1090,12 @@ class GenerateEngine:
             # must be one atomic unit, or a concurrent call could evict and
             # recycle pages this batch still references.
             with self._paged_lock:
+                later = self._prefix_wave_split(prompts, session_ids)
+                if later:
+                    return self._generate_waves(
+                        later, prompts, temperature, top_p, max_new_tokens,
+                        rng, session_ids, constrain_json, action_enums,
+                        images, initial_json_state)
                 return self._generate_impl(
                     prompts, temperature, top_p, max_new_tokens, rng,
                     session_ids, constrain_json, action_enums, images,
@@ -1060,6 +1104,97 @@ class GenerateEngine:
                                    max_new_tokens, rng, session_ids,
                                    constrain_json, action_enums, images,
                                    initial_json_state)
+
+    def _prefix_wave_split(self, prompts, session_ids) -> list[int]:
+        """Intra-batch prefix dedup (the consensus fan-out shape: K new
+        agent sessions arrive in ONE batch sharing the built system/task
+        prompt): rows that would re-prefill a page-aligned prefix another
+        row of the SAME batch is about to prefill — and that the radix
+        cache does not cover yet — are deferred to a SECOND wave, which
+        then adopts the first wave's freshly cached pages. The shared
+        prompt prefills once; rows 2..K prefill only their suffix.
+        Returns the deferred row indices ([] = single wave)."""
+        if (not self.prefix_sharing or session_ids is None
+                or self.cfg.sliding_window is not None
+                or self.cfg.vision is not None):
+            return []
+        st = self.sessions
+        page = st.page
+        first: list[int] = []
+        later: list[int] = []
+        from collections import Counter
+        sid_counts = Counter(s for s in session_ids if s)
+        with st.lock:
+            seen: set = set()
+            for i, sid in enumerate(session_ids):
+                if not sid or sid in seen:
+                    continue        # sessionless / duplicate-sid rows
+                seen.add(sid)
+                if sid_counts[sid] > 1:
+                    # duplicated sid in one batch: deferring the first
+                    # occurrence would hand the session to the duplicate —
+                    # keep the existing first-occurrence-owns semantics
+                    continue
+                if st._sessions.get(sid) is not None:
+                    continue        # resident: resumes off its own pages
+                cap = len(prompts[i]) - 1
+                best = 0
+                for j in first:
+                    l = min(_lcp(prompts[j], prompts[i]), cap)
+                    best = max(best, (l // page) * page)
+                # defer only when waiting gains >= 1 full page over what
+                # the cache would already serve this row today
+                if (best >= page and
+                        st.prefix_cache.match_len(prompts[i], cap)
+                        < best):
+                    later.append(i)
+                else:
+                    first.append(i)
+        return later
+
+    def _generate_waves(self, later, prompts, temperature, top_p,
+                        max_new_tokens, rng, session_ids, constrain_json,
+                        action_enums, images, initial_json_state):
+        """Two-wave sessioned generate (caller holds _paged_lock): wave 1
+        prefills the batch's unique prefixes and stores them (radix-cache
+        inserts included), wave 2 runs the deferred duplicate-prefix rows,
+        which now adopt those pages and prefill only their suffixes.
+        Phase/telemetry fields accumulate across both waves."""
+        n = len(prompts)
+        later_set = set(later)
+        first_idx = [i for i in range(n) if i not in later_set]
+
+        def pick(seq, idxs):
+            if seq is None or isinstance(seq, (int, float)):
+                return seq
+            return [seq[i] for i in idxs]
+
+        rng1 = rng2 = None
+        if rng is not None:
+            rng1, rng2 = jax.random.split(rng)
+
+        def run(idxs, wave_rng):
+            return self._generate_impl(
+                [prompts[i] for i in idxs], pick(temperature, idxs),
+                pick(top_p, idxs), pick(max_new_tokens, idxs), wave_rng,
+                pick(session_ids, idxs), pick(constrain_json, idxs),
+                pick(action_enums, idxs),
+                pick(images, idxs) if images is not None else None,
+                pick(initial_json_state, idxs))
+
+        res1 = run(first_idx, rng1)
+        w1 = (self.last_prefill_tokens, self.last_prefill_s,
+              self.last_decode_s)
+        res2 = run(later, rng2)
+        self.last_prefill_tokens += w1[0]
+        self.last_prefill_s += w1[1]
+        self.last_decode_s += w1[2]
+        merged: list = [None] * n
+        for j, i in enumerate(first_idx):
+            merged[i] = res1[j]
+        for j, i in enumerate(later):
+            merged[i] = res2[j]
+        return merged
 
     def drop_session(self, session_id: str) -> None:
         """Release a session's pages — including any image-digest-qualified
@@ -1140,11 +1275,12 @@ class GenerateEngine:
                 s = self.sessions.get(sid)
                 if s is None:
                     # Cross-session prefix sharing: a NEW session whose
-                    # prompt starts with another resident session's
-                    # page-aligned prefix (same system prompt across the
-                    # tree's agents) adopts those pages read-only —
-                    # _run_paged refcount-acquires them and uses them as
-                    # this row's dst prefix, so only the suffix prefills.
+                    # prompt starts with a RADIX-CACHED page-aligned
+                    # prefix (same system prompt across the tree's
+                    # agents; models/prefix_cache.py) adopts those pages
+                    # read-only — _run_paged refcount-acquires them and
+                    # uses them as this row's dst prefix, so only the
+                    # suffix prefills.
                     if (self.prefix_sharing
                             and self.cfg.sliding_window is None
                             # VLM engines: identical placeholder token
@@ -1153,7 +1289,7 @@ class GenerateEngine:
                             # on the wrong image (the digest-keyed
                             # session safeguard, models/runtime.py)
                             and self.cfg.vision is None):
-                        d = self.sessions.find_prefix_donor(
+                        d = self.sessions.match_prefix(
                             prompts[i], len(prompts[i]) - 1)
                         if d is not None:
                             sess_rows[i] = d
@@ -1456,6 +1592,11 @@ class GenerateEngine:
                 if any(j == safe_full and pre_buf % page
                        for j in shared_beyond):
                     partial_swap[0] = True
+                if shared_beyond:
+                    # copy-on-write: the divergent rewrite lands on fresh
+                    # pages; the shared copies (radix cache / adopters)
+                    # keep their content (prefix_cache.py invariant I2)
+                    st.prefix_cache.note_cow(len(shared_beyond))
                 n_extra = max(0, need - len(old)) + len(shared_beyond)
                 if n_extra:
                     extra = st.alloc(n_extra, protect=protect)
@@ -1622,6 +1763,15 @@ class GenerateEngine:
             # releases above cover exactly the no-longer-referenced ones)
             st.put_raw(sid, _Session(tokens=toks, pages=pages,
                                      start_pos=start))
+            # Radix prefix cache insert: every FULL page of the stored
+            # conversation (prompt + retained response KV) becomes
+            # adoptable by future sessions. Windowed/trimmed sessions are
+            # excluded (their pages don't start at position 0) and VLM
+            # engines never share (image hazard, see the lookup site).
+            if (self.prefix_sharing and start == 0
+                    and self.cfg.sliding_window is None
+                    and self.cfg.vision is None):
+                st.insert_prefix(toks, pages)
         # temp pages (direct decode for sessionless rows) die with the call
         for tmp in temp_lists:
             if tmp:

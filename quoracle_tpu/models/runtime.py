@@ -75,6 +75,9 @@ class QueryResult:
     # decode is HBM-bound — a single latency hides which one regressed.
     prefill_ms: float = 0.0
     decode_ms: float = 0.0
+    # Prompt tokens served from resident KV (session resume or a radix
+    # prefix-cache hit, models/prefix_cache.py) instead of re-prefilled.
+    cached_tokens: int = 0
     error: Optional[str] = None        # None = success
     permanent_error: bool = False      # parity: only auth-type errors are
                                        # permanent (model_query.ex:322-332)
@@ -119,6 +122,11 @@ class ModelBackend(abc.ABC):
         termination / pool switch). ``model_specs`` limits the drop to those
         members' engines — a pool switch keeps unchanged members' still-valid
         prefixes resident. No-op for backends without KV residency."""
+
+    def attach_bus(self, bus) -> None:
+        """Optional: give the backend an event bus to broadcast serving
+        telemetry on (TOPIC_SERVING — prefix-cache hit/miss/evict counters,
+        phase timings). No-op for backends without serving internals."""
 
 
 # ---------------------------------------------------------------------------
@@ -309,6 +317,7 @@ class TPUBackend(ModelBackend):
         self.pool = list(pool)
         self.engines: dict[str, GenerateEngine] = dict(engines or {})
         self.overlap = overlap
+        self._bus = None          # attach_bus: serving-telemetry broadcasts
         init_fn = init_params_fn or init_params
 
         def build_engine(spec: str, i: int, mesh=None) -> GenerateEngine:
@@ -423,7 +432,38 @@ class TPUBackend(ModelBackend):
         else:
             for spec, idxs in groups:
                 self._query_member(spec, idxs, requests, results)
+        self._broadcast_serving(by_model)
         return [r for r in results if r is not None]
+
+    def attach_bus(self, bus) -> None:
+        self._bus = bus
+
+    def _broadcast_serving(self, by_model: dict) -> None:
+        """One TOPIC_SERVING event per query round: each queried member's
+        phase timings + radix-prefix-cache counters, for the dashboard's
+        ring-buffer replay (infra/event_history.py) and SSE tail. Never
+        raises into the serving path."""
+        if self._bus is None:
+            return
+        try:
+            from quoracle_tpu.infra.bus import TOPIC_SERVING
+            members = {}
+            for spec in by_model:
+                e = self.engines.get(spec)
+                if e is None:
+                    continue
+                members[spec] = {
+                    "prefill_tokens": e.last_prefill_tokens,
+                    "prefill_ms": round(e.last_prefill_s * 1000, 1),
+                    "decode_ms": round(e.last_decode_s * 1000, 1),
+                    "kv_free_pages": e.sessions.free_pages(),
+                    "prefix_cache": e.sessions.prefix_cache.stats(),
+                }
+            self._bus.broadcast(TOPIC_SERVING, {
+                "event": "serving_round", "ts": time.time(),
+                "members": members})
+        except Exception:                 # noqa: BLE001 — telemetry only
+            logger.exception("serving telemetry broadcast failed")
 
     def _query_member(self, spec: str, idxs: list[int],
                       requests: Sequence[QueryRequest],
@@ -554,7 +594,8 @@ class TPUBackend(ModelBackend):
                 latency_ms=latency_ms,
                 # draft/verify interleave: a prefill/decode split is not
                 # meaningful (same convention as continuous mode)
-                prefill_ms=0.0, decode_ms=0.0)
+                prefill_ms=0.0, decode_ms=0.0,
+                cached_tokens=getattr(g, "n_cached_tokens", 0))
             return
         # The member's baton batcher may merge these rows with concurrent
         # agents' rounds into one generate.
@@ -578,7 +619,8 @@ class TPUBackend(ModelBackend):
                 model_spec=spec, text=g.text,
                 usage=Usage(g.n_prompt_tokens, g.n_gen_tokens, cost),
                 latency_ms=latency_ms,
-                prefill_ms=prefill_ms, decode_ms=decode_ms)
+                prefill_ms=prefill_ms, decode_ms=decode_ms,
+                cached_tokens=g.n_cached_tokens)
 
     def _query_member_continuous(self, spec: str, rows: list[dict],
                                  live_idxs: list[int],
@@ -636,7 +678,8 @@ class TPUBackend(ModelBackend):
             results[i] = QueryResult(
                 model_spec=spec, text=g.text,
                 usage=Usage(g.n_prompt_tokens, g.n_gen_tokens, cost),
-                latency_ms=latency_ms, prefill_ms=0.0, decode_ms=0.0)
+                latency_ms=latency_ms, prefill_ms=0.0, decode_ms=0.0,
+                cached_tokens=g.n_cached_tokens)
 
     def embed(self, texts: Sequence[str]) -> list[np.ndarray]:
         return self.embedder.embed(texts)

@@ -19,7 +19,15 @@ re-derived for XLA's static shapes):
   * between chunks, finished rows retire (futures resolve) and queued
     rows are admitted into free slots — a new agent's row starts decoding
     ``chunk`` tokens after the CURRENT CHUNK, not after every other
-    agent's full round.
+    agent's full round;
+  * a row's FIRST chunk goes through the engine's radix prefix cache
+    (models/prefix_cache.py): a new session whose prompt starts with a
+    cached page-aligned prefix (the fleet's shared system/task preamble)
+    prefills only its suffix, and same-chunk admissions sharing an
+    uncached prefix are wave-split so the batch prefills it once. A
+    scheduler-owned session is dropped when its row retires, but the
+    prefix pages it prefilled stay adoptable in the cache until LRU
+    eviction reclaims them.
 
 Static-shape discipline: batch sizes ride the engine's existing
 BATCH_BUCKETS and ``chunk`` is a fixed decode bound, so steady state

@@ -90,6 +90,9 @@ class Runtime:
         self.costs = CostRecorder(escrow=self.escrow, events=self.events,
                                   persist_fn=self.store.persist_cost)
         self.backend = backend or self._build_backend(config)
+        # serving telemetry (prefix-cache counters, phase timings) rides
+        # the bus into EventHistory's ring + the dashboard SSE tail
+        self.backend.attach_bus(self.bus)
         self.token_manager = TokenManager(
             self.backend.count_tokens,
             context_limit_fn=self.backend.context_window)

@@ -167,12 +167,14 @@ class DashboardServer:
         payload = {
             "lifecycle": h.replay_lifecycle(),
             "actions": h.replay_actions(),
+            "serving": h.replay_serving(),
         }
         if agent_id:
             payload["logs"] = h.replay_logs(agent_id)
         if agent_id or task_id:
-            # task mailbox broadcasts ring-key by sender agent_id when the
-            # message carries one, else by task_id (event_history.py)
+            # task mailbox broadcasts ring under the TASK key and, when
+            # the message names a sender ('agent_id' or the executors'
+            # 'from' field), under that sender too (event_history.py)
             payload["messages"] = h.replay_messages(agent_id or task_id)
         return payload
 
@@ -262,6 +264,9 @@ class DashboardServer:
                     "last_prefill_tokens": e.last_prefill_tokens,
                     "kv_sessions": len(e.sessions),
                     "kv_free_pages": e.sessions.free_pages(),
+                    # radix prefix cache (models/prefix_cache.py):
+                    # hit/miss/evict/COW counters + resident page count
+                    "prefix_cache": e.sessions.prefix_cache.stats(),
                 }
                 for spec, e in engines.items()
             }

@@ -468,6 +468,7 @@ def test_history_endpoint_serves_ring_buffer_mount_replay():
             assert status == 200
             assert hist["actions"]              # decision/action events
             assert "logs" in hist and "messages" in hist
+            assert "serving" in hist            # serving-telemetry ring
             # per-agent ring captured the agent's own broadcasts
             assert isinstance(hist["logs"], list)
             # the task mailbox ring auto-tracks from the "running"
@@ -479,6 +480,16 @@ def test_history_endpoint_serves_ring_buffer_mount_replay():
             assert status == 200
             assert any("history-probe" in str(m)
                        for m in hist["messages"])
+            # AGENT-keyed replay must carry CONTENT too (ADVICE r5: the
+            # executor emits the sender as 'from', and the old keying
+            # left this ring permanently empty)
+            await until(lambda: rt.history.replay_messages(root_id))
+            status, hist = await http_json(
+                base + f"/api/history?agent_id={root_id}")
+            assert status == 200
+            assert any("history-probe" in str(m)
+                       for m in hist["messages"]), \
+                "agent-keyed message ring is empty (sender keying dead)"
         finally:
             await server.stop()
             await rt.shutdown()

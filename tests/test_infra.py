@@ -64,6 +64,56 @@ def test_event_history_replay_and_bounds():
     assert hist.replay_lifecycle()[0]["event"] == "agent_spawned"
 
 
+def test_event_history_task_message_keyed_by_sender_from():
+    """ADVICE r5: executors emit the sender as 'from', not 'agent_id' —
+    the ring must key by the sender (with agent_id taking precedence) AND
+    still serve the task-mailbox replay under the task key."""
+    bus = EventBus()
+    events = AgentEvents(bus)
+    hist = EventHistory(bus)
+    hist.track_task("t1")
+    events.task_message("t1", {"from": "agent-9", "content": "probe-xyz"})
+    agent_ring = hist.replay_messages("agent-9")
+    assert agent_ring and agent_ring[0]["message"]["content"] == "probe-xyz"
+    task_ring = hist.replay_messages("t1")
+    assert task_ring and task_ring[0]["message"]["content"] == "probe-xyz"
+    # explicit agent_id wins over 'from'
+    events.task_message("t1", {"agent_id": "agent-7", "from": "user",
+                               "content": "second"})
+    assert hist.replay_messages("agent-7")
+    assert not hist.replay_messages("user")
+
+
+def test_event_history_track_after_close_is_noop():
+    """ADVICE r5: close() swaps the subscription list out under the lock;
+    a track_* racing (or following) close must not leak a subscription."""
+    bus = EventBus()
+    hist = EventHistory(bus)
+    hist.close()
+    hist.track_agent("late-agent")
+    hist.track_task("late-task")
+    assert hist._subs == []
+    # and the bus got nothing new: broadcasts reach no handler of ours
+    bus.broadcast("agents:late-agent:logs",
+                  {"event": "log", "agent_id": "late-agent"})
+    assert hist.replay_logs("late-agent") == []
+
+
+def test_event_history_serving_ring():
+    """TOPIC_SERVING rounds (prefix-cache counters + phase timings) ride
+    their own ring for the dashboard mount replay."""
+    from quoracle_tpu.infra.bus import TOPIC_SERVING
+    bus = EventBus()
+    hist = EventHistory(bus, max_logs=3)
+    for i in range(5):
+        bus.broadcast(TOPIC_SERVING, {
+            "event": "serving_round",
+            "members": {"m": {"prefix_cache": {"hits": i}}}})
+    ring = hist.replay_serving()
+    assert len(ring) == 3
+    assert ring[-1]["members"]["m"]["prefix_cache"]["hits"] == 4
+
+
 # ------------------------------------------------------------------- escrow
 
 def test_escrow_lock_spend_release():
