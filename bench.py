@@ -85,6 +85,13 @@ TASKS = [
     "Summarize progress so far and message your parent with a status update.",
     "Two children disagree about the deployment order; resolve it.",
 ]
+SYSTEM_PROMPT = (
+    "You are an autonomous agent in a recursive agent tree. "
+    "Decide your next action. Respond ONLY with a JSON object "
+    '{"action": ..., "params": {...}, "reasoning": ..., '
+    '"wait": false}. Available actions: send_message, todo, wait, '
+    "orient, spawn_child, execute_shell, file_read, file_write, "
+    "fetch_web, call_api, batch_sync, dismiss_child.")
 REFINEMENTS = [
     "Consensus was not reached. Other models proposed different actions. "
     "Review your proposal as a skeptical reviewer and respond with your "
@@ -235,12 +242,7 @@ def run_cycle(backend, pool, session_prefix: str, task: str,
     from quoracle_tpu.consensus.temperature import temperature_for_round
     from quoracle_tpu.models.runtime import QueryRequest
 
-    system = ("You are an autonomous agent in a recursive agent tree. "
-              "Decide your next action. Respond ONLY with a JSON object "
-              '{"action": ..., "params": {...}, "reasoning": ..., '
-              '"wait": false}. Available actions: send_message, todo, wait, '
-              "orient, spawn_child, execute_shell, file_read, file_write, "
-              "fetch_web, call_api, batch_sync, dismiss_child.")
+    system = SYSTEM_PROMPT
     # per (agent, member) conversation, as the consensus engine keeps them.
     # With an image, the task message is multimodal: VLM members splice the
     # ViT soft tokens, text members see the stringified "[image]" marker —
@@ -425,6 +427,55 @@ def measure_embed_retrieval(backend) -> dict:
     }
 
 
+def measure_consensus_telemetry(backend, pool,
+                                n_decides: int = N_CYCLES) -> dict:
+    """Config 9: ``n_decides`` REAL ConsensusEngine.decide calls over the
+    full pool. Round and decide latency quantiles come from the telemetry
+    histograms' count deltas around the measured window
+    (infra/telemetry.py quantile over quoracle_round_ms /
+    quoracle_decide_ms) — NOT from wall-clock diffs — so the artifact
+    reports exactly what GET /metrics scrapes. Per-decide rows carry the
+    prefill/decode decomposition (ConsensusOutcome.prefill_ms/decode_ms)."""
+    from quoracle_tpu.consensus.engine import ConsensusConfig, ConsensusEngine
+    from quoracle_tpu.infra.telemetry import DECIDE_MS, ROUND_MS, quantile
+
+    eng = ConsensusEngine(backend, ConsensusConfig(
+        model_pool=list(pool), session_key="bench-config9"))
+    rb, _, _ = ROUND_MS.counts()
+    db, _, _ = DECIDE_MS.counts()
+    rows = []
+    for i in range(n_decides):
+        msgs = {m: [{"role": "system", "content": SYSTEM_PROMPT},
+                    {"role": "user",
+                     "content": TASKS[i % len(TASKS)]}] for m in pool}
+        out = eng.decide(msgs)
+        rows.append({"status": out.status, "rounds": out.rounds_used,
+                     "latency_ms": round(out.latency_ms, 1),
+                     "prefill_ms": round(out.prefill_ms, 1),
+                     "decode_ms": round(out.decode_ms, 1),
+                     "cached_tokens": out.cached_tokens})
+        log(f"config9 decide {i}: {rows[-1]}")
+    ra, _, _ = ROUND_MS.counts()
+    da, _, _ = DECIDE_MS.counts()
+    rdelta = [a - b for a, b in zip(ra, rb)]
+    ddelta = [a - b for a, b in zip(da, db)]
+
+    def q(h, delta, p):
+        v = quantile(h.buckets, delta, p)
+        return round(v, 1) if v is not None else None
+    return {
+        "rows": rows,
+        "n_decides": n_decides,
+        "n_rounds": sum(rdelta),
+        "round_p50_ms": q(ROUND_MS, rdelta, 0.50),
+        "round_p95_ms": q(ROUND_MS, rdelta, 0.95),
+        "decide_p50_ms": q(DECIDE_MS, ddelta, 0.50),
+        "decide_p95_ms": q(DECIDE_MS, ddelta, 0.95),
+        "prefill_ms_total": round(sum(r["prefill_ms"] for r in rows), 1),
+        "decode_ms_total": round(sum(r["decode_ms"] for r in rows), 1),
+    }
+
+
 def base_payload() -> dict:
     """Every key the artifact can carry, pre-filled null — ANY exit path
     prints this line with whatever was actually measured, so degraded runs
@@ -478,6 +529,20 @@ def base_payload() -> dict:
         "config8_prefix_cache_hits": None,
         "config8_prefix_cache_hit_tokens": None,
         "config8_prefix_cache": None,
+        # config 9 — consensus serving telemetry (infra/telemetry.py):
+        # N real ConsensusEngine.decide calls; round/decide latency
+        # p50/p95 come from the quoracle_round_ms / quoracle_decide_ms
+        # histogram COUNT DELTAS (the same numbers GET /metrics scrapes),
+        # rows decompose each decide into prefill vs decode ms.
+        "config9_n_decides": None,
+        "config9_n_rounds": None,
+        "config9_round_p50_ms": None,
+        "config9_round_p95_ms": None,
+        "config9_decide_p50_ms": None,
+        "config9_decide_p95_ms": None,
+        "config9_prefill_ms_total": None,
+        "config9_decode_ms_total": None,
+        "config9_rows": None,
         "cycles": None,
         "rounds_per_cycle": None,
         "max_new_tokens": None,
@@ -818,6 +883,13 @@ def _run(args, payload: dict, deadline_at: float) -> None:
     if cfg8:
         log(f"config8: {cfg8}")
 
+    # config 9 must run while ``backend`` is still alive — the vision
+    # config below frees it to make HBM room for the VLM pool
+    cfg9 = guard("config9",
+                 lambda: measure_consensus_telemetry(backend, pool))
+    if cfg9:
+        log(f"config9: {cfg9}")
+
     def vision_config():
         # config 5: vision pool — free the trio's HBM first (weights + KV
         # page pools), then serve llama + the VLM checkpoint with an
@@ -951,9 +1023,21 @@ def _run(args, payload: dict, deadline_at: float) -> None:
                 cfg8["cache_delta"].get("hit_tokens", 0),
             "config8_prefix_cache": cfg8["cache_stats"],
         })
+    if cfg9:
+        payload.update({
+            "config9_n_decides": cfg9["n_decides"],
+            "config9_n_rounds": cfg9["n_rounds"],
+            "config9_round_p50_ms": cfg9["round_p50_ms"],
+            "config9_round_p95_ms": cfg9["round_p95_ms"],
+            "config9_decide_p50_ms": cfg9["decide_p50_ms"],
+            "config9_decide_p95_ms": cfg9["decide_p95_ms"],
+            "config9_prefill_ms_total": cfg9["prefill_ms_total"],
+            "config9_decode_ms_total": cfg9["decode_ms_total"],
+            "config9_rows": cfg9["rows"],
+        })
     log(json.dumps({"config1": cfg1, "config2": cfg2, "config3": cfg3,
                     "config4": cfg4, "config5": cfg5, "config6": cfg6,
-                    "config7": cfg7, "config8": cfg8},
+                    "config7": cfg7, "config8": cfg8, "config9": cfg9},
                    indent=1, default=str))
     payload.update({
         "cycles": N_CYCLES,

@@ -34,6 +34,7 @@ from typing import Any, Optional
 
 from quoracle_tpu.actions.executors import ActionError, get_executor
 from quoracle_tpu.infra.security import resolve_secrets, scrub_output
+from quoracle_tpu.infra.telemetry import ACTION_MS, ACTIONS_TOTAL, TRACER
 
 logger = logging.getLogger(__name__)
 
@@ -68,6 +69,14 @@ class ActionRouter:
         core, deps = self.core, self.core.deps
         deps.events.action_started(core.agent_id, self.action_id, self.action,
                                    self.params)
+        # Unbound span (telemetry.py): routers interleave on the event
+        # loop, so a thread-local current-span binding would leak across
+        # tasks — the span links by explicit trace_id (the task) instead.
+        span = TRACER.start("action.execute",
+                            trace_id=core.config.task_id, parent=None,
+                            agent_id=core.agent_id, action=self.action,
+                            phase="action")
+        t0 = time.monotonic()
         try:
             params, _used = resolve_secrets(
                 self.params,
@@ -89,6 +98,10 @@ class ActionRouter:
             result = {"status": "error",
                       "error": f"{type(e).__name__}: {e}"}
         result = scrub_output(result, deps.secrets.values())
+        span.finish(status=result["status"])
+        ACTION_MS.observe((time.monotonic() - t0) * 1000,
+                          action=self.action)
+        ACTIONS_TOTAL.inc(action=self.action, status=result["status"])
         deps.events.action_completed(core.agent_id, self.action_id,
                                      self.action, result["status"])
         core.post({"type": "action_result", "action_id": self.action_id,

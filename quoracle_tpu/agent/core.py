@@ -54,6 +54,7 @@ from quoracle_tpu.context.message_builder import build_messages_for_model
 from quoracle_tpu.governance.capabilities import filter_actions
 from quoracle_tpu.infra.costs import CostEntry
 from quoracle_tpu.infra.injection import UNTRUSTED_ACTIONS, wrap_untrusted
+from quoracle_tpu.infra.telemetry import TRACER
 from quoracle_tpu.utils.normalize import to_json
 
 logger = logging.getLogger(__name__)
@@ -340,7 +341,18 @@ class AgentCore:
     def _consensus_blocking(self) -> ConsensusOutcome:
         """Worker-thread half of the cycle: condense → build → decide →
         inline-condense. Exclusive ctx access holds because the actor loop is
-        suspended awaiting this function."""
+        suspended awaiting this function.
+
+        Trace root for the whole tick: trace_id is the TASK, so every
+        child span down the serving path (decide → rounds → member
+        generate phases) lands in /api/trace?task_id=…. Binding the
+        current span thread-locally is safe here — this runs on an
+        executor thread, one tick at a time per agent."""
+        with TRACER.span("agent.decide_tick", trace_id=self.config.task_id,
+                         parent=None, agent_id=self.agent_id):
+            return self._consensus_blocking_impl()
+
+    def _consensus_blocking_impl(self) -> ConsensusOutcome:
         deps, cfg = self.deps, self.config
         if self._system_prompt is None:
             available, active = [], []

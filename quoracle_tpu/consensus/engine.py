@@ -29,6 +29,9 @@ from quoracle_tpu.consensus.parser import (
 from quoracle_tpu.consensus.result import Decision, pick_winner
 from quoracle_tpu.consensus.rules import EmbedAccumulator
 from quoracle_tpu.consensus.temperature import temperature_for_round
+from quoracle_tpu.infra.telemetry import (
+    DECIDE_MS, ROUND_MS, ROUNDS_TOTAL, TRACER,
+)
 from quoracle_tpu.models.runtime import ModelBackend, QueryRequest
 
 DEFAULT_THRESHOLD = 0.5          # reference consensus/manager.ex:11-21
@@ -106,7 +109,26 @@ class ConsensusEngine:
         ``messages_per_model`` maps model_spec -> chat messages (system prompt
         included) — each pool member fills its own context window (reference
         per-model histories, README.md:642-650).
+
+        Traced end to end (infra/telemetry.py): one ``consensus.decide``
+        span (child of the agent's decide-tick span when called from the
+        agent runtime) wrapping per-round ``consensus.round`` spans, with
+        quoracle_decide_ms / quoracle_round_ms histogram observations.
         """
+        t0 = time.monotonic()
+        with TRACER.span("consensus.decide",
+                         agent_id=self.config.session_key,
+                         n_models=len(self.config.model_pool)) as sp:
+            outcome = self._decide(messages_per_model)
+            sp.attrs.update(status=outcome.status,
+                            rounds=outcome.rounds_used,
+                            prefill_ms=round(outcome.prefill_ms, 1),
+                            decode_ms=round(outcome.decode_ms, 1),
+                            cached_tokens=outcome.cached_tokens)
+        DECIDE_MS.observe((time.monotonic() - t0) * 1000)
+        return outcome
+
+    def _decide(self, messages_per_model: dict[str, list[dict]]) -> ConsensusOutcome:
         t0 = time.monotonic()
         cfg = self.config
         outcome = ConsensusOutcome(status="ok")
@@ -196,6 +218,22 @@ class ConsensusEngine:
     def _query_round(self, histories: dict[str, list[dict]], pool: list[str],
                      round_num: int, outcome: ConsensusOutcome,
                      ) -> tuple[list[ActionProposal], list[ModelFailure]]:
+        # One round = query + parse + validate; the span parents the
+        # backend's per-member generate spans, and quoracle_round_ms is
+        # what bench config 9 reports p50/p95 from.
+        t0 = time.monotonic()
+        with TRACER.span("consensus.round", round=round_num,
+                         agent_id=self.config.session_key):
+            result = self._query_round_impl(histories, pool, round_num,
+                                            outcome)
+        ROUND_MS.observe((time.monotonic() - t0) * 1000)
+        ROUNDS_TOTAL.inc()
+        return result
+
+    def _query_round_impl(self, histories: dict[str, list[dict]],
+                          pool: list[str], round_num: int,
+                          outcome: ConsensusOutcome,
+                          ) -> tuple[list[ActionProposal], list[ModelFailure]]:
         cfg = self.config
         requests = [
             QueryRequest(

@@ -106,6 +106,11 @@ TOPIC_ACTIONS = "actions:all"
 # hit/miss/evict counters (models/prefix_cache.py), broadcast by
 # TPUBackend.attach_bus consumers and ring-buffered by EventHistory.
 TOPIC_SERVING = "serving:metrics"
+# Finished trace spans (infra/telemetry.py): the Runtime registers a
+# tracer sink that re-broadcasts every finished span here; EventHistory
+# ring-buffers them for /api/trace?task_id=… mount replay and the SSE
+# tail streams them live.
+TOPIC_TRACE = "trace:spans"
 
 
 def topic_agent_state(agent_id: str) -> str:

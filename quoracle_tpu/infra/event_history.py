@@ -14,10 +14,15 @@ from typing import Optional
 
 from quoracle_tpu.infra.bus import (
     EventBus, Subscription, TOPIC_ACTIONS, TOPIC_LIFECYCLE, TOPIC_SERVING,
+    TOPIC_TRACE,
 )
 
 MAX_LOGS_PER_AGENT = 100      # reference ui/event_history.ex:17-20
 MAX_MESSAGES_PER_AGENT = 50
+# Trace-span ring: one consensus round emits ~10 spans (tick, decide,
+# rounds, members, phases, action), so 512 covers dozens of recent rounds
+# across tasks; /api/trace filters by trace_id.
+MAX_TRACE_SPANS = 512
 
 
 class EventHistory:
@@ -36,6 +41,7 @@ class EventHistory:
         self._lifecycle: deque = deque(maxlen=max_logs)
         self._actions: deque = deque(maxlen=max_logs)
         self._serving: deque = deque(maxlen=max_logs)
+        self._traces: deque = deque(maxlen=MAX_TRACE_SPANS)
         self._tasks: set[str] = set()
         self._lock = threading.Lock()
         self._closed = False
@@ -43,6 +49,7 @@ class EventHistory:
             bus.subscribe(TOPIC_LIFECYCLE, self._on_lifecycle),
             bus.subscribe(TOPIC_ACTIONS, self._on_action),
             bus.subscribe(TOPIC_SERVING, self._on_serving),
+            bus.subscribe(TOPIC_TRACE, self._on_trace),
         ]
 
     # Agent log/message topics are per-agent; the runtime calls track_agent
@@ -103,6 +110,10 @@ class EventHistory:
         with self._lock:
             self._serving.append(event)
 
+    def _on_trace(self, topic: str, event: dict) -> None:
+        with self._lock:
+            self._traces.append(event)
+
     def _on_task_message(self, topic: str, event: dict) -> None:
         # topic is "tasks:<id>:messages". Ring under the TASK key always
         # (the mailbox replay), and ALSO under the SENDER when the message
@@ -139,6 +150,15 @@ class EventHistory:
         """Recent serving rounds (phase timings + prefix-cache counters)."""
         with self._lock:
             return list(self._serving)
+
+    def replay_traces(self, trace_id: Optional[str] = None) -> list[dict]:
+        """Recent finished spans (infra/telemetry.py), optionally filtered
+        to one trace (= task). Backs /api/trace?task_id=…."""
+        with self._lock:
+            spans = list(self._traces)
+        if trace_id is None:
+            return spans
+        return [s for s in spans if s.get("trace_id") == trace_id]
 
     def close(self) -> None:
         # swap the list out under the lock: a concurrent track_* sees

@@ -1,0 +1,532 @@
+"""Serving-path telemetry: metrics registry + span tracing.
+
+The reference feeds Phoenix LiveDashboard from `telemetry.ex` summaries;
+here the equivalent is split in two primitives sized for the TPU serving
+path:
+
+* **MetricsRegistry** — counters, gauges, and fixed-bucket EXPONENTIAL
+  latency histograms. Recording is lock-cheap (one small per-metric lock,
+  a bisect, two adds — no allocation on the hot path); snapshots derive
+  p50/p95/p99 by linear interpolation inside the owning bucket, and
+  `render_prometheus()` emits the text exposition format for scraping at
+  ``GET /metrics`` (web/server.py).
+* **Tracer** — span-based tracing. A :class:`Span` carries ``trace_id``
+  (the task), ``agent_id``, ``round``, and ``phase`` attributes and links
+  to its parent; finished spans go to registered sinks (the Runtime's
+  sink broadcasts them on ``TOPIC_TRACE``, ring-buffered by
+  infra/event_history.py and queryable at ``/api/trace?task_id=…``).
+  Propagation across the thread hops of the serving path (agent executor
+  thread → pool-member threads → baton-batcher drain) is explicit:
+  ``TRACER.use(parent)`` rebinds the current span in a foreign thread.
+
+Telemetry is the ONE deliberately process-wide component in a codebase
+that otherwise injects every dependency (root AGENTS.md DI rule): metrics
+are write-mostly aggregates and spans carry their own ``trace_id``, so
+cross-Runtime isolation comes from filtering, not instancing. Tests that
+need a hermetic view build their own :class:`MetricsRegistry` /
+:class:`Tracer` or attach a private sink.
+
+Recording never touches RNG or device state — temp-0 outputs are
+bit-identical with tracing on or off (ISSUE 2 acceptance).
+"""
+
+from __future__ import annotations
+
+import bisect
+import itertools
+import threading
+import time
+from typing import Any, Callable, Iterable, Optional, Sequence
+
+# ---------------------------------------------------------------------------
+# Buckets
+# ---------------------------------------------------------------------------
+
+# Latency buckets in MILLISECONDS: powers of two from 0.5 ms to ~65 s.
+# Exponential spacing keeps relative quantile error bounded (~±50% worst
+# case, far tighter after interpolation) across the 5 decades the serving
+# path spans (µs-scale cache lookups to multi-second compile rounds).
+DEFAULT_MS_BUCKETS: tuple[float, ...] = tuple(2.0 ** i for i in range(-1, 17))
+
+# Throughput buckets (tokens/second): powers of four, 1 .. ~4.2M tok/s.
+THROUGHPUT_BUCKETS: tuple[float, ...] = tuple(4.0 ** i for i in range(0, 12))
+
+
+def quantile(bounds: Sequence[float], counts: Sequence[int],
+             p: float) -> Optional[float]:
+    """The p-quantile (0 < p < 1) of a bucketed distribution.
+
+    ``counts`` has ``len(bounds) + 1`` slots (the last is the +Inf
+    overflow). Linear interpolation inside the owning bucket; the overflow
+    bucket reports its lower edge (no upper bound to interpolate to).
+    Returns None for an empty histogram. Exposed as a module function so
+    bench.py can compute quantiles of COUNT DELTAS (before/after a
+    measured window) without a second histogram instance.
+    """
+    total = sum(counts)
+    if total <= 0:
+        return None
+    target = p * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        if c <= 0:
+            continue
+        if cum + c >= target:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            if i >= len(bounds):          # +Inf overflow bucket
+                return lo
+            hi = bounds[i]
+            frac = (target - cum) / c
+            return lo + frac * (hi - lo)
+        cum += c
+    return bounds[-1]
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _label_str(key: tuple) -> str:
+    return ",".join(f"{k}={v}" for k, v in key)
+
+
+def _escape(v: Any) -> str:
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _fmt_labels(key: tuple, extra: tuple = ()) -> str:
+    items = tuple(key) + tuple(extra)
+    if not items:
+        return ""
+    return "{" + ",".join(f'{k}="{_escape(v)}"' for k, v in items) + "}"
+
+
+# ---------------------------------------------------------------------------
+# Metrics
+# ---------------------------------------------------------------------------
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        # label-key tuple -> cell (shape depends on the metric kind)
+        self._cells: dict[tuple, Any] = {}
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, n: float = 1.0, **labels: Any) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            self._cells[key] = self._cells.get(key, 0.0) + n
+
+    def value(self, **labels: Any) -> float:
+        with self._lock:
+            return float(self._cells.get(_label_key(labels), 0.0))
+
+    def total(self) -> float:
+        with self._lock:
+            return float(sum(self._cells.values()))
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            cells = dict(self._cells)
+        return {"type": self.kind, "total": sum(cells.values()),
+                "series": {_label_str(k): v for k, v in cells.items()}}
+
+    def _render(self, out: list[str]) -> None:
+        with self._lock:
+            cells = dict(self._cells)
+        for key, v in sorted(cells.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {_num(v)}")
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, v: float, **labels: Any) -> None:
+        with self._lock:
+            self._cells[_label_key(labels)] = float(v)
+
+    def value(self, **labels: Any) -> Optional[float]:
+        with self._lock:
+            return self._cells.get(_label_key(labels))
+
+    def _snapshot(self) -> dict:
+        with self._lock:
+            cells = dict(self._cells)
+        return {"type": self.kind,
+                "series": {_label_str(k): v for k, v in cells.items()}}
+
+    def _render(self, out: list[str]) -> None:
+        with self._lock:
+            cells = dict(self._cells)
+        for key, v in sorted(cells.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {_num(v)}")
+
+
+class _HistCell:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * (n_buckets + 1)   # +1 = +Inf overflow
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Metric):
+    """Fixed-bucket exponential histogram. ``observe`` is the hot path:
+    one lock, one bisect, three adds."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Sequence[float] = DEFAULT_MS_BUCKETS):
+        super().__init__(name, help)
+        self.buckets = tuple(float(b) for b in buckets)
+        assert list(self.buckets) == sorted(set(self.buckets)), \
+            "histogram buckets must be strictly increasing"
+
+    def observe(self, v: float, **labels: Any) -> None:
+        key = _label_key(labels)
+        idx = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            cell = self._cells.get(key)
+            if cell is None:
+                cell = self._cells[key] = _HistCell(len(self.buckets))
+            cell.counts[idx] += 1
+            cell.sum += v
+            cell.count += 1
+
+    # -- reads -----------------------------------------------------------
+
+    def counts(self, **labels: Any) -> tuple[list[int], float, int]:
+        """(bucket counts incl. +Inf slot, sum, count). With no labels the
+        counts AGGREGATE across every label set — bench.py diffs these
+        around a measured window."""
+        with self._lock:
+            if labels:
+                cell = self._cells.get(_label_key(labels))
+                cells = [cell] if cell is not None else []
+            else:
+                cells = list(self._cells.values())
+        agg = [0] * (len(self.buckets) + 1)
+        s, n = 0.0, 0
+        for c in cells:
+            for i, v in enumerate(c.counts):
+                agg[i] += v
+            s += c.sum
+            n += c.count
+        return agg, s, n
+
+    def percentiles(self, ps: Iterable[float] = (0.50, 0.95, 0.99),
+                    **labels: Any) -> dict[float, Optional[float]]:
+        agg, _, _ = self.counts(**labels)
+        return {p: quantile(self.buckets, agg, p) for p in ps}
+
+    def _snapshot(self) -> dict:
+        def q(agg):
+            return {f"p{int(p * 100)}": quantile(self.buckets, agg, p)
+                    for p in (0.50, 0.95, 0.99)}
+        with self._lock:
+            cells = {k: (list(c.counts), c.sum, c.count)
+                     for k, c in self._cells.items()}
+        agg, s, n = [0] * (len(self.buckets) + 1), 0.0, 0
+        series = {}
+        for k, (counts, cs, cn) in cells.items():
+            for i, v in enumerate(counts):
+                agg[i] += v
+            s += cs
+            n += cn
+            series[_label_str(k)] = {"count": cn, "sum": cs, **q(counts)}
+        return {"type": self.kind, "count": n, "sum": s, **q(agg),
+                "series": series}
+
+    def _render(self, out: list[str]) -> None:
+        with self._lock:
+            cells = {k: (list(c.counts), c.sum, c.count)
+                     for k, c in self._cells.items()}
+        for key, (counts, s, n) in sorted(cells.items()):
+            cum = 0
+            for b, c in zip(self.buckets, counts):
+                cum += c
+                out.append(f"{self.name}_bucket"
+                           f"{_fmt_labels(key, (('le', _num(b)),))} {cum}")
+            out.append(f"{self.name}_bucket"
+                       f"{_fmt_labels(key, (('le', '+Inf'),))} {n}")
+            out.append(f"{self.name}_sum{_fmt_labels(key)} {_num(s)}")
+            out.append(f"{self.name}_count{_fmt_labels(key)} {n}")
+
+
+def _num(v: float) -> str:
+    """Prometheus number formatting: integral floats render bare."""
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+class MetricsRegistry:
+    """Get-or-create registry; re-registering a name returns the existing
+    metric (type mismatch raises — two layers silently recording into
+    differently-typed metrics of one name would corrupt both)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get(self, cls, name: str, help: str, **kw) -> Any:
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name!r} already registered as "
+                                f"{m.kind}, not {cls.kind}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_MS_BUCKETS) -> Histogram:
+        return self._get(Histogram, name, help, buckets=buckets)
+
+    def snapshot(self) -> dict:
+        """JSON-friendly view for /api/metrics: per metric the aggregate
+        (and per-label-series) counts + p50/p95/p99 quantiles — the
+        histogram replacement for the last-call scalars."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        return {m.name: m._snapshot() for m in metrics}
+
+    def render_prometheus(self) -> str:
+        """Text exposition format (version 0.0.4). HELP/TYPE headers are
+        emitted for every registered metric even before first traffic, so
+        scrapers and tests see the full metric surface immediately."""
+        with self._lock:
+            metrics = sorted(self._metrics.values(), key=lambda m: m.name)
+        out: list[str] = []
+        for m in metrics:
+            if m.help:
+                out.append(f"# HELP {m.name} {m.help}")
+            out.append(f"# TYPE {m.name} {m.kind}")
+            m._render(out)
+        return "\n".join(out) + "\n"
+
+    def reset(self) -> None:
+        """Drop every registered metric (tests)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+# ---------------------------------------------------------------------------
+# Tracing
+# ---------------------------------------------------------------------------
+
+_span_ids = itertools.count(1)
+
+
+class Span:
+    """One timed unit of work. Attributes are free-form; the serving path
+    uses trace_id (task), agent_id, model, round, phase."""
+
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "attrs",
+                 "_t0", "ts", "duration_ms", "_tracer")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 trace_id: Optional[str], parent_id: Optional[str],
+                 attrs: dict):
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = f"s{next(_span_ids):x}"
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self._t0 = time.monotonic()
+        self.ts = time.time()
+        self.duration_ms: Optional[float] = None
+        self._tracer = tracer
+
+    def finish(self, **attrs: Any) -> None:
+        if self.duration_ms is not None:
+            return                        # idempotent
+        if attrs:
+            self.attrs.update(attrs)
+        self.duration_ms = (time.monotonic() - self._t0) * 1000.0
+        self._tracer._emit(self)
+
+    def as_event(self) -> dict:
+        return {"event": "span", "ts": self.ts, "name": self.name,
+                "trace_id": self.trace_id, "span_id": self.span_id,
+                "parent_id": self.parent_id,
+                "duration_ms": (round(self.duration_ms, 3)
+                                if self.duration_ms is not None else None),
+                **self.attrs}
+
+
+class _SpanCtx:
+    """Context manager: binds the span as the thread's current on enter,
+    restores the previous current and finishes on exit."""
+
+    __slots__ = ("_tracer", "_span", "_bind", "_prev")
+
+    def __init__(self, tracer: "Tracer", span: Span, bind: bool):
+        self._tracer = tracer
+        self._span = span
+        self._bind = bind
+
+    def __enter__(self) -> Span:
+        if self._bind:
+            self._prev = self._tracer.current()
+            self._tracer._set_current(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._bind:
+            self._tracer._set_current(self._prev)
+        self._span.finish(**({"error": repr(exc)} if exc is not None
+                             else {}))
+
+
+class _UseCtx:
+    __slots__ = ("_tracer", "_span", "_prev")
+
+    def __init__(self, tracer: "Tracer", span: Optional[Span]):
+        self._tracer = tracer
+        self._span = span
+
+    def __enter__(self) -> Optional[Span]:
+        self._prev = self._tracer.current()
+        self._tracer._set_current(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self._tracer._set_current(self._prev)
+
+
+class Tracer:
+    """Thread-local current-span stack + sink fan-out. Sinks receive the
+    finished span's event dict; sink exceptions are swallowed (telemetry
+    must never take the serving path down)."""
+
+    def __init__(self) -> None:
+        self._tls = threading.local()
+        self._sinks: list[Callable[[dict], None]] = []
+        self._sink_lock = threading.Lock()
+
+    # -- sinks -----------------------------------------------------------
+
+    def add_sink(self, fn: Callable[[dict], None]) -> None:
+        with self._sink_lock:
+            if fn not in self._sinks:
+                self._sinks.append(fn)
+
+    def remove_sink(self, fn: Callable[[dict], None]) -> None:
+        with self._sink_lock:
+            if fn in self._sinks:
+                self._sinks.remove(fn)
+
+    def _emit(self, span: Span) -> None:
+        with self._sink_lock:
+            sinks = list(self._sinks)
+        if not sinks:
+            return
+        event = span.as_event()
+        for fn in sinks:
+            try:
+                fn(event)
+            except Exception:             # noqa: BLE001 — telemetry only
+                pass
+
+    # -- current-span plumbing ------------------------------------------
+
+    def current(self) -> Optional[Span]:
+        return getattr(self._tls, "span", None)
+
+    def _set_current(self, span: Optional[Span]) -> None:
+        self._tls.span = span
+
+    def use(self, span: Optional[Span]) -> _UseCtx:
+        """Rebind ``span`` as current in THIS thread (cross-thread
+        propagation: capture `current()` before the hop, `use()` it
+        inside). Restores the previous binding on exit."""
+        return _UseCtx(self, span)
+
+    # -- span creation ---------------------------------------------------
+
+    def span(self, name: str, trace_id: Optional[str] = None,
+             parent: Optional[Span] = None, bind: bool = True,
+             **attrs: Any) -> _SpanCtx:
+        """Open a span as a context manager. ``parent`` defaults to the
+        thread's current span; ``trace_id`` inherits from the parent.
+        ``bind=False`` creates + times the span without making it current
+        (for async code on the event loop, where a thread-local binding
+        would leak across interleaved tasks)."""
+        return _SpanCtx(self, self.start(name, trace_id, parent, **attrs),
+                        bind)
+
+    def start(self, name: str, trace_id: Optional[str] = None,
+              parent: Optional[Span] = None, **attrs: Any) -> Span:
+        """Open an unbound span; the caller must ``finish()`` it."""
+        p = parent if parent is not None else self.current()
+        tid = trace_id or (p.trace_id if p is not None else None)
+        return Span(self, name, tid, p.span_id if p is not None else None,
+                    attrs)
+
+    def emit(self, name: str, duration_ms: float,
+             trace_id: Optional[str] = None, parent: Optional[Span] = None,
+             **attrs: Any) -> None:
+        """Retroactive span: a phase whose duration was measured elsewhere
+        (e.g. the engine's device-fenced prefill/decode seconds) enters
+        the trace after the fact."""
+        span = self.start(name, trace_id, parent, **attrs)
+        span.duration_ms = float(duration_ms)
+        self._emit(span)
+
+
+# ---------------------------------------------------------------------------
+# Process-wide defaults + the serving path's named instruments
+# ---------------------------------------------------------------------------
+
+METRICS = MetricsRegistry()
+TRACER = Tracer()
+
+# Histograms (ms unless noted). Registered at import so GET /metrics
+# exposes the full surface before first traffic.
+PREFILL_MS = METRICS.histogram(
+    "quoracle_prefill_ms", "per-generate prefill device phase (ms)")
+DECODE_MS = METRICS.histogram(
+    "quoracle_decode_ms", "per-generate decode device phase (ms)")
+ROUND_MS = METRICS.histogram(
+    "quoracle_round_ms", "one consensus query round: query+parse+validate (ms)")
+DECIDE_MS = METRICS.histogram(
+    "quoracle_decide_ms", "full ConsensusEngine.decide, refinement included (ms)")
+ACTION_MS = METRICS.histogram(
+    "quoracle_action_ms", "action executor wall time (ms)")
+DECODE_STEP_MS = METRICS.histogram(
+    "quoracle_decode_step_ms", "decode phase per emitted token (ms)",
+    buckets=tuple(2.0 ** i for i in range(-4, 12)))
+PREFIX_LOOKUP_MS = METRICS.histogram(
+    "quoracle_prefix_lookup_ms", "radix prefix-cache lookup (ms)",
+    buckets=tuple(2.0 ** i for i in range(-6, 8)))
+PREFILL_TOKENS_PER_S = METRICS.histogram(
+    "quoracle_prefill_tokens_per_s", "per-wave prefill token throughput",
+    buckets=THROUGHPUT_BUCKETS)
+JIT_COMPILES = METRICS.counter(
+    "quoracle_jit_compiles_total",
+    "first-call shape-bucket compiles per engine (cache-miss rounds)")
+ROUNDS_TOTAL = METRICS.counter(
+    "quoracle_consensus_rounds_total", "consensus query rounds run")
+ACTIONS_TOTAL = METRICS.counter(
+    "quoracle_actions_total", "actions executed, labeled by status")
+LIVE_AGENTS = METRICS.gauge(
+    "quoracle_live_agents", "live agents at last scrape")
+KV_FREE_PAGES = METRICS.gauge(
+    "quoracle_kv_free_pages", "free KV pool pages per engine at last scrape")

@@ -21,6 +21,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from quoracle_tpu.infra.telemetry import (
+    DECODE_MS, DECODE_STEP_MS, JIT_COMPILES, PREFILL_MS,
+    PREFILL_TOKENS_PER_S, PREFIX_LOOKUP_MS, TRACER,
+)
 from quoracle_tpu.models.config import ModelConfig
 from quoracle_tpu.models.sampling import sample_tokens
 from quoracle_tpu.models.transformer import (
@@ -730,6 +734,11 @@ class GenerateEngine:
         # wall seconds of the last prefill / decode device phases.
         self.last_prefill_s = 0.0
         self.last_decode_s = 0.0
+        # Shape keys this engine has already dispatched: a miss marks the
+        # call as a first-shape (JIT compile) call for telemetry — how the
+        # dashboards tell a cache-hit round from a compile-miss round.
+        # Races on the set are benign (worst case one double-count).
+        self._seen_shapes: set[tuple] = set()
         self._build_step()
 
     def _build_step(self):
@@ -1289,8 +1298,12 @@ class GenerateEngine:
                             # on the wrong image (the digest-keyed
                             # session safeguard, models/runtime.py)
                             and self.cfg.vision is None):
+                        t_pl = time.monotonic()
                         d = self.sessions.match_prefix(
                             prompts[i], len(prompts[i]) - 1)
+                        PREFIX_LOOKUP_MS.observe(
+                            (time.monotonic() - t_pl) * 1000,
+                            model=self.cfg.name)
                         if d is not None:
                             sess_rows[i] = d
                             reuse_abs[i] = len(d.tokens)
@@ -1436,6 +1449,8 @@ class GenerateEngine:
         self.last_prefill_s = t_prefill - t0
         self.last_decode_s = now - t_prefill
         latency = now - t0
+        self._record_telemetry(n, B, T, cache_len, max_new, paged,
+                               n_emitted, latency)
 
         results = []
         for i in range(n):
@@ -1461,6 +1476,37 @@ class GenerateEngine:
                             and constrain_json[i] else -1),
             ))
         return results
+
+    def _record_telemetry(self, n: int, B: int, T: int, cache_len: int,
+                          max_new: int, paged: bool, n_emitted,
+                          latency: float) -> None:
+        """Per-call histogram observations + first-shape (JIT compile)
+        events for this generate (infra/telemetry.py): device phase
+        latencies, per-wave prefill token throughput, per-emitted-token
+        decode time. Pure observation — no RNG, no device work — so
+        temp-0 outputs are bit-identical with telemetry sinks on or off.
+        A shape key unseen by this engine marks the call as a first-call
+        compile (the wall time is compile-dominated unless the persistent
+        XLA cache already held the executable)."""
+        name = self.cfg.name
+        PREFILL_MS.observe(self.last_prefill_s * 1000, model=name)
+        DECODE_MS.observe(self.last_decode_s * 1000, model=name)
+        if self.last_prefill_s > 0 and self.last_prefill_tokens:
+            PREFILL_TOKENS_PER_S.observe(
+                self.last_prefill_tokens / self.last_prefill_s, model=name)
+        steps = max((int(n_emitted[i]) for i in range(n)), default=0)
+        if steps > 0 and self.last_decode_s > 0:
+            DECODE_STEP_MS.observe(self.last_decode_s * 1000 / steps,
+                                   model=name)
+        shape = (B, T, cache_len, max_new, paged)
+        if shape not in self._seen_shapes:
+            self._seen_shapes.add(shape)
+            JIT_COMPILES.inc(model=name)
+            TRACER.emit(
+                "generate.first_shape_compile", latency * 1000,
+                model=name, phase="compile",
+                shape=f"B{B}xT{T}xC{cache_len}xN{max_new}"
+                      + ("p" if paged else ""))
 
     def _ensure_pool(self) -> None:
         """Allocate the device page pool on first sessioned call (engines

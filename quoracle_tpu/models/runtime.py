@@ -29,6 +29,7 @@ import numpy as np
 from quoracle_tpu.models.config import (
     OUTPUT_FLOOR, ModelConfig, get_model_config,
 )
+from quoracle_tpu.infra.telemetry import TRACER
 from quoracle_tpu.models.generate import (
     ContextOverflowError, GenerateEngine, splice_session_prompt,
 )
@@ -423,15 +424,19 @@ class TPUBackend(ModelBackend):
 
         results: list[Optional[QueryResult]] = [None] * len(requests)
         groups = list(by_model.items())
+        # Span propagation across the member-thread hop: the consensus
+        # round's span is thread-local to THIS thread, so capture it here
+        # and rebind it inside each member thread (telemetry.TRACER.use).
+        parent = TRACER.current()
         if self.overlap and len(groups) > 1:
             from concurrent.futures import ThreadPoolExecutor
             with ThreadPoolExecutor(max_workers=len(groups),
                                     thread_name_prefix="pool-member") as ex:
                 list(ex.map(lambda g: self._query_member(
-                    g[0], g[1], requests, results), groups))
+                    g[0], g[1], requests, results, parent), groups))
         else:
             for spec, idxs in groups:
-                self._query_member(spec, idxs, requests, results)
+                self._query_member(spec, idxs, requests, results, parent)
         self._broadcast_serving(by_model)
         return [r for r in results if r is not None]
 
@@ -467,9 +472,34 @@ class TPUBackend(ModelBackend):
 
     def _query_member(self, spec: str, idxs: list[int],
                       requests: Sequence[QueryRequest],
-                      results: list[Optional[QueryResult]]) -> None:
-        """One pool member's slice of the round. Writes into disjoint
-        ``results`` positions — safe from concurrent member threads."""
+                      results: list[Optional[QueryResult]],
+                      parent=None) -> None:
+        """One pool member's slice of the round, wrapped in a
+        ``backend.member`` span (rebinding ``parent`` — the consensus
+        round span captured on the query() thread). The member's device
+        prefill/decode phases enter the trace retroactively from the
+        QueryResult timings (the actual fences live in generate.py)."""
+        with TRACER.use(parent):
+            with TRACER.span("backend.member", model=spec) as msp:
+                self._query_member_impl(spec, idxs, requests, results)
+                done = [results[i] for i in idxs
+                        if results[i] is not None and results[i].ok]
+                msp.attrs.update(
+                    n_rows=len(idxs),
+                    cached_tokens=sum(r.cached_tokens for r in done))
+                if done and (done[0].prefill_ms or done[0].decode_ms):
+                    # phase timings are per-batch (identical across the
+                    # member's rows) — one retroactive span per phase
+                    TRACER.emit("generate.prefill", done[0].prefill_ms,
+                                parent=msp, phase="prefill", model=spec)
+                    TRACER.emit("generate.decode", done[0].decode_ms,
+                                parent=msp, phase="decode", model=spec)
+
+    def _query_member_impl(self, spec: str, idxs: list[int],
+                           requests: Sequence[QueryRequest],
+                           results: list[Optional[QueryResult]]) -> None:
+        """Writes into disjoint ``results`` positions — safe from
+        concurrent member threads."""
         engine = self.engines.get(spec)
         if engine is None or spec not in self._batchers:
             # not a pool member — includes draft engines, which load into
@@ -747,14 +777,17 @@ class MockBackend(ModelBackend):
         out = []
         for r in requests:
             self.calls.append(r)
-            script = self._scripts.get(r.model_spec)
-            if script:
-                text = script.pop(0)
-            elif self._respond is not None:
-                text = self._respond(r)
-            else:
-                text = ('{"action": "wait", "params": {"duration": 1}, '
-                        '"reasoning": "mock default"}')
+            # same span shape as the TPU backend so span-linkage tests
+            # (and trace consumers) see decide → round → member on mocks
+            with TRACER.span("backend.member", model=r.model_spec):
+                script = self._scripts.get(r.model_spec)
+                if script:
+                    text = script.pop(0)
+                elif self._respond is not None:
+                    text = self._respond(r)
+                else:
+                    text = ('{"action": "wait", "params": {"duration": 1}, '
+                            '"reasoning": "mock default"}')
             if text == "__error__":
                 out.append(QueryResult(model_spec=r.model_spec,
                                        error="scripted failure"))

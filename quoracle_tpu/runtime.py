@@ -20,9 +20,10 @@ from quoracle_tpu.agent.state import AgentDeps
 from quoracle_tpu.agent.supervisor import AgentSupervisor
 from quoracle_tpu.context.token_manager import TokenManager
 from quoracle_tpu.infra.budget import Escrow
-from quoracle_tpu.infra.bus import AgentEvents, EventBus
+from quoracle_tpu.infra.bus import TOPIC_TRACE, AgentEvents, EventBus
 from quoracle_tpu.infra.costs import CostRecorder
 from quoracle_tpu.infra.event_history import EventHistory
+from quoracle_tpu.infra.telemetry import TRACER
 from quoracle_tpu.models.runtime import MockBackend, ModelBackend, TPUBackend
 from quoracle_tpu.persistence import Database, Persistence, TaskManager
 from quoracle_tpu.persistence.store import PersistentSecretStore
@@ -93,6 +94,14 @@ class Runtime:
         # serving telemetry (prefix-cache counters, phase timings) rides
         # the bus into EventHistory's ring + the dashboard SSE tail
         self.backend.attach_bus(self.bus)
+        # finished trace spans (infra/telemetry.py — the process-wide
+        # tracer) re-broadcast on THIS runtime's bus: EventHistory rings
+        # them for /api/trace mount replay, SSE tails them live. The sink
+        # detaches in close(); spans carry trace_id, so a second Runtime's
+        # ring filters per task regardless.
+        self._trace_sink = (
+            lambda event: self.bus.broadcast(TOPIC_TRACE, event))
+        TRACER.add_sink(self._trace_sink)
         self.token_manager = TokenManager(
             self.backend.count_tokens,
             context_limit_fn=self.backend.context_window)
@@ -209,6 +218,7 @@ class Runtime:
         self.close()
 
     def close(self) -> None:
+        TRACER.remove_sink(self._trace_sink)
         self.store.detach_bus()
         self.history.close()
         self.db.close()

@@ -11,8 +11,13 @@ Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
                             (SecretManagementLive; mutations via the API)
   GET  /healthz             health check (reference HealthController)
   GET  /events              SSE: every bus broadcast as one JSON event
+  GET  /metrics             Prometheus text exposition (infra/telemetry.py
+                            registry; bearer-token gated like the API)
   GET  /api/status          runtime summary
-  GET  /api/metrics         telemetry snapshot (VM, rows, serving phases)
+  GET  /api/metrics         telemetry snapshot (VM, rows, serving phases,
+                            histogram quantiles)
+  GET  /api/trace?task_id   finished trace spans for one task (TOPIC_TRACE
+                            ring in infra/event_history.py)
   GET  /api/tasks           tasks + live agent counts
   GET  /api/agents?task_id  agent tree with budget/cost/todo state
   GET  /api/logs?agent_id   durable logs (newest last)
@@ -225,18 +230,35 @@ class DashboardServer:
         Telemetry polls Phoenix/Ecto/VM metrics into LiveDashboard,
         telemetry.ex:20-50 — here the same classes of numbers come from
         one on-demand endpoint): process/VM stats, durable-row counts,
-        live-agent counts, cost totals, and the serving backend's
-        per-member phase timings + KV-session occupancy."""
+        live-agent counts, cost totals, the serving backend's per-member
+        phase timings + KV-session occupancy, and the histogram-quantile
+        telemetry block (infra/telemetry.py) that supersedes the
+        last-call scalars — which stay for parity."""
         import resource
         import threading
         import time as _time
 
+        from quoracle_tpu.infra.telemetry import METRICS
+
         rt = self.runtime
         ru = resource.getrusage(resource.RUSAGE_SELF)
-        # ru_maxrss is KiB on Linux but BYTES on darwin (getrusage(2))
+        # ru_maxrss is KiB on Linux but BYTES on darwin (getrusage(2)) —
+        # and it is PEAK rss either way; current rss comes from
+        # /proc/self/statm on Linux (falls back to the peak elsewhere).
         rss_div = 1024 * 1024 if sys.platform == "darwin" else 1024
+        peak_rss_mb = round(ru.ru_maxrss / rss_div, 1)
+        rss_mb = peak_rss_mb
+        try:
+            import os
+            with open("/proc/self/statm") as f:
+                rss_pages = int(f.read().split()[1])
+            rss_mb = round(rss_pages * os.sysconf("SC_PAGE_SIZE")
+                           / (1024 * 1024), 1)
+        except (OSError, IndexError, ValueError):
+            pass
         vm = {
-            "rss_mb": round(ru.ru_maxrss / rss_div, 1),
+            "rss_mb": rss_mb,
+            "peak_rss_mb": peak_rss_mb,
             "user_cpu_s": round(ru.ru_utime, 1),
             "system_cpu_s": round(ru.ru_stime, 1),
             "threads": threading.active_count(),
@@ -272,7 +294,30 @@ class DashboardServer:
             }
         return {"vm": vm, "rows": counts, "agents": agents,
                 "backend": backend,
+                # histogram quantiles (p50/p95/p99) per instrument — the
+                # tail-latency view the last_* scalars above cannot give
+                "telemetry": METRICS.snapshot(),
                 "total_cost": str(rt.store.total_costs())}
+
+    def trace_payload(self, trace_id: Optional[str]) -> dict:
+        """Finished spans from the TOPIC_TRACE ring, filtered to one
+        trace (= task) when given. Spans link via span_id/parent_id;
+        clients rebuild the decide → member prefill/decode → action tree
+        from those fields."""
+        spans = self.runtime.history.replay_traces(trace_id)
+        return {"task_id": trace_id, "n_spans": len(spans), "spans": spans}
+
+    def prometheus_text(self) -> str:
+        """GET /metrics body: scrape-time gauge refresh + the registry's
+        text exposition (infra/telemetry.py)."""
+        from quoracle_tpu.infra.telemetry import (
+            KV_FREE_PAGES, LIVE_AGENTS, METRICS,
+        )
+        rt = self.runtime
+        LIVE_AGENTS.set(len(rt.registry.all()))
+        for spec, e in (getattr(rt.backend, "engines", None) or {}).items():
+            KV_FREE_PAGES.set(e.sessions.free_pages(), model=spec)
+        return METRICS.render_prometheus()
 
     def settings_payload(self) -> dict:
         """The settings surface (reference SecretManagementLive): system
@@ -298,6 +343,11 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):          # quiet access log
         # redact ?token=… — GET /events carries the bearer token as a query
         # param (EventSource can't set headers); it must not reach logs.
+        # The same applies to every other tokened GET (/metrics scrapers,
+        # /api/trace?task_id=…&token=…): the regex matches the token
+        # param at any position, so new endpoints are covered by
+        # construction — only the token value is secret, task/trace ids
+        # are not.
         import re
         args = tuple(re.sub(r"([?&]token=)[^& ]*", r"\1[REDACTED]", a)
                      if isinstance(a, str) else a for a in args)
@@ -315,6 +365,15 @@ class _Handler(BaseHTTPRequestHandler):
         body = html_text.encode()
         self.send_response(status)
         self.send_header("content-type", "text/html; charset=utf-8")
+        self.send_header("content-length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_text(self, text: str, content_type: str,
+                   status: int = 200) -> None:
+        body = text.encode()
+        self.send_response(status)
+        self.send_header("content-type", content_type)
         self.send_header("content-length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -390,6 +449,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.settings_payload())
             elif parsed.path == "/api/metrics":
                 self._send_json(d.metrics_payload())
+            elif parsed.path == "/api/trace":
+                self._send_json(d.trace_payload(one("task_id")
+                                                or one("trace_id")))
+            elif parsed.path == "/metrics":
+                # Prometheus text exposition; gated by the same bearer
+                # token as the API above (scrapers pass it via the
+                # authorization header or ?token=)
+                self._send_text(d.prometheus_text(),
+                                "text/plain; version=0.0.4; charset=utf-8")
             elif parsed.path == "/events":
                 self._stream_events()
             else:

@@ -203,9 +203,47 @@ def settings_page(payload: dict, credentials: list[dict]) -> str:
     return _page("settings", body, refresh=15)
 
 
+def _fmt_ms(v: Any) -> str:
+    return f"{v:.2f}" if isinstance(v, (int, float)) else ""
+
+
+def latency_panel(telemetry: dict) -> str:
+    """Histogram-quantile latency table (the panel ISSUE 2 wires into the
+    dashboard views): one row per histogram instrument — and per label
+    series under it — with count and p50/p95/p99, from the
+    infra/telemetry.py snapshot embedded in /api/metrics."""
+    rows = []
+    for name, m in sorted(telemetry.items()):
+        if m.get("type") != "histogram":
+            continue
+        rows.append(
+            f"<tr class=\"hist\" data-metric=\"{_e(name)}\">"
+            f"<td>{_e(name)}</td><td>{_e(m.get('count', 0))}</td>"
+            f"<td>{_fmt_ms(m.get('p50'))}</td>"
+            f"<td>{_fmt_ms(m.get('p95'))}</td>"
+            f"<td>{_fmt_ms(m.get('p99'))}</td></tr>")
+        for label, s in sorted((m.get("series") or {}).items()):
+            if not label:
+                continue
+            rows.append(
+                f"<tr class=\"hist-series\">"
+                f"<td class=\"meta\">&nbsp;&nbsp;{_e(label)}</td>"
+                f"<td>{_e(s.get('count', 0))}</td>"
+                f"<td>{_fmt_ms(s.get('p50'))}</td>"
+                f"<td>{_fmt_ms(s.get('p95'))}</td>"
+                f"<td>{_fmt_ms(s.get('p99'))}</td></tr>")
+    if not rows:
+        return ""
+    return ("<h2 class=\"meta\">latency (histogram quantiles)</h2>"
+            "<table id=\"latency\"><tr><th>metric</th><th>count</th>"
+            "<th>p50</th><th>p95</th><th>p99</th></tr>"
+            + "".join(rows) + "</table>")
+
+
 def telemetry_page(metrics: dict) -> str:
     """Dev telemetry view (reference LiveDashboard at /dev/dashboard):
-    the /api/metrics snapshot as readable tables."""
+    the /api/metrics snapshot as readable tables, led by the latency
+    histogram panel."""
     def table(title: str, d: dict) -> str:
         return (f"<h2 class=\"meta\">{_e(title)}</h2>"
                 f"<table class=\"metrics\" data-section=\"{_e(title)}\">"
@@ -213,9 +251,13 @@ def telemetry_page(metrics: dict) -> str:
     sections = []
     flat = {}
     for key, val in metrics.items():
+        if key == "telemetry":
+            continue            # rendered as the latency panel below
         if isinstance(val, dict):
             sections.append(table(key, val))
         else:
             flat[key] = val
-    body = (table("runtime", flat) if flat else "") + "".join(sections)
+    body = (latency_panel(metrics.get("telemetry") or {})
+            + (table("runtime", flat) if flat else "")
+            + "".join(sections))
     return _page("telemetry", body, refresh=10)

@@ -11,6 +11,9 @@ echo "$(date -u +%FT%TZ) direct run: device confirmed live (probe ok)" >> "$LOG"
 
 # Primary record first. If a previous run left calibration gates behind,
 # use them; their absence just means the paged direct paths stay off.
+# The artifact now also carries config 9 (consensus round/decide p50/p95
+# from the infra/telemetry.py histograms, prefill vs decode per decide) —
+# committed here with the rest of the bench record.
 [ -f /root/repo/calib_v5e.json ] && export QUORACLE_PAGED_CALIB=/root/repo/calib_v5e.json
 timeout 5400 python bench.py > /root/repo/BENCH_r05_live.json 2>> "$LOG"
 rc=$?

@@ -187,7 +187,8 @@ def test_dashboard_auth_token_gates_mutations(monkeypatch):
             assert status == 401
             # the standalone views carry full transcripts/settings —
             # gated like the API reads
-            for path in ("/logs", "/mailbox", "/telemetry", "/settings"):
+            for path in ("/logs", "/mailbox", "/telemetry", "/settings",
+                         "/metrics", "/api/trace", "/api/metrics"):
                 status, _ = await http_json(base + path)
                 assert status == 401, f"{path} not token-gated"
             # POST without token → 401
@@ -490,6 +491,98 @@ def test_history_endpoint_serves_ring_buffer_mount_replay():
             assert any("history-probe" in str(m)
                        for m in hist["messages"]), \
                 "agent-keyed message ring is empty (sender keying dead)"
+        finally:
+            await server.stop()
+            await rt.shutdown()
+    asyncio.run(asyncio.wait_for(main(), 60))
+
+
+def test_trace_and_prometheus_endpoints():
+    """ISSUE 2 acceptance: a 3-member consensus round run under a
+    task-rooted span is retrievable via /api/trace?task_id=… with the
+    decide → round → member linkage intact and durations consistent with
+    ConsensusOutcome.latency_ms; GET /metrics serves Prometheus text with
+    the quoracle_ round/decide histograms; /api/metrics carries the
+    histogram-quantile telemetry block plus current-vs-peak RSS."""
+    from quoracle_tpu.consensus.engine import ConsensusConfig, ConsensusEngine
+    from quoracle_tpu.infra.telemetry import TRACER
+
+    async def main():
+        rt = Runtime(RuntimeConfig(), backend=MockBackend())
+        server = await DashboardServer(rt, port=0).start()
+        base = server.url
+        try:
+            eng = ConsensusEngine(rt.backend, ConsensusConfig(
+                model_pool=list(POOL), session_key="agent-t"))
+
+            def decide():
+                with TRACER.span("agent.decide_tick", trace_id="task-tr1",
+                                 parent=None, agent_id="agent-t"):
+                    return eng.decide(
+                        {m: [{"role": "user", "content": "go"}]
+                         for m in POOL})
+            out = await asyncio.get_running_loop().run_in_executor(
+                None, decide)
+            assert out.status == "ok"
+
+            # --- /api/trace: spans rode TOPIC_TRACE into the ring -------
+            status, tr = await http_json(
+                base + "/api/trace?task_id=task-tr1")
+            assert status == 200 and tr["task_id"] == "task-tr1"
+            spans = tr["spans"]
+            assert tr["n_spans"] == len(spans) >= 2 + len(POOL)
+            by_name = {}
+            for s in spans:
+                by_name.setdefault(s["name"], []).append(s)
+            decide_sp = by_name["consensus.decide"][0]
+            rounds = by_name["consensus.round"]
+            members = by_name["backend.member"]
+            assert len(members) == len(POOL) * len(rounds)
+            assert all(r["parent_id"] == decide_sp["span_id"]
+                       for r in rounds)
+            # the decide span covers the outcome's own latency (within
+            # tracer overhead), and its rounds nest inside it
+            assert decide_sp["duration_ms"] >= out.latency_ms - 1.0
+            assert decide_sp["duration_ms"] <= out.latency_ms + 250.0
+            assert sum(r["duration_ms"] for r in rounds) \
+                <= decide_sp["duration_ms"] + 1.0
+            # an unknown trace id filters to empty, not an error
+            status, none = await http_json(
+                base + "/api/trace?task_id=no-such-task")
+            assert status == 200 and none["spans"] == []
+
+            # --- GET /metrics: Prometheus text exposition ---------------
+            def fetch_text():
+                with urllib.request.urlopen(base + "/metrics",
+                                            timeout=10) as resp:
+                    return resp.headers.get("content-type"), \
+                        resp.read().decode()
+            ctype, text = await asyncio.get_running_loop().run_in_executor(
+                None, fetch_text)
+            assert ctype.startswith("text/plain")
+            assert "# TYPE quoracle_round_ms histogram" in text
+            assert "# TYPE quoracle_decide_ms histogram" in text
+            assert "# TYPE quoracle_prefill_ms histogram" in text
+            counts = {line.rsplit(" ", 1)[0]: float(line.rsplit(" ", 1)[1])
+                      for line in text.strip().splitlines()
+                      if not line.startswith("#")}
+            assert counts["quoracle_decide_ms_count"] >= 1
+            assert counts["quoracle_round_ms_count"] >= 1
+            assert counts["quoracle_consensus_rounds_total"] >= 1
+
+            # --- /api/metrics: quantile block + rss decomposition -------
+            status, m = await http_json(base + "/api/metrics")
+            assert status == 200
+            tele = m["telemetry"]
+            assert tele["quoracle_decide_ms"]["type"] == "histogram"
+            assert tele["quoracle_decide_ms"]["count"] >= 1
+            assert tele["quoracle_decide_ms"]["p50"] is not None
+            # rss_mb is CURRENT (/proc/self/statm); peak reported apart.
+            # statm and ru_maxrss account shared pages slightly
+            # differently, so allow a small skew above the "peak".
+            assert m["vm"]["rss_mb"] <= m["vm"]["peak_rss_mb"] + 2.0
+            # last-call scalars stay for parity with the pre-ISSUE-2 API
+            assert "backend" in m
         finally:
             await server.stop()
             await rt.shutdown()

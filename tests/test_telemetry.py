@@ -1,0 +1,208 @@
+"""Telemetry substrate (infra/telemetry.py): histogram quantile accuracy
+against a sorted-sample oracle, concurrent-writer safety, span parent/child
+linkage across a decide → generate round, cross-thread span propagation,
+and Prometheus text-exposition round-trip (ISSUE 2 satellite coverage)."""
+
+import random
+import threading
+
+import pytest
+
+from quoracle_tpu.consensus.engine import ConsensusConfig, ConsensusEngine
+from quoracle_tpu.infra.telemetry import (
+    TRACER, Histogram, MetricsRegistry, Tracer, quantile,
+)
+from quoracle_tpu.models.runtime import MockBackend
+
+POOL = MockBackend.DEFAULT_POOL
+
+
+# --- histogram quantiles ----------------------------------------------------
+
+def _oracle(samples, p):
+    s = sorted(samples)
+    return s[min(len(s) - 1, int(p * len(s)))]
+
+
+def test_histogram_percentiles_match_sorted_oracle():
+    """Bucketed p50/p95/p99 vs the exact sorted-sample quantile: with 2x
+    exponential buckets + in-bucket interpolation both land in the same
+    bucket, so the estimate is within one bucket width (factor ~2; 2.2
+    allows the off-by-one-sample edge at a bucket boundary)."""
+    rng = random.Random(7)
+    h = Histogram("t_ms")
+    samples = [rng.lognormvariate(3.0, 1.2) for _ in range(5000)]
+    for v in samples:
+        h.observe(v)
+    ps = h.percentiles((0.50, 0.95, 0.99))
+    for p, est in ps.items():
+        exact = _oracle(samples, p)
+        assert exact / 2.2 <= est <= exact * 2.2, (p, est, exact)
+    assert ps[0.50] <= ps[0.95] <= ps[0.99]
+    _, s, n = h.counts()
+    assert n == len(samples)
+    assert abs(s - sum(samples)) < 1e-6 * max(1.0, sum(samples))
+
+
+def test_quantile_edge_cases():
+    bounds = (1.0, 2.0, 4.0)
+    assert quantile(bounds, [0, 0, 0, 0], 0.5) is None     # empty
+    # overflow-only mass reports the +Inf bucket's lower edge
+    assert quantile(bounds, [0, 0, 0, 10], 0.5) == 4.0
+    # all mass in the first bucket interpolates from 0
+    q = quantile(bounds, [10, 0, 0, 0], 0.5)
+    assert 0.0 < q <= 1.0
+
+
+def test_histogram_concurrent_writers():
+    """Threads hammering one histogram: no lost updates, per-label series
+    isolated, aggregate view sums every label set."""
+    h = Histogram("t_conc")
+    N, T = 10_000, 8
+    expect_one = sum((i % 100) + 0.5 for i in range(N))
+
+    def work(k):
+        for i in range(N):
+            h.observe((i % 100) + 0.5, model=f"m{k % 2}")
+
+    threads = [threading.Thread(target=work, args=(k,)) for k in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    agg, s, n = h.counts()
+    assert n == N * T == sum(agg)
+    assert abs(s - T * expect_one) < 1e-3
+    _, _, n0 = h.counts(model="m0")
+    _, _, n1 = h.counts(model="m1")
+    assert n0 == n1 == N * T // 2
+
+
+# --- registry ---------------------------------------------------------------
+
+def test_registry_get_or_create_and_type_mismatch():
+    reg = MetricsRegistry()
+    c = reg.counter("dup_name")
+    assert reg.counter("dup_name") is c
+    with pytest.raises(TypeError):
+        reg.gauge("dup_name")
+
+
+# --- span linkage -----------------------------------------------------------
+
+def test_span_linkage_decide_round_member():
+    """A fake decide→generate round (ConsensusEngine over the MockBackend)
+    emits the production span tree: agent.decide_tick → consensus.decide →
+    consensus.round → backend.member, all under the task's trace_id."""
+    spans = []
+    TRACER.add_sink(spans.append)
+    try:
+        eng = ConsensusEngine(
+            MockBackend(),
+            ConsensusConfig(model_pool=list(POOL), session_key="agent-1"))
+        with TRACER.span("agent.decide_tick", trace_id="task-42",
+                         parent=None, agent_id="agent-1"):
+            out = eng.decide({m: [{"role": "user", "content": "go"}]
+                              for m in POOL})
+    finally:
+        TRACER.remove_sink(spans.append)
+
+    assert out.status == "ok"
+    mine = [s for s in spans if s["trace_id"] == "task-42"]
+    by_name = {}
+    for s in mine:
+        by_name.setdefault(s["name"], []).append(s)
+    tick = by_name["agent.decide_tick"][0]
+    assert tick["parent_id"] is None
+    decide = by_name["consensus.decide"][0]
+    assert decide["parent_id"] == tick["span_id"]
+    rounds = by_name["consensus.round"]
+    assert rounds and all(r["parent_id"] == decide["span_id"]
+                          for r in rounds)
+    members = by_name["backend.member"]
+    assert len(members) == len(POOL) * len(rounds)
+    round_ids = {r["span_id"] for r in rounds}
+    assert all(m["parent_id"] in round_ids for m in members)
+    # decide span attrs carry the outcome decomposition
+    assert decide["status"] == "ok"
+    assert decide["rounds"] == out.rounds_used
+    # children nest inside the parent's duration (within timer slack)
+    assert decide["duration_ms"] <= tick["duration_ms"] + 1.0
+    assert sum(r["duration_ms"] for r in rounds) \
+        <= decide["duration_ms"] + 1.0
+
+
+def test_span_cross_thread_propagation():
+    """The TPUBackend pool-member hop: capture current() on the query
+    thread, TRACER.use(parent) inside the member thread — children link
+    and inherit the trace, and the worker's binding does not leak."""
+    tracer = Tracer()
+    spans = []
+    tracer.add_sink(spans.append)
+    with tracer.span("root", trace_id="t-x") as root:
+        parent = tracer.current()
+        assert parent is root
+
+        def worker():
+            with tracer.use(parent):
+                with tracer.span("child"):
+                    pass
+            assert tracer.current() is None    # restored on exit
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    child = next(s for s in spans if s["name"] == "child")
+    assert child["parent_id"] == root.span_id
+    assert child["trace_id"] == "t-x"
+
+
+def test_span_sink_exceptions_swallowed():
+    tracer = Tracer()
+    tracer.add_sink(lambda e: 1 / 0)
+    got = []
+    tracer.add_sink(got.append)
+    with tracer.span("s", trace_id="t"):
+        pass
+    assert [e["name"] for e in got] == ["s"]   # bad sink didn't block good
+
+
+# --- prometheus exposition --------------------------------------------------
+
+def test_prometheus_exposition_round_trip():
+    """Render one gauge, one counter, one histogram and parse the text
+    back: TYPE headers, label escaping, cumulative buckets, sum/count."""
+    reg = MetricsRegistry()
+    c = reg.counter("q_total", "things done")
+    g = reg.gauge("q_gauge")
+    h = reg.histogram("q_ms", buckets=(1.0, 10.0, 100.0))
+    c.inc(3, status="ok")
+    c.inc(status="err")
+    g.set(7.5, model="m")
+    for v in (0.5, 5.0, 50.0, 500.0):
+        h.observe(v)
+
+    text = reg.render_prometheus()
+    assert text.endswith("\n")
+    assert "# HELP q_total things done" in text
+
+    types, values = {}, {}
+    for line in text.strip().splitlines():
+        if line.startswith("# TYPE"):
+            _, _, name, kind = line.split()
+            types[name] = kind
+        elif not line.startswith("#"):
+            key, val = line.rsplit(" ", 1)
+            values[key] = float(val)
+    assert types == {"q_total": "counter", "q_gauge": "gauge",
+                     "q_ms": "histogram"}
+    assert values['q_total{status="ok"}'] == 3
+    assert values['q_total{status="err"}'] == 1
+    assert values['q_gauge{model="m"}'] == 7.5
+    # buckets are CUMULATIVE; +Inf equals count
+    assert values['q_ms_bucket{le="1"}'] == 1
+    assert values['q_ms_bucket{le="10"}'] == 2
+    assert values['q_ms_bucket{le="100"}'] == 3
+    assert values['q_ms_bucket{le="+Inf"}'] == 4
+    assert values["q_ms_count"] == 4
+    assert values["q_ms_sum"] == 555.5
