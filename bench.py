@@ -476,6 +476,108 @@ def measure_consensus_telemetry(backend, pool,
     }
 
 
+def measure_resource_observability(backend, pool,
+                                   n_decides: int = N_CYCLES) -> dict:
+    """Config 10: resource observability (ISSUE 3) under a SUSTAINED
+    consensus load — ``n_decides`` real ConsensusEngine.decide calls run
+    through a continuous-batching dispatch layer (shared engines, only
+    the scheduler changes — same shape as config 6) while a sampler
+    thread polls live device memory (infra/resources.py) and scheduler
+    queue health at ~4 Hz. Reported: minimum HBM headroom seen during
+    the load, compile-registry hit rate (models/generate.py
+    CompileRegistry), queue-depth p95 over the samples, and the
+    admission-wait p95 from the quoracle_sched_admit_wait_ms histogram
+    COUNT DELTAS (the same numbers GET /metrics scrapes). With
+    QUORACLE_BENCH_RESOURCES set, the full sample timeline is written
+    there as a sidecar artifact (run_live_bench.sh commits it)."""
+    import threading
+
+    from quoracle_tpu.consensus.engine import ConsensusConfig, ConsensusEngine
+    from quoracle_tpu.infra import resources as res
+    from quoracle_tpu.infra.telemetry import (
+        SCHED_ADMIT_WAIT_MS, WATCHDOG_STALLS, quantile,
+    )
+    from quoracle_tpu.models.runtime import TPUBackend
+
+    backend10 = TPUBackend(pool, engines=backend.engines,
+                           embedder=backend.embedder, continuous=True)
+    samples: list[dict] = []
+    stop = threading.Event()
+
+    def sampler():
+        while not stop.is_set():
+            devs = res.device_memory_stats()
+            sched = backend10.scheduler_stats()
+            samples.append({
+                "ts": round(time.time(), 3),
+                "headroom_frac": res.headroom_fraction(devs),
+                "bytes_in_use": sum(d["bytes_in_use"] for d in devs),
+                "queue_depth": sum(s["queued"] for s in sched.values()),
+                "live_rows": sum(s["live"] for s in sched.values()),
+            })
+            stop.wait(0.25)
+
+    ab, _, _ = SCHED_ADMIT_WAIT_MS.counts()
+    th = threading.Thread(target=sampler, daemon=True)
+    th.start()
+    eng = ConsensusEngine(backend10, ConsensusConfig(
+        model_pool=list(pool), session_key="bench-config10"))
+    try:
+        for i in range(n_decides):
+            msgs = {m: [{"role": "system", "content": SYSTEM_PROMPT},
+                        {"role": "user",
+                         "content": TASKS[(i + 2) % len(TASKS)]}]
+                    for m in pool}
+            out = eng.decide(msgs)
+            log(f"config10 decide {i}: status={out.status} "
+                f"rounds={out.rounds_used}")
+    finally:
+        stop.set()
+        th.join(5)
+        for cb in backend10._cbatchers.values():
+            cb.close()
+    aa, _, _ = SCHED_ADMIT_WAIT_MS.counts()
+    wait_delta = [a - b for a, b in zip(aa, ab)]
+    admit_p95 = quantile(SCHED_ADMIT_WAIT_MS.buckets, wait_delta, 0.95)
+
+    comp = {spec: backend.engines[spec].compiles.snapshot()
+            for spec in pool}
+    hits = sum(c["hits"] for c in comp.values())
+    misses = sum(c["misses"] for c in comp.values())
+    headrooms = [s["headroom_frac"] for s in samples
+                 if s["headroom_frac"] is not None]
+    depths = sorted(s["queue_depth"] for s in samples)
+    result = {
+        "n_decides": n_decides,
+        "n_samples": len(samples),
+        "hbm_headroom_min_frac": (round(min(headrooms), 4)
+                                  if headrooms else None),
+        "hbm_bytes_in_use_max": (max(s["bytes_in_use"] for s in samples)
+                                 if samples else None),
+        "compile_hits": hits,
+        "compile_misses": misses,
+        "compile_hit_rate": (round(hits / (hits + misses), 4)
+                             if hits + misses else None),
+        "compile_storms": sum(c["storms_total"] for c in comp.values()),
+        "queue_depth_p95": (depths[min(len(depths) - 1,
+                                       int(0.95 * len(depths)))]
+                            if depths else None),
+        "admit_wait_p95_ms": (round(admit_p95, 2)
+                              if admit_p95 is not None else None),
+        "watchdog_stalls": WATCHDOG_STALLS.total(),
+        "scheduler": {spec: {k: s[k] for k in
+                             ("steps", "retired", "failed")}
+                      for spec, s in backend10.scheduler_stats().items()},
+    }
+    sidecar = os.environ.get("QUORACLE_BENCH_RESOURCES")
+    if sidecar:
+        with open(sidecar, "w") as f:
+            json.dump({"summary": result, "samples": samples,
+                       "compile": comp}, f)
+        log(f"config10 sample timeline written to {sidecar}")
+    return result
+
+
 def base_payload() -> dict:
     """Every key the artifact can carry, pre-filled null — ANY exit path
     prints this line with whatever was actually measured, so degraded runs
@@ -543,6 +645,19 @@ def base_payload() -> dict:
         "config9_prefill_ms_total": None,
         "config9_decode_ms_total": None,
         "config9_rows": None,
+        # config 10 — resource observability (ISSUE 3): live HBM headroom,
+        # compile-registry hit rate, and scheduler queue health sampled
+        # during a sustained continuous-batching consensus load; the
+        # admission-wait p95 comes from the
+        # quoracle_sched_admit_wait_ms histogram count deltas.
+        "config10_n_samples": None,
+        "config10_hbm_headroom_min_frac": None,
+        "config10_hbm_bytes_in_use_max": None,
+        "config10_compile_hit_rate": None,
+        "config10_compile_storms": None,
+        "config10_queue_depth_p95": None,
+        "config10_admit_wait_p95_ms": None,
+        "config10_watchdog_stalls": None,
         "cycles": None,
         "rounds_per_cycle": None,
         "max_new_tokens": None,
@@ -890,6 +1005,13 @@ def _run(args, payload: dict, deadline_at: float) -> None:
     if cfg9:
         log(f"config9: {cfg9}")
 
+    # config 10 shares backend's engines too (continuous dispatch layer
+    # over them) — it must also run before the vision config frees them
+    cfg10 = guard("config10",
+                  lambda: measure_resource_observability(backend, pool))
+    if cfg10:
+        log(f"config10: {cfg10}")
+
     def vision_config():
         # config 5: vision pool — free the trio's HBM first (weights + KV
         # page pools), then serve llama + the VLM checkpoint with an
@@ -1035,9 +1157,23 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             "config9_decode_ms_total": cfg9["decode_ms_total"],
             "config9_rows": cfg9["rows"],
         })
+    if cfg10:
+        payload.update({
+            "config10_n_samples": cfg10["n_samples"],
+            "config10_hbm_headroom_min_frac":
+                cfg10["hbm_headroom_min_frac"],
+            "config10_hbm_bytes_in_use_max":
+                cfg10["hbm_bytes_in_use_max"],
+            "config10_compile_hit_rate": cfg10["compile_hit_rate"],
+            "config10_compile_storms": cfg10["compile_storms"],
+            "config10_queue_depth_p95": cfg10["queue_depth_p95"],
+            "config10_admit_wait_p95_ms": cfg10["admit_wait_p95_ms"],
+            "config10_watchdog_stalls": cfg10["watchdog_stalls"],
+        })
     log(json.dumps({"config1": cfg1, "config2": cfg2, "config3": cfg3,
                     "config4": cfg4, "config5": cfg5, "config6": cfg6,
-                    "config7": cfg7, "config8": cfg8, "config9": cfg9},
+                    "config7": cfg7, "config8": cfg8, "config9": cfg9,
+                    "config10": cfg10},
                    indent=1, default=str))
     payload.update({
         "cycles": N_CYCLES,

@@ -111,6 +111,11 @@ TOPIC_SERVING = "serving:metrics"
 # ring-buffers them for /api/trace?task_id=… mount replay and the SSE
 # tail streams them live.
 TOPIC_TRACE = "trace:spans"
+# Resource incidents (ISSUE 3): stall-watchdog trips and flight-recorder
+# dumps (runtime.StallWatchdog) — ring-buffered by EventHistory (the
+# /api/history "resources" key) and tailed live by the SSE stream, so an
+# open dashboard sees the incident the moment the watchdog fires.
+TOPIC_RESOURCES = "resources:events"
 
 
 def topic_agent_state(agent_id: str) -> str:

@@ -13,8 +13,8 @@ from collections import deque
 from typing import Optional
 
 from quoracle_tpu.infra.bus import (
-    EventBus, Subscription, TOPIC_ACTIONS, TOPIC_LIFECYCLE, TOPIC_SERVING,
-    TOPIC_TRACE,
+    EventBus, Subscription, TOPIC_ACTIONS, TOPIC_LIFECYCLE, TOPIC_RESOURCES,
+    TOPIC_SERVING, TOPIC_TRACE,
 )
 
 MAX_LOGS_PER_AGENT = 100      # reference ui/event_history.ex:17-20
@@ -42,6 +42,7 @@ class EventHistory:
         self._actions: deque = deque(maxlen=max_logs)
         self._serving: deque = deque(maxlen=max_logs)
         self._traces: deque = deque(maxlen=MAX_TRACE_SPANS)
+        self._resources: deque = deque(maxlen=max_logs)
         self._tasks: set[str] = set()
         self._lock = threading.Lock()
         self._closed = False
@@ -50,6 +51,7 @@ class EventHistory:
             bus.subscribe(TOPIC_ACTIONS, self._on_action),
             bus.subscribe(TOPIC_SERVING, self._on_serving),
             bus.subscribe(TOPIC_TRACE, self._on_trace),
+            bus.subscribe(TOPIC_RESOURCES, self._on_resource),
         ]
 
     # Agent log/message topics are per-agent; the runtime calls track_agent
@@ -114,6 +116,10 @@ class EventHistory:
         with self._lock:
             self._traces.append(event)
 
+    def _on_resource(self, topic: str, event: dict) -> None:
+        with self._lock:
+            self._resources.append(event)
+
     def _on_task_message(self, topic: str, event: dict) -> None:
         # topic is "tasks:<id>:messages". Ring under the TASK key always
         # (the mailbox replay), and ALSO under the SENDER when the message
@@ -150,6 +156,12 @@ class EventHistory:
         """Recent serving rounds (phase timings + prefix-cache counters)."""
         with self._lock:
             return list(self._serving)
+
+    def replay_resources(self) -> list[dict]:
+        """Recent resource incidents (watchdog stalls, flight-recorder
+        dumps — TOPIC_RESOURCES)."""
+        with self._lock:
+            return list(self._resources)
 
     def replay_traces(self, trace_id: Optional[str] = None) -> list[dict]:
         """Recent finished spans (infra/telemetry.py), optionally filtered

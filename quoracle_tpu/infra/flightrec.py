@@ -1,0 +1,181 @@
+"""Crash-safe flight recorder: a bounded ring of recent structured events
+(finished spans, resource samples, scheduler transitions, watchdog trips)
+that can be DUMPED to a JSON file when something goes wrong (ISSUE 3).
+
+The operational gap this closes: a capacity incident — HBM exhaustion, a
+recompile storm, a wedged decode loop — usually kills the process or the
+operator's patience before anyone attaches a scraper, and the Prometheus
+counters that survive say *that* it happened, not *what led up to it*.
+The recorder keeps the last ``capacity`` events in memory at near-zero
+cost (one deque append per event) and serializes them on:
+
+  * a stall-watchdog trip (runtime.StallWatchdog → ``dump(reason=...)``),
+  * an unhandled crash — ``sys.excepthook`` is chained, with an
+    ``atexit`` backstop for crashes the hook saw but could not persist,
+  * demand: ``POST /api/flightrec/dump`` (web/server.py).
+
+Dumps land in ``QUORACLE_FLIGHTREC_DIR`` (default: a per-uid directory
+under the system temp dir) as ``flightrec-<utc>-<reason>.json``;
+``retention`` newest dumps are kept, older ones unlinked — the recorder
+must never become the disk-filler it exists to diagnose.
+
+Like METRICS/TRACER (infra/telemetry.py), the module-level ``FLIGHT`` is
+deliberately process-wide: events carry their own attribution, a crash
+hook is global by nature, and tests that need a hermetic ring construct
+their own :class:`FlightRecorder`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Any, Optional
+
+DEFAULT_CAPACITY = 2048
+DEFAULT_RETENTION = 12
+
+
+class FlightRecorder:
+    """Bounded ring of structured events + JSON dump-on-demand."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 directory: Optional[str] = None,
+                 retention: int = DEFAULT_RETENTION):
+        self.capacity = capacity
+        self.retention = retention
+        self._dir = directory
+        self._ring: deque = deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._installed = False
+        self._crashed = False
+        self._dumps = 0
+        self._last_dump: Optional[str] = None
+
+    # -- recording -------------------------------------------------------
+
+    def record(self, kind: str, **fields: Any) -> None:
+        event = {"ts": time.time(), "kind": kind, **fields}
+        with self._lock:
+            self._ring.append(event)
+
+    def record_span(self, event: dict) -> None:
+        """Tracer sink shape: a finished span's event dict."""
+        with self._lock:
+            self._ring.append({"kind": "span", **event})
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    # -- dumping ---------------------------------------------------------
+
+    def directory(self) -> str:
+        return (self._dir
+                or os.environ.get("QUORACLE_FLIGHTREC_DIR")
+                or os.path.join(tempfile.gettempdir(),
+                                f"quoracle-flightrec-{os.getuid()}"))
+
+    def dump(self, reason: str = "manual",
+             path: Optional[str] = None) -> str:
+        """Serialize the ring to a JSON file and return its path. Never
+        raises into a crashing process' hook — the CALLER decides whether
+        a dump failure matters."""
+        events = self.snapshot()
+        if path is None:
+            d = self.directory()
+            os.makedirs(d, exist_ok=True)
+            stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+            safe = "".join(c if c.isalnum() or c in "-_" else "-"
+                           for c in reason)[:48]
+            path = os.path.join(
+                d, f"flightrec-{stamp}-{os.getpid()}-{safe}.json")
+        payload = {
+            "reason": reason,
+            "ts": time.time(),
+            "pid": os.getpid(),
+            "argv": list(sys.argv),
+            "n_events": len(events),
+            "events": events,
+        }
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            json.dump(payload, f, default=str)
+        os.replace(tmp, path)               # readers never see a torn file
+        with self._lock:
+            self._dumps += 1
+            self._last_dump = path
+            self._crashed = False           # persisted; atexit can relax
+        self._prune(os.path.dirname(path))
+        return path
+
+    def _prune(self, d: str) -> None:
+        """Keep the ``retention`` newest dumps in ``d``."""
+        try:
+            dumps = sorted(
+                f for f in os.listdir(d)
+                if f.startswith("flightrec-") and f.endswith(".json"))
+            for f in dumps[:max(0, len(dumps) - self.retention)]:
+                os.unlink(os.path.join(d, f))
+        except OSError:
+            pass
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "n_events": len(self._ring),
+                "capacity": self.capacity,
+                "directory": self.directory(),
+                "retention": self.retention,
+                "dumps": self._dumps,
+                "last_dump": self._last_dump,
+                "crash_hooks_installed": self._installed,
+            }
+
+    # -- crash hooks -----------------------------------------------------
+
+    def install(self) -> None:
+        """Idempotently chain ``sys.excepthook`` (+ an ``atexit``
+        backstop) and register the recorder as a tracer sink so finished
+        spans enter the ring. Called by Runtime.__init__; never
+        uninstalled — crash capture is process-scoped by nature."""
+        with self._lock:
+            if self._installed:
+                return
+            self._installed = True
+        from quoracle_tpu.infra.telemetry import TRACER
+        TRACER.add_sink(self.record_span)
+
+        prev_hook = sys.excepthook
+
+        def hook(exc_type, exc, tb):
+            self._crashed = True
+            self.record("crash", exc_type=exc_type.__name__,
+                        error=repr(exc))
+            try:
+                self.dump(reason=f"crash-{exc_type.__name__}")
+            except Exception:             # noqa: BLE001 — dying anyway
+                pass
+            prev_hook(exc_type, exc, tb)
+
+        sys.excepthook = hook
+
+        import atexit
+
+        def backstop():
+            # only crashes the hook recorded but could not persist (dump
+            # resets the flag) — a clean exit writes nothing
+            if self._crashed:
+                try:
+                    self.dump(reason="atexit")
+                except Exception:         # noqa: BLE001
+                    pass
+
+        atexit.register(backstop)
+
+
+FLIGHT = FlightRecorder()

@@ -1,0 +1,239 @@
+"""Live resource accounting for the serving path (ISSUE 3 tentpole):
+device-memory sampling, per-component HBM attribution, and the
+scrape-time collector that feeds the gauges in infra/telemetry.py.
+
+Until now HBM existed in the codebase only as a *plan* — the static
+budget arithmetic of ``parallel/mesh.pool_sizing`` (weights + page pool
+vs. ``POOL_TAIL_RESERVE``). This module is the *actual*: what the
+devices report in use right now (``device.memory_stats()``, with a
+``jax.live_arrays()`` fallback for backends that expose no allocator
+stats — the CPU path CI runs on), attributed per engine to the
+components an operator can act on — params are fixed cost, the KV page
+pool is sized at boot, prefix-cache pages are reclaimable by eviction.
+
+Nothing here touches RNG or device *state*: sampling reads allocator
+counters and host-side bookkeeping only, so scrapes are safe on the
+serving hot path and temp-0 outputs are bit-identical with the collector
+registered or not (the ISSUE 2 invariant extends to resources).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_PROC_T0 = time.monotonic()
+
+
+def device_memory_stats() -> list[dict]:
+    """One dict per local device: bytes in use / limit / peak and where
+    the numbers came from. TPU/GPU backends answer ``memory_stats()``;
+    the CPU backend reports none, so the fallback sums ``live_arrays``
+    buffer bytes per device (sharded arrays split evenly across their
+    devices) — an under-count of allocator overhead but an honest view
+    of what serving actually holds."""
+    import jax
+
+    live_share: Optional[dict] = None
+
+    def live_bytes(dev) -> int:
+        nonlocal live_share
+        if live_share is None:
+            live_share = {}
+            for arr in jax.live_arrays():
+                try:
+                    devs = list(arr.devices())
+                except Exception:         # noqa: BLE001 — deleted buffer
+                    continue
+                share = arr.nbytes / max(1, len(devs))
+                for dv in devs:
+                    live_share[dv.id] = live_share.get(dv.id, 0.0) + share
+        return int(live_share.get(dev.id, 0))
+
+    from quoracle_tpu.parallel.mesh import device_hbm_limit
+
+    out = []
+    for d in jax.local_devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:                 # noqa: BLE001 — optional API
+            stats = None
+        if stats and stats.get("bytes_in_use") is not None:
+            out.append({
+                "device": d.id,
+                "platform": d.platform,
+                "kind": getattr(d, "device_kind", "unknown"),
+                "bytes_in_use": int(stats["bytes_in_use"]),
+                "bytes_limit": device_hbm_limit(d),
+                "peak_bytes_in_use": int(
+                    stats.get("peak_bytes_in_use") or 0),
+                "source": "memory_stats",
+            })
+        else:
+            out.append({
+                "device": d.id,
+                "platform": d.platform,
+                "kind": getattr(d, "device_kind", "unknown"),
+                "bytes_in_use": live_bytes(d),
+                "bytes_limit": device_hbm_limit(d),
+                "peak_bytes_in_use": 0,
+                "source": "live_arrays",
+            })
+    return out
+
+
+def headroom_fraction(devices: Optional[list[dict]] = None) -> Optional[float]:
+    """min over limit-reporting devices of (limit - used) / limit, or
+    None when no device reports a limit (CPU)."""
+    devices = devices if devices is not None else device_memory_stats()
+    fracs = [(d["bytes_limit"] - d["bytes_in_use"]) / d["bytes_limit"]
+             for d in devices if d.get("bytes_limit")]
+    return min(fracs) if fracs else None
+
+
+def process_stats() -> dict:
+    """Self-observation block for /api/resources: uptime, threads, open
+    fds, current RSS (same /proc sources as the /api/metrics vm block)."""
+    import os
+
+    from quoracle_tpu.infra.telemetry import open_fd_count
+
+    rss_mb = None
+    try:
+        with open("/proc/self/statm") as f:
+            rss_mb = round(int(f.read().split()[1])
+                           * os.sysconf("SC_PAGE_SIZE") / (1024 * 1024), 1)
+    except (OSError, IndexError, ValueError):
+        pass
+    return {
+        "pid": os.getpid(),
+        "uptime_s": round(time.monotonic() - _PROC_T0, 1),
+        "threads": threading.active_count(),
+        "open_fds": open_fd_count(),
+        "rss_mb": rss_mb,
+    }
+
+
+def hbm_attribution(backend) -> dict:
+    """Per-engine HBM attribution: params bytes, KV page-pool bytes
+    (split into session-held, prefix-cache-held, and free pages), set
+    against the static ``POOL_TAIL_RESERVE`` budget from
+    parallel/mesh.py. Backends without engines (MockBackend) attribute
+    nothing — the empty dict IS the answer."""
+    import jax
+
+    from quoracle_tpu.parallel.mesh import POOL_TAIL_RESERVE
+
+    members = {}
+    engines = getattr(backend, "engines", None) or {}
+    for spec, e in engines.items():
+        try:
+            params_b = sum(
+                int(getattr(p, "nbytes", 0) or 0)
+                for p in jax.tree.leaves(e.params))
+            st = e.sessions
+            cfg = e.cfg
+            page_b = (2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
+                      * jax.numpy.dtype(e.cache_dtype).itemsize * st.page)
+            pool_b = 0
+            if st.k is not None:
+                pool_b = int(st.k.nbytes) + int(st.v.nbytes)
+            with st.lock:
+                free = len(st._free)
+                n_sessions = len(st._sessions)
+                occ = st.prefix_cache.occupancy()
+            # page 0 is scratch; used = allocated (non-free, non-scratch)
+            used_pages = st.n_pages - 1 - free
+            members[spec] = {
+                "params_bytes": params_b,
+                "kv_pool_bytes": pool_b,
+                "kv_pool_pages": st.n_pages,
+                "kv_page_bytes": page_b,
+                "kv_used_pages": used_pages,
+                "kv_used_bytes": used_pages * page_b,
+                "kv_free_pages": free,
+                "prefix_cache_pages": occ["resident_pages"],
+                "prefix_cache_bytes": occ["resident_pages"] * page_b,
+                "prefix_cache": occ,
+                "sessions": n_sessions,
+            }
+        except Exception:                 # noqa: BLE001 — partial is fine
+            logger.exception("hbm attribution failed for %s", spec)
+    totals = {
+        "params_bytes": sum(m["params_bytes"] for m in members.values()),
+        "kv_pool_bytes": sum(m["kv_pool_bytes"] for m in members.values()),
+        "prefix_cache_bytes": sum(m["prefix_cache_bytes"]
+                                  for m in members.values()),
+        "tail_reserve_bytes": int(POOL_TAIL_RESERVE),
+    }
+    return {"members": members, "totals": totals}
+
+
+class ResourceCollector:
+    """The scrape-time sampler a Runtime registers on METRICS
+    (``METRICS.register_collector``): refreshes the HBM, prefix-cache,
+    scheduler, and compile-storm gauges from live state, and drops a
+    rate-limited ``resource_sample`` event into the flight recorder so a
+    later dump shows the memory trajectory, not just the final frame."""
+
+    def __init__(self, runtime, min_sample_gap_s: float = 1.0):
+        self.runtime = runtime
+        self.min_sample_gap_s = min_sample_gap_s
+        self._last_sample = 0.0
+
+    def __call__(self) -> None:
+        from quoracle_tpu.infra.flightrec import FLIGHT
+        from quoracle_tpu.infra.telemetry import (
+            HBM_COMPONENT_BYTES, HBM_HEADROOM_RATIO, HBM_LIMIT_BYTES,
+            HBM_USED_BYTES, PREFIX_CACHE_PAGES,
+        )
+
+        devices = device_memory_stats()
+        for d in devices:
+            HBM_USED_BYTES.set(d["bytes_in_use"], device=d["device"])
+            if d["bytes_limit"]:
+                HBM_LIMIT_BYTES.set(d["bytes_limit"], device=d["device"])
+        frac = headroom_fraction(devices)
+        HBM_HEADROOM_RATIO.set(frac if frac is not None else -1.0)
+
+        attribution = hbm_attribution(self.runtime.backend)
+        for spec, m in attribution["members"].items():
+            HBM_COMPONENT_BYTES.set(m["params_bytes"], model=spec,
+                                    component="params")
+            HBM_COMPONENT_BYTES.set(m["kv_pool_bytes"], model=spec,
+                                    component="kv_pool")
+            HBM_COMPONENT_BYTES.set(m["prefix_cache_bytes"], model=spec,
+                                    component="prefix_cache")
+            occ = m["prefix_cache"]
+            PREFIX_CACHE_PAGES.set(occ["resident_pages"], model=spec,
+                                   kind="resident")
+            PREFIX_CACHE_PAGES.set(occ["referenced_pages"], model=spec,
+                                   kind="referenced")
+            PREFIX_CACHE_PAGES.set(occ["evictable_leaf_pages"],
+                                   model=spec, kind="evictable")
+        # storm gauges decay with time, not with traffic — refresh so a
+        # storm that ended shows 0 at the next scrape even with no new
+        # generate() calls
+        for e in (getattr(self.runtime.backend, "engines", None)
+                  or {}).values():
+            compiles = getattr(e, "compiles", None)
+            if compiles is not None:
+                compiles.refresh()
+
+        now = time.monotonic()
+        if now - self._last_sample >= self.min_sample_gap_s:
+            self._last_sample = now
+            FLIGHT.record(
+                "resource_sample",
+                headroom_frac=frac,
+                bytes_in_use=sum(d["bytes_in_use"] for d in devices),
+                devices=len(devices),
+                members={spec: {"kv_free_pages": m["kv_free_pages"],
+                                "prefix_cache_pages":
+                                    m["prefix_cache_pages"],
+                                "sessions": m["sessions"]}
+                         for spec, m in attribution["members"].items()})

@@ -273,11 +273,43 @@ def _num(v: float) -> str:
 class MetricsRegistry:
     """Get-or-create registry; re-registering a name returns the existing
     metric (type mismatch raises — two layers silently recording into
-    differently-typed metrics of one name would corrupt both)."""
+    differently-typed metrics of one name would corrupt both).
+
+    COLLECTORS are scrape-time callbacks (ISSUE 3): values that are a
+    *view of live state* (device memory, queue depth, open fds) rather
+    than an event stream would go stale the moment they were set — so a
+    collector re-derives them lazily at every ``snapshot()`` /
+    ``render_prometheus()``, setting plain gauges the exposition then
+    renders. Collector exceptions are swallowed: a broken sampler must
+    never take a scrape (or the serving path behind it) down."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._metrics: dict[str, _Metric] = {}
+        self._collectors: list[Callable[[], None]] = []
+
+    # -- collectors ------------------------------------------------------
+
+    def register_collector(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def remove_collector(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            if fn in self._collectors:
+                self._collectors.remove(fn)
+
+    def collect(self) -> None:
+        """Run every registered collector (outside the registry lock —
+        collectors call back into gauge()/set())."""
+        with self._lock:
+            fns = list(self._collectors)
+        for fn in fns:
+            try:
+                fn()
+            except Exception:             # noqa: BLE001 — telemetry only
+                pass
 
     def _get(self, cls, name: str, help: str, **kw) -> Any:
         with self._lock:
@@ -302,7 +334,9 @@ class MetricsRegistry:
     def snapshot(self) -> dict:
         """JSON-friendly view for /api/metrics: per metric the aggregate
         (and per-label-series) counts + p50/p95/p99 quantiles — the
-        histogram replacement for the last-call scalars."""
+        histogram replacement for the last-call scalars. Collectors run
+        first so lazily-sampled gauges are current."""
+        self.collect()
         with self._lock:
             metrics = list(self._metrics.values())
         return {m.name: m._snapshot() for m in metrics}
@@ -310,7 +344,9 @@ class MetricsRegistry:
     def render_prometheus(self) -> str:
         """Text exposition format (version 0.0.4). HELP/TYPE headers are
         emitted for every registered metric even before first traffic, so
-        scrapers and tests see the full metric surface immediately."""
+        scrapers and tests see the full metric surface immediately.
+        Collectors run first (scrape-time gauge refresh)."""
+        self.collect()
         with self._lock:
             metrics = sorted(self._metrics.values(), key=lambda m: m.name)
         out: list[str] = []
@@ -322,7 +358,8 @@ class MetricsRegistry:
         return "\n".join(out) + "\n"
 
     def reset(self) -> None:
-        """Drop every registered metric (tests)."""
+        """Drop every registered metric (tests). Collectors survive — they
+        get-or-create their gauges by name at the next scrape."""
         with self._lock:
             self._metrics.clear()
 
@@ -530,3 +567,86 @@ LIVE_AGENTS = METRICS.gauge(
     "quoracle_live_agents", "live agents at last scrape")
 KV_FREE_PAGES = METRICS.gauge(
     "quoracle_kv_free_pages", "free KV pool pages per engine at last scrape")
+
+# -- resource observability (ISSUE 3) ---------------------------------------
+# HBM accounting gauges are COLLECTOR-refreshed (infra/resources.py sets
+# them from jax device.memory_stats() / live_arrays at scrape time).
+HBM_USED_BYTES = METRICS.gauge(
+    "quoracle_hbm_used_bytes", "device memory in use, per device")
+HBM_LIMIT_BYTES = METRICS.gauge(
+    "quoracle_hbm_limit_bytes", "device memory capacity, per device")
+HBM_HEADROOM_RATIO = METRICS.gauge(
+    "quoracle_hbm_headroom_ratio",
+    "min over devices of (limit - used) / limit; -1 when no device "
+    "reports a limit")
+HBM_COMPONENT_BYTES = METRICS.gauge(
+    "quoracle_hbm_component_bytes",
+    "per-engine HBM attribution: params / kv_pool / prefix_cache bytes")
+COMPILE_HITS = METRICS.counter(
+    "quoracle_compile_cache_hits_total",
+    "generate() dispatches whose (model, shape-bucket) was already "
+    "compiled (models/generate.py CompileRegistry)")
+COMPILE_MISSES = METRICS.counter(
+    "quoracle_compile_cache_misses_total",
+    "first-dispatch (model, shape-bucket) compiles")
+COMPILE_MISSES_IN_WINDOW = METRICS.gauge(
+    "quoracle_compile_misses_in_window",
+    "compile misses inside the storm window, per model")
+COMPILE_STORM = METRICS.gauge(
+    "quoracle_compile_storm",
+    "1 while a model's compile misses exceed the storm threshold "
+    "inside the window (recompile storm), else 0")
+SCHED_QUEUE_DEPTH = METRICS.gauge(
+    "quoracle_sched_queue_depth",
+    "rows waiting for a continuous-batcher slot, per model")
+SCHED_SLOTS_BUSY = METRICS.gauge(
+    "quoracle_sched_slots_busy",
+    "rows live in the shared decode loop, per model")
+SCHED_ADMIT_WAIT_MS = METRICS.histogram(
+    "quoracle_sched_admit_wait_ms",
+    "submit → decode-loop admission wait (ms)")
+SCHED_ROWS_TOTAL = METRICS.counter(
+    "quoracle_sched_rows_total",
+    "continuous-batcher rows by terminal status (retired | failed)")
+WATCHDOG_STALLS = METRICS.counter(
+    "quoracle_watchdog_stalls_total",
+    "stall-watchdog trips (decode loop made no progress past deadline)")
+WATCHDOG_STALLED = METRICS.gauge(
+    "quoracle_watchdog_stalled",
+    "1 while a watched source is tripped, per source")
+PREFIX_CACHE_PAGES = METRICS.gauge(
+    "quoracle_prefix_cache_pages",
+    "radix prefix-cache occupancy per model: kind = resident | "
+    "referenced | evictable")
+
+# Process self-observation (ISSUE 3 satellite): sampled lazily by the
+# collector below so /api/metrics and GET /metrics always carry a current
+# view — no writer has to remember to refresh them.
+_PROC_T0 = time.monotonic()
+
+
+def open_fd_count() -> Optional[int]:
+    """Open file descriptors of this process (Linux /proc; None where the
+    kernel doesn't expose it)."""
+    import os
+    try:
+        return len(os.listdir("/proc/self/fd"))
+    except OSError:
+        return None
+
+
+def _process_collector() -> None:
+    import threading as _threading
+    METRICS.gauge("quoracle_process_uptime_s",
+                  "seconds since telemetry import").set(
+        round(time.monotonic() - _PROC_T0, 3))
+    METRICS.gauge("quoracle_process_threads",
+                  "live threads at scrape").set(
+        _threading.active_count())
+    fds = open_fd_count()
+    if fds is not None:
+        METRICS.gauge("quoracle_process_open_fds",
+                      "open file descriptors at scrape").set(fds)
+
+
+METRICS.register_collector(_process_collector)

@@ -552,6 +552,107 @@ class SessionStore:
             return len(self._sessions)
 
 
+class CompileRegistry:
+    """Per-engine record of every dispatched shape bucket (ISSUE 3):
+    replaces the single first-shape ``_seen_shapes`` heuristic with an
+    accountable ledger — each (shape-bucket) key remembers its first-call
+    wall time (compile-dominated unless the persistent XLA cache held the
+    executable) and how many later calls HIT it, and a sliding miss
+    window trips a RECOMPILE-STORM gauge when more than ``threshold``
+    new shapes compile inside ``window_s`` seconds. A storm is the
+    classic capacity incident of bucketed serving (a caller bypassing
+    the shape buckets turns every round into a 15-40 s compile) and is
+    now attributable from telemetry instead of reproduced.
+
+    Per ENGINE, not process-wide: each engine's jit wrappers own their
+    compile caches, so a second engine for the same model genuinely
+    recompiles — one shared ledger would miscount that as a hit. The
+    process-wide aggregate lives in the METRICS counters the methods
+    feed (quoracle_compile_cache_{hits,misses}_total)."""
+
+    def __init__(self, model: str, window_s: float = 120.0,
+                 threshold: int = 4):
+        import threading
+        self.model = model
+        self.window_s = window_s
+        self.threshold = threshold
+        self._lock = threading.Lock()
+        self._shapes: dict[tuple, dict] = {}
+        self._miss_times: list[float] = []
+        self.hits = 0
+        self.misses = 0
+        self.storm = False
+        self.storms_total = 0
+
+    def record(self, shape: tuple, wall_ms: float) -> bool:
+        """Record one dispatch; returns True on a MISS (first sight of
+        this shape bucket — the call paid the compile)."""
+        from quoracle_tpu.infra.telemetry import COMPILE_HITS, COMPILE_MISSES
+        now = time.monotonic()
+        with self._lock:
+            entry = self._shapes.get(shape)
+            if entry is None:
+                self._shapes[shape] = {
+                    "shape": shape, "compile_ms": round(wall_ms, 1),
+                    "ts": time.time(), "hits": 0,
+                }
+                self.misses += 1
+                self._miss_times.append(now)
+                miss = True
+            else:
+                entry["hits"] += 1
+                self.hits += 1
+                miss = False
+            self._refresh_locked(now)
+        (COMPILE_MISSES if miss else COMPILE_HITS).inc(model=self.model)
+        return miss
+
+    def _refresh_locked(self, now: float) -> None:
+        from quoracle_tpu.infra.telemetry import (
+            COMPILE_MISSES_IN_WINDOW, COMPILE_STORM,
+        )
+        self._miss_times = [t for t in self._miss_times
+                            if now - t <= self.window_s]
+        n = len(self._miss_times)
+        storm = n >= self.threshold
+        COMPILE_MISSES_IN_WINDOW.set(n, model=self.model)
+        COMPILE_STORM.set(1.0 if storm else 0.0, model=self.model)
+        if storm and not self.storm:
+            self.storms_total += 1
+            from quoracle_tpu.infra.flightrec import FLIGHT
+            FLIGHT.record("compile_storm", model=self.model,
+                          misses_in_window=n, window_s=self.window_s)
+        self.storm = storm
+
+    def refresh(self) -> None:
+        """Re-evaluate the storm window against the clock (collector
+        hook: a storm must clear at the next scrape even with no new
+        dispatches aging the window)."""
+        with self._lock:
+            self._refresh_locked(time.monotonic())
+
+    def snapshot(self, max_shapes: int = 32) -> dict:
+        """JSON view for /api/resources: totals, hit rate, storm state,
+        and the most expensive shape entries."""
+        with self._lock:
+            shapes = sorted(self._shapes.values(),
+                            key=lambda e: -e["compile_ms"])[:max_shapes]
+            total = self.hits + self.misses
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "hit_rate": round(self.hits / total, 4) if total else None,
+                "n_shapes": len(self._shapes),
+                "storm": self.storm,
+                "storms_total": self.storms_total,
+                "misses_in_window": len(self._miss_times),
+                "window_s": self.window_s,
+                "threshold": self.threshold,
+                "shapes": [{**e, "shape": "x".join(map(str, e["shape"]))}
+                           for e in shapes],
+            }
+
+
 def _lcp(a: Sequence[int], b: Sequence[int]) -> int:
     n = min(len(a), len(b))
     i = 0
@@ -734,11 +835,10 @@ class GenerateEngine:
         # wall seconds of the last prefill / decode device phases.
         self.last_prefill_s = 0.0
         self.last_decode_s = 0.0
-        # Shape keys this engine has already dispatched: a miss marks the
-        # call as a first-shape (JIT compile) call for telemetry — how the
-        # dashboards tell a cache-hit round from a compile-miss round.
-        # Races on the set are benign (worst case one double-count).
-        self._seen_shapes: set[tuple] = set()
+        # Compile ledger (ISSUE 3): every dispatched shape bucket with
+        # wall time + hit/miss counts, plus the recompile-storm window —
+        # /api/resources serves its snapshot per engine.
+        self.compiles = CompileRegistry(cfg.name)
         self._build_step()
 
     def _build_step(self):
@@ -1499,8 +1599,7 @@ class GenerateEngine:
             DECODE_STEP_MS.observe(self.last_decode_s * 1000 / steps,
                                    model=name)
         shape = (B, T, cache_len, max_new, paged)
-        if shape not in self._seen_shapes:
-            self._seen_shapes.add(shape)
+        if self.compiles.record(shape, latency * 1000):
             JIT_COMPILES.inc(model=name)
             TRACER.emit(
                 "generate.first_shape_compile", latency * 1000,

@@ -252,5 +252,26 @@ class RadixPrefixCache:
             "cached_pages": len(self._pages),
         }
 
+    def occupancy(self) -> dict:
+        """Live occupancy — the point-in-time complement to the monotonic
+        stats() counters (ISSUE 3 satellite): resident pages (every tree
+        node), REFERENCED pages (refcount > 1 — a session or in-flight
+        adopter reads them beyond the tree's own reference, so eviction
+        cannot touch them), and evictable LEAF pages (refcount exactly 1
+        and no children — what one evict() pass could reclaim right now).
+        Assumes the owning SessionStore's lock is held, like every other
+        inspecting method here."""
+        referenced = evictable = 0
+        for pg, node in self._pages.items():
+            if self.store._refs.get(pg, 1) > 1:
+                referenced += 1
+            elif not node.children:
+                evictable += 1
+        return {
+            "resident_pages": len(self._pages),
+            "referenced_pages": referenced,
+            "evictable_leaf_pages": evictable,
+        }
+
     def __len__(self) -> int:
         return len(self._pages)

@@ -129,6 +129,18 @@ class ModelBackend(abc.ABC):
         telemetry on (TOPIC_SERVING — prefix-cache hit/miss/evict counters,
         phase timings). No-op for backends without serving internals."""
 
+    def watchdog_sources(self) -> list:
+        """(name, progress_fn) pairs for the Runtime's stall watchdog
+        (runtime.StallWatchdog); each fn returns (active, progress
+        counter). Empty for backends without decode loops to watch."""
+        return []
+
+    def scheduler_stats(self) -> dict:
+        """Per-member continuous-batcher health snapshots for
+        /api/resources (queue depth, live rows, retired/failed counts).
+        Empty for backends without a scheduler."""
+        return {}
+
 
 # ---------------------------------------------------------------------------
 # TPU backend
@@ -442,6 +454,13 @@ class TPUBackend(ModelBackend):
 
     def attach_bus(self, bus) -> None:
         self._bus = bus
+
+    def watchdog_sources(self) -> list:
+        return [(f"decode-loop:{spec}", cb.progress)
+                for spec, cb in self._cbatchers.items()]
+
+    def scheduler_stats(self) -> dict:
+        return {spec: cb.stats() for spec, cb in self._cbatchers.items()}
 
     def _broadcast_serving(self, by_model: dict) -> None:
         """One TOPIC_SERVING event per query round: each queried member's

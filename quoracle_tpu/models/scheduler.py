@@ -42,9 +42,15 @@ from __future__ import annotations
 import dataclasses
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from typing import Optional, Sequence
 
+from quoracle_tpu.infra.flightrec import FLIGHT
+from quoracle_tpu.infra.telemetry import (
+    SCHED_ADMIT_WAIT_MS, SCHED_QUEUE_DEPTH, SCHED_ROWS_TOTAL,
+    SCHED_SLOTS_BUSY,
+)
 from quoracle_tpu.models.generate import GenResult
 
 
@@ -88,6 +94,13 @@ class ContinuousBatcher:
         self._lock = threading.Lock()
         self._wake = threading.Event()
         self._stop = False
+        # health telemetry (ISSUE 3): monotonic progress/outcome counters.
+        # ``steps`` is the stall watchdog's progress signal — frozen steps
+        # with live rows means the decode loop is wedged.
+        self.steps = 0
+        self.retired = 0
+        self.failed = 0
+        self._model = engine.cfg.name
         self._thread = threading.Thread(
             target=self._loop, name=f"batcher-{engine.cfg.name}",
             daemon=True)
@@ -98,9 +111,6 @@ class ContinuousBatcher:
                session_id: Optional[str] = None,
                constrain_json: bool = False,
                action_enum: Optional[Sequence[str]] = None) -> Future:
-        import time
-        if self._stop:
-            raise RuntimeError("ContinuousBatcher is closed")
         row = _Row(prompt=list(prompt), temperature=temperature,
                    top_p=top_p, max_new=max(1, max_new_tokens),
                    session_id=session_id or self._own_session_id(),
@@ -117,28 +127,24 @@ class ContinuousBatcher:
                 f"prompt of {len(row.prompt)} tokens >= max_seq "
                 f"{self.engine.max_seq} for model {self.engine.cfg.name}"))
             return row.future
-        self._queue.put(row)
-        if self._stop:
-            # close() raced this submit: its drain may have run before our
-            # put landed, stranding the row. Take over the drain — the
-            # done() guards make this safe against the worker having
-            # admitted the row first.
-            err = RuntimeError("ContinuousBatcher is closed")
-            while True:
-                try:
-                    r2 = self._queue.get_nowait()
-                except queue.Empty:
-                    break
-                if not r2.future.done():
-                    r2.future.set_exception(err)
-                if r2.owns_session:
-                    self.engine.drop_session(r2.session_id)
-            return row.future
+        # Reject-after-closed UNDER THE LOCK (ISSUE 3 satellite): close()
+        # flips _stop under this same lock, so a row can only enter the
+        # queue strictly BEFORE the flip — and close()'s drain (which runs
+        # after) is then guaranteed to see it. The old unlocked
+        # check-put-recheck dance left a window where a concurrently
+        # submitted row landed after the drain and stranded its future.
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("ContinuousBatcher is closed")
+            self._queue.put(row)
+            depth = self._queue.qsize()
+        SCHED_QUEUE_DEPTH.set(depth, model=self._model)
         self._wake.set()
         return row.future
 
     def close(self) -> None:
-        self._stop = True
+        with self._lock:
+            self._stop = True
         self._wake.set()
         self._thread.join(timeout=10)
         if self._thread.is_alive():
@@ -163,6 +169,8 @@ class ContinuousBatcher:
         for row in leftovers:
             if not row.future.done():
                 row.future.set_exception(err)
+                self.failed += 1
+                SCHED_ROWS_TOTAL.inc(model=self._model, status="failed")
             if row.owns_session:
                 self.engine.drop_session(row.session_id)
 
@@ -171,15 +179,49 @@ class ContinuousBatcher:
             self._seq += 1
             return f"__cb{self._seq}"
 
+    # -- health telemetry (ISSUE 3) ------------------------------------
+
+    def stats(self) -> dict:
+        """Point-in-time health snapshot for /api/resources (racy reads
+        of worker-owned state — a snapshot, not an invariant)."""
+        return {
+            "queued": self._queue.qsize(),
+            "live": len(self._live),
+            "max_slots": self.max_slots,
+            "chunk": self.chunk,
+            "steps": self.steps,
+            "retired": self.retired,
+            "failed": self.failed,
+            "closed": self._stop,
+        }
+
+    def progress(self) -> tuple[bool, int]:
+        """Stall-watchdog source (runtime.StallWatchdog): (work pending?,
+        monotonic progress counter). Active with a frozen counter past
+        the deadline = the decode loop is wedged."""
+        active = (not self._stop
+                  and (bool(self._live) or not self._queue.empty()))
+        return active, self.steps
+
     # ------------------------------------------------------------------
 
     def _admit(self) -> None:
+        admitted = 0
         while len(self._live) < self.max_slots:
             try:
                 row = self._queue.get_nowait()
             except queue.Empty:
-                return
+                break
+            SCHED_ADMIT_WAIT_MS.observe(
+                (time.monotonic() - row.t_submit) * 1000,
+                model=self._model)
             self._live.append(row)
+            admitted += 1
+        if admitted:
+            FLIGHT.record("sched_admit", model=self._model, rows=admitted,
+                          live=len(self._live))
+        SCHED_QUEUE_DEPTH.set(self._queue.qsize(), model=self._model)
+        SCHED_SLOTS_BUSY.set(len(self._live), model=self._model)
 
     def _loop(self) -> None:
         while not self._stop:
@@ -192,6 +234,7 @@ class ContinuousBatcher:
                 self._live = self._step(self._live)
             except Exception:             # noqa: BLE001 — isolate, don't
                 self._live = self._isolate_failure(self._live)  # nuke all
+            self.steps += 1               # watchdog progress signal
         # worker exit (close()): the worker owns _live, so it fails any
         # remaining rows itself — close() only takes over when this
         # thread is confirmed dead
@@ -199,6 +242,8 @@ class ContinuousBatcher:
         for row in self._live:
             if not row.future.done():
                 row.future.set_exception(err)
+                self.failed += 1
+                SCHED_ROWS_TOTAL.inc(model=self._model, status="failed")
             if row.owns_session:
                 self.engine.drop_session(row.session_id)
         self._live = []
@@ -223,6 +268,10 @@ class ContinuousBatcher:
                     row.future.set_exception(e)
                 if row.owns_session:
                     self.engine.drop_session(row.session_id)
+                self.failed += 1
+                SCHED_ROWS_TOTAL.inc(model=self._model, status="failed")
+                FLIGHT.record("sched_row_failed", model=self._model,
+                              session=row.session_id, error=repr(e))
         return survivors
 
     def _step(self, rows: list) -> list:
@@ -258,7 +307,6 @@ class ContinuousBatcher:
                         or (len(row.prompt) + len(row.emitted)
                             >= self.engine.max_seq - 1))
             if finished:
-                import time
                 if not row.future.done():   # close() may have failed it
                     row.future.set_result(GenResult(
                         token_ids=list(row.emitted),
@@ -272,6 +320,12 @@ class ContinuousBatcher:
                     ))
                 if row.owns_session:
                     self.engine.drop_session(row.session_id)
+                self.retired += 1
+                SCHED_ROWS_TOTAL.inc(model=self._model, status="retired")
+                FLIGHT.record("sched_retire", model=self._model,
+                              session=row.session_id,
+                              n_tokens=len(row.emitted),
+                              finish=res.finish_reason)
             else:
                 still.append(row)
         return still

@@ -87,6 +87,25 @@ POOL_TAIL_RESERVE = 1.25 * 1024 ** 3    # activations + compiled programs +
                                         # grammar tables + fragmentation
 
 
+def device_hbm_limit(device) -> int:
+    """Best-effort memory capacity of one jax device, in bytes: the live
+    runtime's ``memory_stats()`` limit when the backend exposes it (TPU
+    and GPU do), the public v5e spec as the TPU fallback, 0 for hosts
+    that report nothing (CPU) — callers treat 0 as "no budget known"
+    rather than inventing one (infra/resources.py headroom gauges)."""
+    try:
+        stats = device.memory_stats()
+    except Exception:                     # noqa: BLE001 — optional API
+        stats = None
+    if stats:
+        limit = int(stats.get("bytes_limit")
+                    or stats.get("bytes_reservable_limit") or 0)
+        if limit > 0:
+            return limit
+    return (V5E_HBM_BYTES
+            if getattr(device, "platform", "") == "tpu" else 0)
+
+
 def pool_sizing(pool: Sequence[str], n_devices: int = 8,
                 hbm_per_chip: int = V5E_HBM_BYTES,
                 dtype_bytes: int = 2) -> dict:

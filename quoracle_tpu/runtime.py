@@ -13,23 +13,158 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-from typing import Any, Optional
+import threading
+import time
+from typing import Any, Callable, Optional
 
 from quoracle_tpu.agent.registry import AgentRegistry
 from quoracle_tpu.agent.state import AgentDeps
 from quoracle_tpu.agent.supervisor import AgentSupervisor
 from quoracle_tpu.context.token_manager import TokenManager
 from quoracle_tpu.infra.budget import Escrow
-from quoracle_tpu.infra.bus import TOPIC_TRACE, AgentEvents, EventBus
+from quoracle_tpu.infra.bus import (
+    TOPIC_RESOURCES, TOPIC_TRACE, AgentEvents, EventBus,
+)
 from quoracle_tpu.infra.costs import CostRecorder
 from quoracle_tpu.infra.event_history import EventHistory
-from quoracle_tpu.infra.telemetry import TRACER
+from quoracle_tpu.infra.flightrec import FLIGHT
+from quoracle_tpu.infra.telemetry import METRICS, TRACER
 from quoracle_tpu.models.runtime import MockBackend, ModelBackend, TPUBackend
 from quoracle_tpu.persistence import Database, Persistence, TaskManager
 from quoracle_tpu.persistence.store import PersistentSecretStore
 
 
 logger = logging.getLogger(__name__)
+
+
+class StallWatchdog:
+    """Detects wedged decode loops (ISSUE 3): each SOURCE is a
+    ``(name, fn)`` pair where ``fn() -> (active, progress)`` — ``active``
+    says the source has work in flight, ``progress`` is a monotonic
+    counter that advances whenever real work completes (the continuous
+    batcher's chunk-step count, models/scheduler.py). A source that stays
+    active with a frozen counter past ``deadline_s`` trips the watchdog:
+    the stall counter/gauge record it, a ``watchdog_stall`` event rides
+    ``TOPIC_RESOURCES`` onto the bus (dashboard SSE + /api/history), and
+    the flight recorder dumps the last spans/resource samples/scheduler
+    transitions to disk — the incident is attributable after the fact
+    even if the process is killed moments later.
+
+    A tripped source un-trips itself when progress resumes or the work
+    drains (gauge back to 0); each distinct wedge trips once, not once
+    per poll."""
+
+    def __init__(self, bus: Optional[EventBus] = None,
+                 deadline_s: float = 30.0,
+                 poll_s: Optional[float] = None):
+        self.bus = bus
+        self.deadline_s = deadline_s
+        self.poll_s = poll_s if poll_s is not None \
+            else max(0.5, deadline_s / 4)
+        self._sources: dict[str, Callable[[], tuple]] = {}
+        self._last: dict[str, tuple] = {}     # name -> (progress, since)
+        self._tripped: set[str] = set()
+        self.trips = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def add_source(self, name: str, fn: Callable[[], tuple]) -> None:
+        with self._lock:
+            self._sources[name] = fn
+
+    def start(self) -> None:
+        """Start the poll thread — only once there is something to watch
+        (a Runtime over a MockBackend registers no sources and spends no
+        thread)."""
+        if self._thread is not None or not self._sources:
+            return
+        self._thread = threading.Thread(target=self._loop,
+                                        name="stall-watchdog", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            self.check_now()
+
+    def check_now(self) -> list[str]:
+        """One scan over every source; returns the names that tripped in
+        THIS scan (tests drive this directly instead of sleeping)."""
+        now = time.monotonic()
+        with self._lock:
+            sources = dict(self._sources)
+        tripped = []
+        for name, fn in sources.items():
+            try:
+                active, progress = fn()
+            except Exception:             # noqa: BLE001 — telemetry only
+                continue
+            last = self._last.get(name)
+            if not active:
+                self._last.pop(name, None)
+                self._untrip(name)
+                continue
+            if last is None or last[0] != progress:
+                self._last[name] = (progress, now)
+                self._untrip(name)
+                continue
+            if (now - last[1] >= self.deadline_s
+                    and name not in self._tripped):
+                self._tripped.add(name)
+                self.trips += 1
+                tripped.append(name)
+                self._trip(name, now - last[1])
+        return tripped
+
+    def _untrip(self, name: str) -> None:
+        if name in self._tripped:
+            self._tripped.discard(name)
+            from quoracle_tpu.infra.telemetry import WATCHDOG_STALLED
+            WATCHDOG_STALLED.set(0.0, source=name)
+
+    def _trip(self, name: str, stalled_s: float) -> None:
+        from quoracle_tpu.infra.telemetry import (
+            WATCHDOG_STALLED, WATCHDOG_STALLS,
+        )
+        WATCHDOG_STALLS.inc(source=name)
+        WATCHDOG_STALLED.set(1.0, source=name)
+        FLIGHT.record("watchdog_stall", source=name,
+                      stalled_s=round(stalled_s, 1),
+                      deadline_s=self.deadline_s)
+        dump_path = None
+        try:
+            dump_path = FLIGHT.dump(reason=f"watchdog-{name}")
+        except Exception:                 # noqa: BLE001 — keep serving
+            logger.exception("flight-recorder dump failed on stall")
+        logger.error("stall watchdog tripped: %s made no progress for "
+                     "%.1fs (flight recorder: %s)", name, stalled_s,
+                     dump_path)
+        if self.bus is not None:
+            try:
+                self.bus.broadcast(TOPIC_RESOURCES, {
+                    "event": "watchdog_stall", "ts": time.time(),
+                    "source": name, "stalled_s": round(stalled_s, 1),
+                    "deadline_s": self.deadline_s,
+                    "dump_path": dump_path,
+                })
+            except Exception:             # noqa: BLE001 — telemetry only
+                pass
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "deadline_s": self.deadline_s,
+                "sources": sorted(self._sources),
+                "tripped": sorted(self._tripped),
+                "trips": self.trips,
+                "running": self._thread is not None,
+            }
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
 
 
 @dataclasses.dataclass
@@ -102,6 +237,20 @@ class Runtime:
         self._trace_sink = (
             lambda event: self.bus.broadcast(TOPIC_TRACE, event))
         TRACER.add_sink(self._trace_sink)
+        # Resource observability (ISSUE 3): crash hooks + span sink into
+        # the process-wide flight recorder, a scrape-time collector that
+        # refreshes the HBM/prefix-cache/compile-storm gauges from THIS
+        # runtime's live state, and the stall watchdog over the backend's
+        # decode loops. The collector detaches in close() (the recorder's
+        # hooks are process-scoped by design and stay).
+        FLIGHT.install()
+        from quoracle_tpu.infra.resources import ResourceCollector
+        self._resource_collector = ResourceCollector(self)
+        METRICS.register_collector(self._resource_collector)
+        self.watchdog = StallWatchdog(self.bus)
+        for name, fn in self.backend.watchdog_sources():
+            self.watchdog.add_source(name, fn)
+        self.watchdog.start()
         self.token_manager = TokenManager(
             self.backend.count_tokens,
             context_limit_fn=self.backend.context_window)
@@ -218,6 +367,8 @@ class Runtime:
         self.close()
 
     def close(self) -> None:
+        self.watchdog.close()
+        METRICS.remove_collector(self._resource_collector)
         TRACER.remove_sink(self._trace_sink)
         self.store.detach_bus()
         self.history.close()

@@ -16,6 +16,11 @@ Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
   GET  /api/status          runtime summary
   GET  /api/metrics         telemetry snapshot (VM, rows, serving phases,
                             histogram quantiles)
+  GET  /api/resources       live resource accounting (ISSUE 3): device
+                            memory + per-engine HBM attribution, compile
+                            registry, scheduler health, watchdog + flight
+                            recorder status (infra/resources.py)
+  POST /api/flightrec/dump  dump the flight-recorder ring to a JSON file
   GET  /api/trace?task_id   finished trace spans for one task (TOPIC_TRACE
                             ring in infra/event_history.py)
   GET  /api/tasks           tasks + live agent counts
@@ -173,6 +178,7 @@ class DashboardServer:
             "lifecycle": h.replay_lifecycle(),
             "actions": h.replay_actions(),
             "serving": h.replay_serving(),
+            "resources": h.replay_resources(),
         }
         if agent_id:
             payload["logs"] = h.replay_logs(agent_id)
@@ -299,6 +305,33 @@ class DashboardServer:
                 "telemetry": METRICS.snapshot(),
                 "total_cost": str(rt.store.total_costs())}
 
+    def resources_payload(self) -> dict:
+        """GET /api/resources: the live resource view (ISSUE 3) — device
+        memory with per-engine HBM attribution (infra/resources.py),
+        the compile registry per engine (models/generate.py), scheduler
+        health (models/scheduler.py), the stall watchdog, and the flight
+        recorder's status. Collectors run first so the gauges a scraper
+        reads next agree with this JSON."""
+        from quoracle_tpu.infra import resources
+        from quoracle_tpu.infra.flightrec import FLIGHT
+        from quoracle_tpu.infra.telemetry import METRICS
+
+        METRICS.collect()
+        rt = self.runtime
+        engines = getattr(rt.backend, "engines", None) or {}
+        watchdog = getattr(rt, "watchdog", None)
+        return {
+            "process": resources.process_stats(),
+            "devices": resources.device_memory_stats(),
+            "hbm": resources.hbm_attribution(rt.backend),
+            "compile": {spec: e.compiles.snapshot()
+                        for spec, e in engines.items()
+                        if getattr(e, "compiles", None) is not None},
+            "scheduler": rt.backend.scheduler_stats(),
+            "watchdog": watchdog.status() if watchdog is not None else None,
+            "flight_recorder": FLIGHT.status(),
+        }
+
     def trace_payload(self, trace_id: Optional[str]) -> dict:
         """Finished spans from the TOPIC_TRACE ring, filtered to one
         trace (= task) when given. Spans link via span_id/parent_id;
@@ -420,7 +453,8 @@ class _Handler(BaseHTTPRequestHandler):
                     d.messages_payload(one("task_id")), one("task_id")))
             elif parsed.path == "/telemetry":
                 from quoracle_tpu.web import views
-                self._send_html(views.telemetry_page(d.metrics_payload()))
+                self._send_html(views.telemetry_page(
+                    d.metrics_payload(), d.resources_payload()))
             elif parsed.path == "/settings":
                 from quoracle_tpu.web import views
                 self._send_html(views.settings_page(
@@ -449,6 +483,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.settings_payload())
             elif parsed.path == "/api/metrics":
                 self._send_json(d.metrics_payload())
+            elif parsed.path == "/api/resources":
+                self._send_json(d.resources_payload())
             elif parsed.path == "/api/trace":
                 self._send_json(d.trace_payload(one("task_id")
                                                 or one("trace_id")))
@@ -545,6 +581,12 @@ class _Handler(BaseHTTPRequestHandler):
                 task_id = self.path.split("/")[3]
                 restored = d.call_async(d.runtime.tasks.restore_task(task_id))
                 self._send_json({"task_id": task_id, "restored": restored})
+            elif self.path == "/api/flightrec/dump":
+                from quoracle_tpu.infra.flightrec import FLIGHT
+                path = FLIGHT.dump(reason=str(body.get("reason")
+                                              or "api"))
+                self._send_json({"path": path,
+                                 **FLIGHT.status()}, 201)
             elif self.path == "/api/messages":
                 ok = d.post_to_agent(body.get("agent_id", ""), {
                     "type": "user_message",

@@ -240,10 +240,89 @@ def latency_panel(telemetry: dict) -> str:
             + "".join(rows) + "</table>")
 
 
-def telemetry_page(metrics: dict) -> str:
+def _mb(v: Any) -> str:
+    return (f"{v / (1024 * 1024):.1f}"
+            if isinstance(v, (int, float)) else "")
+
+
+def resources_panel(res: dict) -> str:
+    """Live resource panel (ISSUE 3): device memory, per-engine HBM
+    attribution, compile-cache health, scheduler queue health, and the
+    flight recorder's status — the /api/resources payload as tables."""
+    if not res:
+        return ""
+    parts = ["<h2 class=\"meta\">resources</h2>"]
+    devs = res.get("devices") or []
+    if devs:
+        rows = "".join(
+            f"<tr class=\"device-row\"><td>{_e(d.get('device'))}</td>"
+            f"<td>{_e(d.get('kind'))}</td>"
+            f"<td>{_mb(d.get('bytes_in_use'))}</td>"
+            f"<td>{_mb(d.get('bytes_limit')) or '—'}</td>"
+            f"<td class=\"meta\">{_e(d.get('source'))}</td></tr>"
+            for d in devs)
+        parts.append(
+            "<table id=\"devices\"><tr><th>device</th><th>kind</th>"
+            "<th>used MB</th><th>limit MB</th><th>source</th></tr>"
+            + rows + "</table>")
+    members = (res.get("hbm") or {}).get("members") or {}
+    if members:
+        rows = "".join(
+            f"<tr class=\"hbm-row\" data-model=\"{_e(spec)}\">"
+            f"<td>{_e(spec)}</td><td>{_mb(m.get('params_bytes'))}</td>"
+            f"<td>{_mb(m.get('kv_pool_bytes'))}</td>"
+            f"<td>{_e(m.get('kv_free_pages'))}</td>"
+            f"<td>{_e(m.get('prefix_cache_pages'))}</td>"
+            f"<td>{_e(m.get('sessions'))}</td></tr>"
+            for spec, m in sorted(members.items()))
+        parts.append(
+            "<table id=\"hbm\"><tr><th>model</th><th>params MB</th>"
+            "<th>kv pool MB</th><th>free pages</th><th>cache pages</th>"
+            "<th>sessions</th></tr>" + rows + "</table>")
+    comp = res.get("compile") or {}
+    if comp:
+        rows = "".join(
+            f"<tr class=\"compile-row\" data-model=\"{_e(spec)}\">"
+            f"<td>{_e(spec)}</td><td>{_e(c.get('hits'))}</td>"
+            f"<td>{_e(c.get('misses'))}</td>"
+            f"<td>{_e(c.get('hit_rate'))}</td>"
+            f"<td>{'STORM' if c.get('storm') else ''}</td></tr>"
+            for spec, c in sorted(comp.items()))
+        parts.append(
+            "<table id=\"compiles\"><tr><th>model</th><th>hits</th>"
+            "<th>misses</th><th>hit rate</th><th></th></tr>"
+            + rows + "</table>")
+    sched = res.get("scheduler") or {}
+    if sched:
+        rows = "".join(
+            f"<tr class=\"sched-row\" data-model=\"{_e(spec)}\">"
+            f"<td>{_e(spec)}</td><td>{_e(s.get('queued'))}</td>"
+            f"<td>{_e(s.get('live'))}/{_e(s.get('max_slots'))}</td>"
+            f"<td>{_e(s.get('retired'))}</td>"
+            f"<td>{_e(s.get('failed'))}</td></tr>"
+            for spec, s in sorted(sched.items()))
+        parts.append(
+            "<table id=\"scheduler\"><tr><th>model</th><th>queued</th>"
+            "<th>slots</th><th>retired</th><th>failed</th></tr>"
+            + rows + "</table>")
+    fr = res.get("flight_recorder") or {}
+    if fr:
+        parts.append(
+            f"<p class=\"meta\" id=\"flightrec\">flight recorder: "
+            f"{_e(fr.get('n_events'))}/{_e(fr.get('capacity'))} events, "
+            f"{_e(fr.get('dumps'))} dumps, last="
+            f"{_e(fr.get('last_dump') or 'none')}</p>")
+    wd = res.get("watchdog") or {}
+    if wd.get("tripped"):
+        parts.append(f"<p class=\"lvl-error\" id=\"watchdog\">STALLED: "
+                     f"{_e(', '.join(wd['tripped']))}</p>")
+    return "".join(parts)
+
+
+def telemetry_page(metrics: dict, resources: Optional[dict] = None) -> str:
     """Dev telemetry view (reference LiveDashboard at /dev/dashboard):
     the /api/metrics snapshot as readable tables, led by the latency
-    histogram panel."""
+    histogram panel and the live resources panel."""
     def table(title: str, d: dict) -> str:
         return (f"<h2 class=\"meta\">{_e(title)}</h2>"
                 f"<table class=\"metrics\" data-section=\"{_e(title)}\">"
@@ -258,6 +337,7 @@ def telemetry_page(metrics: dict) -> str:
         else:
             flat[key] = val
     body = (latency_panel(metrics.get("telemetry") or {})
+            + resources_panel(resources or {})
             + (table("runtime", flat) if flat else "")
             + "".join(sections))
     return _page("telemetry", body, refresh=10)

@@ -207,6 +207,53 @@ def test_close_mid_chunk_leaves_no_stranded_future():
         pass
 
 
+def test_submit_racing_close_never_strands_futures():
+    """ISSUE 3 satellite: submits racing close() must never strand a
+    future. The reject-after-closed check runs UNDER the batcher lock —
+    close() flips _stop under the same lock, so every row that made it
+    into the queue is covered by close()'s drain and every later submit
+    raises. Each accepted future must end DONE (result or the documented
+    close failure); none may hang."""
+    import threading
+    from concurrent.futures import wait
+
+    eng = make_engine()
+    cb = ContinuousBatcher(eng, chunk=4)
+    accepted: list = []
+    acc_lock = threading.Lock()
+    closed = threading.Event()
+
+    def spam(k):
+        i = 0
+        while not closed.is_set() and i < 200:
+            try:
+                f = cb.submit(enc(f"user: race {k}-{i}"), temperature=0.0,
+                              max_new_tokens=2)
+            except RuntimeError:
+                return                    # closed: the documented rejection
+            with acc_lock:
+                accepted.append(f)
+            i += 1
+
+    threads = [threading.Thread(target=spam, args=(k,)) for k in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.25)                      # let submits + chunks interleave
+    cb.close()
+    closed.set()
+    for t in threads:
+        t.join(30)
+        assert not t.is_alive()
+    assert accepted, "race produced no submissions"
+    done, not_done = wait(accepted, timeout=120)
+    assert not not_done, f"{len(not_done)} futures stranded"
+    for f in accepted:
+        exc = f.exception()
+        if exc is not None:               # queued at close: fails loudly
+            assert "closed" in str(exc).lower()
+    assert len(eng.sessions) == 0         # every owned session dropped
+
+
 def test_credential_duplicate_model_spec_is_deterministic(caplog):
     """ADVICE r4 #4 regression: two credentials for one model_spec resolve
     to the lowest id (stable across engines/plans) and WARN about the
