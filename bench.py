@@ -578,6 +578,202 @@ def measure_resource_observability(backend, pool,
     return result
 
 
+def measure_qos_overload(backend, pool, overload_x: int = 4,
+                         n_interactive: int = 12,
+                         batch_max_new: int = 32) -> dict:
+    """Config 11: serving QoS under SUSTAINED overload (ISSUE 4).
+
+    One pool member serves through decode-level continuous batching while
+    an offered load of ``overload_x`` × its slot capacity in BATCH rows is
+    kept outstanding (each retired batch row is immediately replaced —
+    sustained overload, not a one-shot burst). Against that background,
+    INTERACTIVE rows are submitted one at a time and their completion
+    latency measured. Run twice over the SAME engines:
+
+      * qos=off — the FIFO admission the pre-QoS scheduler had: every
+        interactive row queues behind the entire backlog;
+      * qos=on  — weighted-fair DRR + aging floor + admission controller
+        (tight queue bound so the overload visibly sheds).
+
+    Reported: unloaded interactive p50 (the denominator of the acceptance
+    ratios), interactive p95/p99 with QoS on/off, BATCH throughput on/off
+    (fairness has a bulk-throughput price — record it), shed counts +
+    retry_after hints, goodput-per-retired-row, and the accounting
+    identity submitted == retired + shed + failed for the QoS run — no
+    request may vanish silently (every shed is a structured reject AND a
+    flight-recorder event; the artifact records both sides).
+    """
+    import statistics as stats_mod
+    import threading
+
+    from quoracle_tpu.infra.flightrec import FLIGHT
+    from quoracle_tpu.models.runtime import TPUBackend
+    from quoracle_tpu.models.tokenizer import get_tokenizer
+    from quoracle_tpu.serving.admission import (
+        AdmissionConfig, AdmissionError,
+    )
+    from quoracle_tpu.serving.qos import Priority, QoSConfig
+
+    member = pool[0]
+    tok = get_tokenizer(member)
+    batch_prompt = tok.encode(
+        "background agent subtree task: " + max(TASKS, key=len),
+        add_bos=True)
+    inter_prompts = [
+        tok.encode(f"[user turn {i}] {TASKS[i % len(TASKS)]}",
+                   add_bos=True)
+        for i in range(n_interactive)]
+    slots = 8
+
+    def build(qos_on: bool) -> TPUBackend:
+        qos = QoSConfig(
+            aging_floor_s=1.0,
+            admission=AdmissionConfig(max_queue_depth=2 * slots,
+                                      base_retry_ms=250),
+        ) if qos_on else None
+        # chunk 16 (not the default 32): chunk boundaries are the only
+        # preemption points, so a shorter chunk tightens the interactive
+        # admit latency for BOTH phases — the on/off comparison stays fair
+        return TPUBackend(pool, engines=backend.engines,
+                          embedder=backend.embedder, continuous=True,
+                          continuous_chunk=16, continuous_slots=slots,
+                          qos=qos)
+
+    def run_phase(b: TPUBackend, qos_on: bool, seconds: float) -> dict:
+        cb = b._cbatchers[member]
+        stop = threading.Event()
+        counts = {"batch_submitted": 0, "batch_retired": 0,
+                  "batch_shed": 0, "batch_failed": 0}
+        clock = {"batch_tokens": 0}
+        lock = threading.Lock()
+
+        def batch_pump():
+            """Keep overload_x × slots BATCH rows outstanding. A shed
+            (future already failed at submit) backs the pump off like a
+            well-behaved client honoring retry_after — sustained offered
+            load, not a reject-spin."""
+            outstanding: list = []
+            while not stop.is_set():
+                outstanding = [f for f in outstanding if not f.done()]
+                backoff = 0.01
+                while len(outstanding) < overload_x * slots \
+                        and not stop.is_set():
+                    with lock:
+                        counts["batch_submitted"] += 1
+                    f = cb.submit(batch_prompt, temperature=0.0,
+                                  max_new_tokens=batch_max_new,
+                                  priority=Priority.BATCH,
+                                  tenant="bulk")
+                    f.add_done_callback(_account)
+                    if f.done():          # shed at admission
+                        backoff = 0.25
+                        break
+                    outstanding.append(f)
+                stop.wait(backoff)
+
+        def _account(f):
+            with lock:
+                try:
+                    g = f.result()
+                    counts["batch_retired"] += 1
+                    clock["batch_tokens"] += g.n_gen_tokens
+                except AdmissionError:
+                    counts["batch_shed"] += 1
+                except Exception:       # noqa: BLE001 — close-path fails
+                    counts["batch_failed"] += 1
+
+        pump = threading.Thread(target=batch_pump, daemon=True)
+        t0 = time.monotonic()
+        pump.start()
+        time.sleep(min(2.0, seconds / 4))        # let the backlog form
+        lats = []
+        deadline = t0 + seconds
+        for p in inter_prompts:
+            if time.monotonic() > deadline:
+                break
+            t1 = time.monotonic()
+            g = cb.submit(p, temperature=0.0, max_new_tokens=16,
+                          priority=Priority.INTERACTIVE,
+                          tenant="human").result(300)
+            lats.append((time.monotonic() - t1) * 1000)
+        stop.set()
+        pump.join(10)
+        wall = time.monotonic() - t0
+        # close() fails the still-queued/live pump rows loudly; their
+        # done-callbacks land in counts, closing the accounting identity
+        b.close()
+        t_acct = time.monotonic()
+        while time.monotonic() - t_acct < 30:
+            with lock:
+                settled = (counts["batch_retired"] + counts["batch_shed"]
+                           + counts["batch_failed"])
+                if settled >= counts["batch_submitted"]:
+                    break
+            time.sleep(0.05)
+        lats.sort()
+        q = lambda p: (lats[min(len(lats) - 1, int(p * len(lats)))]
+                       if lats else None)
+        with lock:
+            snap = dict(counts)
+        retired_rows = snap["batch_retired"] + len(lats)
+        return {
+            "interactive_n": len(lats),
+            "interactive_p50_ms": round(q(0.50), 1) if lats else None,
+            "interactive_p95_ms": round(q(0.95), 1) if lats else None,
+            "interactive_p99_ms": round(q(0.99), 1) if lats else None,
+            "batch_tokens_per_s": round(clock["batch_tokens"] / wall, 1),
+            "goodput_tokens_per_retired_row": round(
+                (clock["batch_tokens"] + 16 * len(lats))
+                / max(1, retired_rows), 1),
+            **snap,
+            "wall_s": round(wall, 1),
+        }
+
+    # unloaded reference: solo interactive rows through a fresh batcher
+    b_ref = build(False)
+    try:
+        lats0 = []
+        for p in inter_prompts[:4]:
+            t1 = time.monotonic()
+            b_ref._cbatchers[member].submit(
+                p, temperature=0.0, max_new_tokens=16).result(300)
+            lats0.append((time.monotonic() - t1) * 1000)
+        unloaded_p50 = stats_mod.median(lats0)
+    finally:
+        b_ref.close()
+
+    phase_s = 20.0 if MAX_NEW <= 16 else 60.0    # smoke vs real run
+    off = run_phase(build(False), False, phase_s)
+    shed_before = sum(1 for e in FLIGHT.snapshot()
+                      if e.get("kind") == "qos_shed")
+    on = run_phase(build(True), True, phase_s)
+    shed_events = sum(1 for e in FLIGHT.snapshot()
+                      if e.get("kind") == "qos_shed") - shed_before
+
+    total_on = on["batch_retired"] + on["batch_shed"] + on["batch_failed"]
+    return {
+        "overload_x": overload_x,
+        "unloaded_interactive_p50_ms": round(unloaded_p50, 1),
+        "qos_off": off,
+        "qos_on": on,
+        "shed_rate": round(on["batch_shed"]
+                           / max(1, on["batch_submitted"]), 4),
+        "shed_flightrec_events": shed_events,
+        # acceptance: p95 ratios vs the unloaded p50 (on ≤ 2x, off > 5x)
+        "interactive_p95_ratio_on": (
+            round(on["interactive_p95_ms"] / unloaded_p50, 2)
+            if on["interactive_p95_ms"] else None),
+        "interactive_p95_ratio_off": (
+            round(off["interactive_p95_ms"] / unloaded_p50, 2)
+            if off["interactive_p95_ms"] else None),
+        # no silent drops: every submitted row ended retired, shed
+        # (a structured reject + flight-recorder event), or failed
+        # loudly at close — the identity must balance exactly
+        "accounting_gap": on["batch_submitted"] - total_on,
+        "no_silent_drops": on["batch_submitted"] == total_on,
+    }
+
+
 def base_payload() -> dict:
     """Every key the artifact can carry, pre-filled null — ANY exit path
     prints this line with whatever was actually measured, so degraded runs
@@ -658,6 +854,23 @@ def base_payload() -> dict:
         "config10_queue_depth_p95": None,
         "config10_admit_wait_p95_ms": None,
         "config10_watchdog_stalls": None,
+        # config 11 — serving QoS under sustained 4x overload (ISSUE 4):
+        # INTERACTIVE tail vs the unloaded p50 with QoS on/off, BATCH
+        # throughput price, shed rate + structured-reject accounting
+        # (no_silent_drops: submitted == retired + shed + failed).
+        "config11_overload_x": None,
+        "config11_unloaded_interactive_p50_ms": None,
+        "config11_interactive_p95_on_ms": None,
+        "config11_interactive_p95_off_ms": None,
+        "config11_interactive_p95_ratio_on": None,
+        "config11_interactive_p95_ratio_off": None,
+        "config11_batch_tps_on": None,
+        "config11_batch_tps_off": None,
+        "config11_shed_rate": None,
+        "config11_shed_flightrec_events": None,
+        "config11_goodput_on": None,
+        "config11_goodput_off": None,
+        "config11_no_silent_drops": None,
         "cycles": None,
         "rounds_per_cycle": None,
         "max_new_tokens": None,
@@ -1012,6 +1225,13 @@ def _run(args, payload: dict, deadline_at: float) -> None:
     if cfg10:
         log(f"config10: {cfg10}")
 
+    # config 11 also rides backend's engines (fresh continuous dispatch
+    # layers over them, QoS off then on) — before the vision config
+    cfg11 = guard("config11",
+                  lambda: measure_qos_overload(backend, pool))
+    if cfg11:
+        log(f"config11: {cfg11}")
+
     def vision_config():
         # config 5: vision pool — free the trio's HBM first (weights + KV
         # page pools), then serve llama + the VLM checkpoint with an
@@ -1157,6 +1377,32 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             "config9_decode_ms_total": cfg9["decode_ms_total"],
             "config9_rows": cfg9["rows"],
         })
+    if cfg11:
+        payload.update({
+            "config11_overload_x": cfg11["overload_x"],
+            "config11_unloaded_interactive_p50_ms":
+                cfg11["unloaded_interactive_p50_ms"],
+            "config11_interactive_p95_on_ms":
+                cfg11["qos_on"]["interactive_p95_ms"],
+            "config11_interactive_p95_off_ms":
+                cfg11["qos_off"]["interactive_p95_ms"],
+            "config11_interactive_p95_ratio_on":
+                cfg11["interactive_p95_ratio_on"],
+            "config11_interactive_p95_ratio_off":
+                cfg11["interactive_p95_ratio_off"],
+            "config11_batch_tps_on":
+                cfg11["qos_on"]["batch_tokens_per_s"],
+            "config11_batch_tps_off":
+                cfg11["qos_off"]["batch_tokens_per_s"],
+            "config11_shed_rate": cfg11["shed_rate"],
+            "config11_shed_flightrec_events":
+                cfg11["shed_flightrec_events"],
+            "config11_goodput_on":
+                cfg11["qos_on"]["goodput_tokens_per_retired_row"],
+            "config11_goodput_off":
+                cfg11["qos_off"]["goodput_tokens_per_retired_row"],
+            "config11_no_silent_drops": cfg11["no_silent_drops"],
+        })
     if cfg10:
         payload.update({
             "config10_n_samples": cfg10["n_samples"],
@@ -1173,7 +1419,7 @@ def _run(args, payload: dict, deadline_at: float) -> None:
     log(json.dumps({"config1": cfg1, "config2": cfg2, "config3": cfg3,
                     "config4": cfg4, "config5": cfg5, "config6": cfg6,
                     "config7": cfg7, "config8": cfg8, "config9": cfg9,
-                    "config10": cfg10},
+                    "config10": cfg10, "config11": cfg11},
                    indent=1, default=str))
     payload.update({
         "cycles": N_CYCLES,

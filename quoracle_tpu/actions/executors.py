@@ -468,6 +468,9 @@ async def spawn_child_action(core, router, params: dict) -> dict:
             budget_mode="allocated" if allocated is not None else "na",
             budget_limit=allocated,
             working_dir=core.config.working_dir,
+            # QoS: tenant attribution flows down the tree; the child's
+            # CLASS is derived from its depth at build time, not copied
+            tenant=core.config.tenant,
         )
 
     def _release_escrow() -> None:

@@ -148,12 +148,31 @@ class AgentCore:
 
         self.engine = self._build_engine()
 
+    def _tree_depth(self) -> int:
+        """Distance from the task root, walked through the live registry
+        (parents register before spawning children, so the chain is
+        complete at build time; a cycle guard covers restore oddities)."""
+        depth, cur, seen = 0, self.config.parent_id, set()
+        while cur is not None and cur not in seen:
+            seen.add(cur)
+            depth += 1
+            reg = self.deps.registry.lookup(cur)
+            cur = reg.parent_id if reg is not None else None
+        return depth
+
     def _build_engine(self) -> ConsensusEngine:
         """Consensus engine for the CURRENT model pool — rebuilt on
         switch_model_pool (reference core.ex:115-127)."""
+        from quoracle_tpu.serving.qos import priority_for_depth
         config, deps = self.config, self.deps
         allowed = filter_actions(list(ACTIONS), config.capability_groups,
                                  config.forbidden_actions)
+        # QoS class from tree position (ISSUE 4): root agents serve the
+        # user directly and outrank grandchildren's fan-out work; an
+        # explicit qos_priority on the config wins over the derivation.
+        priority = (config.qos_priority
+                    if config.qos_priority is not None
+                    else int(priority_for_depth(self._tree_depth())))
         return ConsensusEngine(
             deps.backend,
             ConsensusConfig(
@@ -163,6 +182,8 @@ class AgentCore:
                 allowed_actions=set(allowed),
                 profile_optional_spawn=self.grove is not None,
                 session_key=self.agent_id,   # KV residency per agent×model
+                priority=priority,
+                tenant=config.tenant,
             ),
             log=lambda event, data: deps.events.log(
                 self.agent_id, "debug", event, **data))

@@ -66,6 +66,14 @@ class AgentConfig:
     budget_mode: str = "na"
     budget_limit: Optional[Decimal] = None
 
+    # serving QoS (ISSUE 4): the tenant every model row this agent
+    # submits is attributed to (inherited down the tree; the dashboard
+    # maps bearer token → tenant at task creation), plus an optional
+    # explicit class override — None derives the class from tree depth
+    # (serving/qos.priority_for_depth: root agents outrank grandchildren).
+    tenant: str = "default"
+    qos_priority: Optional[int] = None
+
     # actions
     working_dir: str = "/tmp"
     max_consensus_retries: int = 3                  # agent AGENTS.md:204-214

@@ -72,7 +72,9 @@ async def cmd_run(args: argparse.Namespace) -> int:
                                coordinator_address=args.coordinator,
                                num_processes=args.num_processes,
                                process_id=args.process_id,
-                               draft_map=_parse_drafts(args.drafts) or None))
+                               draft_map=_parse_drafts(args.drafts) or None,
+                               continuous=args.continuous,
+                               qos=args.qos or None))
     _attach_printer(rt)
     if pool is None and args.profile is None:
         pool = rt.default_pool()
@@ -98,7 +100,9 @@ async def cmd_resume(args: argparse.Namespace) -> int:
                                coordinator_address=args.coordinator,
                                num_processes=args.num_processes,
                                process_id=args.process_id,
-                               draft_map=_parse_drafts(args.drafts) or None))
+                               draft_map=_parse_drafts(args.drafts) or None,
+                               continuous=args.continuous,
+                               qos=args.qos or None))
     _attach_printer(rt)
     result = await rt.boot()
     print(json.dumps(result), flush=True)
@@ -121,7 +125,8 @@ async def cmd_serve(args: argparse.Namespace) -> int:
         coordinator_address=args.coordinator,
         num_processes=args.num_processes,
         process_id=args.process_id,
-        draft_map=_parse_drafts(args.drafts) or None))
+        draft_map=_parse_drafts(args.drafts) or None,
+        continuous=args.continuous, qos=args.qos or None))
     # Validate host/token BEFORE boot so a refused bind exits with a clean
     # message instead of a traceback over a half-started runtime.
     try:
@@ -189,6 +194,15 @@ def build_parser() -> argparse.ArgumentParser:
                         default=None)
         sp.add_argument("--process-id", dest="process_id", type=int,
                         default=None)
+        sp.add_argument("--continuous", action="store_true",
+                        help="decode-level continuous batching for the "
+                             "TPU backend (models/scheduler.py)")
+        sp.add_argument("--qos", action="store_true",
+                        help="serving QoS (ISSUE 4): weighted-fair "
+                             "admission + overload shedding + SLO "
+                             "demotion with default thresholds; tenants "
+                             "via the qos_tenants setting + "
+                             "serving/qos.QoSConfig")
 
     runp = sub.add_parser("run", help="create a task and watch it")
     runp.add_argument("description")

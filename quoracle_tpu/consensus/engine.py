@@ -55,6 +55,13 @@ class ConsensusConfig:
     # backends that support it (TPU); mock/HTTP backends ignore the flag and
     # the parser's markdown-unwrap recovery still applies.
     constrained_json: bool = True
+    # Serving QoS (ISSUE 4): class/tenant attribution for every row this
+    # engine submits, derived from agent depth by the agent runtime
+    # (serving/qos.priority_for_depth — root agents outrank
+    # grandchildren), plus an optional per-round latency budget.
+    priority: Optional[int] = None
+    tenant: str = "default"
+    deadline_ms: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -81,6 +88,10 @@ class ConsensusOutcome:
     # prefix-cache hits) instead of re-prefilled, summed over all rounds
     # and members — the per-turn view of the serving layer's reuse.
     cached_tokens: int = 0
+    # Rows that missed their QoS deadline (serving/admission.py) across
+    # all rounds. A deadline miss is a MEMBER miss — the member simply
+    # has no proposal this round — never a pool failure by itself.
+    deadline_misses: int = 0
     cost: float = 0.0
     embed_texts: int = 0
     bug_reports: list[tuple[str, str]] = dataclasses.field(default_factory=list)
@@ -252,6 +263,9 @@ class ConsensusEngine:
                 action_enum=(tuple(sorted(cfg.allowed_actions))
                              if cfg.constrained_json and cfg.allowed_actions
                              else None),
+                priority=cfg.priority,
+                tenant=cfg.tenant,
+                deadline_ms=cfg.deadline_ms,
             )
             for m in pool
         ]
@@ -267,6 +281,15 @@ class ConsensusEngine:
             outcome.decode_ms += getattr(res, "decode_ms", 0.0)
             outcome.cached_tokens += getattr(res, "cached_tokens", 0)
             if not res.ok:
+                # Deadline-expired rows (serving/admission.py
+                # DeadlineExceededError, surfaced as a "deadline_exceeded:"
+                # error) are a MEMBER miss: no correction feedback (the
+                # model never answered — nothing to correct), and the other
+                # members' proposals carry the round. Only when EVERY
+                # member misses does the round degrade to all_failed, the
+                # same as any other total outage.
+                if res.error.startswith("deadline_exceeded"):
+                    outcome.deadline_misses += 1
                 failures.append(ModelFailure(res.model_spec, res.error))
                 continue
             parsed = parse_response(res.model_spec, res.text)

@@ -619,6 +619,33 @@ PREFIX_CACHE_PAGES = METRICS.gauge(
     "radix prefix-cache occupancy per model: kind = resident | "
     "referenced | evictable")
 
+# -- serving QoS (ISSUE 4) ---------------------------------------------------
+# Admission control + weighted-fair scheduling (quoracle_tpu/serving/):
+# every admit/shed decision and the per-class queue/latency state.
+QOS_ADMITTED_TOTAL = METRICS.counter(
+    "quoracle_qos_admitted_total",
+    "requests admitted past QoS admission control, by class and tenant")
+QOS_SHED_TOTAL = METRICS.counter(
+    "quoracle_qos_shed_total",
+    "requests shed by QoS admission control, by class/tenant/reason "
+    "(rate_limit | overload | deadline)")
+QOS_ADMIT_WAIT_MS = METRICS.histogram(
+    "quoracle_qos_admit_wait_ms",
+    "submit → decode-loop admission wait per QoS class (ms)")
+QOS_QUEUE_DEPTH = METRICS.gauge(
+    "quoracle_qos_queue_depth",
+    "rows waiting in the weighted-fair queue, per class and model")
+QOS_CLASS_TAIL_MS = METRICS.gauge(
+    "quoracle_qos_class_tail_ms",
+    "EWMA latency-tail estimate per QoS class (serving/slo.py)")
+QOS_WEIGHT_MULTIPLIER = METRICS.gauge(
+    "quoracle_qos_weight_multiplier",
+    "SLO-driven DRR weight multiplier per class (1.0 = undemoted)")
+QOS_DEMOTIONS_TOTAL = METRICS.counter(
+    "quoracle_qos_demotions_total",
+    "bulk-class weight demotions while the INTERACTIVE tail is over "
+    "its SLO target")
+
 # Process self-observation (ISSUE 3 satellite): sampled lazily by the
 # collector below so /api/metrics and GET /metrics always carry a current
 # view — no writer has to remember to refresh them.

@@ -22,7 +22,7 @@ import abc
 import dataclasses
 import logging
 import time
-from typing import Callable, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
@@ -34,6 +34,9 @@ from quoracle_tpu.models.generate import (
     ContextOverflowError, GenerateEngine, splice_session_prompt,
 )
 from quoracle_tpu.models.tokenizer import Tokenizer, get_tokenizer
+from quoracle_tpu.serving.admission import (
+    AdmissionError, DeadlineExceededError,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -57,6 +60,15 @@ class QueryRequest:
     # capability-gated set (None = syntax-only). Only read when
     # constrain_json is True.
     action_enum: Optional[tuple] = None
+    # -- serving QoS (ISSUE 4) ----------------------------------------
+    # Multi-tenant attribution + scheduling class (serving/qos.Priority;
+    # None = AGENT) + a relative latency budget: a row still queued when
+    # ``deadline_ms`` has elapsed since query() entry is failed at admit
+    # (DeadlineExceededError → a "deadline_exceeded:" member miss), not
+    # decoded. QoS moves WHEN rows run, never what they compute.
+    tenant: str = "default"
+    priority: Optional[int] = None
+    deadline_ms: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -140,6 +152,12 @@ class ModelBackend(abc.ABC):
         /api/resources (queue depth, live rows, retired/failed counts).
         Empty for backends without a scheduler."""
         return {}
+
+    def qos_stats(self) -> dict:
+        """Serving-QoS snapshot for /api/qos (admission controller,
+        per-member weighted-fair queues, SLO tracker). ``enabled`` False
+        for backends without QoS wiring."""
+        return {"enabled": False}
 
 
 # ---------------------------------------------------------------------------
@@ -247,7 +265,26 @@ class _MemberBatcher:
         return futs
 
     def _generate(self, subs: list[tuple[list[dict], list]]) -> None:
-        rows = [r for sub_rows, _ in subs for r in sub_rows]
+        pairs = [(r, f) for sub_rows, sub_futs in subs
+                 for r, f in zip(sub_rows, sub_futs)]
+        # Deadline-aware drop at serve time (ISSUE 4): a row whose
+        # budget elapsed while waiting for the baton is failed here —
+        # the batch runs without it rather than decoding dead work.
+        live: list = []
+        now = time.monotonic()
+        for r, f in pairs:
+            dl = r.get("deadline_s")
+            if dl is not None and now >= dl:
+                if not f.done():
+                    f.set_exception(DeadlineExceededError(
+                        "deadline passed before the member batch served "
+                        "this row", tenant=r.get("tenant"),
+                        priority=r.get("priority")))
+            else:
+                live.append((r, f))
+        if not live:
+            return
+        rows = [r for r, _ in live]
         gens = self.engine.generate(
             [r["prompt"] for r in rows],
             temperature=[r["temperature"] for r in rows],
@@ -265,8 +302,7 @@ class _MemberBatcher:
                     else None))
         phases = (self.engine.last_prefill_s * 1000,
                   self.engine.last_decode_s * 1000)
-        futs = [f for _, sub_futs in subs for f in sub_futs]
-        for f, g in zip(futs, gens):
+        for (_, f), g in zip(live, gens):
             f.set_result((g, *phases))
 
     def _drain(self, mine: list) -> None:
@@ -279,6 +315,12 @@ class _MemberBatcher:
                 subs, self._pending = self._pending[:], []
             if not subs:
                 return
+            # QoS (ISSUE 4): serve urgent submissions first. All of a
+            # drain's submissions still merge into one generate, so this
+            # only matters when a failure forces the per-submission
+            # retry — the stable sort keeps arrival order within a class.
+            subs.sort(key=lambda s: min(
+                (r.get("priority") or 1 for r in s[0]), default=1))
             try:
                 self._generate(subs)
             except Exception:
@@ -308,7 +350,8 @@ class TPUBackend(ModelBackend):
                  overlap: bool = True,
                  continuous: bool = False, continuous_chunk: int = 32,
                  continuous_slots: int = 8,
-                 draft_map: Optional[dict] = None, draft_k: int = 6):
+                 draft_map: Optional[dict] = None, draft_k: int = 6,
+                 qos=None):
         """``submeshes``: one jax Mesh per pool member (parallel.mesh.
         pool_submeshes) — each member's engine serves tp-sharded on its own
         chips, and ``overlap`` runs members concurrently from host threads
@@ -322,7 +365,15 @@ class TPUBackend(ModelBackend):
         to ``continuous_slots`` rows per step. Image rows (which skip KV
         sessions by design) stay on the baton path. Under continuous
         mode the per-call prefill/decode phase split is not meaningful
-        (many rows share each device step) and is reported as 0."""
+        (many rows share each device step) and is reported as 0.
+
+        ``qos`` turns on serving QoS (ISSUE 4): pass True for defaults
+        or a serving/qos.QoSConfig. Each member's continuous batcher
+        then admits through a weighted-fair DRR queue (aging floor
+        included), a shared AdmissionController sheds under overload
+        with structured ``retry_after_ms`` rejects, and a shared
+        SLOTracker demotes bulk-class weight while the INTERACTIVE
+        latency tail is over target."""
         import jax
         from quoracle_tpu.models.embeddings import EmbeddingEncoder
         from quoracle_tpu.models.transformer import init_params
@@ -390,13 +441,42 @@ class TPUBackend(ModelBackend):
                           for spec in self.pool}
         self.continuous = continuous
         self._cbatchers = {}
+        # Serving QoS (ISSUE 4): ONE controller + SLO tracker shared
+        # across members (overload and tail burn are system conditions),
+        # one weighted-fair queue per member. qos=True → defaults.
+        self.qos_controller = None
+        self.slo = None
+        qos_policies: dict[str, Any] = {}
+        if qos:
+            from quoracle_tpu.serving.admission import AdmissionController
+            from quoracle_tpu.serving.qos import (
+                QoSConfig, WeightedFairPolicy,
+            )
+            from quoracle_tpu.serving.slo import SLOTracker
+            qcfg = qos if isinstance(qos, QoSConfig) else QoSConfig()
+            self.slo = SLOTracker(targets_ms=qcfg.slo_targets_ms)
+            self.qos_controller = AdmissionController(
+                config=qcfg.admission, tenants=qcfg.tenants)
+            qos_policies = {
+                spec: WeightedFairPolicy(
+                    weights=qcfg.weights, quantum=qcfg.quantum,
+                    aging_floor_s=qcfg.aging_floor_s,
+                    weight_fn=self.slo.weight_multiplier, model=spec)
+                for spec in self.pool}
         if continuous:
             from quoracle_tpu.models.scheduler import ContinuousBatcher
             self._cbatchers = {
                 spec: ContinuousBatcher(self.engines[spec],
                                         chunk=continuous_chunk,
-                                        max_slots=continuous_slots)
+                                        max_slots=continuous_slots,
+                                        policy=qos_policies.get(spec),
+                                        admission=self.qos_controller,
+                                        slo=self.slo)
                 for spec in self.pool}
+            if self.qos_controller is not None:
+                for spec, pol in qos_policies.items():
+                    self.qos_controller.register_depth_source(
+                        spec, pol.qsize)
 
         if embedder is not None:
             self.embedder = embedder
@@ -461,6 +541,17 @@ class TPUBackend(ModelBackend):
 
     def scheduler_stats(self) -> dict:
         return {spec: cb.stats() for spec, cb in self._cbatchers.items()}
+
+    def qos_stats(self) -> dict:
+        if self.qos_controller is None:
+            return {"enabled": False}
+        return {
+            "enabled": True,
+            "admission": self.qos_controller.stats(),
+            "slo": self.slo.stats() if self.slo is not None else None,
+            "queues": {spec: cb.stats().get("qos")
+                       for spec, cb in self._cbatchers.items()},
+        }
 
     def _broadcast_serving(self, by_model: dict) -> None:
         """One TOPIC_SERVING event per query round: each queried member's
@@ -579,6 +670,18 @@ class TPUBackend(ModelBackend):
             window, out_lim = engine.cfg.context_window, engine.cfg.output_limit
             floor = min(OUTPUT_FLOOR, out_lim)
             budget = min(out_lim, max(floor, window - len(ids)))
+            # QoS deadline: the relative budget anchors at query() entry
+            # (t0) — time already burned tokenizing/splicing counts.
+            deadline_s = (t0 + r.deadline_ms / 1000.0
+                          if r.deadline_ms is not None else None)
+            if deadline_s is not None and time.monotonic() >= deadline_s:
+                # already dead at build time — covers every dispatch path
+                # (speculative, baton, continuous) with one check
+                results[i] = QueryResult(
+                    model_spec=spec,
+                    error=f"deadline_exceeded: {r.deadline_ms:.0f}ms "
+                          f"budget elapsed before dispatch")
+                continue
             rows.append({
                 "prompt": ids, "temperature": r.temperature,
                 "top_p": r.top_p,
@@ -587,6 +690,8 @@ class TPUBackend(ModelBackend):
                 "session_id": r.session_id,
                 "constrain_json": r.constrain_json,
                 "action_enum": r.action_enum, "image": img,
+                "priority": r.priority, "tenant": r.tenant,
+                "deadline_s": deadline_s,
             })
             live_idxs.append(i)
         if not live_idxs:
@@ -657,6 +762,16 @@ class TPUBackend(ModelBackend):
                 results[i] = QueryResult(model_spec=spec,
                                          error=f"context_overflow: {e}")
                 continue
+            except DeadlineExceededError as e:
+                results[i] = QueryResult(model_spec=spec,
+                                         error=f"deadline_exceeded: {e}")
+                continue
+            except AdmissionError as e:
+                results[i] = QueryResult(
+                    model_spec=spec,
+                    error=f"admission_rejected: {e} "
+                          f"(retry_after_ms={e.retry_after_ms})")
+                continue
             except Exception as e:
                 results[i] = QueryResult(model_spec=spec,
                                          error=f"generate failed: {e}")
@@ -709,13 +824,25 @@ class TPUBackend(ModelBackend):
                     top_p=r["top_p"], max_new_tokens=r["budget"],
                     session_id=r["session_id"],
                     constrain_json=r["constrain_json"],
-                    action_enum=r["action_enum"]))
+                    action_enum=r["action_enum"],
+                    priority=r["priority"], tenant=r["tenant"],
+                    deadline_s=r["deadline_s"]))
         for i, f in zip(live_idxs, futs):
             try:
                 g = f.result()
             except ContextOverflowError as e:
                 results[i] = QueryResult(model_spec=spec,
                                          error=f"context_overflow: {e}")
+                continue
+            except DeadlineExceededError as e:
+                results[i] = QueryResult(model_spec=spec,
+                                         error=f"deadline_exceeded: {e}")
+                continue
+            except AdmissionError as e:   # structured shed, row-level
+                results[i] = QueryResult(
+                    model_spec=spec,
+                    error=f"admission_rejected: {e} "
+                          f"(retry_after_ms={e.retry_after_ms})")
                 continue
             except Exception as e:        # noqa: BLE001 — row-level error
                 results[i] = QueryResult(model_spec=spec,

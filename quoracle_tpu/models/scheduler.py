@@ -35,12 +35,20 @@ compiles exactly two programs (prefill bucket × decode chunk) per batch
 bucket. Sampled rows draw fresh RNG per chunk — the stream differs from a
 one-shot call (same distribution); temperature-0 rows are bit-identical
 to one-shot (tests/test_scheduler.py equality).
+
+Admission ORDER is a policy (ISSUE 4): the batcher queues through a
+``serving/qos.AdmissionPolicy`` — FIFO by default, weighted-fair DRR with
+an aging floor under QoS — and an optional
+``serving/admission.AdmissionController`` sheds at submit (structured
+reject with ``retry_after_ms``) while deadline-expired rows are failed at
+admit instead of decoded. QoS reorders *scheduling* only: what a row
+computes once admitted is untouched, so temp-0 equality holds with QoS on
+or off (tests/test_qos.py).
 """
 
 from __future__ import annotations
 
 import dataclasses
-import queue
 import threading
 import time
 from concurrent.futures import Future
@@ -48,10 +56,16 @@ from typing import Optional, Sequence
 
 from quoracle_tpu.infra.flightrec import FLIGHT
 from quoracle_tpu.infra.telemetry import (
-    SCHED_ADMIT_WAIT_MS, SCHED_QUEUE_DEPTH, SCHED_ROWS_TOTAL,
-    SCHED_SLOTS_BUSY,
+    QOS_ADMIT_WAIT_MS, SCHED_ADMIT_WAIT_MS, SCHED_QUEUE_DEPTH,
+    SCHED_ROWS_TOTAL, SCHED_SLOTS_BUSY,
 )
 from quoracle_tpu.models.generate import GenResult
+from quoracle_tpu.serving.admission import (
+    AdmissionError, DeadlineExceededError,
+)
+from quoracle_tpu.serving.qos import (
+    AdmissionPolicy, FifoPolicy, class_name, coerce_priority,
+)
 
 
 @dataclasses.dataclass
@@ -71,6 +85,11 @@ class _Row:
     n_cached_first: Optional[int] = None
     owns_session: bool = False          # scheduler-created → drop at end
     t_submit: float = 0.0
+    # QoS (ISSUE 4): class + tenant attribution and the absolute
+    # (monotonic) deadline after which the row is failed, not decoded.
+    priority: int = 1                   # Priority.AGENT
+    tenant: str = "default"
+    deadline_s: Optional[float] = None
 
 
 class ContinuousBatcher:
@@ -83,12 +102,22 @@ class ContinuousBatcher:
     """
 
     def __init__(self, engine, chunk: int = 32, max_slots: int = 8,
-                 admit_wait_s: float = 0.002):
+                 admit_wait_s: float = 0.002,
+                 policy: Optional[AdmissionPolicy] = None,
+                 admission=None, slo=None):
+        """``policy`` orders admission (default: the original FIFO;
+        serving/qos.WeightedFairPolicy for DRR + aging). ``admission``
+        is an optional serving/admission.AdmissionController consulted
+        on every submit — sheds fail the row's future with a structured
+        AdmissionError instead of growing the queue. ``slo`` is an
+        optional serving/slo.SLOTracker fed per-class retire latency."""
         self.engine = engine
         self.chunk = chunk
         self.max_slots = max_slots
         self.admit_wait_s = admit_wait_s
-        self._queue: "queue.Queue[_Row]" = queue.Queue()
+        self._policy = policy if policy is not None else FifoPolicy()
+        self.admission = admission
+        self.slo = slo
         self._live: list[_Row] = []
         self._seq = 0
         self._lock = threading.Lock()
@@ -110,12 +139,16 @@ class ContinuousBatcher:
                top_p: float = 1.0, max_new_tokens: int = 256,
                session_id: Optional[str] = None,
                constrain_json: bool = False,
-               action_enum: Optional[Sequence[str]] = None) -> Future:
+               action_enum: Optional[Sequence[str]] = None,
+               priority=None, tenant: str = "default",
+               deadline_s: Optional[float] = None) -> Future:
         row = _Row(prompt=list(prompt), temperature=temperature,
                    top_p=top_p, max_new=max(1, max_new_tokens),
                    session_id=session_id or self._own_session_id(),
                    constrain=constrain_json, action_enum=action_enum,
-                   future=Future(), t_submit=time.monotonic())
+                   future=Future(), t_submit=time.monotonic(),
+                   priority=int(coerce_priority(priority)),
+                   tenant=tenant, deadline_s=deadline_s)
         row.owns_session = session_id is None
         # Per-row admission check: an over-window prompt must fail ONLY
         # its own future — inside a shared chunk the engine's
@@ -127,6 +160,21 @@ class ContinuousBatcher:
                 f"prompt of {len(row.prompt)} tokens >= max_seq "
                 f"{self.engine.max_seq} for model {self.engine.cfg.name}"))
             return row.future
+        # QoS admission (ISSUE 4): shed BEFORE the row can queue — a
+        # structured reject on the row's OWN future (same idiom as the
+        # overflow check above), never silent queue growth. The
+        # controller may clamp the class to the tenant's floor.
+        if self.admission is not None:
+            try:
+                row.priority = int(self.admission.admit(
+                    tenant=row.tenant, priority=row.priority,
+                    deadline_s=row.deadline_s,
+                    queue_depth=self._policy.qsize()))
+            except AdmissionError as e:
+                row.future.set_exception(e)
+                self.failed += 1
+                SCHED_ROWS_TOTAL.inc(model=self._model, status="failed")
+                return row.future
         # Reject-after-closed UNDER THE LOCK (ISSUE 3 satellite): close()
         # flips _stop under this same lock, so a row can only enter the
         # queue strictly BEFORE the flip — and close()'s drain (which runs
@@ -136,8 +184,8 @@ class ContinuousBatcher:
         with self._lock:
             if self._stop:
                 raise RuntimeError("ContinuousBatcher is closed")
-            self._queue.put(row)
-            depth = self._queue.qsize()
+            self._policy.put(row)
+            depth = self._policy.qsize()
         SCHED_QUEUE_DEPTH.set(depth, model=self._model)
         self._wake.set()
         return row.future
@@ -161,11 +209,7 @@ class ContinuousBatcher:
         if not self._thread.is_alive():
             leftovers = list(self._live)
             self._live = []
-        while True:
-            try:
-                leftovers.append(self._queue.get_nowait())
-            except queue.Empty:
-                break
+        leftovers.extend(self._policy.drain())
         for row in leftovers:
             if not row.future.done():
                 row.future.set_exception(err)
@@ -173,6 +217,12 @@ class ContinuousBatcher:
                 SCHED_ROWS_TOTAL.inc(model=self._model, status="failed")
             if row.owns_session:
                 self.engine.drop_session(row.session_id)
+        # Zero the live gauges (ISSUE 4 satellite): the queue is drained
+        # and no slot can ever be busy again — leaving the last-set
+        # values would show phantom depth/occupancy on /metrics scrapes
+        # after shutdown.
+        SCHED_QUEUE_DEPTH.set(0, model=self._model)
+        SCHED_SLOTS_BUSY.set(0, model=self._model)
 
     def _own_session_id(self) -> str:
         with self._lock:
@@ -185,7 +235,7 @@ class ContinuousBatcher:
         """Point-in-time health snapshot for /api/resources (racy reads
         of worker-owned state — a snapshot, not an invariant)."""
         return {
-            "queued": self._queue.qsize(),
+            "queued": self._policy.qsize(),
             "live": len(self._live),
             "max_slots": self.max_slots,
             "chunk": self.chunk,
@@ -193,6 +243,7 @@ class ContinuousBatcher:
             "retired": self.retired,
             "failed": self.failed,
             "closed": self._stop,
+            "qos": self._policy.snapshot(),
         }
 
     def progress(self) -> tuple[bool, int]:
@@ -200,7 +251,7 @@ class ContinuousBatcher:
         monotonic progress counter). Active with a frozen counter past
         the deadline = the decode loop is wedged."""
         active = (not self._stop
-                  and (bool(self._live) or not self._queue.empty()))
+                  and (bool(self._live) or self._policy.qsize() > 0))
         return active, self.steps
 
     # ------------------------------------------------------------------
@@ -208,19 +259,42 @@ class ContinuousBatcher:
     def _admit(self) -> None:
         admitted = 0
         while len(self._live) < self.max_slots:
-            try:
-                row = self._queue.get_nowait()
-            except queue.Empty:
+            row = self._policy.pop()
+            if row is None:
                 break
-            SCHED_ADMIT_WAIT_MS.observe(
-                (time.monotonic() - row.t_submit) * 1000,
-                model=self._model)
+            now = time.monotonic()
+            # Deadline-aware drop (ISSUE 4): a row whose deadline passed
+            # while queued is failed AT ADMIT — decoding tokens nobody
+            # will wait for would steal the slot from a live request.
+            if row.deadline_s is not None and now >= row.deadline_s:
+                if not row.future.done():
+                    row.future.set_exception(DeadlineExceededError(
+                        f"deadline passed after "
+                        f"{(now - row.t_submit) * 1000:.0f}ms in queue",
+                        tenant=row.tenant, priority=row.priority))
+                if row.owns_session:
+                    self.engine.drop_session(row.session_id)
+                self.failed += 1
+                SCHED_ROWS_TOTAL.inc(model=self._model, status="failed")
+                from quoracle_tpu.infra.telemetry import QOS_SHED_TOTAL
+                QOS_SHED_TOTAL.inc(cls=class_name(row.priority),
+                                   tenant=row.tenant, reason="deadline")
+                FLIGHT.record("qos_deadline_drop", model=self._model,
+                              cls=class_name(row.priority),
+                              tenant=row.tenant,
+                              waited_ms=round(
+                                  (now - row.t_submit) * 1000, 1))
+                continue
+            wait_ms = (now - row.t_submit) * 1000
+            SCHED_ADMIT_WAIT_MS.observe(wait_ms, model=self._model)
+            QOS_ADMIT_WAIT_MS.observe(wait_ms,
+                                      cls=class_name(row.priority))
             self._live.append(row)
             admitted += 1
         if admitted:
             FLIGHT.record("sched_admit", model=self._model, rows=admitted,
                           live=len(self._live))
-        SCHED_QUEUE_DEPTH.set(self._queue.qsize(), model=self._model)
+        SCHED_QUEUE_DEPTH.set(self._policy.qsize(), model=self._model)
         SCHED_SLOTS_BUSY.set(len(self._live), model=self._model)
 
     def _loop(self) -> None:
@@ -247,6 +321,9 @@ class ContinuousBatcher:
             if row.owns_session:
                 self.engine.drop_session(row.session_id)
         self._live = []
+        # gauge reset on the worker-exit path too (ISSUE 4 satellite):
+        # whichever of close()/worker runs last, the scrape reads zero
+        SCHED_SLOTS_BUSY.set(0, model=self._model)
 
     def _isolate_failure(self, rows: list) -> list:
         """A shared chunk raised. One poisoned row must not discard every
@@ -322,6 +399,12 @@ class ContinuousBatcher:
                     self.engine.drop_session(row.session_id)
                 self.retired += 1
                 SCHED_ROWS_TOTAL.inc(model=self._model, status="retired")
+                if self.slo is not None:
+                    # per-class tail tracking (serving/slo.py): feeds the
+                    # INTERACTIVE-burn → BATCH-demotion control loop
+                    self.slo.observe(
+                        row.priority,
+                        (time.monotonic() - row.t_submit) * 1000)
                 FLIGHT.record("sched_retire", model=self._model,
                               session=row.session_id,
                               n_tokens=len(row.emitted),

@@ -52,6 +52,7 @@ class TaskManager:
         working_dir: str = "/tmp",
         grove: Optional[str] = None,
         task_fields: Optional[dict] = None,
+        tenant: str = "default",
     ) -> tuple[str, Any]:
         """Create the task row, spawn the root agent, deliver the initial
         message (reference task_manager.ex:39-92). With ``grove`` (a grove
@@ -128,6 +129,9 @@ class TaskManager:
             budget_mode="root" if budget is not None else "na",
             budget_limit=Decimal(budget) if budget is not None else None,
             working_dir=working_dir,
+            # QoS (ISSUE 4): the whole agent tree bills its model rows to
+            # the creating tenant (dashboard: bearer token → tenant)
+            tenant=tenant,
         )
         root = await self.deps.supervisor.start_agent(config)
         root.post({"type": "user_message", "content": description,

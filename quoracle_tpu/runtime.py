@@ -206,6 +206,14 @@ class RuntimeConfig:
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
+    # Decode-level continuous batching (models/scheduler.py) for the TPU
+    # backend's pool members (round-granularity baton batching otherwise).
+    continuous: bool = False
+    # Serving QoS (ISSUE 4): True for defaults, or a serving/qos.QoSConfig
+    # (a dict of its fields also works — handy from CLI/JSON config).
+    # Turns on weighted-fair admission + overload shedding; implies
+    # nothing unless the backend is "tpu".
+    qos: Any = None
 
 
 class Runtime:
@@ -350,10 +358,16 @@ class Runtime:
             logger.warning("ignoring non-dict draft_map setting %r",
                            draft_map)
             draft_map = None
+        qos = config.qos
+        if isinstance(qos, dict):
+            from quoracle_tpu.serving.qos import QoSConfig
+            qos = QoSConfig(**qos)
         return TPUBackend(pool, seed=config.seed,
                           embed_model=config.embed_model,
                           submeshes=submeshes,
-                          draft_map=draft_map or None)
+                          draft_map=draft_map or None,
+                          continuous=config.continuous,
+                          qos=qos)
 
     async def boot(self) -> dict:
         """Boot-time revival of persisted running tasks (reference

@@ -1,0 +1,28 @@
+"""Serving QoS (ISSUE 4): multi-tenant admission control, weighted-fair
+scheduling, and overload shedding for the continuous-batching serving
+path.
+
+Three modules, one dependency direction (serving → infra, never →
+models — the scheduler imports *us*):
+
+* :mod:`quoracle_tpu.serving.qos` — priority classes, per-tenant token
+  buckets, and the deficit-round-robin weighted-fair queue that replaces
+  the FIFO in ``ContinuousBatcher._admit`` via the
+  :class:`~quoracle_tpu.serving.qos.AdmissionPolicy` seam.
+* :mod:`quoracle_tpu.serving.admission` — the admission controller that
+  sheds load from live overload signals (queue depth, admit-wait p95,
+  HBM headroom) with structured rejects carrying ``retry_after_ms``.
+* :mod:`quoracle_tpu.serving.slo` — per-class latency targets with EWMA
+  tail tracking that demotes BATCH/BACKGROUND admission weight while the
+  INTERACTIVE tail is over target.
+"""
+
+from quoracle_tpu.serving.admission import (       # noqa: F401
+    AdmissionConfig, AdmissionController, AdmissionError,
+    DeadlineExceededError, OverloadedError, RateLimitedError,
+)
+from quoracle_tpu.serving.qos import (             # noqa: F401
+    AdmissionPolicy, FifoPolicy, Priority, QoSConfig, TenantPolicy,
+    TokenBucket, WeightedFairPolicy, priority_for_depth,
+)
+from quoracle_tpu.serving.slo import SLOTracker    # noqa: F401

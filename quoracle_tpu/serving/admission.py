@@ -1,0 +1,322 @@
+"""Admission control + overload shedding (ISSUE 4 tentpole, part b).
+
+PR 3 made overload *visible* (queue-depth gauges, admit-wait histogram,
+HBM headroom); this module makes it *actionable*: every submission passes
+``AdmissionController.admit()`` before it may queue, and under pressure
+the controller SHEDS — a structured :class:`AdmissionError` carrying
+``retry_after_ms`` instead of silent queue growth. Shedding is selective
+by class: bulk tiers (BATCH/BACKGROUND) go first, AGENT only under hard
+overload, INTERACTIVE only at the absolute depth cap that protects the
+process itself. Deadline-expired rows fail at admit with the distinct
+:class:`DeadlineExceededError` — the consensus engine treats that as a
+member miss (one row's lateness), never a pool failure.
+
+Signals (refreshed at most every ``refresh_s``, so admit() stays cheap):
+
+* queue depth — live, from the depth sources each continuous batcher
+  registers (its policy's ``qsize``);
+* admit-wait p95 — COUNT DELTAS of the ``quoracle_sched_admit_wait_ms``
+  histogram over the refresh window (the same numbers /metrics scrapes);
+* HBM headroom — ``infra/resources.headroom_fraction()`` (None on CPU,
+  where the signal simply doesn't fire).
+
+Every decision lands in telemetry (``quoracle_qos_{admitted,shed}_total``
+by class/tenant/reason) and every shed in the flight recorder
+(``qos_shed`` events), so a saturation incident is attributable from the
+dump alone.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from quoracle_tpu.infra.telemetry import (
+    QOS_ADMITTED_TOTAL, QOS_SHED_TOTAL, SCHED_ADMIT_WAIT_MS, quantile,
+)
+from quoracle_tpu.serving.qos import (
+    Priority, TenantPolicy, class_name, coerce_priority,
+)
+
+
+class AdmissionError(RuntimeError):
+    """Structured reject: machine-readable reason + retry hint. The web
+    layer maps this to 429 + ``Retry-After``; the serving layer maps it
+    to a failed row whose error string carries the same fields."""
+
+    reason = "rejected"
+
+    def __init__(self, message: str, retry_after_ms: int = 1000,
+                 tenant: Optional[str] = None,
+                 priority: Optional[Priority] = None):
+        super().__init__(message)
+        self.retry_after_ms = max(0, int(retry_after_ms))
+        self.tenant = tenant
+        self.priority = priority
+
+    def as_dict(self) -> dict:
+        return {
+            "error": str(self),
+            "reason": self.reason,
+            "retry_after_ms": self.retry_after_ms,
+            "tenant": self.tenant,
+            "priority": (class_name(self.priority)
+                         if self.priority is not None else None),
+        }
+
+
+class RateLimitedError(AdmissionError):
+    """Tenant token bucket empty; retry_after_ms = time to refill."""
+
+    reason = "rate_limit"
+
+
+class OverloadedError(AdmissionError):
+    """System-level shed: queue depth / admit-wait / HBM pressure."""
+
+    reason = "overload"
+
+
+class DeadlineExceededError(AdmissionError):
+    """The row's deadline passed before it could be admitted (or was
+    already expired at submit). Retrying the SAME request is pointless —
+    retry_after_ms is 0 by convention. The consensus engine treats this
+    as a member miss, not a pool failure."""
+
+    reason = "deadline"
+
+    def __init__(self, message: str, tenant: Optional[str] = None,
+                 priority: Optional[Priority] = None):
+        super().__init__(message, retry_after_ms=0, tenant=tenant,
+                         priority=priority)
+
+
+@dataclasses.dataclass
+class AdmissionConfig:
+    """Shed thresholds. ``max_queue_depth`` is the soft bound: past it
+    bulk classes shed; past 2x AGENT sheds too; past 4x everything sheds
+    (the process-protection cap). ``max_admit_wait_p95_ms`` and
+    ``min_hbm_headroom`` shed bulk classes only — they are early-warning
+    signals, not hard limits."""
+
+    max_queue_depth: int = 64
+    max_admit_wait_p95_ms: float = 4000.0
+    min_hbm_headroom: float = 0.03
+    base_retry_ms: int = 1000
+    max_retry_ms: int = 30000
+    refresh_s: float = 1.0
+    hbm_refresh_s: float = 5.0
+    # fewer than this many new admit-wait samples in a window → the p95
+    # signal is stale noise, not evidence of overload
+    min_wait_samples: int = 8
+
+
+class AdmissionController:
+    """One per backend (shared across pool members — overload is a
+    system condition, not a per-engine one). Thread-safe; ``admit()`` is
+    called on every submission and does no I/O outside its rate-limited
+    signal refresh."""
+
+    def __init__(self, config: Optional[AdmissionConfig] = None,
+                 tenants: Optional[dict] = None,
+                 headroom_fn: Optional[Callable[[], Optional[float]]] = None,
+                 model: str = ""):
+        self.config = config or AdmissionConfig()
+        self.model = model
+        self._lock = threading.Lock()
+        self._tenants: dict[str, TenantPolicy] = {}
+        self._buckets: dict[str, Any] = {}
+        for name, pol in (tenants or {}).items():
+            self.set_tenant(pol if isinstance(pol, TenantPolicy)
+                            else TenantPolicy(name=name, **pol))
+        self._headroom_fn = headroom_fn
+        self._depth_sources: dict[str, Callable[[], int]] = {}
+        # cached signals (refreshed under _sig_lock, read without)
+        self._sig_lock = threading.Lock()
+        self._t_refresh = 0.0
+        self._t_hbm = 0.0
+        self._wait_counts: Optional[list] = None
+        self.admit_wait_p95_ms: Optional[float] = None
+        self.hbm_headroom: Optional[float] = None
+        self.admitted = 0
+        self.shed = 0
+
+    # -- configuration ---------------------------------------------------
+
+    def set_tenant(self, policy: TenantPolicy) -> None:
+        with self._lock:
+            self._tenants[policy.name] = policy
+            self._buckets[policy.name] = policy.make_bucket()
+
+    def register_depth_source(self, name: str,
+                              fn: Callable[[], int]) -> None:
+        with self._lock:
+            self._depth_sources[name] = fn
+
+    # -- signals ---------------------------------------------------------
+
+    def _default_headroom(self) -> Optional[float]:
+        from quoracle_tpu.infra.resources import headroom_fraction
+        return headroom_fraction()
+
+    def refresh_signals(self, now: Optional[float] = None) -> None:
+        """Refresh the cached overload signals if the window elapsed.
+        Exceptions are swallowed — a broken sampler must never take
+        admission (and the serving path behind it) down."""
+        now = time.monotonic() if now is None else now
+        cfg = self.config
+        with self._sig_lock:
+            if now - self._t_refresh < cfg.refresh_s:
+                return
+            self._t_refresh = now
+            try:
+                counts, _, _ = SCHED_ADMIT_WAIT_MS.counts()
+                if self._wait_counts is not None:
+                    delta = [a - b for a, b in
+                             zip(counts, self._wait_counts)]
+                    if sum(delta) >= cfg.min_wait_samples:
+                        self.admit_wait_p95_ms = quantile(
+                            SCHED_ADMIT_WAIT_MS.buckets, delta, 0.95)
+                    else:
+                        self.admit_wait_p95_ms = None
+                self._wait_counts = counts
+            except Exception:             # noqa: BLE001 — telemetry only
+                pass
+            if now - self._t_hbm >= cfg.hbm_refresh_s:
+                self._t_hbm = now
+                try:
+                    fn = self._headroom_fn or self._default_headroom
+                    self.hbm_headroom = fn()
+                except Exception:         # noqa: BLE001 — optional signal
+                    self.hbm_headroom = None
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            fns = list(self._depth_sources.values())
+        depth = 0
+        for fn in fns:
+            try:
+                depth = max(depth, int(fn()))
+            except Exception:             # noqa: BLE001
+                pass
+        return depth
+
+    def _retry_ms(self, depth: int, cls: Priority) -> int:
+        """Retry hint grows with how far past the bound the queue is and
+        with how demotable the class is (bulk work backs off longer)."""
+        cfg = self.config
+        over = depth / max(1, cfg.max_queue_depth)
+        scale = 1.0 + max(0.0, over - 1.0) + 0.5 * int(cls)
+        return min(cfg.max_retry_ms, int(cfg.base_retry_ms * scale))
+
+    # -- the decision ----------------------------------------------------
+
+    def admit(self, tenant: str = "default", priority: Any = None,
+              deadline_s: Optional[float] = None,
+              queue_depth: Optional[int] = None,
+              cost: float = 1.0) -> Priority:
+        """Admit or raise. Returns the EFFECTIVE priority (the tenant's
+        ``max_class`` clamp applied) so the caller enqueues the row at
+        the class admission actually granted."""
+        now = time.monotonic()
+        cls = coerce_priority(priority)
+        with self._lock:
+            pol = self._tenants.get(tenant) or self._tenants.get("*")
+            bucket = self._buckets.get(pol.name) if pol else None
+        if pol is not None and cls < pol.max_class:
+            cls = pol.max_class
+        if deadline_s is not None and now >= deadline_s:
+            self._record_shed(cls, tenant, "deadline", 0)
+            raise DeadlineExceededError(
+                f"deadline passed {((now - deadline_s) * 1000):.0f}ms "
+                f"before admission", tenant=tenant, priority=cls)
+        if bucket is not None:
+            wait_s = bucket.try_acquire(cost, now=now)
+            if wait_s > 0:
+                retry = int(wait_s * 1000) + 1
+                self._record_shed(cls, tenant, "rate_limit", retry)
+                raise RateLimitedError(
+                    f"tenant {tenant!r} over its rate "
+                    f"({pol.rate_per_s}/s, burst {pol.burst:g})",
+                    retry_after_ms=retry, tenant=tenant, priority=cls)
+        self.refresh_signals(now)
+        cfg = self.config
+        depth = queue_depth if queue_depth is not None \
+            else self.queue_depth()
+        if depth >= 4 * cfg.max_queue_depth:
+            self._shed(cls, tenant, depth,
+                       f"queue at hard cap ({depth} rows)")
+        if depth >= 2 * cfg.max_queue_depth and cls >= Priority.AGENT:
+            self._shed(cls, tenant, depth,
+                       f"queue past 2x bound ({depth} rows)")
+        if cls >= Priority.BATCH:
+            if depth >= cfg.max_queue_depth:
+                self._shed(cls, tenant, depth,
+                           f"queue past bound ({depth} rows)")
+            p95 = self.admit_wait_p95_ms
+            if p95 is not None and p95 > cfg.max_admit_wait_p95_ms:
+                self._shed(cls, tenant, depth,
+                           f"admit-wait p95 {p95:.0f}ms over "
+                           f"{cfg.max_admit_wait_p95_ms:.0f}ms")
+            head = self.hbm_headroom
+            if head is not None and head < cfg.min_hbm_headroom:
+                self._shed(cls, tenant, depth,
+                           f"HBM headroom {head:.3f} under "
+                           f"{cfg.min_hbm_headroom}")
+        with self._sig_lock:
+            self.admitted += 1
+        QOS_ADMITTED_TOTAL.inc(cls=cls.name.lower(), tenant=tenant)
+        return cls
+
+    def _shed(self, cls: Priority, tenant: str, depth: int,
+              why: str) -> None:
+        retry = self._retry_ms(depth, cls)
+        self._record_shed(cls, tenant, "overload", retry)
+        raise OverloadedError(f"shed {cls.name} for tenant {tenant!r}: "
+                              f"{why}", retry_after_ms=retry,
+                              tenant=tenant, priority=cls)
+
+    def _record_shed(self, cls: Priority, tenant: str, reason: str,
+                     retry_ms: int) -> None:
+        from quoracle_tpu.infra.flightrec import FLIGHT
+        with self._sig_lock:
+            self.shed += 1
+        QOS_SHED_TOTAL.inc(cls=cls.name.lower(), tenant=tenant,
+                           reason=reason)
+        FLIGHT.record("qos_shed", cls=cls.name.lower(), tenant=tenant,
+                      reason=reason, retry_after_ms=retry_ms,
+                      model=self.model)
+
+    # -- reads -----------------------------------------------------------
+
+    def stats(self) -> dict:
+        cfg = self.config
+        with self._lock:
+            tenants = {
+                name: {
+                    "rate_per_s": pol.rate_per_s,
+                    "burst": pol.burst,
+                    "max_class": class_name(pol.max_class),
+                    "tokens": (round(self._buckets[name].level(), 2)
+                               if self._buckets.get(name) else None),
+                } for name, pol in self._tenants.items()
+            }
+            depth_sources = sorted(self._depth_sources)
+        with self._sig_lock:
+            admitted, shed = self.admitted, self.shed
+        return {
+            "admitted": admitted,
+            "shed": shed,
+            "queue_depth": self.queue_depth(),
+            "admit_wait_p95_ms": self.admit_wait_p95_ms,
+            "hbm_headroom": self.hbm_headroom,
+            "thresholds": {
+                "max_queue_depth": cfg.max_queue_depth,
+                "max_admit_wait_p95_ms": cfg.max_admit_wait_p95_ms,
+                "min_hbm_headroom": cfg.min_hbm_headroom,
+            },
+            "tenants": tenants,
+            "depth_sources": depth_sources,
+        }

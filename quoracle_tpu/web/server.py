@@ -20,6 +20,9 @@ Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
                             memory + per-engine HBM attribution, compile
                             registry, scheduler health, watchdog + flight
                             recorder status (infra/resources.py)
+  GET  /api/qos             serving-QoS panel (ISSUE 4): admission
+                            controller signals/thresholds, per-member
+                            weighted-fair queues, SLO tails, shed counters
   POST /api/flightrec/dump  dump the flight-recorder ring to a JSON file
   GET  /api/trace?task_id   finished trace spans for one task (TOPIC_TRACE
                             ring in infra/event_history.py)
@@ -340,6 +343,39 @@ class DashboardServer:
         spans = self.runtime.history.replay_traces(trace_id)
         return {"task_id": trace_id, "n_spans": len(spans), "spans": spans}
 
+    def qos_payload(self) -> dict:
+        """GET /api/qos: the serving-QoS panel (ISSUE 4) — admission
+        controller state (signals, thresholds, tenant buckets), the
+        per-member weighted-fair queue snapshots, the SLO tracker's
+        per-class tails, and the admit/shed counter series."""
+        from quoracle_tpu.infra.telemetry import (
+            QOS_ADMIT_WAIT_MS, QOS_ADMITTED_TOTAL, QOS_SHED_TOTAL,
+        )
+        backend = self.runtime.backend
+        payload = (backend.qos_stats()
+                   if hasattr(backend, "qos_stats")
+                   else {"enabled": False})
+        payload["counters"] = {
+            "admitted": QOS_ADMITTED_TOTAL._snapshot(),
+            "shed": QOS_SHED_TOTAL._snapshot(),
+            "admit_wait_ms": QOS_ADMIT_WAIT_MS._snapshot(),
+        }
+        payload["tenant_map_configured"] = bool(
+            self._tenant_map())
+        return payload
+
+    def _tenant_map(self) -> dict:
+        """The ``qos_tenants`` setting: {bearer token: tenant name}.
+        Unset/malformed → empty (every caller is tenant 'default')."""
+        try:
+            mapping = self.runtime.store.get_setting("qos_tenants")
+        except Exception:                # noqa: BLE001 — optional setting
+            return {}
+        return mapping if isinstance(mapping, dict) else {}
+
+    def tenant_for_token(self, token: Optional[str]) -> str:
+        return self._tenant_map().get(token or "", "default")
+
     def prometheus_text(self) -> str:
         """GET /metrics body: scrape-time gauge refresh + the registry's
         text exposition (infra/telemetry.py)."""
@@ -386,11 +422,14 @@ class _Handler(BaseHTTPRequestHandler):
                      if isinstance(a, str) else a for a in args)
         logger.debug("dashboard: " + fmt, *args)
 
-    def _send_json(self, payload: Any, status: int = 200) -> None:
+    def _send_json(self, payload: Any, status: int = 200,
+                   extra_headers: Optional[dict] = None) -> None:
         body = json.dumps(payload, default=str).encode()
         self.send_response(status)
         self.send_header("content-type", "application/json")
         self.send_header("content-length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, str(v))
         self.end_headers()
         self.wfile.write(body)
 
@@ -454,7 +493,8 @@ class _Handler(BaseHTTPRequestHandler):
             elif parsed.path == "/telemetry":
                 from quoracle_tpu.web import views
                 self._send_html(views.telemetry_page(
-                    d.metrics_payload(), d.resources_payload()))
+                    d.metrics_payload(), d.resources_payload(),
+                    d.qos_payload()))
             elif parsed.path == "/settings":
                 from quoracle_tpu.web import views
                 self._send_html(views.settings_page(
@@ -485,6 +525,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.metrics_payload())
             elif parsed.path == "/api/resources":
                 self._send_json(d.resources_payload())
+            elif parsed.path == "/api/qos":
+                self._send_json(d.qos_payload())
             elif parsed.path == "/api/trace":
                 self._send_json(d.trace_payload(one("task_id")
                                                 or one("trace_id")))
@@ -552,14 +594,42 @@ class _Handler(BaseHTTPRequestHandler):
         return hmac.compare_digest(got.encode("utf-8", "surrogateescape"),
                                    token.encode("utf-8", "surrogateescape"))
 
+    def _qos_shed(self, tenant: str) -> bool:
+        """Serving-QoS gate for work-creating POSTs (ISSUE 4): dashboard
+        submissions are INTERACTIVE-class; when the backend's admission
+        controller sheds, the caller gets 429 + ``Retry-After`` (seconds,
+        ceil) and the structured reject body with ``retry_after_ms`` —
+        never a hung request against a saturated queue. Returns True when
+        the response has been sent (caller must stop)."""
+        ctrl = getattr(self.dashboard.runtime.backend,
+                       "qos_controller", None)
+        if ctrl is None:
+            return False
+        from quoracle_tpu.serving.admission import AdmissionError
+        from quoracle_tpu.serving.qos import Priority
+        try:
+            ctrl.admit(tenant=tenant, priority=Priority.INTERACTIVE)
+        except AdmissionError as e:
+            self._send_json(
+                e.as_dict(), 429,
+                extra_headers={"Retry-After":
+                               max(1, -(-e.retry_after_ms // 1000))})
+            return True
+        return False
+
     def do_POST(self) -> None:      # noqa: N802 (stdlib API)
         d = self.dashboard
         if not self._authorized():
             self._send_json({"error": "unauthorized"}, 401)
             return
+        tenant = d.tenant_for_token(
+            (self.headers.get("authorization") or "")
+            .removeprefix("Bearer "))
         body = self._read_body()
         try:
             if self.path == "/api/tasks":
+                if self._qos_shed(tenant):
+                    return
                 pool = body.get("model_pool")
                 if pool is None and body.get("profile") is None:
                     pool = d.runtime.default_pool()   # UI sends only text
@@ -568,7 +638,8 @@ class _Handler(BaseHTTPRequestHandler):
                     model_pool=pool,
                     profile=body.get("profile"),
                     budget=body.get("budget"),
-                    grove=body.get("grove")))
+                    grove=body.get("grove"),
+                    tenant=tenant))
                 self._send_json({"task_id": task_id,
                                  "root_agent": root.agent_id}, 201)
             elif self.path.startswith("/api/tasks/") \
@@ -588,6 +659,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json({"path": path,
                                  **FLIGHT.status()}, 201)
             elif self.path == "/api/messages":
+                if self._qos_shed(tenant):
+                    return
                 ok = d.post_to_agent(body.get("agent_id", ""), {
                     "type": "user_message",
                     "content": body.get("content", ""), "from": "user"})

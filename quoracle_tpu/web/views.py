@@ -319,10 +319,67 @@ def resources_panel(res: dict) -> str:
     return "".join(parts)
 
 
-def telemetry_page(metrics: dict, resources: Optional[dict] = None) -> str:
+def qos_panel(qos: dict) -> str:
+    """Serving-QoS panel (ISSUE 4): admission signals + shed counts,
+    per-class weighted-fair queue state, and the SLO tracker's tails —
+    the /api/qos payload as tables. Renders nothing while QoS is off."""
+    if not qos or not qos.get("enabled"):
+        return ""
+    parts = ["<h2 class=\"meta\">serving QoS</h2>"]
+    adm = qos.get("admission") or {}
+    parts.append(
+        f"<p class=\"meta\" id=\"qos-admission\">admitted "
+        f"{_e(adm.get('admitted'))} · shed {_e(adm.get('shed'))} · "
+        f"queue depth {_e(adm.get('queue_depth'))} · admit-wait p95 "
+        f"{_fmt_ms(adm.get('admit_wait_p95_ms'))}ms · HBM headroom "
+        f"{_e(adm.get('hbm_headroom'))}</p>")
+    slo = qos.get("slo") or {}
+    rows = "".join(
+        f"<tr class=\"slo-row\" data-cls=\"{_e(cls)}\">"
+        f"<td>{_e(cls)}</td><td>{_fmt_ms(c.get('tail_ms'))}</td>"
+        f"<td>{_fmt_ms(c.get('target_ms'))}</td>"
+        f"<td>{_e(c.get('observed'))}</td></tr>"
+        for cls, c in sorted((slo.get("classes") or {}).items()))
+    if rows:
+        demoted = (" — BULK DEMOTED" if slo.get("demoted") else "")
+        parts.append(
+            f"<table id=\"qos-slo\"><tr><th>class{_e(demoted)}</th>"
+            "<th>tail ms</th><th>target ms</th><th>observed</th></tr>"
+            + rows + "</table>")
+    for spec, q in sorted((qos.get("queues") or {}).items()):
+        if not q or q.get("policy") != "weighted_fair":
+            continue
+        rows = "".join(
+            f"<tr class=\"qos-queue-row\"><td>{_e(cls)}</td>"
+            f"<td>{_e(c.get('queued'))}</td><td>{_e(c.get('weight'))}</td>"
+            f"<td>{_e(c.get('served'))}</td>"
+            f"<td>{_e(c.get('oldest_wait_s') or '')}</td></tr>"
+            for cls, c in sorted((q.get("classes") or {}).items()))
+        parts.append(
+            f"<table class=\"qos-queue\" data-model=\"{_e(spec)}\">"
+            f"<tr><th>{_e(spec)}</th><th>queued</th><th>weight</th>"
+            f"<th>served</th><th>oldest wait s</th></tr>"
+            + rows + "</table>")
+    tenants = adm.get("tenants") or {}
+    if tenants:
+        rows = "".join(
+            f"<tr class=\"tenant-row\"><td>{_e(name)}</td>"
+            f"<td>{_e(t.get('rate_per_s') or '∞')}</td>"
+            f"<td>{_e(t.get('tokens'))}</td>"
+            f"<td>{_e(t.get('max_class'))}</td></tr>"
+            for name, t in sorted(tenants.items()))
+        parts.append(
+            "<table id=\"qos-tenants\"><tr><th>tenant</th>"
+            "<th>rate/s</th><th>tokens</th><th>max class</th></tr>"
+            + rows + "</table>")
+    return "".join(parts)
+
+
+def telemetry_page(metrics: dict, resources: Optional[dict] = None,
+                   qos: Optional[dict] = None) -> str:
     """Dev telemetry view (reference LiveDashboard at /dev/dashboard):
     the /api/metrics snapshot as readable tables, led by the latency
-    histogram panel and the live resources panel."""
+    histogram panel, the live resources panel, and the QoS panel."""
     def table(title: str, d: dict) -> str:
         return (f"<h2 class=\"meta\">{_e(title)}</h2>"
                 f"<table class=\"metrics\" data-section=\"{_e(title)}\">"
@@ -338,6 +395,7 @@ def telemetry_page(metrics: dict, resources: Optional[dict] = None) -> str:
             flat[key] = val
     body = (latency_panel(metrics.get("telemetry") or {})
             + resources_panel(resources or {})
+            + qos_panel(qos or {})
             + (table("runtime", flat) if flat else "")
             + "".join(sections))
     return _page("telemetry", body, refresh=10)
