@@ -774,6 +774,91 @@ def measure_qos_overload(backend, pool, overload_x: int = 4,
     }
 
 
+def measure_quality_overhead(backend, pool,
+                             n_decides: int = N_CYCLES) -> dict:
+    """Config 12: consensus-quality instrumentation overhead (ISSUE 5).
+
+    ``n_decides`` REAL ConsensusEngine.decide calls over the full pool,
+    run twice over the SAME engines: quality OFF (no audit record, no
+    scorecard/entropy observations) then quality ON. Decide p50/p95 for
+    each phase come from the quoracle_decide_ms histogram COUNT DELTAS
+    around the phase (the same numbers GET /metrics scrapes) — the
+    on/off ratio is the measured price of the audit layer, which must be
+    read-only by construction (temp-0 outcome equality is tier-1-tested;
+    this measures the time side). Also reported: the emitted
+    entropy/margin of the temp-0 pool's decides and the resulting
+    scorecard slice. With QUORACLE_BENCH_QUALITY set, every audit record
+    + the scorecards are written there as a sidecar artifact
+    (run_live_bench.sh commits it)."""
+    from quoracle_tpu.consensus.engine import ConsensusConfig, ConsensusEngine
+    from quoracle_tpu.consensus.quality import QUALITY
+    from quoracle_tpu.infra.telemetry import DECIDE_MS, quantile
+
+    def q(delta, p):
+        v = quantile(DECIDE_MS.buckets, delta, p)
+        return round(v, 1) if v is not None else None
+
+    def run_phase(quality_on: bool) -> dict:
+        eng = ConsensusEngine(backend, ConsensusConfig(
+            model_pool=list(pool),
+            session_key=f"bench-config12-{'on' if quality_on else 'off'}",
+            quality=quality_on))
+        before, _, _ = DECIDE_MS.counts()
+        records = []
+        for i in range(n_decides):
+            msgs = {m: [{"role": "system", "content": SYSTEM_PROMPT},
+                        {"role": "user",
+                         "content": TASKS[(i + 1) % len(TASKS)]}]
+                    for m in pool}
+            out = eng.decide(msgs)
+            if out.audit is not None:
+                records.append(out.audit)
+            log(f"config12 decide {i} (quality={'on' if quality_on else 'off'}): "
+                f"status={out.status} rounds={out.rounds_used}")
+        after, _, _ = DECIDE_MS.counts()
+        delta = [a - b for a, b in zip(after, before)]
+        return {"decide_p50_ms": q(delta, 0.50),
+                "decide_p95_ms": q(delta, 0.95),
+                "records": records}
+
+    off = run_phase(False)
+    on = run_phase(True)
+    entropies = [r["entropy_bits"] for r in on["records"]
+                 if r.get("entropy_bits") is not None]
+    margins = [r["margin"] for r in on["records"]
+               if r.get("margin") is not None]
+    cards = QUALITY.scorecards()
+    result = {
+        "n_decides": n_decides,
+        "n_members": len(pool),
+        "decide_p50_on_ms": on["decide_p50_ms"],
+        "decide_p95_on_ms": on["decide_p95_ms"],
+        "decide_p50_off_ms": off["decide_p50_ms"],
+        "decide_p95_off_ms": off["decide_p95_ms"],
+        "overhead_p50_ratio": (
+            round(on["decide_p50_ms"] / off["decide_p50_ms"], 3)
+            if on["decide_p50_ms"] and off["decide_p50_ms"] else None),
+        "entropy_bits_mean": (round(sum(entropies) / len(entropies), 4)
+                              if entropies else None),
+        "margin_mean": (round(sum(margins) / len(margins), 4)
+                        if margins else None),
+        "rounds": [r["rounds"] for r in on["records"]],
+        "scorecard": {
+            spec: {k: cards["members"].get(spec, {}).get(k)
+                   for k in ("decides", "agreement_rate", "dissent_rate",
+                             "failure_rate", "latency_p50_ms")}
+            for spec in pool
+        },
+    }
+    sidecar = os.environ.get("QUORACLE_BENCH_QUALITY")
+    if sidecar:
+        with open(sidecar, "w") as f:
+            json.dump({"summary": result, "records": on["records"],
+                       "scorecards": cards}, f)
+        log(f"config12 audit records written to {sidecar}")
+    return result
+
+
 def base_payload() -> dict:
     """Every key the artifact can carry, pre-filled null — ANY exit path
     prints this line with whatever was actually measured, so degraded runs
@@ -871,6 +956,18 @@ def base_payload() -> dict:
         "config11_goodput_on": None,
         "config11_goodput_off": None,
         "config11_no_silent_drops": None,
+        # config 12 — consensus-quality instrumentation (ISSUE 5): decide
+        # p50/p95 with scorecards/audit on vs off (histogram count
+        # deltas), and the emitted entropy/margin for the temp-0 pool;
+        # full audit records land in the QUALITY sidecar.
+        "config12_n_decides": None,
+        "config12_decide_p50_on_ms": None,
+        "config12_decide_p95_on_ms": None,
+        "config12_decide_p50_off_ms": None,
+        "config12_decide_p95_off_ms": None,
+        "config12_overhead_p50_ratio": None,
+        "config12_entropy_bits_mean": None,
+        "config12_margin_mean": None,
         "cycles": None,
         "rounds_per_cycle": None,
         "max_new_tokens": None,
@@ -1232,6 +1329,13 @@ def _run(args, payload: dict, deadline_at: float) -> None:
     if cfg11:
         log(f"config11: {cfg11}")
 
+    # config 12 rides backend's engines directly (plain batched dispatch,
+    # quality layer off then on) — before the vision config frees them
+    cfg12 = guard("config12",
+                  lambda: measure_quality_overhead(backend, pool))
+    if cfg12:
+        log(f"config12: {cfg12}")
+
     def vision_config():
         # config 5: vision pool — free the trio's HBM first (weights + KV
         # page pools), then serve llama + the VLM checkpoint with an
@@ -1403,6 +1507,17 @@ def _run(args, payload: dict, deadline_at: float) -> None:
                 cfg11["qos_off"]["goodput_tokens_per_retired_row"],
             "config11_no_silent_drops": cfg11["no_silent_drops"],
         })
+    if cfg12:
+        payload.update({
+            "config12_n_decides": cfg12["n_decides"],
+            "config12_decide_p50_on_ms": cfg12["decide_p50_on_ms"],
+            "config12_decide_p95_on_ms": cfg12["decide_p95_on_ms"],
+            "config12_decide_p50_off_ms": cfg12["decide_p50_off_ms"],
+            "config12_decide_p95_off_ms": cfg12["decide_p95_off_ms"],
+            "config12_overhead_p50_ratio": cfg12["overhead_p50_ratio"],
+            "config12_entropy_bits_mean": cfg12["entropy_bits_mean"],
+            "config12_margin_mean": cfg12["margin_mean"],
+        })
     if cfg10:
         payload.update({
             "config10_n_samples": cfg10["n_samples"],
@@ -1419,7 +1534,8 @@ def _run(args, payload: dict, deadline_at: float) -> None:
     log(json.dumps({"config1": cfg1, "config2": cfg2, "config3": cfg3,
                     "config4": cfg4, "config5": cfg5, "config6": cfg6,
                     "config7": cfg7, "config8": cfg8, "config9": cfg9,
-                    "config10": cfg10, "config11": cfg11},
+                    "config10": cfg10, "config11": cfg11,
+                    "config12": cfg12},
                    indent=1, default=str))
     payload.update({
         "cycles": N_CYCLES,

@@ -184,6 +184,10 @@ class AgentCore:
                 session_key=self.agent_id,   # KV residency per agent×model
                 priority=priority,
                 tenant=config.tenant,
+                # consensus-quality audit attribution (ISSUE 5): every
+                # decide's audit record lands under this task at
+                # /api/consensus?task_id=… (consensus/quality.py)
+                task_id=config.task_id,
             ),
             log=lambda event, data: deps.events.log(
                 self.agent_id, "debug", event, **data))

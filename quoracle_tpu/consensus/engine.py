@@ -26,7 +26,10 @@ from quoracle_tpu.consensus.aggregator import (
 from quoracle_tpu.consensus.parser import (
     ActionProposal, ParseFailure, parse_response,
 )
-from quoracle_tpu.consensus.result import Decision, pick_winner
+from quoracle_tpu.consensus.quality import QUALITY, build_audit_record
+from quoracle_tpu.consensus.result import (
+    Decision, pick_winner, select_winner_cluster,
+)
 from quoracle_tpu.consensus.rules import EmbedAccumulator
 from quoracle_tpu.consensus.temperature import temperature_for_round
 from quoracle_tpu.infra.telemetry import (
@@ -37,6 +40,18 @@ from quoracle_tpu.models.runtime import ModelBackend, QueryRequest
 DEFAULT_THRESHOLD = 0.5          # reference consensus/manager.ex:11-21
 DEFAULT_MAX_REFINEMENT_ROUNDS = 4
 REASONING_WINDOW_ROUNDS = 2      # sliding window of refinement history kept
+
+
+def _note_failures(failures: list["ModelFailure"],
+                   failure_kinds: dict[str, dict[str, int]],
+                   corrected: set[str]) -> None:
+    """Fold one round's failures into the decide-wide quality scratch
+    (per-member kind counts + who got correction feedback)."""
+    for f in failures:
+        kinds = failure_kinds.setdefault(f.model_spec, {})
+        kinds[f.kind] = kinds.get(f.kind, 0) + 1
+        if f.correction is not None:
+            corrected.add(f.model_spec)
 
 
 @dataclasses.dataclass
@@ -62,6 +77,13 @@ class ConsensusConfig:
     priority: Optional[int] = None
     tenant: str = "default"
     deadline_ms: Optional[float] = None
+    # Consensus-quality observability (ISSUE 5, consensus/quality.py):
+    # task attribution for the per-decide audit record, and the master
+    # switch for the whole quality layer (audit record + scorecard +
+    # entropy/margin metrics). Instrumentation is READ-ONLY: temp-0
+    # decisions are bit-identical with it on or off.
+    task_id: Optional[str] = None
+    quality: bool = True
 
 
 @dataclasses.dataclass
@@ -70,6 +92,11 @@ class ModelFailure:
     error: str
     correction: Optional[str] = None  # feeds per-model correction feedback
     raw_text: str = ""                # the failing response, for history
+    # Failure attribution by CAUSE (ISSUE 5): transport = backend error
+    # row, parse = not a JSON action, schema = failed param validation,
+    # deadline = expired at QoS admission. Scorecards and the audit trail
+    # account by kind instead of one undifferentiated list.
+    kind: str = "transport"
 
 
 @dataclasses.dataclass
@@ -94,6 +121,14 @@ class ConsensusOutcome:
     deadline_misses: int = 0
     cost: float = 0.0
     embed_texts: int = 0
+    # Summed per-member proposal latency across all rounds (ms) — the
+    # scorecard's per-member latency signal (consensus/quality.py).
+    member_latency_ms: dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    # The per-decide audit record (ISSUE 5): member -> cluster mapping,
+    # winner, entropy, margin, failures by kind. None when
+    # ConsensusConfig.quality is off.
+    audit: Optional[dict] = None
     bug_reports: list[tuple[str, str]] = dataclasses.field(default_factory=list)
     condense_requests: dict[str, int] = dataclasses.field(default_factory=dict)
     # Refinement transcript per model, for history merging by the agent layer:
@@ -137,6 +172,12 @@ class ConsensusEngine:
                             decode_ms=round(outcome.decode_ms, 1),
                             cached_tokens=outcome.cached_tokens)
         DECIDE_MS.observe((time.monotonic() - t0) * 1000)
+        if outcome.audit is not None:
+            # Scorecards + entropy/margin instruments + drift detection +
+            # audit-record fan-out (consensus/quality.py). After the
+            # decide histogram observation so the quality layer's own
+            # cost never skews the latency it reports on.
+            QUALITY.observe_decide(outcome.audit)
         return outcome
 
     def _decide(self, messages_per_model: dict[str, list[dict]]) -> ConsensusOutcome:
@@ -147,6 +188,14 @@ class ConsensusEngine:
         # Working copy: refinement appends to these, not the caller's lists.
         histories = {m: list(msgs) for m, msgs in messages_per_model.items()}
         acc = EmbedAccumulator()
+        # Quality scratch (ISSUE 5): failure attribution + correction
+        # tracking across ALL rounds (outcome.failures only keeps the
+        # last round's), and the final clustering for the audit record.
+        # Pure observation — nothing here feeds back into control flow.
+        failure_kinds: dict[str, dict[str, int]] = {}
+        corrected: set[str] = set()
+        audit_clusters: list = []
+        winner_index: Optional[int] = None
 
         max_rounds = 1 + max(0, cfg.max_refinement_rounds)
         single_model = len(pool) == 1 and not cfg.force_reflection
@@ -157,12 +206,15 @@ class ConsensusEngine:
             round_num += 1
             proposals, failures = self._query_round(histories, pool, round_num,
                                                     outcome)
+            _note_failures(failures, failure_kinds, corrected)
             if not proposals:
                 outcome.failures = failures
                 outcome.status = ("all_failed" if all(
                     f.correction is None for f in failures) else "all_invalid")
                 outcome.rounds_used = round_num
                 outcome.latency_ms = (time.monotonic() - t0) * 1000
+                self._attach_audit(outcome, pool, [], None, acc,
+                                   failure_kinds, corrected)
                 return outcome
 
             if single_model:
@@ -184,6 +236,9 @@ class ConsensusEngine:
                              and max_rounds > 1)
             if (majority is not None and not reflect_first) \
                     or round_num >= max_rounds:
+                audit_clusters = clusters
+                winner_index = clusters.index(
+                    select_winner_cluster(clusters, majority)[0])
                 outcome.decision = pick_winner(clusters, len(proposals),
                                                round_num, majority,
                                                self.backend, acc)
@@ -215,6 +270,9 @@ class ConsensusEngine:
             clusters = cluster_proposals(proposals, self.backend, acc)
             majority = find_majority_cluster(clusters, len(proposals), 1,
                                              cfg.threshold)
+            audit_clusters = clusters
+            winner_index = clusters.index(
+                select_winner_cluster(clusters, majority)[0])
             outcome.decision = pick_winner(clusters, len(proposals),
                                            round_num, majority,
                                            self.backend, acc)
@@ -222,7 +280,29 @@ class ConsensusEngine:
         outcome.rounds_used = round_num
         outcome.embed_texts = acc.texts
         outcome.latency_ms = (time.monotonic() - t0) * 1000
+        self._attach_audit(outcome, pool, audit_clusters, winner_index, acc,
+                           failure_kinds, corrected)
         return outcome
+
+    def _attach_audit(self, outcome: ConsensusOutcome, pool: list[str],
+                      clusters: list, winner_index: Optional[int],
+                      acc: EmbedAccumulator,
+                      failure_kinds: dict[str, dict[str, int]],
+                      corrected: set[str]) -> None:
+        """Build the per-decide audit record (ISSUE 5) once the outcome is
+        final. Gated by ``ConsensusConfig.quality``; reads only what the
+        decide already computed."""
+        cfg = self.config
+        if not cfg.quality:
+            return
+        current = TRACER.current()
+        task_id = cfg.task_id or (current.trace_id
+                                  if current is not None else None)
+        outcome.audit = build_audit_record(
+            task_id=task_id, agent_id=cfg.session_key, pool=pool,
+            outcome=outcome, clusters=clusters, winner_index=winner_index,
+            sim_margins=acc.margins, failure_counts=failure_kinds,
+            corrected=corrected)
 
     # ------------------------------------------------------------------
 
@@ -280,6 +360,9 @@ class ConsensusEngine:
             outcome.prefill_ms += getattr(res, "prefill_ms", 0.0)
             outcome.decode_ms += getattr(res, "decode_ms", 0.0)
             outcome.cached_tokens += getattr(res, "cached_tokens", 0)
+            outcome.member_latency_ms[res.model_spec] = \
+                outcome.member_latency_ms.get(res.model_spec, 0.0) \
+                + getattr(res, "latency_ms", 0.0)
             if not res.ok:
                 # Deadline-expired rows (serving/admission.py
                 # DeadlineExceededError, surfaced as a "deadline_exceeded:"
@@ -288,9 +371,12 @@ class ConsensusEngine:
                 # members' proposals carry the round. Only when EVERY
                 # member misses does the round degrade to all_failed, the
                 # same as any other total outage.
-                if res.error.startswith("deadline_exceeded"):
+                deadline = res.error.startswith("deadline_exceeded")
+                if deadline:
                     outcome.deadline_misses += 1
-                failures.append(ModelFailure(res.model_spec, res.error))
+                failures.append(ModelFailure(
+                    res.model_spec, res.error,
+                    kind="deadline" if deadline else "transport"))
                 continue
             parsed = parse_response(res.model_spec, res.text)
             if isinstance(parsed, ParseFailure):
@@ -300,7 +386,8 @@ class ConsensusEngine:
                                f"{parsed.error}. Respond with a single JSON "
                                f'object {{"action", "params", "reasoning", '
                                f'"wait"}}.',
-                    raw_text=res.text))
+                    raw_text=res.text,
+                    kind="parse"))
                 continue
             errors = validate_params(
                 parsed.action, parsed.params,
@@ -316,7 +403,8 @@ class ConsensusEngine:
                     correction="Your previous response failed validation: "
                                + "; ".join(errors)
                                + ". Correct the parameters and respond again.",
-                    raw_text=res.text))
+                    raw_text=res.text,
+                    kind="schema"))
                 continue
             if parsed.condense:
                 outcome.condense_requests[parsed.model_spec] = parsed.condense

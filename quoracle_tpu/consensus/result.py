@@ -106,21 +106,32 @@ def _wait_score(cluster: Cluster) -> int:
     return 1
 
 
+def select_winner_cluster(clusters: list[Cluster],
+                          majority: Optional[Cluster],
+                          ) -> tuple[Cluster, str]:
+    """Which cluster wins, and how: majority -> "consensus"; else
+    plurality with the deterministic tiebreak -> "forced_decision".
+    Pure selection (no merging, no embedder) — factored out of
+    :func:`pick_winner` so the quality layer (consensus/quality.py) can
+    attribute the winning cluster for the audit record without
+    re-implementing the tiebreak."""
+    if majority is not None:
+        return majority, "consensus"
+    max_size = max(c.size for c in clusters)
+    tied = [c for c in clusters if c.size == max_size]
+    winner = min(tied, key=lambda c: (get_schema(c.action).priority,
+                                      _wait_score(c),
+                                      clusters.index(c)))
+    return winner, "forced_decision"
+
+
 def pick_winner(clusters: list[Cluster], total: int, round_num: int,
                 majority: Optional[Cluster], embedder: Embedder,
                 acc: Optional[EmbedAccumulator] = None) -> Decision:
     """majority -> consensus; else plurality + tiebreak -> forced_decision
     (reference result.ex:30-42,290-308). Tiebreak among equal-size clusters:
     action priority (schema), then wait score, then first-proposed."""
-    if majority is not None:
-        winner, kind = majority, "consensus"
-    else:
-        max_size = max(c.size for c in clusters)
-        tied = [c for c in clusters if c.size == max_size]
-        winner = min(tied, key=lambda c: (get_schema(c.action).priority,
-                                          _wait_score(c),
-                                          clusters.index(c)))
-        kind = "forced_decision"
+    winner, kind = select_winner_cluster(clusters, majority)
 
     params = merge_cluster_params(winner, embedder, acc)
     wait = merge_wait([p.wait for p in winner.proposals])

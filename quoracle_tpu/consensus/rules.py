@@ -33,9 +33,17 @@ class Embedder(Protocol):
 @dataclasses.dataclass
 class EmbedAccumulator:
     """Counts embedding work done during a consensus round for cost recording
-    (reference Costs.Accumulator batching through consensus merging)."""
+    (reference Costs.Accumulator batching through consensus merging).
+
+    ``margins`` additionally records ``cosine - threshold`` for every
+    semantic-compatibility check that actually embedded (ISSUE 5 quality
+    observability: mass near 0 means clusters formed on a knife edge).
+    Strictly an observation of embeds that happen anyway — recording a
+    margin never ADDS an embedder call, so decide outcomes and embed
+    counts are identical with or without a consumer reading them."""
     texts: int = 0
     chars: int = 0
+    margins: list = dataclasses.field(default_factory=list)
 
     def add(self, batch: Sequence[str]) -> None:
         self.texts += len(batch)
@@ -56,7 +64,10 @@ def semantically_equal(a: str, b: str, threshold: float, embedder: Embedder,
     if acc is not None:
         acc.add([a, b])
     va, vb = embedder.embed([a, b])
-    return _cos(va, vb) >= threshold
+    cos = _cos(va, vb)
+    if acc is not None:
+        acc.margins.append(cos - threshold)
+    return cos >= threshold
 
 
 def values_compatible(rule: tuple, a: Any, b: Any, embedder: Embedder,

@@ -116,6 +116,13 @@ TOPIC_TRACE = "trace:spans"
 # /api/history "resources" key) and tailed live by the SSE stream, so an
 # open dashboard sees the incident the moment the watchdog fires.
 TOPIC_RESOURCES = "resources:events"
+# Consensus quality (ISSUE 5): per-decide audit records and model-health
+# drift alerts (consensus/quality.py) — the Runtime registers a QUALITY
+# sink that re-broadcasts them here; EventHistory rings them (the
+# /api/history "consensus" key + /api/consensus?task_id=…), the durable
+# writer persists audit records to the consensus_audit table, and the
+# SSE stream tails drift alerts live.
+TOPIC_CONSENSUS = "consensus:audit"
 
 
 def topic_agent_state(agent_id: str) -> str:

@@ -13,8 +13,8 @@ from collections import deque
 from typing import Optional
 
 from quoracle_tpu.infra.bus import (
-    EventBus, Subscription, TOPIC_ACTIONS, TOPIC_LIFECYCLE, TOPIC_RESOURCES,
-    TOPIC_SERVING, TOPIC_TRACE,
+    EventBus, Subscription, TOPIC_ACTIONS, TOPIC_CONSENSUS, TOPIC_LIFECYCLE,
+    TOPIC_RESOURCES, TOPIC_SERVING, TOPIC_TRACE,
 )
 
 MAX_LOGS_PER_AGENT = 100      # reference ui/event_history.ex:17-20
@@ -23,6 +23,11 @@ MAX_MESSAGES_PER_AGENT = 50
 # rounds, members, phases, action), so 512 covers dozens of recent rounds
 # across tasks; /api/trace filters by trace_id.
 MAX_TRACE_SPANS = 512
+# Consensus-audit ring (ISSUE 5): one record per decide (plus occasional
+# drift alerts), so 256 covers hours of recent decisions across tasks;
+# /api/consensus filters by task_id, deep history lives in the
+# consensus_audit table.
+MAX_CONSENSUS_RECORDS = 256
 
 
 class EventHistory:
@@ -43,6 +48,7 @@ class EventHistory:
         self._serving: deque = deque(maxlen=max_logs)
         self._traces: deque = deque(maxlen=MAX_TRACE_SPANS)
         self._resources: deque = deque(maxlen=max_logs)
+        self._consensus: deque = deque(maxlen=MAX_CONSENSUS_RECORDS)
         self._tasks: set[str] = set()
         self._lock = threading.Lock()
         self._closed = False
@@ -52,6 +58,7 @@ class EventHistory:
             bus.subscribe(TOPIC_SERVING, self._on_serving),
             bus.subscribe(TOPIC_TRACE, self._on_trace),
             bus.subscribe(TOPIC_RESOURCES, self._on_resource),
+            bus.subscribe(TOPIC_CONSENSUS, self._on_consensus),
         ]
 
     # Agent log/message topics are per-agent; the runtime calls track_agent
@@ -120,6 +127,10 @@ class EventHistory:
         with self._lock:
             self._resources.append(event)
 
+    def _on_consensus(self, topic: str, event: dict) -> None:
+        with self._lock:
+            self._consensus.append(event)
+
     def _on_task_message(self, topic: str, event: dict) -> None:
         # topic is "tasks:<id>:messages". Ring under the TASK key always
         # (the mailbox replay), and ALSO under the SENDER when the message
@@ -162,6 +173,18 @@ class EventHistory:
         dumps — TOPIC_RESOURCES)."""
         with self._lock:
             return list(self._resources)
+
+    def replay_consensus(self, task_id: Optional[str] = None) -> list[dict]:
+        """Recent consensus-audit records + drift alerts (TOPIC_CONSENSUS,
+        consensus/quality.py), optionally filtered to one task. Backs
+        /api/consensus?task_id=… and the /api/history "consensus" key.
+        Drift alerts carry no task_id, so a task filter returns audit
+        records only."""
+        with self._lock:
+            records = list(self._consensus)
+        if task_id is None:
+            return records
+        return [r for r in records if r.get("task_id") == task_id]
 
     def replay_traces(self, trace_id: Optional[str] = None) -> list[dict]:
         """Recent finished spans (infra/telemetry.py), optionally filtered

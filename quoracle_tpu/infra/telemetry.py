@@ -646,6 +646,62 @@ QOS_DEMOTIONS_TOTAL = METRICS.counter(
     "bulk-class weight demotions while the INTERACTIVE tail is over "
     "its SLO target")
 
+# -- consensus quality (ISSUE 5) ---------------------------------------------
+# Decision-quality instruments (consensus/quality.py): per-decide
+# contestedness and the per-member scorecard counters. Registered at
+# import so the full quoracle_consensus_* surface scrapes before first
+# traffic, like everything above.
+CONSENSUS_ENTROPY = METRICS.histogram(
+    "quoracle_consensus_vote_entropy_bits",
+    "Shannon entropy (bits) of the cluster-share distribution per decide: "
+    "0 = unanimous, log2(k) = k-way even split",
+    buckets=(0.01, 0.05, 0.1, 0.2, 0.35, 0.5, 0.7, 0.92, 1.1, 1.4,
+             1.59, 2.0, 2.33, 3.0))
+CONSENSUS_MARGIN = METRICS.histogram(
+    "quoracle_consensus_winner_margin",
+    "winner share minus runner-up share per decide (1 = unanimous, "
+    "0 = tiebroken)",
+    buckets=(0.05, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0))
+CONSENSUS_ROUNDS_TO_DECISION = METRICS.histogram(
+    "quoracle_consensus_rounds_to_decision",
+    "rounds a decide needed (1 = round-1 consensus)",
+    buckets=(1, 2, 3, 4, 5, 6, 8))
+CONSENSUS_SIM_MARGIN = METRICS.histogram(
+    "quoracle_consensus_similarity_margin",
+    "|cosine - threshold| of semantic-compatibility checks during "
+    "clustering, side = above (joined) | below (split): mass near 0 "
+    "means clusters are forming on a knife edge",
+    buckets=(0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.4, 0.8, 1.6))
+MEMBER_DECIDES = METRICS.counter(
+    "quoracle_consensus_member_decides_total",
+    "decides a pool member participated in, per model")
+MEMBER_AGREEMENTS = METRICS.counter(
+    "quoracle_consensus_member_agreement_total",
+    "decides where the member's valid proposal landed in the winning "
+    "cluster, per model")
+MEMBER_DISSENTS = METRICS.counter(
+    "quoracle_consensus_member_dissent_total",
+    "decides where the member's valid proposal lost to another cluster, "
+    "per model")
+MEMBER_FAILURES = METRICS.counter(
+    "quoracle_consensus_member_failures_total",
+    "member failures by cause, per model and kind "
+    "(transport | parse | schema | deadline)")
+MEMBER_RECOVERIES = METRICS.counter(
+    "quoracle_consensus_member_recoveries_total",
+    "decides where a corrected member produced a valid proposal in a "
+    "later round, per model")
+MEMBER_LATENCY_MS = METRICS.histogram(
+    "quoracle_consensus_member_latency_ms",
+    "per-decide summed proposal latency per pool member (ms)")
+MEMBER_DRIFT_EVENTS = METRICS.counter(
+    "quoracle_consensus_drift_total",
+    "model_health_drift trips per model and signal (dissent | failure)")
+MEMBER_DRIFTING = METRICS.gauge(
+    "quoracle_consensus_member_drifting",
+    "1 while a member's recent dissent/failure EWMA deviates from its "
+    "baseline past the drift threshold, per model and signal")
+
 # Process self-observation (ISSUE 3 satellite): sampled lazily by the
 # collector below so /api/metrics and GET /metrics always carry a current
 # view — no writer has to remember to refresh them.

@@ -92,6 +92,16 @@ CREATE TABLE IF NOT EXISTS model_settings (
     key TEXT PRIMARY KEY,
     value TEXT NOT NULL DEFAULT 'null' -- JSON
 );
+CREATE TABLE IF NOT EXISTS consensus_audit (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    task_id TEXT, agent_id TEXT, decide_id TEXT,
+    ts REAL,
+    record TEXT NOT NULL DEFAULT '{}'  -- the full audit record (JSON):
+                                       -- member->cluster map, winner,
+                                       -- entropy, margin, failures by kind
+);
+CREATE INDEX IF NOT EXISTS idx_consensus_audit_task
+    ON consensus_audit(task_id);
 """
 
 

@@ -274,6 +274,8 @@ class Persistence:
         self.db.execute("DELETE FROM agent_costs WHERE agent_id=?",
                         (agent_id,))
         self.db.execute("DELETE FROM actions WHERE agent_id=?", (agent_id,))
+        self.db.execute("DELETE FROM consensus_audit WHERE agent_id=?",
+                        (agent_id,))
 
     # -- costs (CostRecorder persist_fn) -----------------------------------
 
@@ -303,6 +305,22 @@ class Persistence:
         rows = self.db.query(
             "SELECT amount FROM agent_costs WHERE agent_id=?", (agent_id,))
         return sum((Decimal(r["amount"]) for r in rows), Decimal("0"))
+
+    # -- consensus audit (ISSUE 5) -----------------------------------------
+
+    def audit_for_task(self, task_id: str, limit: int = 200) -> list[dict]:
+        """Durable consensus-audit records for one task, oldest first
+        (the /api/consensus read model beyond the in-memory ring)."""
+        rows = self.db.query(
+            "SELECT record FROM consensus_audit WHERE task_id=? "
+            "ORDER BY id DESC LIMIT ?", (task_id, limit))
+        out = []
+        for r in reversed(rows):
+            try:
+                out.append(json.loads(r["record"]))
+            except (TypeError, json.JSONDecodeError):
+                continue
+        return out
 
     # -- tasks -------------------------------------------------------------
 
@@ -423,6 +441,18 @@ class Persistence:
                 "UPDATE actions SET status=?, completed_at=? "
                 "WHERE action_id=?",
                 (event.get("status", "ok"), ts, event.get("action_id")))
+        elif kind == "consensus_audit":
+            # Per-decide audit record (ISSUE 5, consensus/quality.py):
+            # durable alongside the decision logs, keyed by task for
+            # /api/consensus?task_id=… deep history (the EventHistory
+            # ring covers the live tail).
+            self.db.execute(
+                "INSERT INTO consensus_audit "
+                "(task_id, agent_id, decide_id, ts, record) "
+                "VALUES (?,?,?,?,?)",
+                (event.get("task_id"), event.get("agent_id"),
+                 event.get("decide_id"), ts,
+                 json.dumps(event, default=str)))
 
     def detach_bus(self) -> None:
         if self._bus_sub is not None:

@@ -22,8 +22,9 @@ from quoracle_tpu.agent.state import AgentDeps
 from quoracle_tpu.agent.supervisor import AgentSupervisor
 from quoracle_tpu.context.token_manager import TokenManager
 from quoracle_tpu.infra.budget import Escrow
+from quoracle_tpu.consensus.quality import QUALITY
 from quoracle_tpu.infra.bus import (
-    TOPIC_RESOURCES, TOPIC_TRACE, AgentEvents, EventBus,
+    TOPIC_CONSENSUS, TOPIC_RESOURCES, TOPIC_TRACE, AgentEvents, EventBus,
 )
 from quoracle_tpu.infra.costs import CostRecorder
 from quoracle_tpu.infra.event_history import EventHistory
@@ -245,6 +246,15 @@ class Runtime:
         self._trace_sink = (
             lambda event: self.bus.broadcast(TOPIC_TRACE, event))
         TRACER.add_sink(self._trace_sink)
+        # Consensus quality (ISSUE 5): audit records + model-health drift
+        # alerts (consensus/quality.py QUALITY, process-wide like TRACER)
+        # re-broadcast on THIS runtime's bus — EventHistory rings them for
+        # /api/consensus + /api/history "consensus", the durable writer
+        # persists audit records alongside the task's decisions, and the
+        # SSE stream tails drift alerts live. Detached in close().
+        self._quality_sink = (
+            lambda event: self.bus.broadcast(TOPIC_CONSENSUS, event))
+        QUALITY.add_sink(self._quality_sink)
         # Resource observability (ISSUE 3): crash hooks + span sink into
         # the process-wide flight recorder, a scrape-time collector that
         # refreshes the HBM/prefix-cache/compile-storm gauges from THIS
@@ -384,6 +394,7 @@ class Runtime:
         self.watchdog.close()
         METRICS.remove_collector(self._resource_collector)
         TRACER.remove_sink(self._trace_sink)
+        QUALITY.remove_sink(self._quality_sink)
         self.store.detach_bus()
         self.history.close()
         self.db.close()

@@ -23,6 +23,14 @@ Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
   GET  /api/qos             serving-QoS panel (ISSUE 4): admission
                             controller signals/thresholds, per-member
                             weighted-fair queues, SLO tails, shed counters
+  GET  /api/models          consensus-quality scorecards (ISSUE 5): rolling
+                            per-member agreement/dissent/failure-by-kind/
+                            recovery rates, proposal latency, drift state
+                            (consensus/quality.py)
+  GET  /api/consensus?task_id  per-decide audit records for one task
+                            (member→cluster map, winner, entropy, margin,
+                            failures by kind) — in-memory ring merged with
+                            the durable consensus_audit table
   POST /api/flightrec/dump  dump the flight-recorder ring to a JSON file
   GET  /api/trace?task_id   finished trace spans for one task (TOPIC_TRACE
                             ring in infra/event_history.py)
@@ -182,6 +190,10 @@ class DashboardServer:
             "actions": h.replay_actions(),
             "serving": h.replay_serving(),
             "resources": h.replay_resources(),
+            # consensus-audit ring (ISSUE 5): recent decide records +
+            # drift alerts, same bearer gating + token redaction as the
+            # trace ring (both ride the generic gated-GET path)
+            "consensus": h.replay_consensus(),
         }
         if agent_id:
             payload["logs"] = h.replay_logs(agent_id)
@@ -343,6 +355,36 @@ class DashboardServer:
         spans = self.runtime.history.replay_traces(trace_id)
         return {"task_id": trace_id, "n_spans": len(spans), "spans": spans}
 
+    def models_payload(self) -> dict:
+        """GET /api/models: the consensus-quality scorecards (ISSUE 5) —
+        rolling per-member agreement/dissent/failure-by-kind/recovery
+        rates, proposal latency quantiles, and EWMA drift state
+        (consensus/quality.py QUALITY)."""
+        from quoracle_tpu.consensus.quality import QUALITY
+        payload = QUALITY.scorecards()
+        payload["pool"] = self.runtime.default_pool()
+        return payload
+
+    def consensus_payload(self, task_id: Optional[str]) -> dict:
+        """GET /api/consensus?task_id=…: per-decide audit records — the
+        EventHistory ring (live tail) merged with the durable
+        consensus_audit table (deep history), deduped by decide_id and
+        ordered by time."""
+        ring = self.runtime.history.replay_consensus(task_id)
+        durable = (self.runtime.store.audit_for_task(task_id)
+                   if task_id else [])
+        seen: set = set()
+        records = []
+        for r in durable + ring:
+            key = r.get("decide_id") or ("ts", r.get("ts"))
+            if key in seen:
+                continue
+            seen.add(key)
+            records.append(r)
+        records.sort(key=lambda r: r.get("ts") or 0.0)
+        return {"task_id": task_id, "n_records": len(records),
+                "records": records}
+
     def qos_payload(self) -> dict:
         """GET /api/qos: the serving-QoS panel (ISSUE 4) — admission
         controller state (signals, thresholds, tenant buckets), the
@@ -494,7 +536,7 @@ class _Handler(BaseHTTPRequestHandler):
                 from quoracle_tpu.web import views
                 self._send_html(views.telemetry_page(
                     d.metrics_payload(), d.resources_payload(),
-                    d.qos_payload()))
+                    d.qos_payload(), d.models_payload()))
             elif parsed.path == "/settings":
                 from quoracle_tpu.web import views
                 self._send_html(views.settings_page(
@@ -527,6 +569,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.resources_payload())
             elif parsed.path == "/api/qos":
                 self._send_json(d.qos_payload())
+            elif parsed.path == "/api/models":
+                self._send_json(d.models_payload())
+            elif parsed.path == "/api/consensus":
+                self._send_json(d.consensus_payload(one("task_id")))
             elif parsed.path == "/api/trace":
                 self._send_json(d.trace_payload(one("task_id")
                                                 or one("trace_id")))

@@ -375,11 +375,55 @@ def qos_panel(qos: dict) -> str:
     return "".join(parts)
 
 
+def _rate(v: Any) -> str:
+    return f"{v:.1%}" if isinstance(v, (int, float)) else "—"
+
+
+def quality_panel(quality: dict) -> str:
+    """Consensus-quality panel (ISSUE 5): per-member scorecards —
+    agreement/dissent rates, failures by kind, correction recovery,
+    proposal latency, and the drift flag — the /api/models payload as a
+    table. Renders nothing before the first decide."""
+    members = (quality or {}).get("members") or {}
+    if not members:
+        return ""
+    parts = ["<h2 class=\"meta\">consensus quality (per-model scorecards)"
+             "</h2>"]
+    rows = []
+    for spec, s in sorted(members.items()):
+        fails = ", ".join(f"{k}:{n}"
+                          for k, n in sorted((s.get("failures") or {})
+                                             .items())) or "—"
+        drifting = ", ".join(s.get("drifting") or ())
+        rows.append(
+            f"<tr class=\"quality-row\" data-model=\"{_e(spec)}\">"
+            f"<td>{_e(spec)}</td><td>{_e(s.get('decides'))}</td>"
+            f"<td>{_rate(s.get('agreement_rate'))}</td>"
+            f"<td>{_rate(s.get('dissent_rate'))}</td>"
+            f"<td>{_e(fails)}</td>"
+            f"<td>{_rate(s.get('recovery_rate'))}</td>"
+            f"<td>{_fmt_ms(s.get('latency_p50_ms'))}</td>"
+            + (f"<td class=\"lvl-error\">DRIFT: {_e(drifting)}</td>"
+               if drifting else "<td></td>")
+            + "</tr>")
+    parts.append(
+        "<table id=\"quality\"><tr><th>model</th><th>decides</th>"
+        "<th>agree</th><th>dissent</th><th>failures</th><th>recovery</th>"
+        "<th>latency p50</th><th></th></tr>" + "".join(rows) + "</table>")
+    drifting = (quality or {}).get("drifting") or []
+    if drifting:
+        parts.append(f"<p class=\"lvl-error\" id=\"quality-drift\">"
+                     f"MODEL HEALTH DRIFT: {_e(', '.join(drifting))}</p>")
+    return "".join(parts)
+
+
 def telemetry_page(metrics: dict, resources: Optional[dict] = None,
-                   qos: Optional[dict] = None) -> str:
+                   qos: Optional[dict] = None,
+                   quality: Optional[dict] = None) -> str:
     """Dev telemetry view (reference LiveDashboard at /dev/dashboard):
     the /api/metrics snapshot as readable tables, led by the latency
-    histogram panel, the live resources panel, and the QoS panel."""
+    histogram panel, the live resources panel, the QoS panel, and the
+    consensus-quality scorecards."""
     def table(title: str, d: dict) -> str:
         return (f"<h2 class=\"meta\">{_e(title)}</h2>"
                 f"<table class=\"metrics\" data-section=\"{_e(title)}\">"
@@ -396,6 +440,7 @@ def telemetry_page(metrics: dict, resources: Optional[dict] = None,
     body = (latency_panel(metrics.get("telemetry") or {})
             + resources_panel(resources or {})
             + qos_panel(qos or {})
+            + quality_panel(quality or {})
             + (table("runtime", flat) if flat else "")
             + "".join(sections))
     return _page("telemetry", body, refresh=10)

@@ -6,7 +6,7 @@
 # mid-calibration the round lost its primary bench record entirely; the
 # header claimed "commit immediately" but the script never committed.)
 cd /root/repo
-LOG=RELAY_POLL_r07.log
+LOG=RELAY_POLL_r08.log
 echo "$(date -u +%FT%TZ) direct run: device confirmed live (probe ok)" >> "$LOG"
 
 # Primary record first. If a previous run left calibration gates behind,
@@ -14,26 +14,32 @@ echo "$(date -u +%FT%TZ) direct run: device confirmed live (probe ok)" >> "$LOG"
 # The artifact carries config 9 (consensus round/decide p50/p95 from the
 # infra/telemetry.py histograms), config 10 (resource observability,
 # ISSUE 3: HBM headroom, compile hit-rate, queue-depth p95 under a
-# sustained continuous-batching load), and config 11 (serving QoS,
-# ISSUE 4: INTERACTIVE p95 under 4x overload with QoS on/off, shed rate
-# and structured-reject accounting); config 10's sample timeline lands
-# in the sidecar RESOURCES_r07_live.json, committed with the bench record.
+# sustained continuous-batching load), config 11 (serving QoS, ISSUE 4:
+# INTERACTIVE p95 under 4x overload with QoS on/off, shed rate and
+# structured-reject accounting), and config 12 (consensus quality,
+# ISSUE 5: decide p50/p95 with the scorecard/audit layer on vs off, and
+# the emitted vote entropy / winner margin for the temp-0 pool); config
+# 10's sample timeline lands in the sidecar RESOURCES_r08_live.json and
+# config 12's audit records + scorecards in QUALITY_r08_live.json, both
+# committed with the bench record.
 [ -f /root/repo/calib_v5e.json ] && export QUORACLE_PAGED_CALIB=/root/repo/calib_v5e.json
-export QUORACLE_BENCH_RESOURCES=/root/repo/RESOURCES_r07_live.json
-timeout 5400 python bench.py > /root/repo/BENCH_r07_live.json 2>> "$LOG"
+export QUORACLE_BENCH_RESOURCES=/root/repo/RESOURCES_r08_live.json
+export QUORACLE_BENCH_QUALITY=/root/repo/QUALITY_r08_live.json
+timeout 5400 python bench.py > /root/repo/BENCH_r08_live.json 2>> "$LOG"
 rc=$?
-echo "$(date -u +%FT%TZ) bench rc=$rc artifact=BENCH_r07_live.json" >> "$LOG"
+echo "$(date -u +%FT%TZ) bench rc=$rc artifact=BENCH_r08_live.json" >> "$LOG"
 if [ "$rc" -eq 0 ] && python - <<'EOF'
 import json
-d = json.load(open("/root/repo/BENCH_r07_live.json"))
+d = json.load(open("/root/repo/BENCH_r08_live.json"))
 ok = (not d.get("device_unavailable")) and d.get("value")
 raise SystemExit(0 if ok else 1)
 EOF
 then
     echo "$(date -u +%FT%TZ) BENCH SUCCESS — committing the record" >> "$LOG"
-    git add BENCH_r07_live.json RESOURCES_r07_live.json "$LOG" 2>/dev/null
+    git add BENCH_r08_live.json RESOURCES_r08_live.json \
+        QUALITY_r08_live.json "$LOG" 2>/dev/null
     git -c user.name=distsys-graft -c user.email=graft@localhost \
-        commit -m "Chip-verified BENCH_r07_live artifact (direct run)" >> "$LOG" 2>&1 \
+        commit -m "Chip-verified BENCH_r08_live artifact (direct run)" >> "$LOG" 2>&1 \
         || echo "$(date -u +%FT%TZ) commit failed (artifact still on disk)" >> "$LOG"
 else
     echo "$(date -u +%FT%TZ) bench artifact not clean; bonus captures may still run" >> "$LOG"
@@ -47,10 +53,10 @@ timeout 2400 python -m quoracle_tpu.tools.calibrate_paged \
     || echo "$(date -u +%FT%TZ) calibration FAILED (bench record already safe)" >> "$LOG"
 timeout 1800 python -m quoracle_tpu.tools.bench_longctx \
     --resident 16384 --rounds 3 \
-    > /root/repo/LONGCTX_r07.json 2>> "$LOG" \
+    > /root/repo/LONGCTX_r08.json 2>> "$LOG" \
     && echo "$(date -u +%FT%TZ) longctx captured" >> "$LOG" \
     || echo "$(date -u +%FT%TZ) longctx FAILED (bench record already safe)" >> "$LOG"
-git add calib_v5e.json LONGCTX_r07.json "$LOG" 2>/dev/null
+git add calib_v5e.json LONGCTX_r08.json "$LOG" 2>/dev/null
 git -c user.name=distsys-graft -c user.email=graft@localhost \
     commit -m "Post-bench chip captures: paged-gate calibration + long-context sweep" >> "$LOG" 2>&1 \
     || true

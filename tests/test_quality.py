@@ -1,0 +1,568 @@
+"""Consensus-quality observability (ISSUE 5): entropy/margin oracles,
+pick_winner tie-break regression, failure attribution by kind, per-model
+scorecards, drift detection, the audit trail end to end, and the
+read-only guarantee (temp-0 outcome equality with the layer on vs off).
+"""
+
+import asyncio
+import json
+import math
+import urllib.request
+
+from quoracle_tpu.consensus.aggregator import (
+    Cluster, cluster_proposals, find_majority_cluster,
+)
+from quoracle_tpu.consensus.engine import ConsensusConfig, ConsensusEngine
+from quoracle_tpu.consensus.parser import ActionProposal
+from quoracle_tpu.consensus.quality import (
+    ConsensusQuality, build_audit_record, vote_entropy, winner_margin,
+)
+from quoracle_tpu.consensus.result import pick_winner, select_winner_cluster
+from quoracle_tpu.infra.flightrec import FlightRecorder
+from quoracle_tpu.models.runtime import MockBackend, QueryResult
+
+POOL = MockBackend.DEFAULT_POOL
+
+
+def j(action, params=None, wait=False, reasoning="r"):
+    return json.dumps({"action": action, "params": params or {},
+                       "reasoning": reasoning, "wait": wait})
+
+
+def msgs(pool=POOL):
+    return {m: [{"role": "user", "content": "decide"}] for m in pool}
+
+
+def _prop(model, action, params=None, wait=False):
+    return ActionProposal(model_spec=model, action=action,
+                          params=params or {}, wait=wait)
+
+
+# ---------------------------------------------------------------------------
+# Entropy / margin math vs hand-computed oracles (ISSUE 5 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_vote_entropy_oracles():
+    # unanimous: one cluster -> 0 bits
+    assert vote_entropy([3]) == 0.0
+    # 2-1 split of 3: -(2/3·log2(2/3) + 1/3·log2(1/3)) = 0.91829583…
+    assert abs(vote_entropy([2, 1]) - 0.9182958340544896) < 1e-12
+    # 3-way even split: log2(3)
+    assert abs(vote_entropy([1, 1, 1]) - math.log2(3)) < 1e-12
+    # 2-2-1 of 5: 2·(-0.4·log2 0.4) - 0.2·log2 0.2 = 1.52192809…
+    assert abs(vote_entropy([2, 2, 1]) - 1.5219280948873621) < 1e-12
+    # degenerate inputs never divide by zero
+    assert vote_entropy([]) == 0.0
+    assert vote_entropy([0]) == 0.0
+
+
+def test_winner_margin_oracles():
+    assert winner_margin([3]) == 1.0                    # unanimous
+    assert abs(winner_margin([2, 1]) - 1 / 3) < 1e-12   # 2-1 of 3
+    assert winner_margin([1, 1, 1]) == 0.0              # tie
+    assert winner_margin([2, 2, 1]) == 0.0              # tie among leaders
+    assert abs(winner_margin([3, 1, 1]) - 2 / 5) < 1e-12
+    assert winner_margin([]) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# pick_winner deterministic tie-break regression (ISSUE 5 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_tiebreak_action_priority_wins():
+    """Equal-size clusters: the action with the LOWER schema priority
+    number wins, regardless of proposal order (send_message=10 beats
+    file_read=30)."""
+    embedder = MockBackend()
+    read = Cluster(proposals=[_prop("m1", "file_read", {"path": "a"})])
+    send = Cluster(proposals=[_prop("m2", "send_message",
+                                    {"target": "parent", "content": "x"})])
+    for clusters in ([read, send], [send, read]):
+        winner, kind = select_winner_cluster(clusters, None)
+        assert kind == "forced_decision"
+        assert winner is send
+        d = pick_winner(clusters, 2, 2, None, embedder)
+        assert d.kind == "forced_decision"
+        assert d.action == "send_message"
+
+
+def test_tiebreak_wait_score_breaks_same_priority():
+    """Same action in both clusters (equal priority): the cluster that
+    keeps working (wait=False, score 0) beats the one that blocks
+    (wait=True, score 2)."""
+    embedder = MockBackend()
+    blocking = Cluster(proposals=[_prop("m1", "file_read", {"path": "a"},
+                                        wait=True)])
+    working = Cluster(proposals=[_prop("m2", "file_read", {"path": "b"},
+                                       wait=False)])
+    for clusters in ([blocking, working], [working, blocking]):
+        winner, _ = select_winner_cluster(clusters, None)
+        assert winner is working
+        d = pick_winner(clusters, 2, 2, None, embedder)
+        assert d.params == {"path": "b"}
+
+
+def test_tiebreak_first_proposed_is_final():
+    """Identical priority AND wait score: the first-proposed cluster wins
+    (clusters.index) — fully deterministic, order-sensitive by design."""
+    embedder = MockBackend()
+    first = Cluster(proposals=[_prop("m1", "file_read", {"path": "a"})])
+    second = Cluster(proposals=[_prop("m2", "file_read", {"path": "b"})])
+    winner, _ = select_winner_cluster([first, second], None)
+    assert winner is first
+    d = pick_winner([first, second], 2, 2, None, embedder)
+    assert d.params == {"path": "a"}
+
+
+def test_pick_winner_majority_unchanged_by_refactor():
+    """The select_winner_cluster refactor must not change the majority
+    path: a majority cluster is the winner with kind 'consensus'."""
+    backend = MockBackend()
+    props = [_prop(m, "wait", {"duration": 1}) for m in POOL]
+    clusters = cluster_proposals(props, backend)
+    majority = find_majority_cluster(clusters, 3, 1)
+    assert majority is not None
+    d = pick_winner(clusters, 3, 1, majority, backend)
+    assert d.kind == "consensus" and d.cluster_size == 3
+
+
+# ---------------------------------------------------------------------------
+# ModelFailure.kind attribution (ISSUE 5 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_failure_kinds_transport_parse_schema():
+    backend = MockBackend(scripts={
+        POOL[0]: ["__error__"],                       # transport
+        POOL[1]: ["not json at all"],                 # parse
+        POOL[2]: [j("file_read", {})],                # schema: path missing
+    })
+    eng = ConsensusEngine(backend, ConsensusConfig(
+        model_pool=list(POOL), max_refinement_rounds=0))
+    out = eng.decide(msgs())
+    assert out.status == "all_invalid"
+    kinds = {f.model_spec: f.kind for f in out.failures}
+    assert kinds == {POOL[0]: "transport", POOL[1]: "parse",
+                     POOL[2]: "schema"}
+    # the audit record accounts the same failures by kind
+    fc = out.audit["failure_counts"]
+    assert fc[POOL[0]] == {"transport": 1}
+    assert fc[POOL[1]] == {"parse": 1}
+    assert fc[POOL[2]] == {"schema": 1}
+
+
+def test_failure_kind_deadline_and_member_miss():
+    class DeadlineBackend(MockBackend):
+        def query(self, requests):
+            out = []
+            for r in requests:
+                if r.model_spec == POOL[0]:
+                    out.append(QueryResult(
+                        model_spec=r.model_spec,
+                        error="deadline_exceeded: 50ms budget"))
+                else:
+                    out.extend(super().query([r]))
+            return out
+
+    backend = DeadlineBackend()
+    eng = ConsensusEngine(backend, ConsensusConfig(
+        model_pool=list(POOL), max_refinement_rounds=0))
+    out = eng.decide(msgs())
+    # a deadline miss is a MEMBER miss, never a pool failure by itself
+    assert out.status == "ok"
+    assert out.deadline_misses == 1
+    assert [f.kind for f in out.failures] == ["deadline"]
+    assert out.audit["failure_counts"][POOL[0]] == {"deadline": 1}
+    assert out.audit["deadline_misses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Audit record completeness + correction recovery
+# ---------------------------------------------------------------------------
+
+
+def test_audit_record_complete_for_split_decide():
+    a, b = j("file_read", {"path": "a"}), j("file_read", {"path": "b"})
+    backend = MockBackend(scripts={POOL[0]: [a], POOL[1]: [a],
+                                   POOL[2]: [b]})
+    eng = ConsensusEngine(backend, ConsensusConfig(
+        model_pool=list(POOL), max_refinement_rounds=0, task_id="task-q1"))
+    out = eng.decide(msgs())
+    rec = out.audit
+    assert rec["task_id"] == "task-q1"
+    assert rec["status"] == "ok" and rec["rounds"] == 1
+    assert abs(rec["entropy_bits"] - 0.9183) < 1e-3
+    assert abs(rec["margin"] - 1 / 3) < 1e-3
+    assert rec["winner_cluster"] == 0
+    assert [c["size"] for c in rec["clusters"]] == [2, 1]
+    assert rec["members"][POOL[0]]["agreed"] is True
+    assert rec["members"][POOL[1]]["cluster"] == 0
+    assert rec["members"][POOL[2]] == {
+        "action": "file_read", "cluster": 1, "agreed": False,
+        "latency_ms": 0.0}
+    assert rec["decision"]["action"] == "file_read"
+    assert rec["decision"]["confidence"] == out.decision.confidence
+    assert rec["decision"]["kind"] == "forced_decision"
+
+
+def test_audit_tracks_correction_recovery():
+    """A member that fails with correction feedback and recovers to a
+    valid proposal next round lands in both 'corrected' and 'recovered'.
+    (The valid members split in round 1 — unanimity would end the decide
+    before the corrected member gets its retry.)"""
+    backend = _scripted_backend()
+    eng = ConsensusEngine(backend, ConsensusConfig(
+        model_pool=list(POOL), max_refinement_rounds=2))
+    out = eng.decide(msgs())
+    assert out.status == "ok" and out.rounds_used == 2
+    assert out.audit["corrected"] == [POOL[2]]
+    assert out.audit["recovered"] == [POOL[2]]
+    assert out.audit["failure_counts"][POOL[2]] == {"parse": 1}
+
+
+# ---------------------------------------------------------------------------
+# Read-only guarantee: temp-0 outcome equality with quality on vs off
+# (ISSUE 5 satellite + acceptance)
+# ---------------------------------------------------------------------------
+
+
+def _scripted_backend():
+    """A refinement scenario (split round 1, converge round 2) plus one
+    correction — exercises clustering, refinement, and failure paths."""
+    a = j("file_read", {"path": "x.py"})
+    b = j("execute_shell", {"command": "ls"})
+    return MockBackend(scripts={
+        POOL[0]: [a, a], POOL[1]: [b, a], POOL[2]: ["garbage", a]})
+
+
+def test_temp0_outcome_equality_quality_on_off():
+    outs = {}
+    for quality in (True, False):
+        eng = ConsensusEngine(_scripted_backend(), ConsensusConfig(
+            model_pool=list(POOL), max_refinement_rounds=2,
+            quality=quality))
+        outs[quality] = eng.decide(msgs())
+    on, off = outs[True], outs[False]
+    # bit-identical decision + status + rounds + proposals
+    assert on.decision == off.decision
+    assert on.status == off.status == "ok"
+    assert on.rounds_used == off.rounds_used
+    assert [(p.model_spec, p.action, p.params, p.wait)
+            for p in on.proposals] == \
+           [(p.model_spec, p.action, p.params, p.wait)
+            for p in off.proposals]
+    assert on.embed_texts == off.embed_texts
+    assert [(f.model_spec, f.kind) for f in on.failures] == \
+           [(f.model_spec, f.kind) for f in off.failures]
+    # the audit record exists exactly when the layer is on
+    assert on.audit is not None and off.audit is None
+
+
+def test_sim_margins_recorded_without_extra_embeds():
+    """Near-threshold similarity margins come from embeds that happen
+    anyway: embed_texts (the cost accounting) is unchanged by margin
+    recording, and each margin is cosine - threshold."""
+    a = j("send_message", {"target": "parent", "content": "retry the build"})
+    b = j("send_message", {"target": "parent", "content": "wipe the disk"})
+    c = j("send_message", {"target": "parent", "content": "retry the build"})
+    backend = MockBackend(scripts={POOL[0]: [a], POOL[1]: [b],
+                                   POOL[2]: [c]})
+    eng = ConsensusEngine(backend, ConsensusConfig(
+        model_pool=list(POOL), max_refinement_rounds=0))
+    out = eng.decide(msgs())
+    rec = out.audit
+    assert rec["n_sim_checks"] >= 1            # a/b differ -> embedded
+    assert rec["sim_margin_min"] is not None
+    assert all(-2.0 <= m <= 2.0 for m in rec["sim_margins"])
+
+
+# ---------------------------------------------------------------------------
+# Scorecards + drift detection
+# ---------------------------------------------------------------------------
+
+
+def _run_split_decide(q, agree_all=False):
+    a = j("file_read", {"path": "a"})
+    b = j("file_read", {"path": "b"})
+    backend = MockBackend(scripts={
+        POOL[0]: [a], POOL[1]: [a], POOL[2]: [a if agree_all else b]})
+    eng = ConsensusEngine(backend, ConsensusConfig(
+        model_pool=list(POOL), max_refinement_rounds=0))
+    out = eng.decide(msgs())
+    q.observe_decide(out.audit)
+    return out
+
+
+def test_scorecard_accumulates_agreement_and_dissent():
+    q = ConsensusQuality(flight=FlightRecorder(), min_samples=10_000)
+    for _ in range(3):
+        _run_split_decide(q)
+    _run_split_decide(q, agree_all=True)
+    cards = q.scorecards()
+    assert cards["n_decides"] == 4
+    m0 = cards["members"][POOL[0]]
+    m2 = cards["members"][POOL[2]]
+    assert m0["decides"] == 4 and m0["agreements"] == 4
+    assert m0["agreement_rate"] == 1.0
+    assert m2["dissents"] == 3 and m2["agreements"] == 1
+    assert abs(m2["dissent_rate"] - 0.75) < 1e-9
+    assert cards["drifting"] == []
+
+
+def test_scorecard_failure_and_recovery_rates():
+    q = ConsensusQuality(flight=FlightRecorder(), min_samples=10_000)
+    backend = _scripted_backend()
+    eng = ConsensusEngine(backend, ConsensusConfig(
+        model_pool=list(POOL), max_refinement_rounds=2))
+    q.observe_decide(eng.decide(msgs()).audit)
+    card = q.scorecards()["members"][POOL[2]]
+    assert card["failures"] == {"parse": 1}
+    assert card["failure_rate"] == 1.0
+    assert card["corrections"] == 1 and card["recoveries"] == 1
+    assert card["recovery_rate"] == 1.0
+
+
+def _synthetic_record(n, model="m1", agreed=True, failure=None):
+    return {
+        "event": "consensus_audit", "ts": float(n), "decide_id": f"t{n}",
+        "task_id": "task-drift", "agent_id": "a1", "status": "ok",
+        "rounds": 1,
+        "members": {model: {"action": "wait", "cluster": 0,
+                            "agreed": agreed, "latency_ms": 4.0}},
+        "failure_counts": ({model: {failure: 1}} if failure else {}),
+        "corrected": [], "recovered": [], "sim_margins": [],
+        "entropy_bits": 0.0, "margin": 1.0,
+    }
+
+
+def test_drift_detection_trips_flight_and_sink_then_recovers():
+    """Forced drift: a member that agreed for 30 decides starts dissenting
+    every decide — the recent EWMA leaves the frozen baseline, producing a
+    model_health_drift flight event and a sink alert; sustained agreement
+    afterwards clears the trip (hysteresis)."""
+    fr = FlightRecorder()
+    q = ConsensusQuality(flight=fr, min_samples=10, drift_threshold=0.3,
+                         recent_alpha=0.4, baseline_alpha=0.01)
+    alerts = []
+    q.add_sink(lambda e: alerts.append(e)
+               if e.get("event", "").startswith("model_health") else None)
+    n = 0
+    for _ in range(30):
+        q.observe_decide(_synthetic_record(n := n + 1, agreed=True))
+    assert q.scorecards()["drifting"] == []
+    for _ in range(10):
+        q.observe_decide(_synthetic_record(n := n + 1, agreed=False))
+    cards = q.scorecards()
+    assert cards["drifting"] == ["m1"]
+    assert "dissent" in cards["members"]["m1"]["drifting"]
+    drift_events = [e for e in fr.snapshot()
+                    if e["kind"] == "model_health_drift"]
+    assert len(drift_events) == 1                      # trip-once
+    assert drift_events[0]["model"] == "m1"
+    assert drift_events[0]["signal"] == "dissent"
+    assert [a["event"] for a in alerts] == ["model_health_drift"]
+    # recovery: agreement resumes, the trip clears below threshold/2
+    for _ in range(40):
+        q.observe_decide(_synthetic_record(n := n + 1, agreed=True))
+    assert q.scorecards()["drifting"] == []
+    assert alerts[-1]["event"] == "model_health_recovered"
+
+
+def test_drift_detection_failure_signal():
+    fr = FlightRecorder()
+    q = ConsensusQuality(flight=fr, min_samples=5, drift_threshold=0.3,
+                         recent_alpha=0.5, baseline_alpha=0.01)
+    n = 0
+    for _ in range(20):
+        q.observe_decide(_synthetic_record(n := n + 1))
+    for _ in range(8):
+        q.observe_decide(_synthetic_record(n := n + 1, agreed=False,
+                                           failure="transport"))
+    signals = {e["signal"] for e in fr.snapshot()
+               if e["kind"] == "model_health_drift"}
+    assert "failure" in signals
+
+
+def test_quality_sinks_receive_audit_records_and_are_exception_safe():
+    q = ConsensusQuality(flight=FlightRecorder(), min_samples=10_000)
+    seen = []
+
+    def bad_sink(event):
+        raise RuntimeError("boom")
+
+    q.add_sink(bad_sink)
+    q.add_sink(seen.append)
+    _run_split_decide(q)
+    assert len(seen) == 1 and seen[0]["event"] == "consensus_audit"
+    q.remove_sink(bad_sink)
+    q.remove_sink(seen.append)
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition: the quoracle_consensus_* surface
+# ---------------------------------------------------------------------------
+
+
+def test_quality_instruments_in_prometheus_exposition():
+    from quoracle_tpu.infra.telemetry import METRICS
+    eng = ConsensusEngine(_scripted_backend(), ConsensusConfig(
+        model_pool=list(POOL), max_refinement_rounds=2))
+    eng.decide(msgs())
+    text = METRICS.render_prometheus()
+    for name in ("quoracle_consensus_vote_entropy_bits",
+                 "quoracle_consensus_winner_margin",
+                 "quoracle_consensus_rounds_to_decision",
+                 "quoracle_consensus_similarity_margin",
+                 "quoracle_consensus_member_decides_total",
+                 "quoracle_consensus_member_agreement_total",
+                 "quoracle_consensus_member_dissent_total",
+                 "quoracle_consensus_member_failures_total",
+                 "quoracle_consensus_member_drifting"):
+        assert name in text, f"{name} missing from exposition"
+    # the member counters carry model labels
+    assert f'quoracle_consensus_member_decides_total{{model="{POOL[0]}"}}' \
+        in text
+
+
+# ---------------------------------------------------------------------------
+# Dashboard endpoints: /api/consensus, /api/models, /api/history ring,
+# bearer gating (ISSUE 5 satellite + acceptance)
+# ---------------------------------------------------------------------------
+
+
+async def _http_json(url, token=None):
+    def call():
+        req = urllib.request.Request(url)
+        if token:
+            req.add_header("authorization", f"Bearer {token}")
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read() or b"{}")
+    return await asyncio.get_running_loop().run_in_executor(None, call)
+
+
+async def _until(cond, timeout=15.0):
+    import time
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if cond():
+            return
+        await asyncio.sleep(0.02)
+    raise AssertionError("condition not met")
+
+
+def test_consensus_audit_endpoints_end_to_end():
+    from quoracle_tpu.runtime import Runtime, RuntimeConfig
+    from quoracle_tpu.web import DashboardServer
+
+    async def main():
+        rt = Runtime(RuntimeConfig(), backend=MockBackend())
+        server = await DashboardServer(rt, port=0).start()
+        base = server.url
+        try:
+            task_id, root = await rt.tasks.create_task(
+                "audit probe", model_pool=list(POOL))
+            await _until(lambda: rt.history.replay_consensus(task_id))
+            # complete audit record for a decided task
+            status, cons = await _http_json(
+                base + f"/api/consensus?task_id={task_id}")
+            assert status == 200 and cons["n_records"] >= 1
+            rec = cons["records"][0]
+            assert rec["task_id"] == task_id
+            assert rec["agent_id"] == root.agent_id
+            for key in ("members", "decision", "entropy_bits", "margin",
+                        "winner_cluster", "failure_counts", "clusters"):
+                assert key in rec, f"audit record missing {key}"
+            assert set(rec["members"]) == set(POOL)
+            # durable rows landed alongside the task's decisions
+            await _until(lambda: rt.db.query(
+                "SELECT COUNT(*) AS n FROM consensus_audit "
+                "WHERE task_id=?", (task_id,))[0]["n"] >= 1)
+            assert rt.store.audit_for_task(task_id)[0]["task_id"] == task_id
+            # scorecards at /api/models
+            status, models = await _http_json(base + "/api/models")
+            assert status == 200 and models["n_decides"] >= 1
+            assert POOL[0] in models["members"]
+            assert models["members"][POOL[0]]["decides"] >= 1
+            # the consensus ring registered in /api/history
+            status, hist = await _http_json(base + "/api/history")
+            assert status == 200 and "consensus" in hist
+            assert any(r.get("event") == "consensus_audit"
+                       for r in hist["consensus"])
+            await rt.tasks.pause_task(task_id)
+        finally:
+            await server.stop()
+            rt.close()
+
+    asyncio.run(main())
+
+
+def test_consensus_endpoints_bearer_gated():
+    """Same token gating as /api/trace: without the bearer token the new
+    endpoints 401, with it (header or ?token=) they serve."""
+    from quoracle_tpu.runtime import Runtime, RuntimeConfig
+    from quoracle_tpu.web import DashboardServer
+
+    async def main():
+        rt = Runtime(RuntimeConfig(), backend=MockBackend())
+        server = await DashboardServer(rt, port=0,
+                                       auth_token="qual-tok").start()
+        base = server.url
+        try:
+            for path in ("/api/models", "/api/consensus?task_id=t",
+                         "/api/history"):
+                status, _ = await _http_json(base + path)
+                assert status == 401, f"{path} not token-gated"
+                status, _ = await _http_json(base + path,
+                                             token="qual-tok")
+                assert status == 200
+            # ?token= form (EventSource/scraper parity with /api/trace)
+            sep = "&" if "?" in "/api/consensus?task_id=t" else "?"
+            status, _ = await _http_json(
+                base + f"/api/consensus?task_id=t{sep}token=qual-tok")
+            assert status == 200
+        finally:
+            await server.stop()
+            rt.close()
+
+    asyncio.run(main())
+
+
+def test_event_history_consensus_ring_filters_by_task():
+    from quoracle_tpu.infra.bus import EventBus, TOPIC_CONSENSUS
+    from quoracle_tpu.infra.event_history import EventHistory
+
+    bus = EventBus()
+    h = EventHistory(bus)
+    bus.broadcast(TOPIC_CONSENSUS, {"event": "consensus_audit",
+                                    "task_id": "t1", "decide_id": "c1"})
+    bus.broadcast(TOPIC_CONSENSUS, {"event": "consensus_audit",
+                                    "task_id": "t2", "decide_id": "c2"})
+    bus.broadcast(TOPIC_CONSENSUS, {"event": "model_health_drift",
+                                    "model": "m1", "signal": "dissent"})
+    assert len(h.replay_consensus()) == 3
+    t1 = h.replay_consensus("t1")
+    assert [r["decide_id"] for r in t1] == ["c1"]
+    # drift alerts carry no task_id: excluded from task-filtered replay
+    assert all(r["event"] == "consensus_audit"
+               for r in h.replay_consensus("t2"))
+    h.close()
+
+
+def test_build_audit_record_handles_total_failure():
+    """all_failed decides still produce a (winner-less) audit record."""
+    backend = MockBackend(scripts={m: ["__error__"] for m in POOL})
+    eng = ConsensusEngine(backend, ConsensusConfig(
+        model_pool=list(POOL), max_refinement_rounds=0))
+    out = eng.decide(msgs())
+    assert out.status == "all_failed"
+    rec = out.audit
+    assert rec["decision"] is None and rec["winner_cluster"] is None
+    assert rec["entropy_bits"] is None and rec["margin"] is None
+    assert all(rec["failure_counts"][m] == {"transport": 1} for m in POOL)
+    assert all(rec["members"][m]["failure"]["kind"] == "transport"
+               for m in POOL)
