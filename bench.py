@@ -49,6 +49,7 @@ code 0.
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import signal
@@ -774,6 +775,106 @@ def measure_qos_overload(backend, pool, overload_x: int = 4,
     }
 
 
+def measure_spec_continuous(backend, pool, n_rows: int = 6) -> dict:
+    """Config 13: speculative decoding in the PRODUCTION serving path
+    (ISSUE 6) — continuous batching + QoS with speculation on vs off.
+
+    ``n_rows`` consensus-shaped constrained rows (action-JSON grammar,
+    temp 0) ride one member's shared decode loop twice over the SAME
+    engine: once vanilla, once with a draft_map routing the member
+    through batched draft/verify rounds (self-draft here — the trained
+    draft's acceptance factor is config 7's realized row; self-draft
+    isolates the serving-path mechanics: batched draft scan + chunked
+    multi-row verify + per-row commit against the paged session KV).
+
+    Reported: e2e decode ms/token on vs off, realized tokens/round,
+    per-row acceptance p50, fallback counts by reason, and the
+    acceptance gate — temp-0 outputs must be BIT-IDENTICAL on vs off
+    (the same equality bar PRs 4-5 held QoS and quality to).
+    """
+    import statistics as stats_mod
+
+    from quoracle_tpu.models.runtime import TPUBackend
+    from quoracle_tpu.models.tokenizer import get_tokenizer
+    from quoracle_tpu.serving.qos import QoSConfig
+
+    member = pool[0]
+    tok = get_tokenizer(member)
+    enum = ("send_message", "todo", "wait", "execute_shell",
+            "spawn_child")
+    prompts = [
+        tok.encode(f"[agent {i}] {TASKS[i % len(TASKS)]}", add_bos=True)
+        for i in range(n_rows)]
+
+    def run(spec_on: bool) -> dict:
+        b = TPUBackend([member], engines=backend.engines,
+                       embedder=backend.embedder, continuous=True,
+                       continuous_chunk=16, continuous_slots=8,
+                       qos=QoSConfig(),
+                       draft_map=({member: member} if spec_on else None))
+        cb = b._cbatchers[member]
+        try:
+            # warmup: pays the draft/verify (or vanilla chunk) compiles
+            cb.submit(prompts[0], temperature=0.0, max_new_tokens=MAX_NEW,
+                      constrain_json=True,
+                      action_enum=enum).result(900)
+            t0 = time.monotonic()
+            futs = [cb.submit(p, temperature=0.0, max_new_tokens=MAX_NEW,
+                              constrain_json=True, action_enum=enum)
+                    for p in prompts]
+            gens = [f.result(900) for f in futs]
+            wall = time.monotonic() - t0
+            spec_stats = (b._speculators[member].stats()
+                          if spec_on else None)
+        finally:
+            b.close()
+        toks = sum(g.n_gen_tokens for g in gens)
+        rows = [{
+            "tokens": g.n_gen_tokens,
+            "spec_rounds": g.spec_rounds,
+            "spec_drafted": g.spec_drafted_tokens,
+            "spec_accepted": g.spec_accepted_tokens,
+        } for g in gens]
+        return {
+            "texts": [g.text for g in gens],
+            "wall_s": round(wall, 3),
+            "tokens": toks,
+            "ms_per_token": round(wall * 1000 / max(1, toks), 3),
+            "tokens_per_s": round(toks / max(1e-9, wall), 1),
+            "rows": rows,
+            "speculative": spec_stats,
+        }
+
+    off = run(False)
+    on = run(True)
+    equal = on["texts"] == off["texts"]
+    acc_rows = [r["spec_accepted"] / r["spec_drafted"]
+                for r in on["rows"] if r["spec_drafted"]]
+    spec = on["speculative"] or {}
+    result = {
+        "n_rows": n_rows,
+        "max_new": MAX_NEW,
+        "ms_per_token_off": off["ms_per_token"],
+        "ms_per_token_on": on["ms_per_token"],
+        "speedup": round(off["ms_per_token"]
+                         / max(1e-9, on["ms_per_token"]), 3),
+        "tokens_per_round": spec.get("tokens_per_round"),
+        "acceptance_p50": (round(stats_mod.median(acc_rows), 4)
+                           if acc_rows else None),
+        "fallbacks": spec.get("fallbacks") or {},
+        "rounds": spec.get("rounds"),
+        "disengages": spec.get("disengages"),
+        "temp0_equal": equal,
+        "qos_off_detail": {k: off[k] for k in
+                           ("wall_s", "tokens", "tokens_per_s")},
+        "qos_on_detail": {k: on[k] for k in
+                          ("wall_s", "tokens", "tokens_per_s")},
+        "rows_on": on["rows"],
+    }
+    assert equal, "config13: temp-0 outputs diverged with speculation on"
+    return result
+
+
 def measure_quality_overhead(backend, pool,
                              n_decides: int = N_CYCLES) -> dict:
     """Config 12: consensus-quality instrumentation overhead (ISSUE 5).
@@ -968,6 +1069,24 @@ def base_payload() -> dict:
         "config12_overhead_p50_ratio": None,
         "config12_entropy_bits_mean": None,
         "config12_margin_mean": None,
+        # config 7 realized row (ISSUE 6): ceiling × the TRAINED draft's
+        # measured acceptance (latest SPECULATIVE artifact), greedy-equal
+        # asserted from that artifact's record.
+        "config7_trained_acceptance": None,
+        "config7_realized_speedup": None,
+        # config 13 — speculative decoding in the continuous+QoS serving
+        # path (ISSUE 6): constrained consensus-shaped rows through the
+        # shared decode loop with speculation on vs off — decode
+        # ms/token, tokens/round, acceptance p50, fallback count, and
+        # the temp-0 on/off equality gate. Per-row detail lands in the
+        # SPEC sidecar (QUORACLE_BENCH_SPEC).
+        "config13_ms_per_token_on": None,
+        "config13_ms_per_token_off": None,
+        "config13_speedup": None,
+        "config13_tokens_per_round": None,
+        "config13_acceptance_p50": None,
+        "config13_fallbacks": None,
+        "config13_temp0_equal": None,
         "cycles": None,
         "rounds_per_cycle": None,
         "max_new_tokens": None,
@@ -1237,7 +1356,7 @@ def _run(args, payload: dict, deadline_at: float) -> None:
                            / max(1, s.n_gen_tokens))
             acc.append(s.acceptance_rate)
             tpr.append(s.tokens_per_round)
-        return {
+        out = {
             "vanilla_ms_per_token": statistics.median(van_ms),
             "speculative_ms_per_token": statistics.median(spec_ms),
             "ceiling_speedup": statistics.median(van_ms)
@@ -1246,6 +1365,36 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             "tokens_per_round": statistics.median(tpr),
             "k": 6,
         }
+        # Realized trained-draft row (ISSUE 6): the self-draft above is
+        # the mechanism CEILING; the realized speedup multiplies in the
+        # TRAINED draft's measured acceptance from the latest committed
+        # SPECULATIVE artifact (tools/train_draft.py), whose greedy
+        # bit-equality record is asserted before use — an artifact whose
+        # draft ever diverged from vanilla decode must not feed the
+        # projection.
+        arts = sorted(glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "SPECULATIVE_r*.json")))
+        if arts:
+            try:
+                with open(arts[-1]) as f:
+                    rec = json.load(f)
+                eq_a, eq_b = (rec.get("greedy_equal") or "0/1").split("/")
+                assert eq_a == eq_b, \
+                    f"trained draft not greedy-equal: {rec['greedy_equal']}"
+                trained_acc = float(rec["value"])
+                out.update({
+                    "trained_artifact": os.path.basename(arts[-1]),
+                    "trained_acceptance": trained_acc,
+                    "trained_greedy_equal": rec.get("greedy_equal"),
+                    # expected emitted/round at the artifact's K, times
+                    # the per-chunk cost advantage the ceiling measured
+                    "realized_speedup": round(
+                        out["ceiling_speedup"] * trained_acc, 3),
+                })
+            except Exception as e:          # noqa: BLE001 — optional row
+                out["trained_artifact_error"] = repr(e)
+        return out
 
     cfg7 = guard("config7", speculative_config)
     if cfg7:
@@ -1335,6 +1484,22 @@ def _run(args, payload: dict, deadline_at: float) -> None:
                   lambda: measure_quality_overhead(backend, pool))
     if cfg12:
         log(f"config12: {cfg12}")
+
+    # config 13 rides backend's engines too (continuous+QoS dispatch with
+    # a self-draft speculator on vs off) — before the vision config
+    cfg13 = guard("config13",
+                  lambda: measure_spec_continuous(backend, pool))
+    if cfg13:
+        log(f"config13: {cfg13}")
+        sidecar = os.environ.get("QUORACLE_BENCH_SPEC")
+        if sidecar:
+            try:
+                with open(sidecar, "w") as f:
+                    json.dump({"metric": "speculative_continuous",
+                               "config13": cfg13}, f, indent=1)
+                log(f"config13 spec detail written to {sidecar}")
+            except OSError as e:
+                log(f"config13 sidecar write failed: {e}")
 
     def vision_config():
         # config 5: vision pool — free the trio's HBM first (weights + KV
@@ -1441,6 +1606,8 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             "config7_acceptance": round(cfg7["acceptance_rate"], 3),
             "config7_tokens_per_round": round(
                 cfg7["tokens_per_round"], 2),
+            "config7_trained_acceptance": cfg7.get("trained_acceptance"),
+            "config7_realized_speedup": cfg7.get("realized_speedup"),
         })
     if cfg6:
         payload.update({
@@ -1518,6 +1685,16 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             "config12_entropy_bits_mean": cfg12["entropy_bits_mean"],
             "config12_margin_mean": cfg12["margin_mean"],
         })
+    if cfg13:
+        payload.update({
+            "config13_ms_per_token_on": cfg13["ms_per_token_on"],
+            "config13_ms_per_token_off": cfg13["ms_per_token_off"],
+            "config13_speedup": cfg13["speedup"],
+            "config13_tokens_per_round": cfg13["tokens_per_round"],
+            "config13_acceptance_p50": cfg13["acceptance_p50"],
+            "config13_fallbacks": cfg13["fallbacks"],
+            "config13_temp0_equal": cfg13["temp0_equal"],
+        })
     if cfg10:
         payload.update({
             "config10_n_samples": cfg10["n_samples"],
@@ -1535,7 +1712,7 @@ def _run(args, payload: dict, deadline_at: float) -> None:
                     "config4": cfg4, "config5": cfg5, "config6": cfg6,
                     "config7": cfg7, "config8": cfg8, "config9": cfg9,
                     "config10": cfg10, "config11": cfg11,
-                    "config12": cfg12},
+                    "config12": cfg12, "config13": cfg13},
                    indent=1, default=str))
     payload.update({
         "cycles": N_CYCLES,

@@ -73,6 +73,7 @@ async def cmd_run(args: argparse.Namespace) -> int:
                                num_processes=args.num_processes,
                                process_id=args.process_id,
                                draft_map=_parse_drafts(args.drafts) or None,
+                               draft_k=args.draft_k,
                                continuous=args.continuous,
                                qos=args.qos or None))
     _attach_printer(rt)
@@ -101,6 +102,7 @@ async def cmd_resume(args: argparse.Namespace) -> int:
                                num_processes=args.num_processes,
                                process_id=args.process_id,
                                draft_map=_parse_drafts(args.drafts) or None,
+                               draft_k=args.draft_k,
                                continuous=args.continuous,
                                qos=args.qos or None))
     _attach_printer(rt)
@@ -126,6 +128,7 @@ async def cmd_serve(args: argparse.Namespace) -> int:
         num_processes=args.num_processes,
         process_id=args.process_id,
         draft_map=_parse_drafts(args.drafts) or None,
+        draft_k=args.draft_k,
         continuous=args.continuous, qos=args.qos or None))
     # Validate host/token BEFORE boot so a refused bind exits with a clean
     # message instead of a traceback over a half-started runtime.
@@ -186,6 +189,11 @@ def build_parser() -> argparse.ArgumentParser:
                         help="speculative serving: draft model spec for a "
                              "pool member, e.g. xla:llama-1b=xla:draft "
                              "(repeatable; models/speculative.py)")
+        sp.add_argument("--draft-k", dest="draft_k", type=int, default=6,
+                        help="speculative serving: initial draft length K "
+                             "per round (adaptive under --continuous — "
+                             "shrinks on low acceptance, falls back to "
+                             "vanilla below the floor and re-probes)")
         sp.add_argument("--coordinator", dest="coordinator", default=None,
                         help="multi-host: coordinator address "
                              "(host:port) to join the JAX distributed "

@@ -119,6 +119,13 @@ class ConsensusOutcome:
     # all rounds. A deadline miss is a MEMBER miss — the member simply
     # has no proposal this round — never a pool failure by itself.
     deadline_misses: int = 0
+    # Speculative serving (ISSUE 6): draft/verify rounds and accepted
+    # draft tokens summed over all rounds and members — the per-decide
+    # speedup attribution beside cached_tokens (an accepted token is a
+    # decode step the target never paid weight streaming for). Logged in
+    # the decision audit record, queryable at /api/consensus.
+    spec_rounds: int = 0
+    spec_accepted_tokens: int = 0
     cost: float = 0.0
     embed_texts: int = 0
     # Summed per-member proposal latency across all rounds (ms) — the
@@ -170,7 +177,9 @@ class ConsensusEngine:
                             rounds=outcome.rounds_used,
                             prefill_ms=round(outcome.prefill_ms, 1),
                             decode_ms=round(outcome.decode_ms, 1),
-                            cached_tokens=outcome.cached_tokens)
+                            cached_tokens=outcome.cached_tokens,
+                            spec_accepted_tokens=outcome.
+                            spec_accepted_tokens)
         DECIDE_MS.observe((time.monotonic() - t0) * 1000)
         if outcome.audit is not None:
             # Scorecards + entropy/margin instruments + drift detection +
@@ -360,6 +369,9 @@ class ConsensusEngine:
             outcome.prefill_ms += getattr(res, "prefill_ms", 0.0)
             outcome.decode_ms += getattr(res, "decode_ms", 0.0)
             outcome.cached_tokens += getattr(res, "cached_tokens", 0)
+            outcome.spec_rounds += getattr(res, "spec_rounds", 0)
+            outcome.spec_accepted_tokens += getattr(
+                res, "spec_accepted_tokens", 0)
             outcome.member_latency_ms[res.model_spec] = \
                 outcome.member_latency_ms.get(res.model_spec, 0.0) \
                 + getattr(res, "latency_ms", 0.0)

@@ -169,6 +169,12 @@ def build_audit_record(*, task_id: Optional[str], agent_id: Optional[str],
                            if sim_margins else None),
         "n_sim_checks": len(sim_margins),
         "deadline_misses": outcome.deadline_misses,
+        # speculative serving (ISSUE 6): per-decide speedup attribution —
+        # how many of this decide's completion tokens came from accepted
+        # draft proposals instead of vanilla decode steps
+        "spec_rounds": getattr(outcome, "spec_rounds", 0),
+        "spec_accepted_tokens": getattr(outcome, "spec_accepted_tokens",
+                                        0),
         "latency_ms": round(outcome.latency_ms, 2),
     }
 

@@ -130,6 +130,25 @@ def hbm_attribution(backend) -> dict:
 
     members = {}
     engines = getattr(backend, "engines", None) or {}
+    pool = set(getattr(backend, "pool", None) or ())
+    draft_map = dict(getattr(backend, "draft_map", None) or {})
+    draft_for = {d: t for t, d in draft_map.items()}
+    # v1 batch-1 speculative decoders hold DENSE session caches (two
+    # full-size KV caches per resident session — models/runtime.py) that
+    # live outside any engine's page pool; attribute them to their TARGET
+    # member instead of leaving them as unattributed tail.
+    spec_cache = {}
+    for tspec, dec in (getattr(backend, "_spec_decoders", None)
+                       or {}).items():
+        try:
+            with dec.lock:
+                n_b = sum(
+                    int(s[w].k.nbytes) + int(s[w].v.nbytes)
+                    for s in dec._sessions.values() for w in ("t", "d"))
+                spec_cache[tspec] = {"bytes": n_b,
+                                     "sessions": len(dec._sessions)}
+        except Exception:             # noqa: BLE001 — partial is fine
+            logger.exception("spec cache attribution failed for %s", tspec)
     for spec, e in engines.items():
         try:
             params_b = sum(
@@ -148,7 +167,15 @@ def hbm_attribution(backend) -> dict:
                 occ = st.prefix_cache.occupancy()
             # page 0 is scratch; used = allocated (non-free, non-scratch)
             used_pages = st.n_pages - 1 - free
+            # role (ISSUE 6): pool member, speculative draft (never
+            # serves directly — its weights exist to accelerate
+            # ``draft_for``), or aux (e.g. a dedicated embed model)
+            role = ("member" if not pool or spec in pool
+                    else "draft" if spec in draft_for else "aux")
             members[spec] = {
+                "role": role,
+                **({"draft_for": draft_for[spec]}
+                   if spec in draft_for else {}),
                 "params_bytes": params_b,
                 "kv_pool_bytes": pool_b,
                 "kv_pool_pages": st.n_pages,
@@ -161,6 +188,11 @@ def hbm_attribution(backend) -> dict:
                 "prefix_cache": occ,
                 "sessions": n_sessions,
             }
+            if spec in spec_cache:
+                members[spec]["spec_cache_bytes"] = \
+                    spec_cache[spec]["bytes"]
+                members[spec]["spec_cache_sessions"] = \
+                    spec_cache[spec]["sessions"]
         except Exception:                 # noqa: BLE001 — partial is fine
             logger.exception("hbm attribution failed for %s", spec)
     totals = {
@@ -168,6 +200,11 @@ def hbm_attribution(backend) -> dict:
         "kv_pool_bytes": sum(m["kv_pool_bytes"] for m in members.values()),
         "prefix_cache_bytes": sum(m["prefix_cache_bytes"]
                                   for m in members.values()),
+        "draft_params_bytes": sum(
+            m["params_bytes"] for m in members.values()
+            if m.get("role") == "draft"),
+        "spec_cache_bytes": sum(m.get("spec_cache_bytes", 0)
+                                for m in members.values()),
         "tail_reserve_bytes": int(POOL_TAIL_RESERVE),
     }
     return {"members": members, "totals": totals}
@@ -208,6 +245,9 @@ class ResourceCollector:
                                     component="kv_pool")
             HBM_COMPONENT_BYTES.set(m["prefix_cache_bytes"], model=spec,
                                     component="prefix_cache")
+            if "spec_cache_bytes" in m:
+                HBM_COMPONENT_BYTES.set(m["spec_cache_bytes"], model=spec,
+                                        component="spec_cache")
             occ = m["prefix_cache"]
             PREFIX_CACHE_PAGES.set(occ["resident_pages"], model=spec,
                                    kind="resident")

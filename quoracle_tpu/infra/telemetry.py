@@ -646,6 +646,41 @@ QOS_DEMOTIONS_TOTAL = METRICS.counter(
     "bulk-class weight demotions while the INTERACTIVE tail is over "
     "its SLO target")
 
+# -- speculative serving (ISSUE 6) -------------------------------------------
+# Batched draft/verify decoding in the continuous serving path
+# (models/speculative.py BatchedSpeculator): per-member acceptance,
+# realized tokens-per-round, adaptive-K state, and fallback attribution —
+# the scorecard inputs for /api/models and the /telemetry view.
+SPEC_ROUNDS = METRICS.counter(
+    "quoracle_spec_rounds_total",
+    "speculative draft/verify rounds executed, per model")
+SPEC_DRAFTED = METRICS.counter(
+    "quoracle_spec_drafted_tokens_total",
+    "draft tokens proposed across all rounds, per model")
+SPEC_ACCEPTED = METRICS.counter(
+    "quoracle_spec_accepted_tokens_total",
+    "draft tokens accepted by the target verify, per model")
+SPEC_ACCEPTANCE = METRICS.histogram(
+    "quoracle_spec_acceptance",
+    "per-round acceptance rate (accepted / drafted), per model",
+    buckets=(0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 0.95, 1.0))
+SPEC_TOKENS_PER_ROUND = METRICS.histogram(
+    "quoracle_spec_tokens_per_round",
+    "tokens committed per speculative round per row (accepted + "
+    "correction), per model",
+    buckets=(1, 2, 3, 4, 5, 6, 8, 10, 12, 16))
+SPEC_K = METRICS.gauge(
+    "quoracle_spec_k",
+    "current adaptive draft length K, per model")
+SPEC_ENGAGED = METRICS.gauge(
+    "quoracle_spec_engaged",
+    "1 while the member's speculator is engaged, 0 while it has "
+    "disengaged to vanilla decode (acceptance collapse)")
+SPEC_FALLBACK_TOTAL = METRICS.counter(
+    "quoracle_spec_fallback_total",
+    "decode ticks a row fell back to vanilla, per model and reason "
+    "(disengaged | sampling | window | draft_error | verify_error)")
+
 # -- consensus quality (ISSUE 5) ---------------------------------------------
 # Decision-quality instruments (consensus/quality.py): per-decide
 # contestedness and the per-member scorecard counters. Registered at

@@ -337,6 +337,13 @@ class GenResult:
                           # back as initial_json_state to resume a
                           # constrained stream mid-JSON (chunked
                           # continuation, models/scheduler.py)
+    # Speculative serving attribution (models/speculative.py
+    # BatchedSpeculator → models/scheduler.py): how much of this result
+    # was produced by draft/verify rounds instead of vanilla decode
+    # steps. Zero on the plain paths.
+    spec_rounds: int = 0
+    spec_drafted_tokens: int = 0
+    spec_accepted_tokens: int = 0
 
 
 PAGE = 128   # tokens per KV page
@@ -1032,6 +1039,78 @@ class GenerateEngine:
             return out, n_emitted, cache.lens, k_pool, v_pool, cache.k, \
                 cache.v, jstate
 
+        @functools.partial(jax.jit, static_argnames=("kmax", "need_probs"))
+        def step_paged_verify(params, k_pool, v_pool, src_pages, tokens,
+                              prefix_lens, chunk_lens, kv_off, k_arr,
+                              temperature, json_table, json_state,
+                              kmax: int, need_probs: bool):
+            # Speculative VERIFY (models/speculative.py BatchedSpeculator):
+            # teacher-forced chunk forward over [pending, d_1..d_{K-1}]
+            # against each row's resident paged prefix, projecting logits
+            # at the last k_arr positions of every row's chunk — the
+            # positions whose argmax decides draft acceptance. Same gather
+            # as step_paged_prefill; the caller scatters the chunk KV back
+            # to pages (step_scatter_prompt), so a committed prefix is
+            # resident for the next round and rejected draft KV is just
+            # dead weight the next chunk's prefill overwrites (the LCP
+            # session resume IS the rollback).
+            B, maxp = src_pages.shape
+            kw = k_pool[:, src_pages].reshape(L, B, maxp * page, KV, HD)
+            vw = v_pool[:, src_pages].reshape(L, B, maxp * page, KV, HD)
+            cache = _constrain(KVCache(k=kw, v=vw,
+                                       lens=jnp.zeros((B,), jnp.int32)))
+            T = tokens.shape[1]
+            positions = (prefix_lens[:, None]
+                         + jnp.arange(T, dtype=jnp.int32)[None, :])
+            positions = positions + kv_off.astype(jnp.int32)[:, None]
+            total = (prefix_lens + chunk_lens).astype(jnp.int32)
+            hidden, cache = forward_hidden(
+                params, cfg, tokens, positions, cache,
+                write_offset=prefix_lens.astype(jnp.int32), kv_lens=total,
+                kv_pos_offset=kv_off)
+            cache = cache._replace(lens=total)
+            # verify window = each row's last k_arr chunk positions
+            widx = jnp.clip(
+                chunk_lens[:, None] - k_arr[:, None]
+                + jnp.arange(kmax, dtype=jnp.int32)[None, :], 0, T - 1)
+            wh = jnp.take_along_axis(hidden, widx[:, :, None], axis=1)
+            logits = project_logits(params, cfg, wh).astype(jnp.float32)
+            if json_table is not None:
+                # per-position grammar states walk IN-DEVICE from the
+                # state after ctx (json_state) over the window's draft
+                # tokens — the mask applied at position t equals the one
+                # vanilla decode would apply there (bit-exactness).
+                wtok = jnp.take_along_axis(tokens, widx, axis=1)
+
+                def adv(s, tok):
+                    nxt = json_table[jnp.clip(s, 0, None),
+                                     tok].astype(jnp.int32)
+                    s2 = jnp.where(s >= 0, nxt, s)
+                    return s2, s2
+
+                _, rest = jax.lax.scan(adv, json_state, wtok[:, 1:].T)
+                states = jnp.concatenate(
+                    [json_state[None, :], rest], axis=0).T    # [B, kmax]
+                V = logits.shape[-1]
+                logits = grammar_mask(
+                    logits.reshape(B * kmax, V), states.reshape(-1),
+                    json_table, cfg.eos_token_id).reshape(B, kmax, V)
+            ids = jnp.argmax(logits, axis=-1)                 # [B, kmax]
+            if need_probs:
+                probs = jax.nn.softmax(
+                    logits / jnp.maximum(temperature, 1e-6)[:, None, None],
+                    axis=-1)
+                # greedy rows in a mixed batch: one-hot keeps the host
+                # acceptance rule exact (accept iff d_i == argmax p_i)
+                probs = jnp.where(
+                    (temperature <= 0)[:, None, None],
+                    jax.nn.one_hot(ids, logits.shape[-1]), probs)
+            else:
+                # dead [B, kmax, V] outputs still cost HBM writes — drop
+                # them in the hot greedy path (same as the v1 decoder)
+                probs = jnp.zeros((1, 1, 1), jnp.float32)
+            return ids, probs, cache
+
         @functools.partial(jax.jit, donate_argnums=(1, 2))
         def step_paged_prefill_direct(params, k_pool, v_pool, src_tables,
                                       tokens, prefix_lens, chunk_lens,
@@ -1103,6 +1182,7 @@ class GenerateEngine:
         self._step_prefill = step_prefill
         self._step_decode = step_decode
         self._step_paged_prefill = step_paged_prefill
+        self._step_paged_verify = step_paged_verify
         self._step_paged_prefill_direct = step_paged_prefill_direct
         self._step_paged_decode = step_paged_decode
         self._step_scatter_prompt = step_scatter_prompt
@@ -1326,15 +1406,48 @@ class GenerateEngine:
         s = self.sessions.get(session_id)
         return None if s is None else list(s.tokens)
 
+    def verify_chunk(self, prompts, session_ids, verify_k, *,
+                     temperature=0.0, constrain_json=None,
+                     action_enums=None, initial_json_state=None,
+                     need_probs: bool = False) -> list[dict]:
+        """Speculative VERIFY against the paged session KV (the target
+        side of models/speculative.py BatchedSpeculator): each row i's
+        prompt is ctx_i + proposals_i[:-1] and ``verify_k[i]`` =
+        len(proposals_i); ONE teacher-forced chunk forward resumes the
+        row's session (LCP prefix reuse, exactly like generate) and
+        returns the target's verdict at the K_i positions that predict
+        proposals_i — ``ids`` (grammar-masked argmax per position) plus
+        ``probs`` ([K_i, V] masked softmax) when ``need_probs``. The
+        chunk KV is stored back to the session's pages, so the session
+        afterwards holds the full prompt; rejected draft KV past the
+        committed prefix is overwritten by the next round's suffix
+        prefill (LCP resume IS the rollback — no explicit cache surgery).
+
+        ``initial_json_state`` is the row's grammar state after ctx_i
+        (the scheduler's relative-state convention). Every row must be
+        sessioned; speculative serving never runs on sliding-window or
+        vision engines (the BatchedSpeculator enforces eligibility)."""
+        assert session_ids is not None and all(session_ids), \
+            "verify_chunk requires a session per row"
+        assert len(verify_k) == len(prompts)
+        assert all(1 <= int(k) <= len(p)
+                   for k, p in zip(verify_k, prompts))
+        with self._paged_lock:
+            return self._generate_impl(
+                prompts, temperature, 1.0, 1, None, session_ids,
+                constrain_json, action_enums, None, initial_json_state,
+                verify=([int(k) for k in verify_k], bool(need_probs)))
+
     def _generate_impl(self, prompts, temperature=1.0, top_p=1.0,
                        max_new_tokens=256, rng=None, session_ids=None,
                        constrain_json=None, action_enums=None,
                        images=None,
-                       initial_json_state=None) -> list[GenResult]:
+                       initial_json_state=None, verify=None):
         t0 = time.monotonic()
         n = len(prompts)
         if n == 0:
             return []
+        vk = verify[0] if verify is not None else None
         temps = [temperature] * n if isinstance(temperature, (int, float)) else list(temperature)
         tops = [top_p] * n if isinstance(top_p, (int, float)) else list(top_p)
         # Per-row decode budgets: consensus rows grouped into one batch keep
@@ -1399,8 +1512,13 @@ class GenerateEngine:
                             # session safeguard, models/runtime.py)
                             and self.cfg.vision is None):
                         t_pl = time.monotonic()
-                        d = self.sessions.match_prefix(
-                            prompts[i], len(prompts[i]) - 1)
+                        # verify mode: the last K_i positions are the
+                        # verify window and must run through the chunk
+                        # forward — never be served from reused KV
+                        cap = (len(prompts[i]) - 1 if vk is None
+                               else len(prompts[i]) - vk[i])
+                        d = (self.sessions.match_prefix(prompts[i], cap)
+                             if cap > 0 else None)
                         PREFIX_LOOKUP_MS.observe(
                             (time.monotonic() - t_pl) * 1000,
                             model=self.cfg.name)
@@ -1410,7 +1528,10 @@ class GenerateEngine:
                             kv_off_host[i] = 0
                     continue
                 # ≥1 suffix token must run to produce last-position logits
-                p = min(_lcp(s.tokens, prompts[i]), len(prompts[i]) - 1)
+                # (verify mode: the whole K_i window must run — see above)
+                p = min(_lcp(s.tokens, prompts[i]),
+                        len(prompts[i]) - 1 if vk is None
+                        else len(prompts[i]) - vk[i])
                 if self.cfg.sliding_window is not None and p < len(s.tokens):
                     # Windowed models resume only on clean extension: after
                     # a divergence the resident window [start_pos, p) would
@@ -1424,7 +1545,15 @@ class GenerateEngine:
         prefixes = [r - o for r, o in zip(reuse_abs, kv_off_host)]  # buffer
         suffixes = [list(p[r:]) for p, r in zip(prompts, reuse_abs)]
         max_chunk = max(len(s) for s in suffixes)
-        T = _round_up(max_chunk, self.prompt_buckets)
+        # verify chunks are K-token windows (steady state K ≤ 8, plus the
+        # occasional full re-prefill after eviction) — padding them to the
+        # 128-floor prompt buckets would forward 16-20x the needed
+        # positions per round. The verify jit is its own program, so the
+        # extra small buckets cost no compile churn on the main prefill.
+        T = _round_up(max_chunk,
+                      tuple(sorted({8, 16, 32, 64,
+                                    *self.prompt_buckets}))
+                      if vk is not None else self.prompt_buckets)
         if use_ring:
             sp = int(self.mesh.shape["sp"])
             T = ((T + sp - 1) // sp) * sp   # ring shards the chunk evenly
@@ -1514,11 +1643,22 @@ class GenerateEngine:
         else:
             json_args = (None, None)
 
+        vrun = None
+        if verify is not None:
+            # verify is paged by construction (every row sessioned) and
+            # never rides the sp ring (BatchedSpeculator eligibility)
+            assert paged and not use_ring, \
+                "verify_chunk requires the paged session path"
+            k_arr = np.ones((B,), np.int32)
+            k_arr[:n] = vk
+            vrun = (k_arr, _round_up(max(vk), (4, 8, 16)), verify[1])
         if paged:
-            out, n_emitted, jstate_f, t_prefill, now = self._run_paged(
-                prompts, suffixes, sess_rows, reuse_abs, kv_off_host,
-                store_sids, B, maxp, tokens, pre_arr, off_arr, chunk_arr,
-                limits, rng_key, samp, json_args, max_new, put, mat, row, t0)
+            out, n_emitted, jstate_f, t_prefill, now, vout = \
+                self._run_paged(
+                    prompts, suffixes, sess_rows, reuse_abs, kv_off_host,
+                    store_sids, B, maxp, tokens, pre_arr, off_arr,
+                    chunk_arr, limits, rng_key, samp, json_args, max_new,
+                    put, mat, row, t0, verify=vrun)
         else:
             if images is not None and any(i is not None for i in images):
                 vc = self.cfg.vision
@@ -1549,8 +1689,21 @@ class GenerateEngine:
         self.last_prefill_s = t_prefill - t0
         self.last_decode_s = now - t_prefill
         latency = now - t0
-        self._record_telemetry(n, B, T, cache_len, max_new, paged,
+        self._record_telemetry(n, B, T, cache_len,
+                               vrun[1] if vrun is not None else max_new,
+                               "verify" if vrun is not None else paged,
                                n_emitted, latency)
+
+        if verify is not None:
+            vids, vprobs = vout
+            return [{
+                # window position t predicts proposals[t]; valid verdicts
+                # are the first K_i entries (kmax padding is garbage)
+                "ids": [int(x) for x in vids[i, :vk[i]]],
+                "probs": (np.asarray(vprobs[i, :vk[i]], np.float32)
+                          if vprobs is not None else None),
+                "n_cached": reuse_abs[i],
+            } for i in range(n)]
 
         results = []
         for i in range(n):
@@ -1628,7 +1781,7 @@ class GenerateEngine:
     def _run_paged(self, prompts, suffixes, sess_rows, reuse_abs,
                    kv_off_host, store_sids, B, maxp, tokens, pre_arr,
                    off_arr, chunk_arr, limits, rng_key, samp, json_args,
-                   max_new, put, mat, row, t0):
+                   max_new, put, mat, row, t0, verify=None):
         """The paged-session call: gather resident pages in-device, prefill
         the suffix, decode, scatter prompt+response KV back to pages, then
         update session page lists host-side (ints only — no KV bytes move
@@ -1663,6 +1816,8 @@ class GenerateEngine:
                    or (self._paged_shard is not None
                        and int(self.mesh.shape.get("sp", 1)) == 1))
         use_direct = (mesh_ok
+                      and verify is None      # verify is a chunk forward,
+                                              # not a decode loop
                       and not getattr(self, "_force_gather_decode", False)
                       and max(len(p) for p in prompts)
                       >= self.direct_decode_min_tokens)
@@ -1811,7 +1966,33 @@ class GenerateEngine:
             # full gather scatter fills (prefix sharing divergence)
             and not partial_swap[0])
 
-        if use_direct_pre:
+        vout = None
+        if verify is not None:
+            # Speculative verify: ONE teacher-forced chunk forward with
+            # window logits (no decode loop). The chunk KV scatters back
+            # to the rows' own pages so committed tokens are resident for
+            # the next round; rejected-draft KV past the commit point is
+            # dead weight the next LCP resume overwrites.
+            k_arr, kmax, need_probs = verify
+            vids, vprobs, cache = self._step_paged_verify(
+                self.params, st.k, st.v, put(src, mat), put(tokens, mat),
+                put(pre_arr, row), put(chunk_arr, row), put(off_arr, row),
+                put(k_arr, row), samp[0], json_args[0], json_args[1],
+                kmax=kmax, need_probs=need_probs)
+            jax.block_until_ready(vids)   # phase fence: chunk forward done
+            t_prefill = time.monotonic()
+            st.k, st.v = self._step_scatter_prompt(
+                st.k, st.v, cache.k, cache.v, put(dst, mat))
+            cache = None   # k/v donated to the scatter; HBM freed
+            vout = (np.asarray(vids),
+                    np.asarray(vprobs) if need_probs else None)
+            jax.block_until_ready(st.k)
+            now = time.monotonic()
+            out = np.zeros((B, 0), np.int32)
+            n_emitted = np.zeros((B,), np.int32)
+            jstate_f = np.full((B,), -1, np.int32)
+            final_lens = pre_arr + chunk_arr
+        elif use_direct_pre:
             n_tok = st.n_pages * page
             flat = np.full((B, T), n_tok, np.int32)   # OOB sentinel = drop
             for i in range(n):
@@ -1834,7 +2015,9 @@ class GenerateEngine:
             jax.block_until_ready(last_logits)  # phase fence: prefill done
             t_prefill = time.monotonic()
 
-        if use_direct:
+        if verify is not None:
+            pass          # verdicts + scatter already done above
+        elif use_direct:
             # prompt KV → pages (unless the direct prefill already wrote
             # them there), free the working cache, decode straight off the
             # pool (ragged paged attention), then scatter only the
@@ -1913,9 +2096,12 @@ class GenerateEngine:
             # adoptable by future sessions. Windowed/trimmed sessions are
             # excluded (their pages don't start at position 0) and VLM
             # engines never share (image hazard, see the lookup site).
+            # verify-mode store-backs carry unverified DRAFT tokens at the
+            # tail — correct to resume from (token-keyed LCP) but not
+            # worth polluting the shared prefix cache with
             if (self.prefix_sharing and start == 0
                     and self.cfg.sliding_window is None
-                    and self.cfg.vision is None):
+                    and self.cfg.vision is None and verify is None):
                 st.insert_prefix(toks, pages)
         # temp pages (direct decode for sessionless rows) die with the call
         for tmp in temp_lists:
@@ -1927,7 +2113,7 @@ class GenerateEngine:
         for pages in adopted_release:
             if pages:
                 st.release(pages)
-        return out, n_emitted, jstate_f, t_prefill, now
+        return out, n_emitted, jstate_f, t_prefill, now, vout
 
     def _json_table_device(self, enum_set: tuple):
         """Lazily build + cache grammar tables for this tokenizer (one

@@ -91,6 +91,12 @@ class QueryResult:
     # Prompt tokens served from resident KV (session resume or a radix
     # prefix-cache hit, models/prefix_cache.py) instead of re-prefilled.
     cached_tokens: int = 0
+    # Speculative serving attribution (ISSUE 6): draft/verify rounds this
+    # row rode and draft tokens the target accepted — rolls up into
+    # ConsensusOutcome.spec_{accepted_tokens,rounds} for per-decide
+    # speedup attribution at /api/consensus.
+    spec_rounds: int = 0
+    spec_accepted_tokens: int = 0
     error: Optional[str] = None        # None = success
     permanent_error: bool = False      # parity: only auth-type errors are
                                        # permanent (model_query.ex:322-332)
@@ -157,6 +163,13 @@ class ModelBackend(abc.ABC):
         """Serving-QoS snapshot for /api/qos (admission controller,
         per-member weighted-fair queues, SLO tracker). ``enabled`` False
         for backends without QoS wiring."""
+        return {"enabled": False}
+
+    def spec_stats(self) -> dict:
+        """Speculative-serving snapshot for /api/models and the
+        /telemetry view (ISSUE 6): per-member acceptance, adaptive-K
+        state, and fallback attribution. ``enabled`` False for backends
+        without draft models."""
         return {"enabled": False}
 
 
@@ -409,20 +422,24 @@ class TPUBackend(ModelBackend):
             self.engines[spec] = build_engine(spec, i, mesh)
 
         # Speculative serving (models/speculative.py): draft_map routes a
-        # member's ELIGIBLE queries (single row, text-only, greedy or
-        # top_p=1 sampling) through draft-K/verify-one-chunk decoding —
+        # member's decode through draft-K/verify-one-chunk decoding —
         # output stays token-exact at temperature 0. Draft engines load
-        # like members but never serve as pool members themselves.
+        # like members but never serve as pool members themselves. Two
+        # integrations by dispatch mode (ISSUE 6):
+        #   * continuous=True — the PRODUCTION path: one BatchedSpeculator
+        #     per drafted member rides the ContinuousBatcher's decode
+        #     ticks (batched draft scan + one chunked multi-row verify per
+        #     round against the paged session KV; adaptive K with vanilla
+        #     fallback). Built below, handed to the batcher.
+        #   * baton mode — the v1 batch-1 dense-cache SpeculativeDecoder
+        #     serves single uncontended text rows as before.
+        self.draft_map = dict(draft_map or {})
         self._spec_decoders: dict = {}
+        self._speculators: dict = {}
         if draft_map:
-            if continuous:
-                # the continuous path returns before the speculative
-                # branch — silently loading draft weights that can never
-                # serve would be paid-for dead memory
-                raise ValueError("draft_map is not supported with "
-                                 "continuous=True (decode-level batching "
-                                 "already amortizes weight streaming)")
-            from quoracle_tpu.models.speculative import SpeculativeDecoder
+            from quoracle_tpu.models.speculative import (
+                BatchedSpeculator, SpeculativeDecoder,
+            )
             for j, (tspec, dspec) in enumerate(sorted(draft_map.items())):
                 if tspec not in self.engines:
                     raise KeyError(f"draft_map target {tspec!r} is not in "
@@ -431,9 +448,13 @@ class TPUBackend(ModelBackend):
                     self.engines[dspec] = build_engine(
                         dspec, len(self.pool) + 100 + j)
                 te, de = self.engines[tspec], self.engines[dspec]
-                self._spec_decoders[tspec] = SpeculativeDecoder(
-                    te.cfg, te.params, de.cfg, de.params, te.tokenizer,
-                    k=draft_k, max_seq=te.max_seq)
+                if continuous:
+                    self._speculators[tspec] = BatchedSpeculator(
+                        te, de, k=draft_k)
+                else:
+                    self._spec_decoders[tspec] = SpeculativeDecoder(
+                        te.cfg, te.params, de.cfg, de.params, te.tokenizer,
+                        k=draft_k, max_seq=te.max_seq)
 
         # One baton batcher per POOL member (draft engines never serve
         # directly): concurrent agents' rounds coalesce
@@ -471,7 +492,9 @@ class TPUBackend(ModelBackend):
                                         max_slots=continuous_slots,
                                         policy=qos_policies.get(spec),
                                         admission=self.qos_controller,
-                                        slo=self.slo)
+                                        slo=self.slo,
+                                        speculator=self._speculators.get(
+                                            spec))
                 for spec in self.pool}
             if self.qos_controller is not None:
                 for spec, pol in qos_policies.items():
@@ -541,6 +564,19 @@ class TPUBackend(ModelBackend):
 
     def scheduler_stats(self) -> dict:
         return {spec: cb.stats() for spec, cb in self._cbatchers.items()}
+
+    def spec_stats(self) -> dict:
+        if not self._speculators and not self._spec_decoders:
+            return {"enabled": False}
+        members = {spec: s.stats() for spec, s in self._speculators.items()}
+        for spec, dec in self._spec_decoders.items():
+            # v1 batch-1 decoders have no rolling scorecard — report the
+            # wiring so /api/models shows which members are drafted
+            members.setdefault(spec, {
+                "mode": "batch1", "draft": dec.dc.name, "k": dec.k,
+            })
+        return {"enabled": True, "draft_map": dict(self.draft_map),
+                "members": members}
 
     def qos_stats(self) -> dict:
         if self.qos_controller is None:
@@ -749,7 +785,9 @@ class TPUBackend(ModelBackend):
                 # draft/verify interleave: a prefill/decode split is not
                 # meaningful (same convention as continuous mode)
                 prefill_ms=0.0, decode_ms=0.0,
-                cached_tokens=getattr(g, "n_cached_tokens", 0))
+                cached_tokens=getattr(g, "n_cached_tokens", 0),
+                spec_rounds=g.rounds,
+                spec_accepted_tokens=g.accepted)
             return
         # The member's baton batcher may merge these rows with concurrent
         # agents' rounds into one generate.
@@ -855,7 +893,10 @@ class TPUBackend(ModelBackend):
                 model_spec=spec, text=g.text,
                 usage=Usage(g.n_prompt_tokens, g.n_gen_tokens, cost),
                 latency_ms=latency_ms, prefill_ms=0.0, decode_ms=0.0,
-                cached_tokens=g.n_cached_tokens)
+                cached_tokens=g.n_cached_tokens,
+                spec_rounds=getattr(g, "spec_rounds", 0),
+                spec_accepted_tokens=getattr(g, "spec_accepted_tokens",
+                                             0))
 
     def embed(self, texts: Sequence[str]) -> list[np.ndarray]:
         return self.embedder.embed(texts)

@@ -90,6 +90,12 @@ class _Row:
     priority: int = 1                   # Priority.AGENT
     tenant: str = "default"
     deadline_s: Optional[float] = None
+    # Speculative serving attribution (ISSUE 6): draft/verify rounds this
+    # row rode and how many draft tokens the target accepted — surfaced
+    # on the retiring GenResult for per-decide speedup attribution.
+    spec_rounds: int = 0
+    spec_drafted: int = 0
+    spec_accepted: int = 0
 
 
 class ContinuousBatcher:
@@ -104,13 +110,17 @@ class ContinuousBatcher:
     def __init__(self, engine, chunk: int = 32, max_slots: int = 8,
                  admit_wait_s: float = 0.002,
                  policy: Optional[AdmissionPolicy] = None,
-                 admission=None, slo=None):
+                 admission=None, slo=None, speculator=None):
         """``policy`` orders admission (default: the original FIFO;
         serving/qos.WeightedFairPolicy for DRR + aging). ``admission``
         is an optional serving/admission.AdmissionController consulted
         on every submit — sheds fail the row's future with a structured
         AdmissionError instead of growing the queue. ``slo`` is an
-        optional serving/slo.SLOTracker fed per-class retire latency."""
+        optional serving/slo.SLOTracker fed per-class retire latency.
+        ``speculator`` (models/speculative.BatchedSpeculator, ISSUE 6)
+        turns eligible rows' decode ticks into batched draft/verify
+        rounds; ineligible rows decode vanilla in the same tick and
+        temp-0 outputs stay bit-identical either way."""
         self.engine = engine
         self.chunk = chunk
         self.max_slots = max_slots
@@ -118,6 +128,7 @@ class ContinuousBatcher:
         self._policy = policy if policy is not None else FifoPolicy()
         self.admission = admission
         self.slo = slo
+        self.speculator = speculator
         self._live: list[_Row] = []
         self._seq = 0
         self._lock = threading.Lock()
@@ -215,8 +226,7 @@ class ContinuousBatcher:
                 row.future.set_exception(err)
                 self.failed += 1
                 SCHED_ROWS_TOTAL.inc(model=self._model, status="failed")
-            if row.owns_session:
-                self.engine.drop_session(row.session_id)
+            self._drop_row_sessions(row)
         # Zero the live gauges (ISSUE 4 satellite): the queue is drained
         # and no slot can ever be busy again — leaving the last-set
         # values would show phantom depth/occupancy on /metrics scrapes
@@ -244,6 +254,8 @@ class ContinuousBatcher:
             "failed": self.failed,
             "closed": self._stop,
             "qos": self._policy.snapshot(),
+            "speculative": (self.speculator.stats()
+                            if self.speculator is not None else None),
         }
 
     def progress(self) -> tuple[bool, int]:
@@ -272,8 +284,7 @@ class ContinuousBatcher:
                         f"deadline passed after "
                         f"{(now - row.t_submit) * 1000:.0f}ms in queue",
                         tenant=row.tenant, priority=row.priority))
-                if row.owns_session:
-                    self.engine.drop_session(row.session_id)
+                self._drop_row_sessions(row)
                 self.failed += 1
                 SCHED_ROWS_TOTAL.inc(model=self._model, status="failed")
                 from quoracle_tpu.infra.telemetry import QOS_SHED_TOTAL
@@ -318,8 +329,7 @@ class ContinuousBatcher:
                 row.future.set_exception(err)
                 self.failed += 1
                 SCHED_ROWS_TOTAL.inc(model=self._model, status="failed")
-            if row.owns_session:
-                self.engine.drop_session(row.session_id)
+            self._drop_row_sessions(row)
         self._live = []
         # gauge reset on the worker-exit path too (ISSUE 4 satellite):
         # whichever of close()/worker runs last, the scrape reads zero
@@ -343,15 +353,133 @@ class ContinuousBatcher:
             except Exception as e:        # noqa: BLE001 — per-row capture
                 if not row.future.done():
                     row.future.set_exception(e)
-                if row.owns_session:
-                    self.engine.drop_session(row.session_id)
+                self._drop_row_sessions(row)
                 self.failed += 1
                 SCHED_ROWS_TOTAL.inc(model=self._model, status="failed")
                 FLIGHT.record("sched_row_failed", model=self._model,
                               session=row.session_id, error=repr(e))
         return survivors
 
+    def _drop_row_sessions(self, row) -> None:
+        """Owned-session cleanup for a terminal row — the engine session
+        AND (under speculative serving) the draft engine's shadow session
+        the speculator keyed by the same id."""
+        if row.owns_session:
+            self.engine.drop_session(row.session_id)
+            if self.speculator is not None:
+                self.speculator.drop_session(row.session_id)
+
+    def _finish_row(self, row, finish_reason: str,
+                    json_state: int = -1) -> None:
+        """Resolve a finished row's future from its accumulated state and
+        account the retirement (shared by the vanilla and speculative
+        paths — one retire semantics, zero drift)."""
+        if not row.future.done():           # close() may have failed it
+            row.future.set_result(GenResult(
+                token_ids=list(row.emitted),
+                text=self.engine.tokenizer.decode(row.emitted),
+                n_prompt_tokens=len(row.prompt),
+                n_gen_tokens=len(row.emitted),
+                latency_s=time.monotonic() - row.t_submit,
+                finish_reason=finish_reason,
+                n_cached_tokens=row.n_cached_first or 0,
+                json_state=json_state,
+                spec_rounds=row.spec_rounds,
+                spec_drafted_tokens=row.spec_drafted,
+                spec_accepted_tokens=row.spec_accepted,
+            ))
+        self._drop_row_sessions(row)
+        self.retired += 1
+        SCHED_ROWS_TOTAL.inc(model=self._model, status="retired")
+        if self.slo is not None:
+            # per-class tail tracking (serving/slo.py): feeds the
+            # INTERACTIVE-burn → BATCH-demotion control loop
+            self.slo.observe(
+                row.priority,
+                (time.monotonic() - row.t_submit) * 1000)
+        FLIGHT.record("sched_retire", model=self._model,
+                      session=row.session_id,
+                      n_tokens=len(row.emitted),
+                      finish=finish_reason)
+
     def _step(self, rows: list) -> list:
+        """One decode tick. Under speculative serving (ISSUE 6) the tick
+        splits: eligible rows ride batched draft/verify rounds
+        (models/speculative.BatchedSpeculator) while ineligible rows —
+        nucleus-sampled, window-edge, or disengaged-member rows — decode
+        vanilla in the same tick. Both kinds retire through _finish_row;
+        temp-0 outputs are bit-identical either way."""
+        spec = self.speculator
+        spec_rows: list = []
+        spec_ids: set = set()
+        finishes: dict = {}
+        if spec is not None:
+            spec.tick_vanilla()         # re-probe countdown while off
+            for r in rows:
+                reason = spec.ineligible_reason(
+                    len(r.prompt) + len(r.emitted), r.temperature,
+                    r.top_p)
+                if reason is None:
+                    spec_rows.append(r)
+                    spec_ids.add(id(r))
+                else:
+                    spec.note_fallback(reason)
+            if spec_rows:
+                finishes, leftover = self._spec_step(spec_rows)
+                if leftover:            # speculator failed mid-tick:
+                    lids = set(map(id, leftover))   # decode those vanilla
+                    spec_rows = [r for r in spec_rows
+                                 if id(r) not in lids]
+                    spec_ids -= lids
+        plain = [r for r in rows if id(r) not in spec_ids]
+        still = self._plain_step(plain) if plain else []
+        for row in spec_rows:
+            fin = finishes.get(id(row))
+            finished = (fin == "stop"
+                        or len(row.emitted) >= row.max_new
+                        or (len(row.prompt) + len(row.emitted)
+                            >= self.engine.max_seq - 1))
+            if finished:
+                self._finish_row(
+                    row, "stop" if fin == "stop" else "length",
+                    json_state=(row.json_state
+                                if row.json_state is not None else -1))
+            else:
+                still.append(row)
+        return still
+
+    def _spec_step(self, rows: list) -> tuple[dict, list]:
+        """Speculative sub-tick: repeated draft/verify rounds until every
+        row has committed ~chunk tokens, finished, or become ineligible.
+        Returns ({id(row): "stop" | None}, leftover) where ``leftover``
+        rows hit a speculator error and must decode vanilla this tick —
+        their committed progress (rows + sessions mutate in place) is
+        already consistent, so the fallback is seamless."""
+        spec = self.speculator
+        finishes: dict = {}
+        active = list(rows)
+        baseline = {id(r): len(r.emitted) for r in rows}
+        try:
+            while active:
+                for rid, fin in spec.run_round(active).items():
+                    if fin is not None:
+                        finishes[rid] = fin
+                active = [
+                    r for r in active
+                    if finishes.get(id(r)) is None
+                    and len(r.emitted) < r.max_new
+                    and len(r.emitted) - baseline[id(r)] < self.chunk
+                    and spec.ineligible_reason(
+                        len(r.prompt) + len(r.emitted), r.temperature,
+                        r.top_p) is None]
+        except Exception as e:    # noqa: BLE001 — isolate, don't kill rows
+            spec.note_fallback("error", len(active))
+            FLIGHT.record("spec_error", model=self._model, error=repr(e))
+            leftover = [r for r in active if finishes.get(id(r)) is None]
+            return finishes, leftover
+        return finishes, []
+
+    def _plain_step(self, rows: list) -> list:
         prompts = [r.prompt + r.emitted for r in rows]
         budgets = [min(self.chunk, r.max_new - len(r.emitted))
                    for r in rows]
@@ -384,31 +512,7 @@ class ContinuousBatcher:
                         or (len(row.prompt) + len(row.emitted)
                             >= self.engine.max_seq - 1))
             if finished:
-                if not row.future.done():   # close() may have failed it
-                    row.future.set_result(GenResult(
-                        token_ids=list(row.emitted),
-                        text=self.engine.tokenizer.decode(row.emitted),
-                        n_prompt_tokens=len(row.prompt),
-                        n_gen_tokens=len(row.emitted),
-                        latency_s=time.monotonic() - row.t_submit,
-                        finish_reason=res.finish_reason,
-                        n_cached_tokens=row.n_cached_first,
-                        json_state=res.json_state,
-                    ))
-                if row.owns_session:
-                    self.engine.drop_session(row.session_id)
-                self.retired += 1
-                SCHED_ROWS_TOTAL.inc(model=self._model, status="retired")
-                if self.slo is not None:
-                    # per-class tail tracking (serving/slo.py): feeds the
-                    # INTERACTIVE-burn → BATCH-demotion control loop
-                    self.slo.observe(
-                        row.priority,
-                        (time.monotonic() - row.t_submit) * 1000)
-                FLIGHT.record("sched_retire", model=self._model,
-                              session=row.session_id,
-                              n_tokens=len(row.emitted),
-                              finish=res.finish_reason)
+                self._finish_row(row, res.finish_reason, res.json_state)
             else:
                 still.append(row)
         return still

@@ -64,6 +64,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from quoracle_tpu.infra.flightrec import FLIGHT
+from quoracle_tpu.infra.telemetry import (
+    SPEC_ACCEPTANCE, SPEC_ACCEPTED, SPEC_DRAFTED, SPEC_ENGAGED,
+    SPEC_FALLBACK_TOTAL, SPEC_K, SPEC_ROUNDS, SPEC_TOKENS_PER_ROUND,
+)
 from quoracle_tpu.models.config import ModelConfig
 from quoracle_tpu.models.generate import (
     grammar_mask, prefill, prefill_chunk,
@@ -536,3 +541,347 @@ class SpeculativeDecoder:
             accepted=accepted_total,
             n_cached_tokens=n_cached,
         )
+
+
+# ---------------------------------------------------------------------------
+# Batched speculation for the CONTINUOUS serving path (ISSUE 6 tentpole)
+# ---------------------------------------------------------------------------
+
+
+class BatchedSpeculator:
+    """Draft/verify decoding over the ContinuousBatcher's live slots.
+
+    Where :class:`SpeculativeDecoder` (v1) owns a private batch-1 dense
+    cache, this operates entirely on the two engines' PAGED SESSION
+    stores — the same KV the vanilla continuous path uses — so rows can
+    mix speculative and vanilla ticks freely and nothing is resident
+    twice:
+
+      propose   ``draft.generate`` over every eligible slot's context in
+                ONE batched call (greedy, grammar-masked — the draft's own
+                sessions track ctx, so each round forwards one suffix
+                token + K draft steps);
+      verify    ``target.verify_chunk`` — ONE teacher-forced chunk
+                forward per round across all rows against the target's
+                paged session KV, returning per-position grammar-masked
+                argmax (greedy rows) and masked softmax probs (sampled
+                rows);
+      commit    host-side accept/rollback per row. Rollback is FREE: both
+                engines resume sessions by longest-common-prefix, so a
+                rejected draft's stale KV is simply overwritten by the
+                next round's suffix prefill.
+
+    Acceptance math: greedy rows accept d_i iff d_i == argmax(p_i) —
+    temp-0 output is bit-identical to vanilla decode. Sampled rows
+    (top_p == 1 only) draft GREEDILY, i.e. a deterministic one-hot
+    proposal distribution: accept d_i with prob p_i[d_i], else draw the
+    correction from p_i with d_i's mass removed, renormalized — the
+    standard rejection-sampling construction with q = δ(d_i), which
+    preserves the target distribution exactly without shipping draft
+    probs to the host.
+
+    ADAPTIVE K (per member): a rolling EWMA of per-round acceptance
+    shrinks K toward ``k_min`` when acceptance sags below
+    ``shrink_below``, grows it back toward ``k_max`` above
+    ``grow_above``, and DISENGAGES to vanilla decode entirely below
+    ``accept_floor`` — after ``reprobe_after`` vanilla ticks the member
+    re-probes at ``k_min``. All transitions are flight-recorded and the
+    current state exports as quoracle_spec_* gauges.
+
+    Not thread-safe for ``run_round`` (the batcher's single worker thread
+    owns it); ``stats()``/eligibility reads are lock-guarded snapshots.
+    """
+
+    def __init__(self, target_engine, draft_engine, *, k: int = 6,
+                 k_min: int = 2, k_max: int = 8,
+                 accept_floor: float = 0.35, shrink_below: float = 0.6,
+                 grow_above: float = 0.85, ewma_alpha: float = 0.15,
+                 reprobe_after: int = 24, seed: int = 0):
+        assert target_engine.cfg.vocab_size == draft_engine.cfg.vocab_size, \
+            "draft and target must share one tokenizer/vocab"
+        assert target_engine.cfg.sliding_window is None \
+            and draft_engine.cfg.sliding_window is None, \
+            "speculative serving requires full attention (no sliding window)"
+        self.target = target_engine
+        self.draft = draft_engine
+        self.model = target_engine.cfg.name
+        self.k_init = max(1, int(k))
+        self.k_min = max(1, min(int(k_min), self.k_init))
+        self.k_max = max(self.k_init, int(k_max))
+        self.accept_floor = float(accept_floor)
+        self.shrink_below = float(shrink_below)
+        self.grow_above = float(grow_above)
+        self.ewma_alpha = float(ewma_alpha)
+        self.reprobe_after = int(reprobe_after)
+        self._rng_np = np.random.default_rng(seed)
+        self._lock = threading.Lock()
+        self._k = self.k_init
+        self._engaged = True
+        self._ewma: Optional[float] = None
+        self._vanilla_ticks = 0            # ticks since disengage
+        self._rounds_since_probe = 0       # evidence behind the EWMA
+        self._stops = {target_engine.cfg.eos_token_id,
+                       *target_engine.cfg.stop_token_ids}
+        # cumulative counters (stats() snapshot)
+        self.rounds = 0
+        self.drafted = 0
+        self.accepted = 0
+        self.emitted = 0
+        self.disengages = 0
+        self.reprobes = 0
+        self.fallbacks: dict = {}
+        self._tables: dict = {}            # enum key -> (np table, start)
+        SPEC_K.set(self._k, model=self.model)
+        SPEC_ENGAGED.set(1.0, model=self.model)
+
+    # -- eligibility ----------------------------------------------------
+
+    @property
+    def k(self) -> int:
+        return self._k
+
+    @property
+    def engaged(self) -> bool:
+        return self._engaged
+
+    def ineligible_reason(self, ctx_len: int, temperature: float,
+                          top_p: float) -> Optional[str]:
+        """None when a row with this shape may speculate this tick;
+        otherwise the fallback reason (exported per-tick by the
+        scheduler via note_fallback)."""
+        if not self._engaged:
+            return "disengaged"
+        if temperature > 0 and top_p < 1.0:
+            # the acceptance test needs the ACTUAL proposal/target
+            # distributions; the nucleus mask is not applied to either
+            return "sampling"
+        if (ctx_len + self._k + 1 >= self.target.max_seq
+                or ctx_len + self._k + 1 >= self.draft.max_seq):
+            # overflow-near-window: the verify prompt (ctx + K - 1) and
+            # the draft's decode slack must both fit — rows this close to
+            # the window decode vanilla and retire at the edge
+            return "window"
+        return None
+
+    def note_fallback(self, reason: str, n: int = 1) -> None:
+        with self._lock:
+            self.fallbacks[reason] = self.fallbacks.get(reason, 0) + n
+        SPEC_FALLBACK_TOTAL.inc(n, model=self.model, reason=reason)
+
+    def tick_vanilla(self) -> None:
+        """Count one disengaged tick; re-probe after ``reprobe_after``."""
+        with self._lock:
+            if self._engaged:
+                return
+            self._vanilla_ticks += 1
+            if self._vanilla_ticks < self.reprobe_after:
+                return
+            self._engaged = True
+            self._k = self.k_min
+            self._ewma = None              # fresh measurement window
+            self._rounds_since_probe = 0
+            self.reprobes += 1
+        SPEC_ENGAGED.set(1.0, model=self.model)
+        SPEC_K.set(self._k, model=self.model)
+        FLIGHT.record("spec_reprobe", model=self.model, k=self.k_min)
+
+    def drop_session(self, session_id: str) -> None:
+        """Release the DRAFT engine's session for a retired row (the
+        target session is dropped by the scheduler/engine as usual)."""
+        self.draft.drop_session(session_id)
+
+    # -- the round ------------------------------------------------------
+
+    def _host_table(self, action_enum) -> tuple:
+        """(np transition table, start_state) for host-side grammar
+        walks, sourced from the TARGET engine's own table cache so the
+        mask/table can never drift from what the device applied."""
+        key = tuple(sorted(set(action_enum))) if action_enum else None
+        hit = self._tables.get(key)
+        if hit is None:
+            self.target._json_table_device((key,))     # ensure built
+            tt = self.target._json_cache[("one", key)]
+            for old in list(self._tables)[:max(0, len(self._tables) - 7)]:
+                del self._tables[old]                   # keep newest 7 +1
+            hit = self._tables[key] = (tt.table, tt.start_state)
+        return hit
+
+    def run_round(self, rows) -> dict:
+        """One draft/verify round over ``rows`` (scheduler _Row-likes:
+        .prompt/.emitted/.temperature/.top_p/.max_new/.session_id/
+        .constrain/.action_enum/.json_state/.spec_* fields). Mutates each
+        row's emitted/json_state/spec counters in place and returns
+        {id(row): "stop" | None} — "stop" rows hit a stop token and must
+        retire. Raises on engine failure (the scheduler falls back to
+        vanilla for the tick)."""
+        K = self._k
+        eos = self.draft.cfg.eos_token_id
+        ctxs = [list(r.prompt) + list(r.emitted) for r in rows]
+        k_req = [max(1, min(K, r.max_new - len(r.emitted))) for r in rows]
+        drafts = self.draft.generate(
+            ctxs, temperature=0.0, top_p=1.0, max_new_tokens=k_req,
+            session_ids=[r.session_id for r in rows],
+            constrain_json=[bool(r.constrain) for r in rows],
+            action_enums=[r.action_enum for r in rows],
+            initial_json_state=[r.json_state for r in rows])
+        proposals = []
+        for g, kq in zip(drafts, k_req):
+            p = list(g.token_ids)
+            if g.finish_reason == "stop" and len(p) < kq:
+                # the engine pops the terminal stop id; re-propose A stop
+                # (eos) — if the target wants a different stop id the
+                # verify correction supplies it
+                p.append(eos)
+            proposals.append(p or [eos])
+        need_probs = any(r.temperature > 0 for r in rows)
+        vres = self.target.verify_chunk(
+            [c + p[:-1] for c, p in zip(ctxs, proposals)],
+            [r.session_id for r in rows],
+            [len(p) for p in proposals],
+            temperature=[r.temperature for r in rows],
+            constrain_json=[bool(r.constrain) for r in rows],
+            action_enums=[r.action_enum for r in rows],
+            initial_json_state=[r.json_state for r in rows],
+            need_probs=need_probs)
+
+        finishes: dict = {}
+        drafted = accepted = committed_total = 0
+        for r, props, v in zip(rows, proposals, vres):
+            ids, probs = v["ids"], v["probs"]
+            if r.n_cached_first is None:
+                r.n_cached_first = v["n_cached"]
+            j = 0
+            correction: Optional[int] = None
+            greedy = r.temperature <= 0
+            for t, d in enumerate(props):
+                if greedy:
+                    ok = d == ids[t]
+                else:
+                    # one-hot proposal: accept with prob p_t[d]
+                    ok = self._rng_np.random() < float(probs[t, d])
+                if not ok:
+                    if greedy:
+                        correction = int(ids[t])
+                    else:
+                        resid = np.asarray(probs[t], np.float64).copy()
+                        resid[d] = 0.0
+                        z = resid.sum()
+                        correction = (int(ids[t]) if z <= 0 else
+                                      int(self._rng_np.choice(
+                                          resid.shape[0], p=resid / z)))
+                    break
+                j += 1
+            drafted += len(props)
+            accepted += j
+            new_tokens = props[:j]
+            if correction is not None:
+                new_tokens = new_tokens + [correction]
+            # stop/budget cut — v1 commit semantics: the budget cut
+            # applies FIRST, so a stop landing past max_new reports
+            # "length" exactly as vanilla row_limit would
+            cut = len(new_tokens)
+            stop_at = None
+            for idx, t in enumerate(new_tokens):
+                if t in self._stops:
+                    stop_at = idx
+                    cut = idx + 1
+                    break
+            room = r.max_new - len(r.emitted)
+            cut = min(cut, room)
+            finish = None
+            if stop_at is not None and stop_at < cut:
+                finish = "stop"
+            out_tokens = new_tokens[:cut]
+            if finish == "stop":
+                out_tokens = out_tokens[:-1]   # engine parity: stop popped
+            r.emitted.extend(out_tokens)
+            committed_total += len(out_tokens)
+            r.spec_rounds += 1
+            r.spec_drafted += len(props)
+            r.spec_accepted += j
+            if r.constrain and out_tokens:
+                table, start = self._host_table(r.action_enum)
+                s = r.json_state if (r.json_state is not None
+                                     and r.json_state >= 0) else start
+                for t in out_tokens:
+                    if s >= 0:
+                        s = int(table[s, t])
+                r.json_state = s
+            finishes[id(r)] = finish
+
+        with self._lock:
+            self.rounds += 1
+            self.drafted += drafted
+            self.accepted += accepted
+            self.emitted += committed_total
+            rate = accepted / max(1, drafted)
+            self._ewma = (rate if self._ewma is None else
+                          self.ewma_alpha * rate
+                          + (1 - self.ewma_alpha) * self._ewma)
+            self._rounds_since_probe += 1
+            changed = self._adapt_locked()
+        SPEC_ROUNDS.inc(model=self.model)
+        SPEC_DRAFTED.inc(drafted, model=self.model)
+        SPEC_ACCEPTED.inc(accepted, model=self.model)
+        SPEC_ACCEPTANCE.observe(rate, model=self.model)
+        SPEC_TOKENS_PER_ROUND.observe(committed_total / max(1, len(rows)),
+                                      model=self.model)
+        if changed:
+            SPEC_K.set(self._k, model=self.model)
+            SPEC_ENGAGED.set(1.0 if self._engaged else 0.0,
+                             model=self.model)
+        return finishes
+
+    def _adapt_locked(self) -> bool:
+        """Adaptive-K state machine (caller holds the lock). Returns True
+        when K or engagement changed."""
+        ewma = self._ewma
+        if ewma is None:
+            return False
+        if ewma < self.accept_floor and self._rounds_since_probe >= 3:
+            # acceptance collapse — speculation now COSTS latency (every
+            # round pays draft + verify for ~1 token). Disengage; the
+            # scheduler's vanilla ticks count toward the re-probe.
+            self._engaged = False
+            self._vanilla_ticks = 0
+            self._ewma = None
+            self.disengages += 1
+            k_was, self._k = self._k, self.k_init
+            FLIGHT.record("spec_disengage", model=self.model,
+                          ewma=round(ewma, 3), k=k_was)
+            return True
+        if ewma < self.shrink_below and self._k > self.k_min:
+            self._k -= 1
+            return True
+        if ewma > self.grow_above and self._k < self.k_max:
+            self._k += 1
+            return True
+        return False
+
+    # -- observability --------------------------------------------------
+
+    def stats(self) -> dict:
+        """Point-in-time snapshot for /api/models + the scorecards."""
+        with self._lock:
+            return {
+                "mode": "continuous",
+                "draft": self.draft.cfg.name,
+                "engaged": self._engaged,
+                "k": self._k,
+                "k_init": self.k_init,
+                "acceptance_ewma": (round(self._ewma, 4)
+                                    if self._ewma is not None else None),
+                "rounds": self.rounds,
+                "drafted_tokens": self.drafted,
+                "accepted_tokens": self.accepted,
+                "emitted_tokens": self.emitted,
+                "acceptance_rate": (round(self.accepted
+                                          / max(1, self.drafted), 4)
+                                    if self.drafted else None),
+                "tokens_per_round": (round(self.emitted
+                                           / max(1, self.rounds), 2)
+                                     if self.rounds else None),
+                "disengages": self.disengages,
+                "reprobes": self.reprobes,
+                "fallbacks": dict(self.fallbacks),
+            }

@@ -198,8 +198,12 @@ class RuntimeConfig:
     # Speculative serving (models/speculative.py): {target_spec:
     # draft_spec} — eligible member queries draft-K/verify-one-chunk;
     # drafts load like members but never serve directly. Also settable
-    # via the DB setting "draft_map" (dashboard /api/settings).
+    # via the DB setting "draft_map" (dashboard /api/settings). Under
+    # ``continuous`` the drafted members speculate INSIDE the shared
+    # decode loop (BatchedSpeculator, ISSUE 6) with ``draft_k`` as the
+    # initial adaptive draft length.
     draft_map: Optional[dict] = None
+    draft_k: int = 6
     # Multi-host: join the JAX distributed system before building the
     # backend (parallel/distributed.init_process). On TPU pods the three
     # values are usually auto-detected — set coordinator_address (and
@@ -372,7 +376,7 @@ class Runtime:
         if isinstance(qos, dict):
             from quoracle_tpu.serving.qos import QoSConfig
             qos = QoSConfig(**qos)
-        return TPUBackend(pool, seed=config.seed,
+        return TPUBackend(pool, seed=config.seed, draft_k=config.draft_k,
                           embed_model=config.embed_model,
                           submeshes=submeshes,
                           draft_map=draft_map or None,

@@ -34,6 +34,95 @@ import sys
 import time
 
 
+def run_check(args) -> dict:
+    """``--check`` smoke mode (ISSUE 6 satellite): a self-contained,
+    minutes-scale assertion that the draft-training pipeline still
+    produces a USABLE draft — tiny target and tiny draft are both
+    trained briefly on the same format corpus (no finetune prereq, no
+    export), then speculative acceptance is measured on HELD-OUT format
+    prompts and asserted above ``--check-floor``, with greedy
+    bit-equality against vanilla engine decode as the correctness gate.
+    Runs in tier-1 (tests/test_train_draft_check.py), so a regression in
+    the corpus builder, the trainer, or the speculative decoder surfaces
+    before a live bench round burns chip time on it."""
+    import random
+    import tempfile
+
+    import jax
+
+    from quoracle_tpu.models.generate import GenerateEngine
+    from quoracle_tpu.models.make_checkpoint import make_checkpoint
+    from quoracle_tpu.models.speculative import SpeculativeDecoder
+    from quoracle_tpu.models.tokenizer import HFAutoTokenizer
+    from quoracle_tpu.tools.finetune import (
+        SYSTEM, _format_sample, build_format_corpus, train,
+    )
+
+    def log(msg):
+        print(msg, file=sys.stderr, flush=True)
+
+    work = args.workdir or tempfile.mkdtemp(prefix="draft-check-")
+    # tiny scale for BOTH: the check gates the PIPELINE (corpus →
+    # trainer → acceptance), not model quality; deterministic BPE means
+    # the two checkpoints share token ids (asserted below)
+    t_dir = make_checkpoint(os.path.join(work, "target"), family="llama",
+                            scale="tiny", seed=args.seed)
+    d_dir = make_checkpoint(os.path.join(work, "draft"), family="llama",
+                            scale="tiny", seed=args.seed + 7)
+    a = os.path.join(t_dir, "tokenizer.json")
+    b = os.path.join(d_dir, "tokenizer.json")
+    if not filecmp.cmp(a, b, shallow=False):
+        shutil.copy(a, b)
+    tok = HFAutoTokenizer(t_dir)
+
+    rows = build_format_corpus(tok, tok.eos_id, args.corpus_size,
+                               args.seed, args.seq)
+    log(f"check corpus: {len(rows)} rows; {args.steps} steps each")
+    tcfg, tstate = train(t_dir, rows, args.steps, args.batch, args.seq,
+                         args.lr, args.seed, log)
+    dcfg, dstate = train(d_dir, rows, args.steps, args.batch, args.seq,
+                         args.lr, args.seed + 1, log)
+
+    eng = GenerateEngine(tcfg, tstate.params, tok, max_seq=512,
+                         prompt_buckets=(64, 128, 256))
+    dec = SpeculativeDecoder(tcfg, tstate.params, dcfg, dstate.params,
+                             tok, k=args.k, max_seq=512)
+    rng = random.Random(args.seed + 1)       # disjoint: held-out tasks
+    acc, equal = [], 0
+    for i in range(args.n_eval):
+        task, _ = _format_sample(rng)
+        prompt = tok.encode_chat([
+            {"role": "system", "content": SYSTEM},
+            {"role": "user", "content": task}])
+        want = eng.generate([prompt], temperature=0.0,
+                            max_new_tokens=args.max_new)[0]
+        got = dec.generate(prompt, temperature=0.0,
+                           max_new_tokens=args.max_new)
+        acc.append(got.acceptance_rate)
+        equal += int(got.token_ids == want.token_ids)
+        log(f"check task {i}: accept {got.accepted}/{got.drafted} "
+            f"equal={got.token_ids == want.token_ids}")
+    acceptance = statistics.median(acc)
+    payload = {
+        "metric": "speculative_draft_check",
+        "value": round(acceptance, 4),
+        "unit": "acceptance_rate",
+        "floor": args.check_floor,
+        "k": args.k,
+        "steps": args.steps,
+        "greedy_equal": f"{equal}/{args.n_eval}",
+        "ok": bool(acceptance >= args.check_floor
+                   and equal == args.n_eval),
+    }
+    print(json.dumps(payload))
+    assert equal == args.n_eval, \
+        f"greedy speculation diverged from vanilla: {equal}/{args.n_eval}"
+    assert acceptance >= args.check_floor, (
+        f"draft acceptance {acceptance:.3f} below floor "
+        f"{args.check_floor} — the draft-training pipeline regressed")
+    return payload
+
+
 def main() -> None:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--steps", type=int, default=400)
@@ -54,7 +143,35 @@ def main() -> None:
     ap.add_argument("--skip-train", action="store_true",
                     help="reuse an existing draft-tuned checkpoint and "
                          "only run the acceptance measurement")
+    ap.add_argument("--check", action="store_true",
+                    help="smoke mode: train a tiny target + tiny draft "
+                         "for a few steps on the format corpus and "
+                         "assert held-out acceptance above --check-floor "
+                         "(self-contained; no finetune prereq; tier-1)")
+    ap.add_argument("--check-floor", type=float, default=0.2)
     args = ap.parse_args()
+
+    if args.check:
+        # check-mode defaults: small enough for a tier-1 CPU run unless
+        # the caller overrode them explicitly
+        if args.steps == 400:
+            args.steps = 30
+        if args.corpus_size == 2000:
+            args.corpus_size = 300
+        if args.seq == 256:
+            args.seq = 192    # system prompt + task + JSON must fit
+        if args.n_eval == 12:
+            args.n_eval = 4
+        if args.max_new == 96:
+            args.max_new = 48
+        if args.k == 6:
+            args.k = 4
+        from quoracle_tpu.utils.compile_cache import (
+            enable_compilation_cache,
+        )
+        enable_compilation_cache()
+        run_check(args)
+        return
 
     def log(msg):
         print(msg, file=sys.stderr, flush=True)

@@ -363,6 +363,13 @@ class DashboardServer:
         from quoracle_tpu.consensus.quality import QUALITY
         payload = QUALITY.scorecards()
         payload["pool"] = self.runtime.default_pool()
+        # speculative serving (ISSUE 6): per-member acceptance /
+        # tokens-per-round / adaptive-K / fallback scorecard — the
+        # serving-side half of the member picture
+        backend = self.runtime.backend
+        payload["speculative"] = (backend.spec_stats()
+                                  if hasattr(backend, "spec_stats")
+                                  else {"enabled": False})
         return payload
 
     def consensus_payload(self, task_id: Optional[str]) -> dict:

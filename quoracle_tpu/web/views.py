@@ -417,6 +417,38 @@ def quality_panel(quality: dict) -> str:
     return "".join(parts)
 
 
+def spec_panel(spec: dict) -> str:
+    """Speculative-serving panel (ISSUE 6): per-member draft pairing,
+    rolling acceptance / tokens-per-round, the adaptive-K state, and
+    fallback attribution — the /api/models ``speculative`` block as a
+    table. Renders nothing while no member has a draft."""
+    members = (spec or {}).get("members") or {}
+    if not (spec or {}).get("enabled") or not members:
+        return ""
+    parts = ["<h2 class=\"meta\">speculative serving</h2>"]
+    rows = []
+    for model, s in sorted(members.items()):
+        falls = ", ".join(f"{k}:{n}"
+                          for k, n in sorted((s.get("fallbacks") or {})
+                                             .items())) or "—"
+        state = ("engaged" if s.get("engaged")
+                 else "batch1" if s.get("mode") == "batch1" else "OFF")
+        rows.append(
+            f"<tr class=\"spec-row\" data-model=\"{_e(model)}\">"
+            f"<td>{_e(model)}</td><td>{_e(s.get('draft'))}</td>"
+            f"<td>{_e(state)}</td><td>{_e(s.get('k'))}</td>"
+            f"<td>{_rate(s.get('acceptance_rate'))}</td>"
+            f"<td>{_e(s.get('tokens_per_round') or '—')}</td>"
+            f"<td>{_e(s.get('rounds') or 0)}</td>"
+            f"<td>{_e(falls)}</td></tr>")
+    parts.append(
+        "<table id=\"speculative\"><tr><th>model</th><th>draft</th>"
+        "<th>state</th><th>K</th><th>accept</th><th>tok/round</th>"
+        "<th>rounds</th><th>fallbacks</th></tr>" + "".join(rows)
+        + "</table>")
+    return "".join(parts)
+
+
 def telemetry_page(metrics: dict, resources: Optional[dict] = None,
                    qos: Optional[dict] = None,
                    quality: Optional[dict] = None) -> str:
@@ -441,6 +473,7 @@ def telemetry_page(metrics: dict, resources: Optional[dict] = None,
             + resources_panel(resources or {})
             + qos_panel(qos or {})
             + quality_panel(quality or {})
+            + spec_panel((quality or {}).get("speculative") or {})
             + (table("runtime", flat) if flat else "")
             + "".join(sections))
     return _page("telemetry", body, refresh=10)

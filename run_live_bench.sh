@@ -6,57 +6,63 @@
 # mid-calibration the round lost its primary bench record entirely; the
 # header claimed "commit immediately" but the script never committed.)
 cd /root/repo
-LOG=RELAY_POLL_r08.log
+LOG=RELAY_POLL_r09.log
 echo "$(date -u +%FT%TZ) direct run: device confirmed live (probe ok)" >> "$LOG"
 
 # Primary record first. If a previous run left calibration gates behind,
 # use them; their absence just means the paged direct paths stay off.
-# The artifact carries config 9 (consensus round/decide p50/p95 from the
-# infra/telemetry.py histograms), config 10 (resource observability,
-# ISSUE 3: HBM headroom, compile hit-rate, queue-depth p95 under a
-# sustained continuous-batching load), config 11 (serving QoS, ISSUE 4:
-# INTERACTIVE p95 under 4x overload with QoS on/off, shed rate and
-# structured-reject accounting), and config 12 (consensus quality,
-# ISSUE 5: decide p50/p95 with the scorecard/audit layer on vs off, and
-# the emitted vote entropy / winner margin for the temp-0 pool); config
-# 10's sample timeline lands in the sidecar RESOURCES_r08_live.json and
-# config 12's audit records + scorecards in QUALITY_r08_live.json, both
-# committed with the bench record.
+# The artifact carries configs 9-12 (telemetry / resources / QoS /
+# quality, see r08) plus the ISSUE 6 speculative rows: config 7 now adds
+# the realized trained-draft projection (ceiling x the SPECULATIVE
+# artifact's measured acceptance, greedy-equal asserted) and config 13
+# measures the continuous+QoS serving path with speculation on vs off
+# (decode ms/token, tokens/round, acceptance p50, fallback counts,
+# temp-0 on/off bit-equality). Config 13's per-row detail lands in the
+# SPEC_r09_live.json sidecar, committed with the bench record alongside
+# the RESOURCES/QUALITY sidecars.
 [ -f /root/repo/calib_v5e.json ] && export QUORACLE_PAGED_CALIB=/root/repo/calib_v5e.json
-export QUORACLE_BENCH_RESOURCES=/root/repo/RESOURCES_r08_live.json
-export QUORACLE_BENCH_QUALITY=/root/repo/QUALITY_r08_live.json
-timeout 5400 python bench.py > /root/repo/BENCH_r08_live.json 2>> "$LOG"
+export QUORACLE_BENCH_RESOURCES=/root/repo/RESOURCES_r09_live.json
+export QUORACLE_BENCH_QUALITY=/root/repo/QUALITY_r09_live.json
+export QUORACLE_BENCH_SPEC=/root/repo/SPEC_r09_live.json
+timeout 5400 python bench.py > /root/repo/BENCH_r09_live.json 2>> "$LOG"
 rc=$?
-echo "$(date -u +%FT%TZ) bench rc=$rc artifact=BENCH_r08_live.json" >> "$LOG"
+echo "$(date -u +%FT%TZ) bench rc=$rc artifact=BENCH_r09_live.json" >> "$LOG"
 if [ "$rc" -eq 0 ] && python - <<'EOF'
 import json
-d = json.load(open("/root/repo/BENCH_r08_live.json"))
+d = json.load(open("/root/repo/BENCH_r09_live.json"))
 ok = (not d.get("device_unavailable")) and d.get("value")
 raise SystemExit(0 if ok else 1)
 EOF
 then
     echo "$(date -u +%FT%TZ) BENCH SUCCESS — committing the record" >> "$LOG"
-    git add BENCH_r08_live.json RESOURCES_r08_live.json \
-        QUALITY_r08_live.json "$LOG" 2>/dev/null
+    git add BENCH_r09_live.json RESOURCES_r09_live.json \
+        QUALITY_r09_live.json SPEC_r09_live.json "$LOG" 2>/dev/null
     git -c user.name=distsys-graft -c user.email=graft@localhost \
-        commit -m "Chip-verified BENCH_r08_live artifact (direct run)" >> "$LOG" 2>&1 \
+        commit -m "Chip-verified BENCH_r09_live artifact (direct run)" >> "$LOG" 2>&1 \
         || echo "$(date -u +%FT%TZ) commit failed (artifact still on disk)" >> "$LOG"
 else
     echo "$(date -u +%FT%TZ) bench artifact not clean; bonus captures may still run" >> "$LOG"
 fi
 
 # Bonus captures — the primary record is already safe (or already failed
-# on its own terms); a relay death here can no longer erase it.
+# on its own terms); a relay death here can no longer erase it. The
+# draft-training smoke (tools/train_draft.py --check) runs first: it is
+# minutes-scale and guards the SPECULATIVE acceptance floor config 7's
+# realized row depends on.
+timeout 900 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
+    python -m quoracle_tpu.tools.train_draft --check \
+    > /root/repo/SPEC_CHECK_r09.json 2>> "$LOG" \
+    && echo "$(date -u +%FT%TZ) draft check passed" >> "$LOG" \
+    || echo "$(date -u +%FT%TZ) draft check FAILED (bench record already safe)" >> "$LOG"
 timeout 2400 python -m quoracle_tpu.tools.calibrate_paged \
     --out /root/repo/calib_v5e.json >> "$LOG" 2>&1 \
     && echo "$(date -u +%FT%TZ) calibration written" >> "$LOG" \
     || echo "$(date -u +%FT%TZ) calibration FAILED (bench record already safe)" >> "$LOG"
 timeout 1800 python -m quoracle_tpu.tools.bench_longctx \
     --resident 16384 --rounds 3 \
-    > /root/repo/LONGCTX_r08.json 2>> "$LOG" \
-    && echo "$(date -u +%FT%TZ) longctx captured" >> "$LOG" \
+    > /root/repo/LONGCTX_r09.json 2>> "$LOG" \
     || echo "$(date -u +%FT%TZ) longctx FAILED (bench record already safe)" >> "$LOG"
-git add calib_v5e.json LONGCTX_r08.json "$LOG" 2>/dev/null
+git add calib_v5e.json LONGCTX_r09.json SPEC_CHECK_r09.json "$LOG" 2>/dev/null
 git -c user.name=distsys-graft -c user.email=graft@localhost \
-    commit -m "Post-bench chip captures: paged-gate calibration + long-context sweep" >> "$LOG" 2>&1 \
+    commit -m "Post-bench chip captures: draft check + paged-gate calibration + long-context sweep" >> "$LOG" 2>&1 \
     || true
