@@ -875,6 +875,165 @@ def measure_spec_continuous(backend, pool, n_rows: int = 6) -> dict:
     return result
 
 
+def measure_kv_tiering(backend, pool, n_sessions: int = 6) -> dict:
+    """Config 14: tiered KV — session hibernation vs destruction
+    (ISSUE 7, serving/kvtier.py).
+
+    ``n_sessions`` independent temp-0 conversations on one member, two
+    rounds each, with a forced full eviction between rounds. Phase OFF
+    (no tier): eviction destroys the sessions and round 2 pays a COLD
+    RE-PREFILL of each whole conversation. Phase ON (tier attached):
+    the same eviction DEMOTES to the host page store and round 2
+    restores by page-in. Prefix sharing is disabled for the config so
+    each session's cost is isolated (no cross-session adoption blurring
+    the cold baseline).
+
+    Reported: restore-latency p95 (quoracle_kv_restore_ms count deltas)
+    vs the cold re-prefill p95 (per-call prefill fence), demote/restore
+    counts, resident-session capacity at fixed HBM with tiering on vs
+    off, and the acceptance gate — round-2 temp-0 outputs must be
+    BIT-IDENTICAL on vs off (the same equality bar every serving layer
+    holds)."""
+    from quoracle_tpu.infra.telemetry import KV_RESTORE_MS, quantile
+    from quoracle_tpu.models.tokenizer import get_tokenizer
+
+    member = pool[0]
+    eng = backend.engines[member]
+    tok = get_tokenizer(member)
+    st = eng.sessions
+    prompts = [
+        tok.encode(f"{SYSTEM_PROMPT} [agent {i}] "
+                   f"{TASKS[i % len(TASKS)]}", add_bos=True)
+        for i in range(n_sessions)]
+    round_new = min(MAX_NEW, 64)
+
+    def force_evict():
+        # demand every usable page with nothing protected: the ladder
+        # evicts (OFF) or demotes (ON) every resident session
+        with eng._paged_lock:
+            with st.lock:
+                got = st.alloc(st.n_pages - 1)
+                if got:
+                    st._release(got)
+
+    def run_phase(tier) -> dict:
+        tag = "on" if tier is not None else "off"
+        sids = [f"kv14{tag}-{i}" for i in range(n_sessions)]
+        r1 = []
+        for p, sid in zip(prompts, sids):
+            r1.append(eng.generate([p], temperature=0.0,
+                                   max_new_tokens=round_new,
+                                   session_ids=[sid])[0])
+        force_evict()
+        before, _, _ = KV_RESTORE_MS.counts(model=eng.cfg.name,
+                                            kind="session")
+        texts, prefill_ms, cached = [], [], []
+        for p, sid, g in zip(prompts, sids, r1):
+            p2 = p + g.token_ids + tok.encode(" Continue.")
+            g2 = eng.generate([p2], temperature=0.0,
+                              max_new_tokens=round_new,
+                              session_ids=[sid])[0]
+            texts.append(g2.text)
+            prefill_ms.append(eng.last_prefill_s * 1000)
+            cached.append(g2.n_cached_tokens)
+        after, _, _ = KV_RESTORE_MS.counts(model=eng.cfg.name,
+                                           kind="session")
+        delta = [a - b for a, b in zip(after, before)]
+        for sid in sids:
+            eng.drop_session(sid)
+        return {
+            "texts": texts,
+            "round2_cached_tokens": cached,
+            "cold_prefill_ms": [round(v, 2) for v in prefill_ms],
+            "restore_p95_ms": (
+                round(quantile(KV_RESTORE_MS.buckets, delta, 0.95), 3)
+                if sum(delta) else None),
+            "restores_in_window": sum(delta),
+        }
+
+    def p95(vals):
+        s = sorted(vals)
+        return round(s[max(0, int(len(s) * 0.95) - 1)], 2) if s else None
+
+    import numpy as _np
+    pages_per_session = max(
+        1, -(-max(len(p) + 2 * round_new for p in prompts) // st.page))
+    page_bytes = (2 * eng.cfg.n_layers * eng.cfg.n_kv_heads
+                  * eng.cfg.head_dim
+                  * _np.dtype(eng.cache_dtype).itemsize * st.page)
+    session_mb = pages_per_session * page_bytes / (1 << 20)
+
+    sharing = eng.prefix_sharing
+    eng.prefix_sharing = False
+    try:
+        off = run_phase(None)
+        # size the host tier to hold every hibernated session twice over
+        tier = eng.attach_tier(
+            host_mb=max(64, int(2 * n_sessions * session_mb) + 1))
+        try:
+            # warmup: one full hibernate→restore cycle pays the page-in
+            # scatter compile OUTSIDE the measured window (same shape as
+            # the measured sessions), mirroring the prefill/decode
+            # warmups every other config gets
+            wsid = "kv14-warm"
+            wg = eng.generate([prompts[0]], temperature=0.0,
+                              max_new_tokens=round_new,
+                              session_ids=[wsid])[0]
+            force_evict()
+            eng.generate([prompts[0] + wg.token_ids
+                          + tok.encode(" Continue.")],
+                         temperature=0.0, max_new_tokens=round_new,
+                         session_ids=[wsid])
+            eng.drop_session(wsid)
+            warm_stats = tier.stats()
+            on = run_phase(tier)
+            tier_stats = tier.stats()
+            tier_stats["demoted_sessions"] -= \
+                warm_stats["demoted_sessions"]
+            tier_stats["restored_sessions"] -= \
+                warm_stats["restored_sessions"]
+        finally:
+            st.tier = None            # detach: later configs untiered
+    finally:
+        eng.prefix_sharing = sharing
+
+    equal = on["texts"] == off["texts"]
+    hbm_capacity = (st.n_pages - 1) // pages_per_session
+    host_capacity = int(tier_stats["host"]["budget_bytes"]
+                        // (pages_per_session * page_bytes))
+    cold_p95 = p95(off["cold_prefill_ms"])
+    result = {
+        "n_sessions": n_sessions,
+        "round_new_tokens": round_new,
+        # round 2 with tiering OFF re-prefilled from scratch; ON resumed
+        # from restored pages — the cached-token telemetry proves which
+        # path each phase took
+        "round2_cached_tokens_off": off["round2_cached_tokens"],
+        "round2_cached_tokens_on": on["round2_cached_tokens"],
+        "cold_prefill_p95_ms": cold_p95,
+        "restore_p95_ms": on["restore_p95_ms"],
+        "restore_vs_cold_speedup": (
+            round(cold_p95 / on["restore_p95_ms"], 3)
+            if cold_p95 and on["restore_p95_ms"] else None),
+        "demotes": tier_stats["demoted_sessions"],
+        "restores": tier_stats["restored_sessions"],
+        "restore_failures": tier_stats["restore_failures"],
+        # resident-session capacity at fixed HBM: without tiering the
+        # pool bounds it; with tiering hibernated sessions extend it by
+        # the host budget
+        "pages_per_session": pages_per_session,
+        "hbm_session_capacity": hbm_capacity,
+        "tiered_session_capacity": hbm_capacity + host_capacity,
+        "temp0_equal": equal,
+    }
+    assert equal, "config14: temp-0 outputs diverged with tiering on"
+    assert tier_stats["demoted_sessions"] >= n_sessions, \
+        "config14: forced eviction did not demote the sessions"
+    assert all(c > 0 for c in on["round2_cached_tokens"]), \
+        "config14: tiered round 2 did not resume from restored pages"
+    return result
+
+
 def measure_quality_overhead(backend, pool,
                              n_decides: int = N_CYCLES) -> dict:
     """Config 12: consensus-quality instrumentation overhead (ISSUE 5).
@@ -1087,6 +1246,19 @@ def base_payload() -> dict:
         "config13_acceptance_p50": None,
         "config13_fallbacks": None,
         "config13_temp0_equal": None,
+        # config 14 — tiered KV (ISSUE 7): session hibernation vs
+        # destruction at fixed HBM — restore-latency p95 vs cold
+        # re-prefill p95, demote/restore counts, resident capacity with
+        # the host tier, and the temp-0 on/off equality gate. Detail in
+        # the KV sidecar (QUORACLE_BENCH_KV).
+        "config14_restore_p95_ms": None,
+        "config14_cold_prefill_p95_ms": None,
+        "config14_restore_vs_cold_speedup": None,
+        "config14_demotes": None,
+        "config14_restores": None,
+        "config14_hbm_session_capacity": None,
+        "config14_tiered_session_capacity": None,
+        "config14_temp0_equal": None,
         "cycles": None,
         "rounds_per_cycle": None,
         "max_new_tokens": None,
@@ -1501,6 +1673,22 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             except OSError as e:
                 log(f"config13 sidecar write failed: {e}")
 
+    # config 14 rides backend's engines too (tier attach/detach around
+    # the measured phases) — before the vision config frees them
+    cfg14 = guard("config14",
+                  lambda: measure_kv_tiering(backend, pool))
+    if cfg14:
+        log(f"config14: {cfg14}")
+        sidecar = os.environ.get("QUORACLE_BENCH_KV")
+        if sidecar:
+            try:
+                with open(sidecar, "w") as f:
+                    json.dump({"metric": "kv_tiering",
+                               "config14": cfg14}, f, indent=1)
+                log(f"config14 kv detail written to {sidecar}")
+            except OSError as e:
+                log(f"config14 sidecar write failed: {e}")
+
     def vision_config():
         # config 5: vision pool — free the trio's HBM first (weights + KV
         # page pools), then serve llama + the VLM checkpoint with an
@@ -1695,6 +1883,21 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             "config13_fallbacks": cfg13["fallbacks"],
             "config13_temp0_equal": cfg13["temp0_equal"],
         })
+    if cfg14:
+        payload.update({
+            "config14_restore_p95_ms": cfg14["restore_p95_ms"],
+            "config14_cold_prefill_p95_ms":
+                cfg14["cold_prefill_p95_ms"],
+            "config14_restore_vs_cold_speedup":
+                cfg14["restore_vs_cold_speedup"],
+            "config14_demotes": cfg14["demotes"],
+            "config14_restores": cfg14["restores"],
+            "config14_hbm_session_capacity":
+                cfg14["hbm_session_capacity"],
+            "config14_tiered_session_capacity":
+                cfg14["tiered_session_capacity"],
+            "config14_temp0_equal": cfg14["temp0_equal"],
+        })
     if cfg10:
         payload.update({
             "config10_n_samples": cfg10["n_samples"],
@@ -1712,7 +1915,8 @@ def _run(args, payload: dict, deadline_at: float) -> None:
                     "config4": cfg4, "config5": cfg5, "config6": cfg6,
                     "config7": cfg7, "config8": cfg8, "config9": cfg9,
                     "config10": cfg10, "config11": cfg11,
-                    "config12": cfg12, "config13": cfg13},
+                    "config12": cfg12, "config13": cfg13,
+                    "config14": cfg14},
                    indent=1, default=str))
     payload.update({
         "cycles": N_CYCLES,

@@ -375,6 +375,16 @@ class AgentCore:
         executor thread, one tick at a time per agent."""
         with TRACER.span("agent.decide_tick", trace_id=self.config.task_id,
                          parent=None, agent_id=self.agent_id):
+            # Tiered-KV prefetch (ISSUE 7): this agent is about to run a
+            # consensus round keyed by its own id — warm any hibernated
+            # session now so the page-in overlaps prompt building and
+            # condensation instead of serializing before prefill.
+            # Best-effort: backends without tiering no-op, busy engines
+            # skip, and the generate path restores synchronously anyway.
+            try:
+                self.deps.backend.prefetch_sessions(self.agent_id)
+            except Exception:             # noqa: BLE001 — warm-up only
+                pass
             return self._consensus_blocking_impl()
 
     def _consensus_blocking_impl(self) -> ConsensusOutcome:

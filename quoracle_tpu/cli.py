@@ -75,7 +75,9 @@ async def cmd_run(args: argparse.Namespace) -> int:
                                draft_map=_parse_drafts(args.drafts) or None,
                                draft_k=args.draft_k,
                                continuous=args.continuous,
-                               qos=args.qos or None))
+                               qos=args.qos or None,
+                               host_kv_mb=args.host_kv_mb,
+                               disk_kv_dir=args.disk_kv_dir))
     _attach_printer(rt)
     if pool is None and args.profile is None:
         pool = rt.default_pool()
@@ -104,7 +106,9 @@ async def cmd_resume(args: argparse.Namespace) -> int:
                                draft_map=_parse_drafts(args.drafts) or None,
                                draft_k=args.draft_k,
                                continuous=args.continuous,
-                               qos=args.qos or None))
+                               qos=args.qos or None,
+                               host_kv_mb=args.host_kv_mb,
+                               disk_kv_dir=args.disk_kv_dir))
     _attach_printer(rt)
     result = await rt.boot()
     print(json.dumps(result), flush=True)
@@ -129,7 +133,8 @@ async def cmd_serve(args: argparse.Namespace) -> int:
         process_id=args.process_id,
         draft_map=_parse_drafts(args.drafts) or None,
         draft_k=args.draft_k,
-        continuous=args.continuous, qos=args.qos or None))
+        continuous=args.continuous, qos=args.qos or None,
+        host_kv_mb=args.host_kv_mb, disk_kv_dir=args.disk_kv_dir))
     # Validate host/token BEFORE boot so a refused bind exits with a clean
     # message instead of a traceback over a half-started runtime.
     try:
@@ -205,6 +210,18 @@ def build_parser() -> argparse.ArgumentParser:
         sp.add_argument("--continuous", action="store_true",
                         help="decode-level continuous batching for the "
                              "TPU backend (models/scheduler.py)")
+        sp.add_argument("--host-kv-mb", dest="host_kv_mb", type=int,
+                        default=0,
+                        help="tiered KV (serving/kvtier.py): host-RAM "
+                             "budget per pool member for hibernated "
+                             "sessions and stripped prefix blocks; "
+                             "0 disables the host tier unless "
+                             "--disk-kv-dir is set (then 256 MB)")
+        sp.add_argument("--disk-kv-dir", dest="disk_kv_dir", default=None,
+                        help="tiered KV: directory of the checksummed "
+                             "disk prefix store — a restarted process "
+                             "warm-starts from its predecessor's "
+                             "prefixes; corrupt entries are skipped")
         sp.add_argument("--qos", action="store_true",
                         help="serving QoS (ISSUE 4): weighted-fair "
                              "admission + overload shedding + SLO "

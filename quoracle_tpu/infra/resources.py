@@ -95,6 +95,48 @@ def headroom_fraction(devices: Optional[list[dict]] = None) -> Optional[float]:
     return min(fracs) if fracs else None
 
 
+def _kv_page_bytes(engine) -> int:
+    import jax
+    cfg = engine.cfg
+    return (2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
+            * jax.numpy.dtype(engine.cache_dtype).itemsize
+            * engine.sessions.page)
+
+
+def reclaimable_kv_bytes(backend) -> int:
+    """HBM bytes the tier ladder could free RIGHT NOW without losing
+    state (ISSUE 7): allocated pool pages of tier-attached engines,
+    bounded by each tier's remaining host budget. Zero without tiering —
+    evicting untiered pages destroys state, which is not headroom."""
+    total = 0
+    for e in (getattr(backend, "engines", None) or {}).values():
+        tier = getattr(getattr(e, "sessions", None), "tier", None)
+        if tier is None:
+            continue
+        try:
+            total += tier.demotable_bytes(_kv_page_bytes(e))
+        except Exception:                 # noqa: BLE001 — telemetry only
+            pass
+    return total
+
+
+def effective_headroom_fraction(backend) -> Optional[float]:
+    """The QoS admission controller's HBM signal under tiering
+    (serving/admission.py): raw device headroom PLUS the demotable-page
+    margin, capped at 1. Without a limit-reporting device (CPU) the
+    signal stays None, exactly like the raw fraction."""
+    devices = device_memory_stats()
+    frac = headroom_fraction(devices)
+    if frac is None:
+        return None
+    reclaim = reclaimable_kv_bytes(backend)
+    if reclaim:
+        limit = min(d["bytes_limit"] for d in devices
+                    if d.get("bytes_limit"))
+        frac = min(1.0, frac + reclaim / limit)
+    return frac
+
+
 def process_stats() -> dict:
     """Self-observation block for /api/resources: uptime, threads, open
     fds, current RSS (same /proc sources as the /api/metrics vm block)."""
@@ -188,6 +230,24 @@ def hbm_attribution(backend) -> dict:
                 "prefix_cache": occ,
                 "sessions": n_sessions,
             }
+            # tiered KV (ISSUE 7): host/disk tier rows beside the HBM
+            # attribution, so the operator sees the WHOLE ladder —
+            # resident pages, parked host bytes, durable disk entries
+            tier = getattr(st, "tier", None)
+            if tier is not None:
+                ts = tier.stats()
+                members[spec]["kv_host_bytes"] = ts["host"]["bytes"]
+                members[spec]["kv_host_budget_bytes"] = \
+                    ts["host"]["budget_bytes"]
+                members[spec]["kv_host_sessions"] = ts["host"]["sessions"]
+                members[spec]["kv_host_prefix_blocks"] = \
+                    ts["host"]["prefix_blocks"]
+                if ts["disk"] is not None:
+                    members[spec]["kv_disk_bytes"] = ts["disk"]["bytes"]
+                    members[spec]["kv_disk_entries"] = \
+                        ts["disk"]["entries"]
+                members[spec]["kv_demotable_bytes"] = \
+                    tier.demotable_bytes(page_b)
             if spec in spec_cache:
                 members[spec]["spec_cache_bytes"] = \
                     spec_cache[spec]["bytes"]
@@ -205,6 +265,12 @@ def hbm_attribution(backend) -> dict:
             if m.get("role") == "draft"),
         "spec_cache_bytes": sum(m.get("spec_cache_bytes", 0)
                                 for m in members.values()),
+        "kv_host_bytes": sum(m.get("kv_host_bytes", 0)
+                             for m in members.values()),
+        "kv_disk_bytes": sum(m.get("kv_disk_bytes", 0)
+                             for m in members.values()),
+        "kv_demotable_bytes": sum(m.get("kv_demotable_bytes", 0)
+                                  for m in members.values()),
         "tail_reserve_bytes": int(POOL_TAIL_RESERVE),
     }
     return {"members": members, "totals": totals}
@@ -226,7 +292,8 @@ class ResourceCollector:
         from quoracle_tpu.infra.flightrec import FLIGHT
         from quoracle_tpu.infra.telemetry import (
             HBM_COMPONENT_BYTES, HBM_HEADROOM_RATIO, HBM_LIMIT_BYTES,
-            HBM_USED_BYTES, PREFIX_CACHE_PAGES,
+            HBM_USED_BYTES, KV_TIER_BYTES, KV_TIER_ENTRIES,
+            PREFIX_CACHE_PAGES,
         )
 
         devices = device_memory_stats()
@@ -255,6 +322,24 @@ class ResourceCollector:
                                    kind="referenced")
             PREFIX_CACHE_PAGES.set(occ["evictable_leaf_pages"],
                                    model=spec, kind="evictable")
+            # tiered KV occupancy (ISSUE 7): one gauge series per tier
+            if "kv_host_bytes" in m:
+                KV_TIER_BYTES.set(m["kv_used_bytes"], model=spec,
+                                  tier="hbm")
+                KV_TIER_BYTES.set(m["kv_host_bytes"], model=spec,
+                                  tier="host")
+                KV_TIER_BYTES.set(m.get("kv_disk_bytes", 0), model=spec,
+                                  tier="disk")
+                KV_TIER_ENTRIES.set(m["sessions"], model=spec,
+                                    tier="hbm", kind="session")
+                KV_TIER_ENTRIES.set(m["kv_host_sessions"], model=spec,
+                                    tier="host", kind="session")
+                KV_TIER_ENTRIES.set(m["kv_host_prefix_blocks"],
+                                    model=spec, tier="host",
+                                    kind="prefix")
+                KV_TIER_ENTRIES.set(m.get("kv_disk_entries", 0),
+                                    model=spec, tier="disk",
+                                    kind="prefix")
         # storm gauges decay with time, not with traffic — refresh so a
         # storm that ended shows 0 at the next scrape even with no new
         # generate() calls

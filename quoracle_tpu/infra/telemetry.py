@@ -681,6 +681,44 @@ SPEC_FALLBACK_TOTAL = METRICS.counter(
     "decode ticks a row fell back to vanilla, per model and reason "
     "(disengaged | sampling | window | draft_error | verify_error)")
 
+# -- tiered KV (ISSUE 7) -----------------------------------------------------
+# Host offload + session hibernation + the disk prefix store
+# (serving/kvtier.py TierManager): tier occupancy, demote/restore flow,
+# and restore latency — the observability contract of the capacity layer.
+KV_TIER_BYTES = METRICS.gauge(
+    "quoracle_kv_tier_bytes",
+    "KV bytes resident per tier (hbm | host | disk), per model — "
+    "collector-refreshed (infra/resources.py)")
+KV_TIER_ENTRIES = METRICS.gauge(
+    "quoracle_kv_tier_entries",
+    "entries per tier and kind (session | prefix), per model")
+KV_DEMOTES_TOTAL = METRICS.counter(
+    "quoracle_kv_demotes_total",
+    "HBM→host demotions by kind (session | prefix), per model — "
+    "eviction that preserved state instead of destroying it")
+KV_RESTORES_TOTAL = METRICS.counter(
+    "quoracle_kv_restores_total",
+    "host/disk→HBM restores by kind and source, per model — touches "
+    "served by page-in instead of re-prefill")
+KV_RESTORE_MS = METRICS.histogram(
+    "quoracle_kv_restore_ms",
+    "page-in latency per restore (ms), by kind — compare against "
+    "quoracle_prefill_ms for the hibernation win")
+KV_DISK_SPILLS_TOTAL = METRICS.counter(
+    "quoracle_kv_disk_spills_total",
+    "prefix blocks written to the checksummed disk store, per model")
+KV_DISK_LOADS_TOTAL = METRICS.counter(
+    "quoracle_kv_disk_loads_total",
+    "disk prefix loads by status (ok | corrupt), per model — corrupt "
+    "entries are skipped and unlinked, never served")
+KV_HOST_EVICTIONS_TOTAL = METRICS.counter(
+    "quoracle_kv_host_evictions_total",
+    "host-tier LRU evictions by kind (session | prefix), per model")
+KV_ALLOC_DRIFT_TOTAL = METRICS.counter(
+    "quoracle_kv_alloc_drift_total",
+    "SessionStore.alloc accounting-drift refusals (the formerly silent "
+    "defensive branch), per model — any nonzero value is a bug report")
+
 # -- consensus quality (ISSUE 5) ---------------------------------------------
 # Decision-quality instruments (consensus/quality.py): per-decide
 # contestedness and the per-member scorecard counters. Registered at

@@ -409,6 +409,12 @@ class SessionStore:
         # the store only manages ids.
         self.k: Optional[jax.Array] = None
         self.v: Optional[jax.Array] = None
+        # Tiered KV (ISSUE 7, serving/kvtier.py): when attached, alloc's
+        # eviction ladder DEMOTES victims to the host tier instead of
+        # destroying them, and the engine's session lookup restores
+        # hibernated sessions by page-in instead of re-prefill.
+        self.tier = None
+        self.model = ""          # metric label; engine sets cfg.name
 
     def get(self, key: str) -> Optional[_Session]:
         with self.lock:
@@ -452,8 +458,26 @@ class SessionStore:
                     break        # _attainable guarantees this can't happen
                 lru = min(victims, key=lambda k: self._sessions[k].last_used)
                 victims.remove(lru)
-                self._release(self._sessions.pop(lru).pages)
-            if len(self._free) < n:       # defensive: accounting drift
+                sess = self._sessions.pop(lru)
+                if self.tier is not None:
+                    # eviction is demotion, not destruction (ISSUE 7):
+                    # one device_get copies the victim host-side; the
+                    # release below drops only the victim's own refs, so
+                    # shared/COW pages other holders read stay resident
+                    self.tier.demote_session(lru, sess)
+                self._release(sess.pages)
+            if len(self._free) < n:
+                # defensive: accounting drift — _attainable promised pages
+                # the ladder could not deliver. Formerly a silent None;
+                # now counted and flight-recorded (ISSUE 7 satellite) so
+                # a refcount bug surfaces as telemetry, not as mystery
+                # re-prefills.
+                from quoracle_tpu.infra.flightrec import FLIGHT
+                from quoracle_tpu.infra.telemetry import KV_ALLOC_DRIFT_TOTAL
+                KV_ALLOC_DRIFT_TOTAL.inc(model=self.model)
+                FLIGHT.record("kv_alloc_drift", model=self.model,
+                              requested=n, free=len(self._free),
+                              sessions=len(self._sessions))
                 return None
             return [self._free.pop() for _ in range(n)]
 
@@ -513,6 +537,13 @@ class SessionStore:
         shared page. Returns a synthetic marker session (cached prefix
         tokens + page ids, shared_prefix=True) or None."""
         with self.lock:
+            if self.tier is not None:
+                # tiered extension (ISSUE 7): blocks stripped to the host
+                # tier — or persisted to disk by a previous process —
+                # page back in and re-enter the tree before the match, so
+                # a restart-warm prefix is indistinguishable from a
+                # resident one
+                self.tier.extend_prefix(tokens, max_reuse)
             pages, matched = self.prefix_cache.match(tokens, max_reuse)
             if matched < self.page:
                 return None
@@ -523,9 +554,19 @@ class SessionStore:
                       pages: Sequence[int]) -> int:
         """Feed a freshly stored session's full pages into the radix
         cache (the engine calls this at store-back for full-attention,
-        non-VLM sessions with start_pos == 0)."""
+        non-VLM sessions with start_pos == 0). With a disk-backed tier
+        attached, each full block also writes through to the checksummed
+        prefix store (content-addressed — re-inserts cost one stat), so
+        a restarted process warm-starts from these prefixes."""
         with self.lock:
-            return self.prefix_cache.insert(tokens, pages)
+            added = self.prefix_cache.insert(tokens, pages)
+            if (self.tier is not None and self.tier.disk is not None):
+                for j in range(len(tokens) // self.page):
+                    if j < len(pages) and pages[j]:
+                        self.tier.persist_block(
+                            [int(t) for t in tokens[:(j + 1) * self.page]],
+                            pages[j])
+            return added
 
     def put(self, key: str, sess: _Session) -> None:
         """Replace a session, releasing any of the old session's pages the
@@ -536,6 +577,8 @@ class SessionStore:
             if old is not None and old is not sess:
                 self._release([p for p in old.pages if p not in sess.pages])
             self._sessions[key] = sess
+            if self.tier is not None:
+                self.tier.discard_session(key)   # host copy now stale
 
     def put_raw(self, key: str, sess: _Session) -> None:
         """Replace WITHOUT page bookkeeping — the caller owns the page
@@ -543,12 +586,28 @@ class SessionStore:
         sess.last_used = time.monotonic()
         with self.lock:
             self._sessions[key] = sess
+            if self.tier is not None:
+                self.tier.discard_session(key)   # host copy now stale
+
+    def register_restored(self, key: str, tokens: list, pages: list[int],
+                          start_pos: int) -> "_Session":
+        """Build + register a session the tier just paged back in
+        (serving/kvtier.py restore_session — the tier stays ignorant of
+        the _Session type, preserving the serving → infra dependency
+        direction). Caller holds the lock and owns the pages."""
+        sess = _Session(tokens=tokens, pages=pages, start_pos=start_pos)
+        self.put_raw(key, sess)
+        return sess
 
     def drop(self, key: str) -> None:
         with self.lock:
             s = self._sessions.pop(key, None)
             if s is not None:
                 self._release(s.pages)
+            if self.tier is not None:
+                # a dropped conversation must not resurrect from the
+                # host tier under a reused id
+                self.tier.discard_session(key)
 
     def free_pages(self) -> int:
         with self.lock:
@@ -804,6 +863,8 @@ class GenerateEngine:
         self.sessions = SessionStore(
             max_tokens=max(PAGE, min(session_max_bytes // token_bytes,
                                      32 * self.max_seq)))
+        self.sessions.model = cfg.name     # metric label (alloc drift,
+                                           # tier counters)
         # The paged steps donate the pool buffers; calls that touch the pool
         # must serialize (concurrent members use separate engines).
         self._paged_lock = threading.Lock()
@@ -1385,6 +1446,46 @@ class GenerateEngine:
             merged[i] = res2[j]
         return merged
 
+    def attach_tier(self, host_mb: int = 256,
+                    disk_dir: Optional[str] = None):
+        """Enable tiered KV (ISSUE 7, serving/kvtier.py): HBM eviction
+        demotes to a ``host_mb``-bounded host page store, touches restore
+        by page-in, and (with ``disk_dir``) prefix-cache blocks persist
+        to a checksummed disk store that warm-starts the next process.
+        The disk signature binds entries to this engine's exact KV
+        geometry and dtype, so mismatched processes can never exchange
+        bytes. Returns the TierManager (also at ``sessions.tier``)."""
+        from quoracle_tpu.serving.kvtier import TierManager
+        cfg = self.cfg
+        sig = (f"{cfg.name.replace('/', '_')}-L{cfg.n_layers}"
+               f"x{cfg.n_kv_heads}x{cfg.head_dim}-p{self.sessions.page}"
+               f"-{jnp.dtype(self.cache_dtype).name}")
+        tier = TierManager(self.sessions, model=cfg.name,
+                           host_mb=host_mb, disk_dir=disk_dir,
+                           paged_lock=self._paged_lock, signature=sig)
+        self.sessions.tier = tier
+        return tier
+
+    def prefetch_session(self, session_id: str) -> bool:
+        """Warm a hibernated session before its owner needs it (the
+        scheduler/agent-tick prefetch hook, ISSUE 7): restore it by
+        page-in if it sits in the host tier. TRY-acquires the paged lock
+        — a busy engine skips the warm-up rather than blocking the
+        caller; the sessioned generate path restores synchronously
+        anyway, so prefetch is purely an overlap optimization."""
+        tier = self.sessions.tier
+        if tier is None or not tier.has_session(session_id):
+            return False
+        if self.sessions.get(session_id) is not None:
+            return False                  # already resident
+        if not self._paged_lock.acquire(blocking=False):
+            return False
+        try:
+            self._ensure_pool()
+            return tier.restore_session(session_id) is not None
+        finally:
+            self._paged_lock.release()
+
     def drop_session(self, session_id: str) -> None:
         """Release a session's pages — including any image-digest-qualified
         variants ("<sid>|img:<sha>", models/runtime.py VLM sessions).
@@ -1396,15 +1497,31 @@ class GenerateEngine:
             for key in [k for k in self.sessions._sessions
                         if k.startswith(prefix)]:
                 self.sessions.drop(key)
+            tier = self.sessions.tier
+            if tier is not None:
+                # digest-keyed variants may live ONLY in the host tier
+                # (hibernated) — discard those too, or a dead agent's
+                # image sessions linger until host-LRU
+                for key in [k for k in tier.host.sessions
+                            if k.startswith(prefix)]:
+                    tier.discard_session(key)
 
     def session_tokens(self, session_id: str) -> Optional[list[int]]:
         """The session's resident conversation ids (host ints, prompt +
         retained response), or None. Callers use these to SPLICE the next
         round's prompt (splice_session_prompt) so its token prefix matches
         the resident KV exactly. Snapshot copy: generate replaces the
-        _Session object wholesale, never mutates tokens in place."""
+        _Session object wholesale, never mutates tokens in place.
+        Hibernated sessions answer from the host tier — the splice works
+        against the hibernated ids and the generate then restores the
+        pages (tokens are host ints in either tier)."""
         s = self.sessions.get(session_id)
-        return None if s is None else list(s.tokens)
+        if s is not None:
+            return list(s.tokens)
+        tier = self.sessions.tier
+        if tier is not None:
+            return tier.peek_tokens(session_id)
+        return None
 
     def verify_chunk(self, prompts, session_ids, verify_k, *,
                      temperature=0.0, constrain_json=None,
@@ -1495,6 +1612,22 @@ class GenerateEngine:
                 store_sids[i] = sid
                 paged = True
                 s = self.sessions.get(sid)
+                if s is None and self.sessions.tier is not None \
+                        and self.sessions.tier.has_session(sid):
+                    # hibernated session: restore by page-in instead of
+                    # re-prefill (ISSUE 7; the caller holds _paged_lock,
+                    # so the pool scatter cannot race a paged step). A
+                    # restore failure of ANY kind degrades to re-prefill
+                    # — the tier is never a correctness dependency.
+                    try:
+                        self._ensure_pool()
+                        s = self.sessions.tier.restore_session(sid)
+                    except Exception:     # noqa: BLE001 — fall back
+                        import logging
+                        logging.getLogger(__name__).exception(
+                            "kv restore failed for %s; re-prefilling",
+                            sid)
+                        s = None
                 if s is None:
                     # Cross-session prefix sharing: a NEW session whose
                     # prompt starts with a RADIX-CACHED page-aligned
@@ -1517,6 +1650,10 @@ class GenerateEngine:
                         # forward — never be served from reused KV
                         cap = (len(prompts[i]) - 1 if vk is None
                                else len(prompts[i]) - vk[i])
+                        if self.sessions.tier is not None:
+                            # tiered lookup may page disk/host blocks
+                            # into the pool — it must exist first
+                            self._ensure_pool()
                         d = (self.sessions.match_prefix(prompts[i], cap)
                              if cap > 0 else None)
                         PREFIX_LOOKUP_MS.observe(

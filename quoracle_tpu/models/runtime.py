@@ -172,6 +172,20 @@ class ModelBackend(abc.ABC):
         without draft models."""
         return {"enabled": False}
 
+    def kv_stats(self) -> dict:
+        """Tiered-KV snapshot for /api/kv (ISSUE 7): per-engine tier
+        occupancy (HBM pages / host bytes / disk entries) and the
+        demote/restore counters. ``enabled`` False for backends without
+        tiering."""
+        return {"enabled": False}
+
+    def prefetch_sessions(self, session_id: str) -> int:
+        """Warm hibernated KV for a conversation before it runs (the
+        agent-tick prefetch hook, ISSUE 7): best-effort page-in on every
+        engine holding a host-tier copy. Returns engines warmed. No-op
+        for backends without tiering."""
+        return 0
+
 
 # ---------------------------------------------------------------------------
 # TPU backend
@@ -364,7 +378,8 @@ class TPUBackend(ModelBackend):
                  continuous: bool = False, continuous_chunk: int = 32,
                  continuous_slots: int = 8,
                  draft_map: Optional[dict] = None, draft_k: int = 6,
-                 qos=None):
+                 qos=None, host_kv_mb: int = 0,
+                 disk_kv_dir: Optional[str] = None):
         """``submeshes``: one jax Mesh per pool member (parallel.mesh.
         pool_submeshes) — each member's engine serves tp-sharded on its own
         chips, and ``overlap`` runs members concurrently from host threads
@@ -421,6 +436,18 @@ class TPUBackend(ModelBackend):
             mesh = submeshes[i % len(submeshes)] if submeshes else None
             self.engines[spec] = build_engine(spec, i, mesh)
 
+        # Tiered KV (ISSUE 7, serving/kvtier.py): HBM eviction demotes
+        # hibernating sessions to a per-member host-RAM page store
+        # (``host_kv_mb`` each) and prefix-cache blocks persist to a
+        # checksummed disk store under ``disk_kv_dir`` that warm-starts
+        # the next process. Pool members only — draft engines' shadow
+        # sessions are derived state, cheaper to re-draft than to park.
+        self.kv_tiered = bool(host_kv_mb or disk_kv_dir)
+        if self.kv_tiered:
+            for spec in self.pool:
+                self.engines[spec].attach_tier(
+                    host_mb=host_kv_mb or 256, disk_dir=disk_kv_dir)
+
         # Speculative serving (models/speculative.py): draft_map routes a
         # member's decode through draft-K/verify-one-chunk decoding —
         # output stays token-exact at temperature 0. Draft engines load
@@ -476,8 +503,18 @@ class TPUBackend(ModelBackend):
             from quoracle_tpu.serving.slo import SLOTracker
             qcfg = qos if isinstance(qos, QoSConfig) else QoSConfig()
             self.slo = SLOTracker(targets_ms=qcfg.slo_targets_ms)
+            # HBM-headroom signal (ISSUE 7): with tiering on, pages held
+            # by demotable sessions/cache leaves are RECLAIMABLE without
+            # loss — the controller sees raw headroom plus that margin,
+            # so bulk classes are not shed for memory the tier ladder
+            # can free on demand.
+            from quoracle_tpu.infra.resources import (
+                effective_headroom_fraction,
+            )
             self.qos_controller = AdmissionController(
-                config=qcfg.admission, tenants=qcfg.tenants)
+                config=qcfg.admission, tenants=qcfg.tenants,
+                headroom_fn=(lambda: effective_headroom_fraction(self))
+                if self.kv_tiered else None)
             qos_policies = {
                 spec: WeightedFairPolicy(
                     weights=qcfg.weights, quantum=qcfg.quantum,
@@ -577,6 +614,41 @@ class TPUBackend(ModelBackend):
             })
         return {"enabled": True, "draft_map": dict(self.draft_map),
                 "members": members}
+
+    def kv_stats(self) -> dict:
+        if not self.kv_tiered:
+            return {"enabled": False}
+        members = {}
+        for spec in self.pool:
+            e = self.engines[spec]
+            st = e.sessions
+            tier = st.tier
+            if tier is None:
+                continue
+            with st.lock:
+                free = len(st._free)
+                n_sessions = len(st._sessions)
+                occ = st.prefix_cache.occupancy()
+            members[spec] = {
+                "hbm": {
+                    "pages": st.n_pages,
+                    "free_pages": free,
+                    "used_pages": st.n_pages - 1 - free,
+                    "sessions": n_sessions,
+                    "prefix_cache": occ,
+                },
+                **tier.stats(),
+            }
+        return {"enabled": True, "members": members}
+
+    def prefetch_sessions(self, session_id: str) -> int:
+        if not self.kv_tiered:
+            return 0
+        warmed = 0
+        for spec in self.pool:
+            if self.engines[spec].prefetch_session(session_id):
+                warmed += 1
+        return warmed
 
     def qos_stats(self) -> dict:
         if self.qos_controller is None:

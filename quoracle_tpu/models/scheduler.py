@@ -199,6 +199,18 @@ class ContinuousBatcher:
             depth = self._policy.qsize()
         SCHED_QUEUE_DEPTH.set(depth, model=self._model)
         self._wake.set()
+        # Tiered-KV prefetch (ISSUE 7): a row resuming a HIBERNATED
+        # session warms it now, overlapping the page-in with its queue
+        # wait. Best-effort and non-blocking (try-acquire inside): a
+        # busy engine skips it and the sessioned generate restores
+        # synchronously at lookup instead.
+        if session_id is not None:
+            prefetch = getattr(self.engine, "prefetch_session", None)
+            if prefetch is not None:
+                try:
+                    prefetch(session_id)
+                except Exception:   # noqa: BLE001 — warm-up only
+                    pass
         return row.future
 
     def close(self) -> None:

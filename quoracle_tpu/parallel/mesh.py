@@ -108,13 +108,26 @@ def device_hbm_limit(device) -> int:
 
 def pool_sizing(pool: Sequence[str], n_devices: int = 8,
                 hbm_per_chip: int = V5E_HBM_BYTES,
-                dtype_bytes: int = 2) -> dict:
+                dtype_bytes: int = 2,
+                host_kv_mb: int = 0,
+                disk_kv_gb: float = 0.0,
+                page: int = 128) -> dict:
     """Explicit HBM budget for a model pool on a v5e sub-mesh partition
     (VERDICT r4 item 4): per member — chips (= recommended_tp), bf16
     weight bytes per chip, the page-pool bytes left after the tail
     reserve, and how many resident KV tokens that pool holds. The
     placement is the SURVEY §7 hard-part-1 design: a static partition of
     the slice, one contiguous tp sub-mesh per member.
+
+    With tiered KV (ISSUE 7, serving/kvtier.py) the HBM figure stops
+    being the capacity ceiling: ``host_kv_mb`` (per member, the
+    ``--host-kv-mb`` flag) and ``disk_kv_gb`` (the ``--disk-kv-dir``
+    store's budget; 0 = unbounded when enabled elsewhere) extend each
+    member with host/disk tier rows — the ``tiers`` block reports
+    resident HBM pages beside hibernation and durable-prefix capacity in
+    tokens, so ``--plan`` output matches what the serving path actually
+    holds. Host/disk copies are UNSHARDED (full KV bytes per token),
+    hence the tp=1 byte rate in those rows.
 
     Returns {"members": [...], "chips_used", "fits", "hbm_per_chip"};
     ``fits`` is False when the pool needs more chips than the slice has
@@ -134,6 +147,12 @@ def pool_sizing(pool: Sequence[str], n_devices: int = 8,
         m_fits = page_pool > 0
         fits = fits and m_fits
         used += tp
+        # host/disk tiers hold full (unsharded) KV bytes per token
+        kv_tok_host = cfg.kv_bytes_per_token(1, dtype_bytes)
+        host_tokens = int(host_kv_mb * (1 << 20) // kv_tok_host) \
+            if host_kv_mb else 0
+        disk_tokens = int(disk_kv_gb * (1 << 30) // kv_tok_host) \
+            if disk_kv_gb else 0
         members.append({
             "model": cfg.name, "tp": tp, "chips": tp,
             "params_b": round(cfg.n_params / 1e9, 2),
@@ -142,13 +161,23 @@ def pool_sizing(pool: Sequence[str], n_devices: int = 8,
                                            2),
             "kv_bytes_per_token_per_chip": kv_tok,
             "resident_kv_tokens": resident,
+            "tiers": {
+                "hbm_pages": resident // page,
+                "hbm_tokens": resident,
+                "host_kv_mb": host_kv_mb,
+                "host_kv_tokens": host_tokens,
+                # disk store has no built-in budget: 0 here means
+                # "no explicit cap given", not "no disk tier"
+                "disk_kv_tokens": disk_tokens,
+            },
             "fits": m_fits,
         })
     fits = fits and used <= n_devices
     return {"members": members, "chips_used": used,
             "n_devices": n_devices, "fits": fits,
             "hbm_per_chip_gb": round(hbm_per_chip / 1024 ** 3, 2),
-            "tail_reserve_gb": round(POOL_TAIL_RESERVE / 1024 ** 3, 2)}
+            "tail_reserve_gb": round(POOL_TAIL_RESERVE / 1024 ** 3, 2),
+            "host_kv_mb_per_member": host_kv_mb}
 
 
 def _largest_tp_divisor(n_kv_heads: int, tp_size: int) -> int:

@@ -219,6 +219,14 @@ class RuntimeConfig:
     # Turns on weighted-fair admission + overload shedding; implies
     # nothing unless the backend is "tpu".
     qos: Any = None
+    # Tiered KV (ISSUE 7, serving/kvtier.py): host-RAM budget per pool
+    # member for hibernated sessions/prefix blocks (0 = tiering off
+    # unless disk_kv_dir is set), and the directory of the checksummed
+    # disk prefix store that warm-starts the next process. Resident
+    # session capacity stops being bounded by resident_kv_tokens and
+    # becomes bounded by host RAM.
+    host_kv_mb: int = 0
+    disk_kv_dir: Optional[str] = None
 
 
 class Runtime:
@@ -381,7 +389,8 @@ class Runtime:
                           submeshes=submeshes,
                           draft_map=draft_map or None,
                           continuous=config.continuous,
-                          qos=qos)
+                          qos=qos, host_kv_mb=config.host_kv_mb,
+                          disk_kv_dir=config.disk_kv_dir)
 
     async def boot(self) -> dict:
         """Boot-time revival of persisted running tasks (reference

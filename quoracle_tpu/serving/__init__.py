@@ -1,9 +1,7 @@
-"""Serving QoS (ISSUE 4): multi-tenant admission control, weighted-fair
-scheduling, and overload shedding for the continuous-batching serving
-path.
+"""Serving-plane subsystems: QoS (ISSUE 4) and tiered KV (ISSUE 7).
 
-Three modules, one dependency direction (serving → infra, never →
-models — the scheduler imports *us*):
+Four modules, one dependency direction (serving → infra, never →
+models — the scheduler and SessionStore import *us*):
 
 * :mod:`quoracle_tpu.serving.qos` — priority classes, per-tenant token
   buckets, and the deficit-round-robin weighted-fair queue that replaces
@@ -11,10 +9,14 @@ models — the scheduler imports *us*):
   :class:`~quoracle_tpu.serving.qos.AdmissionPolicy` seam.
 * :mod:`quoracle_tpu.serving.admission` — the admission controller that
   sheds load from live overload signals (queue depth, admit-wait p95,
-  HBM headroom) with structured rejects carrying ``retry_after_ms``.
+  HBM headroom — demotable tier pages counted as reclaimable) with
+  structured rejects carrying ``retry_after_ms``.
 * :mod:`quoracle_tpu.serving.slo` — per-class latency targets with EWMA
   tail tracking that demotes BATCH/BACKGROUND admission weight while the
   INTERACTIVE tail is over target.
+* :mod:`quoracle_tpu.serving.kvtier` — the KV tier ladder (HBM → pinned
+  host RAM → disk): session hibernation with bit-exact restore, and the
+  checksummed disk prefix store that warm-starts a restarted process.
 """
 
 from quoracle_tpu.serving.admission import (       # noqa: F401
@@ -24,5 +26,8 @@ from quoracle_tpu.serving.admission import (       # noqa: F401
 from quoracle_tpu.serving.qos import (             # noqa: F401
     AdmissionPolicy, FifoPolicy, Priority, QoSConfig, TenantPolicy,
     TokenBucket, WeightedFairPolicy, priority_for_depth,
+)
+from quoracle_tpu.serving.kvtier import (          # noqa: F401
+    DiskPrefixStore, HostPageStore, TierManager,
 )
 from quoracle_tpu.serving.slo import SLOTracker    # noqa: F401

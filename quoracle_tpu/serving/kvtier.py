@@ -1,0 +1,596 @@
+"""Tiered KV: host offload, session hibernation, and a restart-surviving
+prefix store (ISSUE 7 tentpole).
+
+Before this module the KV tier ladder had exactly one rung: a session (or
+radix-cache leaf) lived in the HBM page pool until ``SessionStore.alloc``'s
+eviction ladder destroyed it, and the next touch paid a full re-prefill.
+Agent sessions spend most of their wall-clock WAITING — on actions, on
+children, on wait-timers (PAPERS.md "Stateful Inference for Low-Latency
+Multi-Agent Tool Calling") — so at any instant most resident pages belong
+to nobody who is decoding. Host-memory offload is the standard TPU-serving
+answer to that capacity wall (PAPERS.md Gemma-on-TPU serving): HBM holds
+the working set, host RAM holds the parked set, disk holds what should
+survive the process.
+
+Three tiers, managed by :class:`TierManager` (one per engine/SessionStore):
+
+  HBM   — the device page pool (models/generate.py SessionStore). Unchanged
+          semantics; still the only tier attention can read.
+  HOST  — :class:`HostPageStore`: numpy copies of demoted sessions and
+          stripped prefix-cache leaves, LRU-bounded by ``host_bytes``.
+          Eviction from HBM stops being destruction: ``alloc``'s ladder
+          DEMOTES here (one ``device_get`` per victim) before releasing
+          pages, and a demoted session touched again RESTORES by page-in
+          (``device_put`` + the pool scatter the serving path already
+          uses) instead of re-prefilling. Refcounts for shared/COW pages
+          are untouched: demote copies content and releases only the
+          victim's own references, so adopters and the radix tree keep
+          reading the still-resident originals (prefix_cache.py I1/I2).
+  DISK  — :class:`DiskPrefixStore`: checksummed page-aligned prefix
+          blocks under ``disk_dir``. Prefix-cache inserts persist their
+          blocks (dedup by content hash), so a RESTARTED process lazily
+          warms from its predecessor's prefixes: a radix-tree miss falls
+          through to host then disk, pages in, and re-inserts the block.
+          Corrupt entries (crc mismatch, torn writes) are skipped and
+          unlinked — a bad file must never poison a serving prefix.
+
+Restore invariant (tier-1 tested): a hibernated-and-restored session is
+BIT-IDENTICAL to one that never left HBM — device_get/device_put round a
+page's bytes exactly, the restored session re-enters the store with the
+same tokens/start_pos, and the LCP resume path neither knows nor cares
+where the pages spent the interim. Temp-0 outputs therefore match exactly
+with tiering on or off.
+
+Locking: demote runs inside ``SessionStore.alloc`` (store lock held, and
+the engine's ``_paged_lock`` held by every sessioned caller — the pool
+arrays are only ever touched under it). Restore is called from the
+engine's session-lookup path (same locks) or from ``prefetch`` (which
+try-acquires the engine lock itself, so a busy engine skips the warm-up
+rather than blocking the submitter — the generate path restores
+synchronously anyway).
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import logging
+import os
+import threading
+import time
+import zlib
+from collections import OrderedDict
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _round_up_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _scatter_pages(k_pool, v_pool, k_host, v_host, pages):
+    """Page-in: host block KV → pool pages in place (pools donated, same
+    aliasing discipline as generate.py's step_scatter_prompt). ``pages``
+    may be padded with 0 — page 0 is scratch by construction, so padded
+    writes land harmlessly."""
+    k_pool = k_pool.at[:, pages].set(k_host.astype(k_pool.dtype))
+    v_pool = v_pool.at[:, pages].set(v_host.astype(v_pool.dtype))
+    return k_pool, v_pool
+
+
+class _HostSession:
+    __slots__ = ("tokens", "start_pos", "k", "v", "nbytes", "ts")
+
+    def __init__(self, tokens, start_pos, k, v):
+        self.tokens = tokens
+        self.start_pos = start_pos
+        self.k = k                      # np [L, n_pages, page, KV, HD]
+        self.v = v
+        self.nbytes = int(k.nbytes) + int(v.nbytes)
+        self.ts = time.monotonic()
+
+
+class _HostBlock:
+    __slots__ = ("tokens", "k", "v", "nbytes", "ts")
+
+    def __init__(self, tokens, k, v):
+        self.tokens = tokens            # full token prefix (page-aligned)
+        self.k = k                      # np [L, page, KV, HD]
+        self.v = v
+        self.nbytes = int(k.nbytes) + int(v.nbytes)
+        self.ts = time.monotonic()
+
+
+class HostPageStore:
+    """LRU-bounded host-RAM page store: hibernated sessions + stripped
+    prefix blocks. Session entries DROP on budget pressure (they are one
+    agent's private state — re-prefill recovers them); prefix blocks SPILL
+    to disk first when a DiskPrefixStore is attached (they are shared,
+    reconstructible state worth keeping cheap)."""
+
+    def __init__(self, budget_bytes: int, model: str = ""):
+        self.budget_bytes = int(budget_bytes)
+        self.model = model
+        self.sessions: OrderedDict[str, _HostSession] = OrderedDict()
+        self.prefixes: OrderedDict[str, _HostBlock] = OrderedDict()
+        self.bytes = 0
+        self.evicted_sessions = 0
+        self.evicted_prefixes = 0
+
+    def _charge(self, n: int) -> None:
+        self.bytes += n
+
+    def put_session(self, key: str, entry: _HostSession,
+                    spill_fn=None) -> None:
+        old = self.sessions.pop(key, None)
+        if old is not None:
+            self.bytes -= old.nbytes
+        self.sessions[key] = entry
+        self._charge(entry.nbytes)
+        self.shrink(spill_fn)
+
+    def put_prefix(self, key: str, entry: _HostBlock,
+                   spill_fn=None) -> None:
+        if key in self.prefixes:
+            return
+        self.prefixes[key] = entry
+        self._charge(entry.nbytes)
+        self.shrink(spill_fn)
+
+    def pop_session(self, key: str) -> Optional[_HostSession]:
+        e = self.sessions.pop(key, None)
+        if e is not None:
+            self.bytes -= e.nbytes
+        return e
+
+    def get_prefix(self, key: str) -> Optional[_HostBlock]:
+        e = self.prefixes.get(key)
+        if e is not None:
+            self.prefixes.move_to_end(key)
+            e.ts = time.monotonic()
+        return e
+
+    def shrink(self, spill_fn=None) -> None:
+        """Evict LRU entries until under budget. Prefix blocks go first
+        (disk-spillable via ``spill_fn``; sessions are irreplaceable until
+        their owner re-prefills), oldest-first within each kind."""
+        from quoracle_tpu.infra.telemetry import KV_HOST_EVICTIONS_TOTAL
+        while self.bytes > self.budget_bytes and self.prefixes:
+            key, e = self.prefixes.popitem(last=False)
+            self.bytes -= e.nbytes
+            self.evicted_prefixes += 1
+            KV_HOST_EVICTIONS_TOTAL.inc(model=self.model, kind="prefix")
+            if spill_fn is not None:
+                spill_fn(key, e)
+        while self.bytes > self.budget_bytes and self.sessions:
+            _, e = self.sessions.popitem(last=False)
+            self.bytes -= e.nbytes
+            self.evicted_sessions += 1
+            KV_HOST_EVICTIONS_TOTAL.inc(model=self.model, kind="session")
+
+    def headroom(self) -> int:
+        return max(0, self.budget_bytes - self.bytes)
+
+    def stats(self) -> dict:
+        return {
+            "budget_bytes": self.budget_bytes,
+            "bytes": self.bytes,
+            "sessions": len(self.sessions),
+            "prefix_blocks": len(self.prefixes),
+            "evicted_sessions": self.evicted_sessions,
+            "evicted_prefixes": self.evicted_prefixes,
+        }
+
+
+class DiskPrefixStore:
+    """Checksummed on-disk store of page-aligned prefix blocks, one file
+    per block keyed by the content hash of the token prefix ending at the
+    block. Files are ``.npz`` (tokens, k, v, crc) written atomically
+    (tmp + rename — a torn write is an unreadable tmp file, never a
+    half-entry) under ``<root>/<model-shape-signature>/``, so engines of
+    different geometry or dtype can never load each other's bytes.
+
+    ``load`` verifies the crc32 of the payload against the stored value
+    and the requested token prefix against the stored one; any mismatch
+    counts as corrupt, unlinks the file, and returns None — the caller
+    falls back to a plain prefill. The store is an OPTIMIZATION with a
+    paranoid boundary, never a correctness dependency."""
+
+    def __init__(self, root: str, signature: str, model: str = ""):
+        self.dir = os.path.join(root, signature)
+        self.model = model
+        os.makedirs(self.dir, exist_ok=True)
+        self.writes = 0
+        self.loads = 0
+        self.corrupt = 0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def block_key(tokens: Sequence[int]) -> str:
+        h = hashlib.sha256(
+            np.asarray(tokens, np.int64).tobytes()).hexdigest()
+        return h[:40]
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.dir, f"{key}.npz")
+
+    def has(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+    @staticmethod
+    def _crc(tokens: np.ndarray, k: np.ndarray, v: np.ndarray) -> int:
+        c = zlib.crc32(tokens.tobytes())
+        c = zlib.crc32(k.tobytes(), c)
+        c = zlib.crc32(v.tobytes(), c)
+        return c & 0xFFFFFFFF
+
+    def save(self, key: str, tokens: Sequence[int], k: np.ndarray,
+             v: np.ndarray) -> bool:
+        path = self._path(key)
+        if os.path.exists(path):
+            return False                 # content-addressed: already there
+        toks = np.asarray(tokens, np.int64)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with self._lock:
+                with open(tmp, "wb") as f:
+                    # KV payloads ship as RAW BYTES + dtype name + shape:
+                    # npz round-trips extension dtypes (ml_dtypes
+                    # bfloat16 — the serving cache dtype) as an opaque
+                    # void dtype, which would silently strip the dtype a
+                    # restore needs
+                    np.savez(
+                        f, tokens=toks,
+                        k=np.ascontiguousarray(k).view(np.uint8)
+                        .reshape(-1),
+                        v=np.ascontiguousarray(v).view(np.uint8)
+                        .reshape(-1),
+                        dtype=str(k.dtype), shape=np.asarray(k.shape),
+                        crc=np.uint32(self._crc(toks, k, v)))
+                os.replace(tmp, path)
+            self.writes += 1
+            return True
+        except OSError:
+            logger.exception("disk prefix write failed: %s", path)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+
+    def load(self, key: str,
+             tokens: Sequence[int]) -> Optional[tuple[np.ndarray,
+                                                      np.ndarray]]:
+        path = self._path(key)
+        if not os.path.exists(path):
+            return None
+        try:
+            with np.load(path) as z:
+                toks, crc = z["tokens"], int(z["crc"])
+                dt = jax.numpy.dtype(str(z["dtype"]))
+                shape = tuple(int(s) for s in z["shape"])
+                k = z["k"].view(dt).reshape(shape)
+                v = z["v"].view(dt).reshape(shape)
+            if (self._crc(toks, k, v) != crc
+                    or toks.tolist() != [int(t) for t in tokens]):
+                raise ValueError("checksum/token mismatch")
+            self.loads += 1
+            from quoracle_tpu.infra.telemetry import KV_DISK_LOADS_TOTAL
+            KV_DISK_LOADS_TOTAL.inc(model=self.model, status="ok")
+            return k, v
+        except Exception:                 # noqa: BLE001 — corrupt entry
+            self.corrupt += 1
+            logger.warning("corrupt disk prefix entry skipped: %s", path)
+            from quoracle_tpu.infra.flightrec import FLIGHT
+            from quoracle_tpu.infra.telemetry import KV_DISK_LOADS_TOTAL
+            KV_DISK_LOADS_TOTAL.inc(model=self.model, status="corrupt")
+            FLIGHT.record("kv_disk_corrupt", path=path, model=self.model)
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            return None
+
+    def stats(self) -> dict:
+        try:
+            entries = [f for f in os.listdir(self.dir)
+                       if f.endswith(".npz")]
+            nbytes = sum(os.path.getsize(os.path.join(self.dir, f))
+                         for f in entries)
+        except OSError:
+            entries, nbytes = [], 0
+        return {"dir": self.dir, "entries": len(entries),
+                "bytes": nbytes, "writes": self.writes,
+                "loads": self.loads, "corrupt_skipped": self.corrupt}
+
+
+class TierManager:
+    """The tier ladder for one engine's SessionStore. Attached via
+    ``GenerateEngine.attach_tier`` (which wires ``store.tier = self``);
+    every method that touches the device pool assumes the engine's
+    ``_paged_lock`` discipline described in the module docstring."""
+
+    def __init__(self, store, model: str = "", host_mb: int = 256,
+                 disk_dir: Optional[str] = None, paged_lock=None,
+                 signature: Optional[str] = None):
+        self.store = store
+        self.model = model
+        self.paged_lock = paged_lock
+        self.host = HostPageStore(int(host_mb) * (1 << 20), model=model)
+        self.disk: Optional[DiskPrefixStore] = None
+        if disk_dir:
+            self.disk = DiskPrefixStore(
+                disk_dir, signature or (model.replace("/", "_")
+                                        or "default"), model=model)
+        # monotonic counters (stats() → /api/kv + bench config 14)
+        self.demoted_sessions = 0
+        self.demoted_prefix_pages = 0
+        self.restored_sessions = 0
+        self.restored_prefix_pages = 0
+        self.restore_failures = 0
+
+    # -- device <-> host plumbing ---------------------------------------
+
+    def _gather_host(self, pages: list[int]) -> tuple[np.ndarray,
+                                                      np.ndarray]:
+        """One device_get per victim: the pages' KV as host numpy."""
+        import jax
+        st = self.store
+        idx = np.asarray(pages, np.int32)
+        k = np.asarray(jax.device_get(st.k[:, idx]))
+        v = np.asarray(jax.device_get(st.v[:, idx]))
+        return k, v
+
+    def _scatter_device(self, pages: list[int], k: np.ndarray,
+                        v: np.ndarray) -> None:
+        """Page-in via the pool scatter (shape-bucketed to bound
+        compiles: the page-count axis pads to a power of two, padded
+        slots target scratch page 0)."""
+        import jax.numpy as jnp
+        st = self.store
+        n = len(pages)
+        cap = _round_up_pow2(max(1, n))
+        if cap != n:
+            pad = ((0, 0), (0, cap - n), (0, 0), (0, 0), (0, 0))
+            k = np.pad(k, pad)
+            v = np.pad(v, pad)
+        idx = np.zeros((cap,), np.int32)
+        idx[:n] = pages
+        st.k, st.v = _scatter_pages(st.k, st.v, jnp.asarray(k),
+                                    jnp.asarray(v), jnp.asarray(idx))
+
+    # -- session hibernation --------------------------------------------
+
+    def demote_session(self, key: str, sess) -> bool:
+        """Copy a victim session's KV host-side before its pages release
+        (called from SessionStore.alloc's ladder, both locks held). The
+        caller still releases the pages — refcounted sharing is preserved
+        because only the VICTIM's references drop; adopters and the radix
+        tree keep the resident copies they already hold."""
+        st = self.store
+        pages = [p for p in sess.pages if p]
+        if not pages or st.k is None:
+            return False
+        t0 = time.monotonic()
+        try:
+            k, v = self._gather_host(pages)
+        except Exception:                 # noqa: BLE001 — demote is best-
+            logger.exception("kv demote failed for %s", key)   # effort
+            return False
+        self.host.put_session(
+            key, _HostSession(list(sess.tokens), sess.start_pos, k, v),
+            spill_fn=self._spill_prefix_entry)
+        self.demoted_sessions += 1
+        from quoracle_tpu.infra.flightrec import FLIGHT
+        from quoracle_tpu.infra.telemetry import KV_DEMOTES_TOTAL
+        KV_DEMOTES_TOTAL.inc(model=self.model, kind="session")
+        FLIGHT.record("kv_demote", model=self.model, what="session",
+                      session=key, pages=len(pages),
+                      ms=round((time.monotonic() - t0) * 1000, 2))
+        return True
+
+    def has_session(self, key: str) -> bool:
+        return key in self.host.sessions
+
+    def peek_tokens(self, key: str) -> Optional[list]:
+        e = self.host.sessions.get(key)
+        return list(e.tokens) if e is not None else None
+
+    def discard_session(self, key: str) -> None:
+        """The live store replaced or dropped this session — the host
+        copy is stale and must never restore over fresher state."""
+        self.host.pop_session(key)
+
+    def restore_session(self, key: str):
+        """Page a hibernated session back into the pool and re-register
+        it. Returns the live session or None (pool unattainable / entry
+        gone — the caller re-prefills, which is always correct). Assumes
+        the engine's paged lock is held."""
+        st = self.store
+        with st.lock:
+            e = self.host.sessions.get(key)
+            if e is None:
+                return None
+            n = e.k.shape[1]
+            pages = st.alloc(n, protect=(key,))
+            if pages is None:
+                self.restore_failures += 1
+                return None
+            e = self.host.pop_session(key)
+            if e is None:                 # raced a discard
+                st._release(pages)
+                return None
+            t0 = time.monotonic()
+            self._scatter_device(pages, e.k, e.v)
+            sess = st.register_restored(key, list(e.tokens), pages,
+                                        e.start_pos)
+            self.restored_sessions += 1
+            ms = (time.monotonic() - t0) * 1000
+        from quoracle_tpu.infra.flightrec import FLIGHT
+        from quoracle_tpu.infra.telemetry import (
+            KV_RESTORE_MS, KV_RESTORES_TOTAL,
+        )
+        KV_RESTORES_TOTAL.inc(model=self.model, kind="session",
+                              source="host")
+        KV_RESTORE_MS.observe(ms, model=self.model, kind="session")
+        FLIGHT.record("kv_restore", model=self.model, what="session",
+                      session=key, pages=len(pages), ms=round(ms, 2))
+        return sess
+
+    # -- prefix-block tiering -------------------------------------------
+
+    def _block_key(self, tokens: Sequence[int]) -> str:
+        return DiskPrefixStore.block_key(tokens)
+
+    def _spill_prefix_entry(self, key: str, entry: _HostBlock) -> None:
+        """Host-budget eviction of a prefix block: spill to disk when
+        attached (dedup by key), else the block is simply gone."""
+        if self.disk is None:
+            return
+        if self.disk.save(key, entry.tokens, entry.k, entry.v):
+            from quoracle_tpu.infra.flightrec import FLIGHT
+            from quoracle_tpu.infra.telemetry import KV_DISK_SPILLS_TOTAL
+            KV_DISK_SPILLS_TOTAL.inc(model=self.model)
+            FLIGHT.record("kv_disk_spill", model=self.model,
+                          tokens=len(entry.tokens))
+
+    def capture_leaf(self, tokens: Sequence[int], page: int) -> None:
+        """A radix-cache leaf is about to be stripped (prefix_cache.evict):
+        keep its block alive in the host tier instead of recomputing it
+        later. Called under the store lock (and the paged lock, via
+        alloc)."""
+        st = self.store
+        if st.k is None:
+            return
+        key = self._block_key(tokens)
+        if key in self.host.prefixes:
+            return
+        if self.disk is not None and self.disk.has(key):
+            return        # already durable; skip the device_get
+        try:
+            k, v = self._gather_host([page])
+        except Exception:                 # noqa: BLE001 — best-effort
+            logger.exception("prefix leaf capture failed")
+            return
+        self.host.put_prefix(
+            key, _HostBlock(list(tokens), k[:, 0], v[:, 0]),
+            spill_fn=self._spill_prefix_entry)
+        self.demoted_prefix_pages += 1
+        from quoracle_tpu.infra.flightrec import FLIGHT
+        from quoracle_tpu.infra.telemetry import KV_DEMOTES_TOTAL
+        KV_DEMOTES_TOTAL.inc(model=self.model, kind="prefix")
+        FLIGHT.record("kv_demote", model=self.model, what="prefix",
+                      tokens=len(tokens))
+
+    def persist_block(self, tokens: Sequence[int], page: int) -> None:
+        """Insert-time disk persistence: a block newly cached in the
+        radix tree is written through to disk (content-addressed — a
+        block already persisted costs one stat()). This is what makes a
+        restarted process warm: the disk store accumulates the fleet's
+        hot prefixes while they are still hot, not only at eviction."""
+        if self.disk is None:
+            return
+        key = self._block_key(tokens)
+        if self.disk.has(key):
+            return
+        st = self.store
+        if st.k is None:
+            return
+        try:
+            k, v = self._gather_host([page])
+        except Exception:                 # noqa: BLE001 — best-effort
+            return
+        if self.disk.save(key, tokens, k[:, 0], v[:, 0]):
+            from quoracle_tpu.infra.telemetry import KV_DISK_SPILLS_TOTAL
+            KV_DISK_SPILLS_TOTAL.inc(model=self.model)
+
+    def extend_prefix(self, tokens: Sequence[int], cap: int) -> int:
+        """Lazily page tiered prefix blocks back into the radix tree:
+        while the tree's page-aligned match of ``tokens`` can be extended
+        by a block held in the host or disk tier, alloc a page, scatter
+        the block in, and insert it. Returns blocks restored. Called from
+        SessionStore.match_prefix under the store lock (paged lock held
+        by the sessioned caller)."""
+        st = self.store
+        if st.k is None:
+            return 0
+        page = st.page
+        restored = 0
+        attempted: set = set()
+        while True:
+            j = st.prefix_cache.match_len(tokens, cap) // page
+            end = (j + 1) * page
+            if end > min(len(tokens), cap):
+                break
+            prefix = [int(t) for t in tokens[:end]]
+            key = self._block_key(prefix)
+            if key in attempted:
+                break                     # do not thrash a tiny pool
+            attempted.add(key)
+            blk = self.host.get_prefix(key)
+            source = "host"
+            if blk is None and self.disk is not None:
+                loaded = self.disk.load(key, prefix)
+                if loaded is not None:
+                    blk = _HostBlock(prefix, *loaded)
+                    source = "disk"
+            if blk is None:
+                break
+            pages = st.alloc(1)
+            if pages is None:
+                break
+            t0 = time.monotonic()
+            self._scatter_device(pages, blk.k[:, None], blk.v[:, None])
+            path = st.prefix_cache._walk(tokens, cap)
+            added = st.prefix_cache.insert(
+                prefix, [nd.page for nd in path] + pages)
+            if not added:
+                st._release(pages)        # raced an insert; keep theirs
+                continue
+            restored += 1
+            self.restored_prefix_pages += 1
+            ms = (time.monotonic() - t0) * 1000
+            from quoracle_tpu.infra.telemetry import (
+                KV_RESTORE_MS, KV_RESTORES_TOTAL,
+            )
+            KV_RESTORES_TOTAL.inc(model=self.model, kind="prefix",
+                                  source=source)
+            KV_RESTORE_MS.observe(ms, model=self.model, kind="prefix")
+        if restored:
+            from quoracle_tpu.infra.flightrec import FLIGHT
+            FLIGHT.record("kv_restore", model=self.model, what="prefix",
+                          blocks=restored)
+        return restored
+
+    # -- reads -----------------------------------------------------------
+
+    def demotable_bytes(self, page_bytes: int) -> int:
+        """How many HBM bytes could move to the host tier right now
+        without losing state: every allocated (non-free, non-scratch)
+        page is demotable under tiering, bounded by the host budget's
+        remaining headroom. The QoS admission controller counts this as
+        reclaimable HBM headroom (serving/admission.py)."""
+        st = self.store
+        with st.lock:
+            used = st.n_pages - 1 - len(st._free)
+        return min(used * page_bytes, self.host.headroom())
+
+    def stats(self) -> dict:
+        return {
+            "model": self.model,
+            "host": self.host.stats(),
+            "disk": self.disk.stats() if self.disk is not None else None,
+            "demoted_sessions": self.demoted_sessions,
+            "demoted_prefix_pages": self.demoted_prefix_pages,
+            "restored_sessions": self.restored_sessions,
+            "restored_prefix_pages": self.restored_prefix_pages,
+            "restore_failures": self.restore_failures,
+        }

@@ -23,6 +23,10 @@ Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
   GET  /api/qos             serving-QoS panel (ISSUE 4): admission
                             controller signals/thresholds, per-member
                             weighted-fair queues, SLO tails, shed counters
+  GET  /api/kv              tiered-KV panel (ISSUE 7): per-member tier
+                            occupancy (HBM/host/disk), demote/restore/
+                            spill counters, restore-latency quantiles
+                            (serving/kvtier.py)
   GET  /api/models          consensus-quality scorecards (ISSUE 5): rolling
                             per-member agreement/dissent/failure-by-kind/
                             recovery rates, proposal latency, drift state
@@ -392,6 +396,24 @@ class DashboardServer:
         return {"task_id": task_id, "n_records": len(records),
                 "records": records}
 
+    def kv_payload(self) -> dict:
+        """GET /api/kv: the tiered-KV panel (ISSUE 7) — per-member tier
+        occupancy (HBM pages / host bytes / disk entries), the
+        demote/restore/spill counters, and restore-latency quantiles
+        from the quoracle_kv_restore_ms histogram."""
+        from quoracle_tpu.infra.telemetry import (
+            KV_DEMOTES_TOTAL, KV_RESTORE_MS, KV_RESTORES_TOTAL,
+        )
+        backend = self.runtime.backend
+        payload = (backend.kv_stats() if hasattr(backend, "kv_stats")
+                   else {"enabled": False})
+        payload["counters"] = {
+            "demotes": KV_DEMOTES_TOTAL._snapshot(),
+            "restores": KV_RESTORES_TOTAL._snapshot(),
+            "restore_ms": KV_RESTORE_MS._snapshot(),
+        }
+        return payload
+
     def qos_payload(self) -> dict:
         """GET /api/qos: the serving-QoS panel (ISSUE 4) — admission
         controller state (signals, thresholds, tenant buckets), the
@@ -543,7 +565,8 @@ class _Handler(BaseHTTPRequestHandler):
                 from quoracle_tpu.web import views
                 self._send_html(views.telemetry_page(
                     d.metrics_payload(), d.resources_payload(),
-                    d.qos_payload(), d.models_payload()))
+                    d.qos_payload(), d.models_payload(),
+                    d.kv_payload()))
             elif parsed.path == "/settings":
                 from quoracle_tpu.web import views
                 self._send_html(views.settings_page(
@@ -576,6 +599,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.resources_payload())
             elif parsed.path == "/api/qos":
                 self._send_json(d.qos_payload())
+            elif parsed.path == "/api/kv":
+                self._send_json(d.kv_payload())
             elif parsed.path == "/api/models":
                 self._send_json(d.models_payload())
             elif parsed.path == "/api/consensus":

@@ -449,13 +449,51 @@ def spec_panel(spec: dict) -> str:
     return "".join(parts)
 
 
+def kv_panel(kv: dict) -> str:
+    """Tiered-KV panel (ISSUE 7): per-member tier ladder occupancy —
+    HBM pages, host-tier bytes/entries, disk-store entries — and the
+    demote/restore flow counters, the /api/kv payload as a table.
+    Renders nothing while tiering is off."""
+    members = (kv or {}).get("members") or {}
+    if not (kv or {}).get("enabled") or not members:
+        return ""
+    parts = ["<h2 class=\"meta\">tiered KV</h2>"]
+    rows = []
+    for model, m in sorted(members.items()):
+        hbm = m.get("hbm") or {}
+        host = m.get("host") or {}
+        disk = m.get("disk") or {}
+        rows.append(
+            f"<tr class=\"kv-row\" data-model=\"{_e(model)}\">"
+            f"<td>{_e(model)}</td>"
+            f"<td>{_e(hbm.get('used_pages'))}/"
+            f"{_e(hbm.get('pages'))}</td>"
+            f"<td>{_e(hbm.get('sessions'))}</td>"
+            f"<td>{_mb(host.get('bytes'))}/"
+            f"{_mb(host.get('budget_bytes'))}</td>"
+            f"<td>{_e(host.get('sessions'))}+"
+            f"{_e(host.get('prefix_blocks'))}</td>"
+            f"<td>{_e(disk.get('entries') if disk else '—')}</td>"
+            f"<td>{_e(m.get('demoted_sessions'))}/"
+            f"{_e(m.get('restored_sessions'))}</td>"
+            f"<td>{_e(disk.get('corrupt_skipped') if disk else '—')}"
+            f"</td></tr>")
+    parts.append(
+        "<table id=\"kvtier\"><tr><th>model</th><th>hbm pages</th>"
+        "<th>sessions</th><th>host MB</th><th>host sess+pfx</th>"
+        "<th>disk entries</th><th>demote/restore</th>"
+        "<th>corrupt</th></tr>" + "".join(rows) + "</table>")
+    return "".join(parts)
+
+
 def telemetry_page(metrics: dict, resources: Optional[dict] = None,
                    qos: Optional[dict] = None,
-                   quality: Optional[dict] = None) -> str:
+                   quality: Optional[dict] = None,
+                   kv: Optional[dict] = None) -> str:
     """Dev telemetry view (reference LiveDashboard at /dev/dashboard):
     the /api/metrics snapshot as readable tables, led by the latency
-    histogram panel, the live resources panel, the QoS panel, and the
-    consensus-quality scorecards."""
+    histogram panel, the live resources panel, the QoS panel, the
+    tiered-KV panel, and the consensus-quality scorecards."""
     def table(title: str, d: dict) -> str:
         return (f"<h2 class=\"meta\">{_e(title)}</h2>"
                 f"<table class=\"metrics\" data-section=\"{_e(title)}\">"
@@ -472,6 +510,7 @@ def telemetry_page(metrics: dict, resources: Optional[dict] = None,
     body = (latency_panel(metrics.get("telemetry") or {})
             + resources_panel(resources or {})
             + qos_panel(qos or {})
+            + kv_panel(kv or {})
             + quality_panel(quality or {})
             + spec_panel((quality or {}).get("speculative") or {})
             + (table("runtime", flat) if flat else "")

@@ -1,0 +1,605 @@
+"""Tiered KV (serving/kvtier.py, ISSUE 7): host offload, session
+hibernation, and the restart-surviving disk prefix store.
+
+Covers the subsystem's acceptance bar end to end:
+  * temp-0 BIT-EQUALITY of a hibernate→restore session against one that
+    never left HBM (greedy and grammar-constrained rows);
+  * COW/shared-page refcount integrity across demote/restore — demoting
+    a donor must not disturb adopters or the radix tree, and a restored
+    session diverging must still COW-swap;
+  * kill-and-restart: a NEW engine over the same disk dir serves prefix
+    hits from its predecessor's persisted blocks, and checksum-rejected
+    corrupt entries are skipped (and unlinked), never served;
+  * host-budget LRU eviction with prefix blocks spilling to disk;
+  * the prefetch hook (engine.prefetch_session + ContinuousBatcher
+    submit + backend.prefetch_sessions);
+  * the QoS headroom signal counting demotable pages as reclaimable;
+  * the formerly silent SessionStore.alloc drift branch now counting
+    and flight-recording (ISSUE 7 satellite);
+  * pool_sizing's per-tier capacity rows (ISSUE 7 satellite);
+  * /api/kv + telemetry exposition.
+"""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from quoracle_tpu.models.config import get_model_config
+from quoracle_tpu.models.generate import (
+    GenerateEngine, SessionStore, _Session,
+)
+from quoracle_tpu.models.tokenizer import ByteTokenizer
+from quoracle_tpu.models.transformer import init_params
+from quoracle_tpu.serving.kvtier import DiskPrefixStore, TierManager
+
+CFG = get_model_config("xla:tiny")
+PARAMS = init_params(CFG, jax.random.PRNGKey(0), dtype=jnp.float32)
+
+
+def make_engine(**kw):
+    return GenerateEngine(CFG, PARAMS, ByteTokenizer(), max_seq=512,
+                          prompt_buckets=(32, 64, 128, 256), **kw)
+
+
+def enc(text):
+    return ByteTokenizer().encode(text, add_bos=True)
+
+
+def hibernate_all(engine):
+    """Force the eviction ladder over every resident session: demand all
+    usable pages (no protected keys), then release them."""
+    st = engine.sessions
+    with engine._paged_lock:
+        with st.lock:
+            got = st.alloc(st.n_pages - 1)
+            assert got is not None
+            st._release(got)
+
+
+SYS = "system: " + "policy rules apply here. " * 8    # > 1 page of 128
+
+
+# ---------------------------------------------------------------------------
+# Hibernate → restore bit-equality
+# ---------------------------------------------------------------------------
+
+def test_hibernate_restore_greedy_bit_equal():
+    tok = ByteTokenizer()
+    p1 = enc(SYS + " task: count to five.")
+    ctl = make_engine()
+    a1 = ctl.generate([p1], temperature=0.0, max_new_tokens=24,
+                      session_ids=["s"])
+    p2 = p1 + a1[0].token_ids + tok.encode(" continue")
+    a2 = ctl.generate([p2], temperature=0.0, max_new_tokens=24,
+                      session_ids=["s"])
+
+    eng = make_engine()
+    tier = eng.attach_tier(host_mb=64)
+    b1 = eng.generate([p1], temperature=0.0, max_new_tokens=24,
+                      session_ids=["s"])
+    assert b1[0].token_ids == a1[0].token_ids
+    hibernate_all(eng)
+    assert eng.sessions.get("s") is None
+    assert tier.has_session("s")
+    assert tier.demoted_sessions == 1
+    # the splice layer still sees the conversation ids while hibernated
+    assert eng.session_tokens("s") is not None
+    b2 = eng.generate([p2], temperature=0.0, max_new_tokens=24,
+                      session_ids=["s"])
+    assert b2[0].token_ids == a2[0].token_ids
+    assert tier.restored_sessions == 1
+    # restore means PAGE-IN, not re-prefill: the cached-token count of
+    # the resumed round matches the never-hibernated control exactly
+    assert b2[0].n_cached_tokens == a2[0].n_cached_tokens > 0
+
+
+def test_hibernate_restore_constrained_bit_equal():
+    enum = ("wait", "send_message", "todo")
+    p1 = enc(SYS + ' respond with an action json.')
+    ctl = make_engine()
+    a1 = ctl.generate([p1], temperature=0.0, max_new_tokens=48,
+                      session_ids=["s"], constrain_json=[True],
+                      action_enums=[enum])
+    p2 = p1 + a1[0].token_ids + enc("again")[1:]
+    a2 = ctl.generate([p2], temperature=0.0, max_new_tokens=48,
+                      session_ids=["s"], constrain_json=[True],
+                      action_enums=[enum])
+
+    eng = make_engine()
+    tier = eng.attach_tier(host_mb=64)
+    b1 = eng.generate([p1], temperature=0.0, max_new_tokens=48,
+                      session_ids=["s"], constrain_json=[True],
+                      action_enums=[enum])
+    assert b1[0].token_ids == a1[0].token_ids
+    hibernate_all(eng)
+    b2 = eng.generate([p2], temperature=0.0, max_new_tokens=48,
+                      session_ids=["s"], constrain_json=[True],
+                      action_enums=[enum])
+    assert b2[0].token_ids == a2[0].token_ids
+    assert tier.restored_sessions == 1
+
+
+def test_restore_failure_falls_back_to_prefill():
+    """A hibernated session whose restore cannot get pages re-prefills
+    (correctness never depends on the tier) and the stale host copy is
+    discarded at store-back."""
+    eng = make_engine()
+    tier = eng.attach_tier(host_mb=64)
+    p1 = enc(SYS + " task A")
+    ctl = make_engine()
+    a1 = ctl.generate([p1], temperature=0.0, max_new_tokens=16,
+                      session_ids=["s"])
+    b1 = eng.generate([p1], temperature=0.0, max_new_tokens=16,
+                      session_ids=["s"])
+    hibernate_all(eng)
+    # sabotage: empty the free list with a fake resident hog the ladder
+    # cannot demote past (protect it at restore time via direct call)
+    st = eng.sessions
+    with st.lock:
+        hog = st.alloc(len(st._free))
+        assert hog
+    with eng._paged_lock:
+        assert tier.restore_session("s") is None   # unattainable
+    assert tier.restore_failures == 1
+    with st.lock:
+        st._release(hog)
+    # generate still answers correctly (restore now succeeds — pages are
+    # back; equality with the control is the invariant either way)
+    b2 = eng.generate([p1], temperature=0.0, max_new_tokens=16,
+                      session_ids=["s"])
+    assert b2[0].token_ids == a1[0].token_ids == b1[0].token_ids
+
+
+# ---------------------------------------------------------------------------
+# COW / shared-page refcount integrity across demote/restore
+# ---------------------------------------------------------------------------
+
+def test_shared_refcounts_survive_demote_restore():
+    """Demoting a session whose prefix pages the radix tree (and an
+    adopter) still reference must not free or corrupt those pages; the
+    restored session gets FRESH pages and a later divergence COW-swaps
+    exactly like an always-resident one."""
+    tok = ByteTokenizer()
+    eng = make_engine()
+    tier = eng.attach_tier(host_mb=64)
+    st = eng.sessions
+    p_donor = enc(SYS + " donor task")
+    d1 = eng.generate([p_donor], temperature=0.0, max_new_tokens=16,
+                      session_ids=["donor"])
+    donor_pages = list(st.get("donor").pages)
+    # adopter shares the cached page-aligned SYS prefix
+    p_adopt = enc(SYS + " adopter goes elsewhere")
+    a1 = eng.generate([p_adopt], temperature=0.0, max_new_tokens=16,
+                      session_ids=["adopter"])
+    assert a1[0].n_cached_tokens >= st.page
+    shared = [p for p in st.get("adopter").pages if p in donor_pages]
+    assert shared, "adopter did not share the donor's prefix pages"
+    with st.lock:
+        refs_before = {p: st._refs.get(p, 1) for p in shared}
+
+    # hibernate ONLY the donor (protect the adopter through the ladder)
+    with eng._paged_lock:
+        with st.lock:
+            sess = st._sessions.pop("donor")
+            assert tier.demote_session("donor", sess)
+            st._release(sess.pages)
+    # shared pages survive with exactly one reference fewer; the
+    # adopter's session and the cache still read them
+    with st.lock:
+        for p in shared:
+            assert st._refs.get(p, 1) == refs_before[p] - 1
+            assert p not in st._free
+    oracle = make_engine()
+    o1 = oracle.generate([p_adopt], temperature=0.0, max_new_tokens=16,
+                         session_ids=["x"])
+    a2 = eng.generate([p_adopt], temperature=0.0, max_new_tokens=16,
+                      session_ids=["adopter2"])
+    assert a2[0].token_ids == o1[0].token_ids
+
+    # restore the donor and DIVERGE it mid-shared-page: the adopter's
+    # prefix must stay byte-intact (COW at the write site still fires)
+    p_div = p_donor[:st.page // 2] + tok.encode("DIVERGENT " * 8)
+    d2 = eng.generate([p_div], temperature=0.0, max_new_tokens=16,
+                      session_ids=["donor"])
+    assert tier.restored_sessions == 1
+    o2 = oracle.generate([p_adopt], temperature=0.0, max_new_tokens=16,
+                         session_ids=["y"])
+    a3 = eng.generate([p_adopt], temperature=0.0, max_new_tokens=16,
+                      session_ids=["adopter3"])
+    assert a3[0].token_ids == o2[0].token_ids
+    od = oracle.generate([p_div], temperature=0.0, max_new_tokens=16,
+                         session_ids=["z"])
+    assert d2[0].token_ids == od[0].token_ids
+
+
+def test_dropped_session_does_not_resurrect_from_host_tier():
+    eng = make_engine()
+    tier = eng.attach_tier(host_mb=64)
+    p1 = enc(SYS + " ephemeral")
+    eng.generate([p1], temperature=0.0, max_new_tokens=8,
+                 session_ids=["s"])
+    hibernate_all(eng)
+    assert tier.has_session("s")
+    eng.drop_session("s")
+    assert not tier.has_session("s")
+    assert eng.session_tokens("s") is None
+
+
+# ---------------------------------------------------------------------------
+# Disk prefix store: kill-and-restart warm start, checksum rejection
+# ---------------------------------------------------------------------------
+
+def test_disk_store_warm_starts_restarted_process(tmp_path):
+    d = str(tmp_path / "kv")
+    p1 = enc(SYS + " task one")
+    # "process 1": serve traffic; store-back persists prefix blocks
+    e1 = make_engine()
+    e1.attach_tier(host_mb=64, disk_dir=d)
+    r1 = e1.generate([p1], temperature=0.0, max_new_tokens=16,
+                     session_ids=["a"])
+    files = glob.glob(os.path.join(d, "*", "*.npz"))
+    assert files, "store-back persisted no prefix blocks"
+    # oracle: tierless fresh engine
+    rc = make_engine().generate([p1], temperature=0.0, max_new_tokens=16,
+                                session_ids=["x"])
+    # "process 2" (restart): brand-new engine + store, same disk dir
+    e2 = make_engine()
+    t2 = e2.attach_tier(host_mb=64, disk_dir=d)
+    r2 = e2.generate([p1], temperature=0.0, max_new_tokens=16,
+                     session_ids=["b"])
+    assert r2[0].token_ids == rc[0].token_ids == r1[0].token_ids
+    assert t2.restored_prefix_pages > 0, "no disk warm-start happened"
+    assert r2[0].n_cached_tokens >= e2.sessions.page, \
+        "restart prompt was not served from the warmed prefix cache"
+
+
+def test_disk_store_skips_and_unlinks_corrupt_entries(tmp_path):
+    d = str(tmp_path / "kv")
+    p1 = enc(SYS + " task one")
+    e1 = make_engine()
+    e1.attach_tier(host_mb=64, disk_dir=d)
+    e1.generate([p1], temperature=0.0, max_new_tokens=16,
+                session_ids=["a"])
+    files = glob.glob(os.path.join(d, "*", "*.npz"))
+    assert files
+    victim = files[0]
+    with open(victim, "r+b") as f:
+        f.seek(100)
+        f.write(b"\x00" * 64)
+    rc = make_engine().generate([p1], temperature=0.0, max_new_tokens=16,
+                                session_ids=["x"])
+    e3 = make_engine()
+    t3 = e3.attach_tier(host_mb=64, disk_dir=d)
+    r3 = e3.generate([p1], temperature=0.0, max_new_tokens=16,
+                     session_ids=["c"])
+    # corrupt entry rejected, never served — output matches the oracle
+    # via plain prefill, and the bad file was unlinked (the store-back
+    # then re-persists a CLEAN block under the same content key)
+    assert r3[0].token_ids == rc[0].token_ids
+    assert t3.disk.corrupt >= 1
+    assert t3.restored_prefix_pages == 0
+    fresh = DiskPrefixStore(d, os.path.basename(os.path.dirname(victim)))
+    key = os.path.splitext(os.path.basename(victim))[0]
+    if fresh.has(key):
+        # the rewrite is clean: it loads (or it was unlinked entirely)
+        toks = None
+        with np.load(victim) as z:
+            toks = z["tokens"].tolist()
+        assert fresh.load(key, toks) is not None
+
+
+def test_disk_store_round_trips_bfloat16(tmp_path):
+    """Serving caches are bfloat16; npz round-trips extension dtypes as
+    an opaque void dtype unless the store ships raw bytes + dtype name —
+    regression for the silent-dtype-strip the CLI drive caught."""
+    s = DiskPrefixStore(str(tmp_path), "sig", model="m")
+    toks = list(range(128))
+    k = (np.arange(2 * 128 * 2 * 16, dtype=np.float32)
+         .reshape(2, 128, 2, 16).astype(jnp.bfloat16))
+    v = (k * 2).astype(jnp.bfloat16)
+    key = s.block_key(toks)
+    assert s.save(key, toks, np.asarray(k), np.asarray(v))
+    loaded = s.load(key, toks)
+    assert loaded is not None
+    lk, lv = loaded
+    assert lk.dtype == jnp.bfloat16 and lv.dtype == jnp.bfloat16
+    assert lk.tobytes() == np.asarray(k).tobytes()
+    assert lv.tobytes() == np.asarray(v).tobytes()
+
+
+def test_disk_store_rejects_token_mismatch(tmp_path):
+    s = DiskPrefixStore(str(tmp_path), "sig", model="m")
+    toks = list(range(128))
+    k = np.ones((2, 128, 2, 16), np.float32)
+    key = s.block_key(toks)
+    assert s.save(key, toks, k, k * 2)
+    assert s.load(key, toks) is not None
+    # same key requested under different tokens (hash collision stand-in)
+    # must be rejected, not served
+    assert s.load(key, list(range(1, 129))) is None
+    assert s.corrupt == 1
+
+
+# ---------------------------------------------------------------------------
+# Host budget + disk spill
+# ---------------------------------------------------------------------------
+
+def test_host_budget_evicts_lru_and_spills_prefixes(tmp_path):
+    store = SessionStore(max_tokens=8 * 4, page=4)
+    tier = TierManager(store, model="m", host_mb=1,
+                       disk_dir=str(tmp_path))
+    store.tier = tier
+    # budget of ~2 tiny blocks: force LRU churn
+    blk = np.zeros((2, 4, 2, 4), np.float32)
+    tier.host.budget_bytes = 3 * (2 * blk.nbytes)
+    from quoracle_tpu.serving.kvtier import _HostBlock
+    keys = []
+    for i in range(5):
+        toks = [100 * i + j for j in range(4)]
+        key = tier._block_key(toks)
+        keys.append(key)
+        tier.host.put_prefix(key, _HostBlock(toks, blk + i, blk + i),
+                             spill_fn=tier._spill_prefix_entry)
+    assert tier.host.bytes <= tier.host.budget_bytes
+    assert tier.host.evicted_prefixes == 2
+    # evicted blocks landed on disk, checksummed
+    for key in keys[:2]:
+        assert tier.disk.has(key)
+    for key in keys[2:]:
+        assert key in tier.host.prefixes
+
+
+def test_host_budget_drops_lru_sessions():
+    store = SessionStore(max_tokens=8 * 4, page=4)
+    tier = TierManager(store, model="m", host_mb=1)
+    store.tier = tier
+    from quoracle_tpu.serving.kvtier import _HostSession
+    arr = np.zeros((2, 1, 4, 2, 4), np.float32)
+    tier.host.budget_bytes = 2 * (2 * arr.nbytes)
+    for i in range(4):
+        tier.host.put_session(f"s{i}", _HostSession([i], 0, arr.copy(),
+                                                    arr.copy()))
+    assert tier.host.evicted_sessions == 2
+    assert set(tier.host.sessions) == {"s2", "s3"}
+
+
+# ---------------------------------------------------------------------------
+# Prefetch hooks
+# ---------------------------------------------------------------------------
+
+def test_prefetch_restores_hibernated_session():
+    eng = make_engine()
+    tier = eng.attach_tier(host_mb=64)
+    p1 = enc(SYS + " warm me")
+    eng.generate([p1], temperature=0.0, max_new_tokens=8,
+                 session_ids=["s"])
+    hibernate_all(eng)
+    assert eng.sessions.get("s") is None
+    assert eng.prefetch_session("s") is True
+    assert eng.sessions.get("s") is not None
+    assert tier.restored_sessions == 1
+    # idempotent: already-resident session is not restored twice
+    assert eng.prefetch_session("s") is False
+
+
+def test_prefetch_skips_busy_engine():
+    eng = make_engine()
+    eng.attach_tier(host_mb=64)
+    p1 = enc(SYS + " busy case")
+    eng.generate([p1], temperature=0.0, max_new_tokens=8,
+                 session_ids=["s"])
+    hibernate_all(eng)
+    with eng._paged_lock:          # simulate an in-flight paged call
+        assert eng.prefetch_session("s") is False
+    assert eng.prefetch_session("s") is True
+
+
+def test_continuous_batcher_submit_prefetches():
+    from quoracle_tpu.models.scheduler import ContinuousBatcher
+    eng = make_engine()
+    tier = eng.attach_tier(host_mb=64)
+    p1 = enc(SYS + " via scheduler")
+    ctl = make_engine()
+    o1 = ctl.generate([p1], temperature=0.0, max_new_tokens=8,
+                      session_ids=["s"])
+    eng.generate([p1], temperature=0.0, max_new_tokens=8,
+                 session_ids=["s"])
+    hibernate_all(eng)
+    cb = ContinuousBatcher(eng, chunk=8, max_slots=2)
+    try:
+        tok = ByteTokenizer()
+        p2 = p1 + o1[0].token_ids + tok.encode(" go on")
+        o2 = ctl.generate([p2], temperature=0.0, max_new_tokens=8,
+                          session_ids=["s"])
+        fut = cb.submit(p2, temperature=0.0, max_new_tokens=8,
+                        session_id="s")
+        got = fut.result(timeout=120)
+        assert got.token_ids == o2[0].token_ids
+        assert tier.restored_sessions == 1
+    finally:
+        cb.close()
+
+
+def test_backend_prefetch_sessions():
+    from quoracle_tpu.models.runtime import TPUBackend
+    backend = TPUBackend(pool=["xla:tiny"], host_kv_mb=64)
+    assert backend.kv_tiered
+    eng = backend.engines["xla:tiny"]
+    p1 = enc(SYS + " backend warm")
+    eng.generate([p1], temperature=0.0, max_new_tokens=8,
+                 session_ids=["agent-1"])
+    hibernate_all(eng)
+    assert backend.prefetch_sessions("agent-1") == 1
+    assert eng.sessions.get("agent-1") is not None
+    assert backend.prefetch_sessions("agent-1") == 0
+
+
+# ---------------------------------------------------------------------------
+# QoS headroom: demotable pages count as reclaimable
+# ---------------------------------------------------------------------------
+
+def test_effective_headroom_counts_demotable_pages(monkeypatch):
+    from quoracle_tpu.infra import resources
+    from quoracle_tpu.models.runtime import TPUBackend
+    backend = TPUBackend(pool=["xla:tiny"], host_kv_mb=64)
+    eng = backend.engines["xla:tiny"]
+    eng.generate([enc(SYS + " hold pages")], temperature=0.0,
+                 max_new_tokens=8, session_ids=["s"])
+    assert resources.reclaimable_kv_bytes(backend) > 0
+    # fake a limit-reporting device so the fraction math is exercised
+    monkeypatch.setattr(
+        resources, "device_memory_stats",
+        lambda: [{"device": 0, "bytes_in_use": 90, "bytes_limit": 100,
+                  "peak_bytes_in_use": 0, "platform": "cpu",
+                  "kind": "fake", "source": "test"}])
+    frac = resources.effective_headroom_fraction(backend)
+    assert frac is not None and frac > 0.1   # raw 0.1 + reclaimable
+    # untiered backend: effective == raw
+    untiered = TPUBackend(pool=["xla:tiny"], engines={"xla:tiny": eng})
+    untiered_eng_tier, eng.sessions.tier = eng.sessions.tier, None
+    try:
+        assert resources.reclaimable_kv_bytes(untiered) == 0
+        assert abs(resources.effective_headroom_fraction(untiered)
+                   - 0.1) < 1e-9
+    finally:
+        eng.sessions.tier = untiered_eng_tier
+
+
+# ---------------------------------------------------------------------------
+# Satellite: the alloc drift branch is loud now
+# ---------------------------------------------------------------------------
+
+def test_alloc_drift_counts_and_flight_records(monkeypatch):
+    from quoracle_tpu.infra.flightrec import FLIGHT
+    from quoracle_tpu.infra.telemetry import KV_ALLOC_DRIFT_TOTAL
+    store = SessionStore(max_tokens=4 * 4, page=4)
+    store.model = "drifty"
+    pages = store.alloc(2)
+    store.put("a", _Session(tokens=list(range(8)), pages=pages))
+    # force drift: attainability promises pages eviction can't deliver
+    monkeypatch.setattr(store, "_attainable", lambda victims: 99)
+    before = KV_ALLOC_DRIFT_TOTAL.value(model="drifty")
+    assert store.alloc(10) is None
+    assert KV_ALLOC_DRIFT_TOTAL.value(model="drifty") == before + 1
+    events = [e for e in FLIGHT.snapshot()
+              if e.get("kind") == "kv_alloc_drift"
+              and e.get("model") == "drifty"]
+    assert events and events[-1]["requested"] == 10
+
+
+# ---------------------------------------------------------------------------
+# Satellite: pool_sizing per-tier capacity
+# ---------------------------------------------------------------------------
+
+def test_pool_sizing_reports_tier_capacity():
+    from quoracle_tpu.parallel.mesh import pool_sizing
+    from quoracle_tpu.models.config import NORTH_STAR_POOL
+    sizing = pool_sizing(NORTH_STAR_POOL, 8, host_kv_mb=4096,
+                         disk_kv_gb=64.0)
+    for m in sizing["members"]:
+        tiers = m["tiers"]
+        assert tiers["hbm_tokens"] == m["resident_kv_tokens"]
+        assert tiers["hbm_pages"] == m["resident_kv_tokens"] // 128
+        assert tiers["host_kv_mb"] == 4096
+        assert tiers["host_kv_tokens"] > 0
+        assert tiers["disk_kv_tokens"] > tiers["host_kv_tokens"]
+    assert sizing["host_kv_mb_per_member"] == 4096
+    # host tier capacity uses UNSHARDED bytes/token: it must not exceed
+    # what the budget divided by the tp=1 rate allows
+    from quoracle_tpu.models.config import get_model_config
+    for m in sizing["members"]:
+        cfg = get_model_config(f"xla:{m['model']}") \
+            if not m["model"].startswith("xla:") else \
+            get_model_config(m["model"])
+        rate = cfg.kv_bytes_per_token(1, 2)
+        assert m["tiers"]["host_kv_tokens"] == (4096 << 20) // rate
+    # omitting the knobs keeps the tier block zeroed, not absent
+    plain = pool_sizing(NORTH_STAR_POOL, 8)
+    assert plain["members"][0]["tiers"]["host_kv_tokens"] == 0
+
+
+# ---------------------------------------------------------------------------
+# API + exposition
+# ---------------------------------------------------------------------------
+
+def test_kv_stats_and_prometheus_exposition():
+    from quoracle_tpu.infra.telemetry import METRICS
+    from quoracle_tpu.models.runtime import TPUBackend
+    backend = TPUBackend(pool=["xla:tiny"], host_kv_mb=64)
+    eng = backend.engines["xla:tiny"]
+    eng.generate([enc(SYS + " stats")], temperature=0.0,
+                 max_new_tokens=8, session_ids=["s"])
+    hibernate_all(eng)
+    eng.generate([enc(SYS + " stats")], temperature=0.0,
+                 max_new_tokens=8, session_ids=["s"])
+    stats = backend.kv_stats()
+    assert stats["enabled"]
+    m = stats["members"]["xla:tiny"]
+    assert m["demoted_sessions"] >= 1
+    assert m["restored_sessions"] >= 1
+    assert m["hbm"]["pages"] == eng.sessions.n_pages
+    text = METRICS.render_prometheus()
+    assert "quoracle_kv_demotes_total" in text
+    assert "quoracle_kv_restores_total" in text
+    assert "quoracle_kv_restore_ms" in text
+    assert 'kind="session"' in text
+
+
+def test_api_kv_payload_shapes():
+    """kv_payload over a MockBackend (no tiering) and the TPU backend —
+    the endpoint must answer in both worlds."""
+    from quoracle_tpu.models.runtime import MockBackend, TPUBackend
+
+    class _FakeRuntime:
+        def __init__(self, backend):
+            self.backend = backend
+
+    from quoracle_tpu.web.server import DashboardServer
+    d = DashboardServer.__new__(DashboardServer)
+    d.runtime = _FakeRuntime(MockBackend())
+    payload = d.kv_payload()
+    assert payload["enabled"] is False
+    assert "counters" in payload
+
+    backend = TPUBackend(pool=["xla:tiny"], host_kv_mb=64)
+    d.runtime = _FakeRuntime(backend)
+    payload = d.kv_payload()
+    assert payload["enabled"] is True
+    assert "xla:tiny" in payload["members"]
+
+
+def test_kv_panel_renders():
+    from quoracle_tpu.web.views import kv_panel
+    assert kv_panel({"enabled": False}) == ""
+    html = kv_panel({"enabled": True, "members": {"xla:tiny": {
+        "hbm": {"pages": 10, "free_pages": 4, "used_pages": 5,
+                "sessions": 2, "prefix_cache": {}},
+        "host": {"bytes": 1 << 20, "budget_bytes": 64 << 20,
+                 "sessions": 3, "prefix_blocks": 7},
+        "disk": {"entries": 11, "corrupt_skipped": 0},
+        "demoted_sessions": 5, "restored_sessions": 4,
+    }}})
+    assert "tiered KV" in html and "xla:tiny" in html and "11" in html
+
+
+# ---------------------------------------------------------------------------
+# Flight-recorder events
+# ---------------------------------------------------------------------------
+
+def test_demote_restore_flight_events():
+    from quoracle_tpu.infra.flightrec import FLIGHT
+    eng = make_engine()
+    eng.attach_tier(host_mb=64)
+    p1 = enc(SYS + " flight")
+    eng.generate([p1], temperature=0.0, max_new_tokens=8,
+                 session_ids=["s"])
+    hibernate_all(eng)
+    eng.generate([p1], temperature=0.0, max_new_tokens=8,
+                 session_ids=["s"])
+    kinds = [e["kind"] for e in FLIGHT.snapshot()]
+    assert "kv_demote" in kinds
+    assert "kv_restore" in kinds
