@@ -77,7 +77,8 @@ async def cmd_run(args: argparse.Namespace) -> int:
                                continuous=args.continuous,
                                qos=args.qos or None,
                                host_kv_mb=args.host_kv_mb,
-                               disk_kv_dir=args.disk_kv_dir))
+                               disk_kv_dir=args.disk_kv_dir,
+                               disk_kv_gb=args.disk_kv_gb))
     _attach_printer(rt)
     if pool is None and args.profile is None:
         pool = rt.default_pool()
@@ -108,7 +109,8 @@ async def cmd_resume(args: argparse.Namespace) -> int:
                                continuous=args.continuous,
                                qos=args.qos or None,
                                host_kv_mb=args.host_kv_mb,
-                               disk_kv_dir=args.disk_kv_dir))
+                               disk_kv_dir=args.disk_kv_dir,
+                               disk_kv_gb=args.disk_kv_gb))
     _attach_printer(rt)
     result = await rt.boot()
     print(json.dumps(result), flush=True)
@@ -134,7 +136,8 @@ async def cmd_serve(args: argparse.Namespace) -> int:
         draft_map=_parse_drafts(args.drafts) or None,
         draft_k=args.draft_k,
         continuous=args.continuous, qos=args.qos or None,
-        host_kv_mb=args.host_kv_mb, disk_kv_dir=args.disk_kv_dir))
+        host_kv_mb=args.host_kv_mb, disk_kv_dir=args.disk_kv_dir,
+        disk_kv_gb=args.disk_kv_gb))
     # Validate host/token BEFORE boot so a refused bind exits with a clean
     # message instead of a traceback over a half-started runtime.
     try:
@@ -222,6 +225,12 @@ def build_parser() -> argparse.ArgumentParser:
                              "disk prefix store — a restarted process "
                              "warm-starts from its predecessor's "
                              "prefixes; corrupt entries are skipped")
+        sp.add_argument("--disk-kv-gb", dest="disk_kv_gb", type=float,
+                        default=8.0,
+                        help="byte budget of the disk prefix store per "
+                             "pool member (GiB): oldest-LRU entries "
+                             "prune when a write overflows it; 0 = "
+                             "unbounded")
         sp.add_argument("--qos", action="store_true",
                         help="serving QoS (ISSUE 4): weighted-fair "
                              "admission + overload shedding + SLO "

@@ -1447,14 +1447,16 @@ class GenerateEngine:
         return merged
 
     def attach_tier(self, host_mb: int = 256,
-                    disk_dir: Optional[str] = None):
+                    disk_dir: Optional[str] = None,
+                    disk_gb: float = 8.0):
         """Enable tiered KV (ISSUE 7, serving/kvtier.py): HBM eviction
         demotes to a ``host_mb``-bounded host page store, touches restore
         by page-in, and (with ``disk_dir``) prefix-cache blocks persist
-        to a checksummed disk store that warm-starts the next process.
-        The disk signature binds entries to this engine's exact KV
-        geometry and dtype, so mismatched processes can never exchange
-        bytes. Returns the TierManager (also at ``sessions.tier``)."""
+        to a checksummed disk store — ``disk_gb``-bounded, oldest-LRU
+        entries pruned — that warm-starts the next process. The disk
+        signature binds entries to this engine's exact KV geometry and
+        dtype, so mismatched processes can never exchange bytes.
+        Returns the TierManager (also at ``sessions.tier``)."""
         from quoracle_tpu.serving.kvtier import TierManager
         cfg = self.cfg
         sig = (f"{cfg.name.replace('/', '_')}-L{cfg.n_layers}"
@@ -1462,7 +1464,8 @@ class GenerateEngine:
                f"-{jnp.dtype(self.cache_dtype).name}")
         tier = TierManager(self.sessions, model=cfg.name,
                            host_mb=host_mb, disk_dir=disk_dir,
-                           paged_lock=self._paged_lock, signature=sig)
+                           paged_lock=self._paged_lock, signature=sig,
+                           disk_gb=disk_gb)
         self.sessions.tier = tier
         return tier
 

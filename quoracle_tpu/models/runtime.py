@@ -379,7 +379,8 @@ class TPUBackend(ModelBackend):
                  continuous_slots: int = 8,
                  draft_map: Optional[dict] = None, draft_k: int = 6,
                  qos=None, host_kv_mb: int = 0,
-                 disk_kv_dir: Optional[str] = None):
+                 disk_kv_dir: Optional[str] = None,
+                 disk_kv_gb: float = 8.0):
         """``submeshes``: one jax Mesh per pool member (parallel.mesh.
         pool_submeshes) — each member's engine serves tp-sharded on its own
         chips, and ``overlap`` runs members concurrently from host threads
@@ -446,7 +447,8 @@ class TPUBackend(ModelBackend):
         if self.kv_tiered:
             for spec in self.pool:
                 self.engines[spec].attach_tier(
-                    host_mb=host_kv_mb or 256, disk_dir=disk_kv_dir)
+                    host_mb=host_kv_mb or 256, disk_dir=disk_kv_dir,
+                    disk_gb=disk_kv_gb)
 
         # Speculative serving (models/speculative.py): draft_map routes a
         # member's decode through draft-K/verify-one-chunk decoding —
@@ -554,9 +556,19 @@ class TPUBackend(ModelBackend):
     def close(self) -> None:
         """Stop the continuous batcher threads (no-op otherwise). Queued
         rows fail loudly rather than stranding waiters — scheduler.close()
-        semantics."""
+        semantics. Tiered engines drain their queued disk spills so a
+        clean shutdown hands its successor every persisted prefix (an
+        abrupt kill loses at most the queue — the store is an
+        optimization, never state)."""
         for cb in self._cbatchers.values():
             cb.close()
+        for eng in self.engines.values():
+            tier = getattr(eng.sessions, "tier", None)
+            if tier is not None:
+                try:
+                    tier.flush_spills()
+                except Exception:         # noqa: BLE001 — best-effort
+                    pass
 
     # -- ModelBackend --
 

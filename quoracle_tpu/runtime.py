@@ -227,6 +227,10 @@ class RuntimeConfig:
     # becomes bounded by host RAM.
     host_kv_mb: int = 0
     disk_kv_dir: Optional[str] = None
+    # Byte budget of the disk prefix store (per member): oldest-LRU
+    # entries prune when a write overflows it, so a long-running fleet
+    # cannot fill the disk. Matches pool_sizing's disk_kv_gb knob.
+    disk_kv_gb: float = 8.0
 
 
 class Runtime:
@@ -390,7 +394,8 @@ class Runtime:
                           draft_map=draft_map or None,
                           continuous=config.continuous,
                           qos=qos, host_kv_mb=config.host_kv_mb,
-                          disk_kv_dir=config.disk_kv_dir)
+                          disk_kv_dir=config.disk_kv_dir,
+                          disk_kv_gb=config.disk_kv_gb)
 
     async def boot(self) -> dict:
         """Boot-time revival of persisted running tasks (reference

@@ -47,7 +47,11 @@ arrays are only ever touched under it). Restore is called from the
 engine's session-lookup path (same locks) or from ``prefetch`` (which
 try-acquires the engine lock itself, so a busy engine skips the warm-up
 rather than blocking the submitter — the generate path restores
-synchronously anyway).
+synchronously anyway). Disk writes NEVER happen under those locks:
+demote/persist only copy device pages host-side (one ``device_get`` per
+victim — unavoidable, the pages are about to be recycled) and queue the
+npz write to a daemon spill writer; ``flush_spills`` drains it when a
+caller needs durability (tests, orderly shutdown).
 """
 
 from __future__ import annotations
@@ -56,6 +60,7 @@ import functools
 import hashlib
 import logging
 import os
+import queue
 import threading
 import time
 import zlib
@@ -202,16 +207,80 @@ class DiskPrefixStore:
     and the requested token prefix against the stored one; any mismatch
     counts as corrupt, unlinks the file, and returns None — the caller
     falls back to a plain prefill. The store is an OPTIMIZATION with a
-    paranoid boundary, never a correctness dependency."""
+    paranoid boundary, never a correctness dependency.
 
-    def __init__(self, root: str, signature: str, model: str = ""):
+    Bounded: ``budget_bytes`` (0 = unbounded) caps the directory —
+    when a save overflows it, oldest-mtime entries unlink until the
+    store fits again, and ``load`` touches an entry's mtime so pruning
+    approximates LRU rather than FIFO. Directory size is tracked
+    incrementally (one startup scan, refreshed at most every
+    ``_SCAN_TTL_S``), so a /api/resources scrape costs no listdir."""
+
+    _SCAN_TTL_S = 30.0
+
+    def __init__(self, root: str, signature: str, model: str = "",
+                 budget_bytes: int = 0):
         self.dir = os.path.join(root, signature)
         self.model = model
+        self.budget_bytes = int(budget_bytes)
         os.makedirs(self.dir, exist_ok=True)
         self.writes = 0
         self.loads = 0
         self.corrupt = 0
+        self.pruned = 0
         self._lock = threading.Lock()
+        self._scan_entries = 0
+        self._scan_bytes = 0
+        self._scan_ts = 0.0
+        with self._lock:
+            self._rescan_locked()         # one startup scan; then cached
+
+    def _rescan_locked(self) -> None:
+        entries = nbytes = 0
+        try:
+            for f in os.listdir(self.dir):
+                if not f.endswith(".npz"):
+                    continue
+                entries += 1
+                try:
+                    nbytes += os.path.getsize(os.path.join(self.dir, f))
+                except OSError:
+                    pass
+        except OSError:
+            pass
+        self._scan_entries, self._scan_bytes = entries, nbytes
+        self._scan_ts = time.monotonic()
+
+    def _prune_locked(self) -> None:
+        """Unlink oldest-mtime entries until the store fits the budget
+        (load() touches mtime, so eviction order approximates LRU)."""
+        files = []
+        try:
+            for f in os.listdir(self.dir):
+                if not f.endswith(".npz"):
+                    continue
+                p = os.path.join(self.dir, f)
+                try:
+                    stt = os.stat(p)
+                except OSError:
+                    continue
+                files.append((stt.st_mtime, stt.st_size, p))
+        except OSError:
+            return
+        files.sort()
+        self._scan_entries = len(files)
+        self._scan_bytes = sum(sz for _, sz, _ in files)
+        self._scan_ts = time.monotonic()
+        for _, sz, p in files:
+            if self._scan_bytes <= self.budget_bytes:
+                break
+            try:
+                os.unlink(p)
+            except OSError:
+                continue
+            self._scan_bytes -= sz
+            self._scan_entries -= 1
+            self.pruned += 1
 
     @staticmethod
     def block_key(tokens: Sequence[int]) -> str:
@@ -256,6 +325,14 @@ class DiskPrefixStore:
                         dtype=str(k.dtype), shape=np.asarray(k.shape),
                         crc=np.uint32(self._crc(toks, k, v)))
                 os.replace(tmp, path)
+                try:
+                    self._scan_bytes += os.path.getsize(path)
+                    self._scan_entries += 1
+                except OSError:
+                    self._scan_ts = 0.0   # stale; rescan on next stats
+                if (self.budget_bytes
+                        and self._scan_bytes > self.budget_bytes):
+                    self._prune_locked()
             self.writes += 1
             return True
         except OSError:
@@ -283,6 +360,10 @@ class DiskPrefixStore:
                     or toks.tolist() != [int(t) for t in tokens]):
                 raise ValueError("checksum/token mismatch")
             self.loads += 1
+            try:
+                os.utime(path)            # LRU touch for budget pruning
+            except OSError:
+                pass
             from quoracle_tpu.infra.telemetry import KV_DISK_LOADS_TOTAL
             KV_DISK_LOADS_TOTAL.inc(model=self.model, status="ok")
             return k, v
@@ -297,19 +378,19 @@ class DiskPrefixStore:
                 os.unlink(path)
             except OSError:
                 pass
+            self._scan_ts = 0.0           # stale; rescan on next stats
             return None
 
     def stats(self) -> dict:
-        try:
-            entries = [f for f in os.listdir(self.dir)
-                       if f.endswith(".npz")]
-            nbytes = sum(os.path.getsize(os.path.join(self.dir, f))
-                         for f in entries)
-        except OSError:
-            entries, nbytes = [], 0
-        return {"dir": self.dir, "entries": len(entries),
-                "bytes": nbytes, "writes": self.writes,
-                "loads": self.loads, "corrupt_skipped": self.corrupt}
+        with self._lock:
+            if time.monotonic() - self._scan_ts > self._SCAN_TTL_S:
+                self._rescan_locked()
+            return {"dir": self.dir, "entries": self._scan_entries,
+                    "bytes": self._scan_bytes,
+                    "budget_bytes": self.budget_bytes,
+                    "writes": self.writes, "loads": self.loads,
+                    "corrupt_skipped": self.corrupt,
+                    "pruned": self.pruned}
 
 
 class TierManager:
@@ -320,7 +401,8 @@ class TierManager:
 
     def __init__(self, store, model: str = "", host_mb: int = 256,
                  disk_dir: Optional[str] = None, paged_lock=None,
-                 signature: Optional[str] = None):
+                 signature: Optional[str] = None,
+                 disk_gb: float = 8.0):
         self.store = store
         self.model = model
         self.paged_lock = paged_lock
@@ -329,13 +411,28 @@ class TierManager:
         if disk_dir:
             self.disk = DiskPrefixStore(
                 disk_dir, signature or (model.replace("/", "_")
-                                        or "default"), model=model)
+                                        or "default"), model=model,
+                budget_bytes=int(disk_gb * (1 << 30)))
         # monotonic counters (stats() → /api/kv + bench config 14)
         self.demoted_sessions = 0
         self.demoted_prefix_pages = 0
         self.restored_sessions = 0
         self.restored_prefix_pages = 0
         self.restore_failures = 0
+        self.spill_drops = 0
+        # Disk spills are ASYNC: the eviction ladder runs inside
+        # SessionStore.alloc with the store lock held (and the engine's
+        # paged lock, for sessioned callers) — an npz write there would
+        # stall every allocation under memory pressure. Only the
+        # host-side numpy copy happens under the locks; writes queue to
+        # a daemon writer thread. Best-effort by design: a full queue
+        # drops the spill (the block is reconstructible by prefill).
+        self._spill_q: Optional[queue.Queue] = None
+        if self.disk is not None:
+            self._spill_q = queue.Queue(maxsize=512)
+            threading.Thread(
+                target=self._spill_loop, daemon=True,
+                name=f"kvtier-spill-{model or 'default'}").start()
 
     # -- device <-> host plumbing ---------------------------------------
 
@@ -450,17 +547,46 @@ class TierManager:
     def _block_key(self, tokens: Sequence[int]) -> str:
         return DiskPrefixStore.block_key(tokens)
 
-    def _spill_prefix_entry(self, key: str, entry: _HostBlock) -> None:
-        """Host-budget eviction of a prefix block: spill to disk when
-        attached (dedup by key), else the block is simply gone."""
-        if self.disk is None:
-            return
+    def _spill_loop(self) -> None:
+        while True:
+            key, entry = self._spill_q.get()
+            try:
+                self._write_block(key, entry)
+            except Exception:             # noqa: BLE001 — best-effort
+                logger.exception("kv disk spill failed")
+            finally:
+                self._spill_q.task_done()
+
+    def _write_block(self, key: str, entry: _HostBlock) -> None:
+        """Writer-thread side of a spill: the actual (atomic, content-
+        addressed) disk write, never under the store/paged locks."""
         if self.disk.save(key, entry.tokens, entry.k, entry.v):
             from quoracle_tpu.infra.flightrec import FLIGHT
             from quoracle_tpu.infra.telemetry import KV_DISK_SPILLS_TOTAL
             KV_DISK_SPILLS_TOTAL.inc(model=self.model)
             FLIGHT.record("kv_disk_spill", model=self.model,
                           tokens=len(entry.tokens))
+
+    def _enqueue_spill(self, key: str, entry: _HostBlock) -> None:
+        if self._spill_q is None:
+            return
+        try:
+            self._spill_q.put_nowait((key, entry))
+        except queue.Full:
+            self.spill_drops += 1
+
+    def flush_spills(self) -> None:
+        """Block until every queued disk write has landed (tests and
+        orderly shutdown; the serving path never needs to wait)."""
+        if self._spill_q is not None:
+            self._spill_q.join()
+
+    def _spill_prefix_entry(self, key: str, entry: _HostBlock) -> None:
+        """Host-budget eviction of a prefix block: queue a disk spill
+        when attached (dedup by content key at write time), else the
+        block is simply gone. Runs under the store lock — must not
+        touch the filesystem."""
+        self._enqueue_spill(key, entry)
 
     def capture_leaf(self, tokens: Sequence[int], page: int) -> None:
         """A radix-cache leaf is about to be stripped (prefix_cache.evict):
@@ -495,7 +621,10 @@ class TierManager:
         radix tree is written through to disk (content-addressed — a
         block already persisted costs one stat()). This is what makes a
         restarted process warm: the disk store accumulates the fleet's
-        hot prefixes while they are still hot, not only at eviction."""
+        hot prefixes while they are still hot, not only at eviction.
+        Only the device→host copy happens here (the caller holds the
+        store lock, so the page content is stable); the npz write rides
+        the spill queue."""
         if self.disk is None:
             return
         key = self._block_key(tokens)
@@ -508,9 +637,8 @@ class TierManager:
             k, v = self._gather_host([page])
         except Exception:                 # noqa: BLE001 — best-effort
             return
-        if self.disk.save(key, tokens, k[:, 0], v[:, 0]):
-            from quoracle_tpu.infra.telemetry import KV_DISK_SPILLS_TOTAL
-            KV_DISK_SPILLS_TOTAL.inc(model=self.model)
+        self._enqueue_spill(
+            key, _HostBlock([int(t) for t in tokens], k[:, 0], v[:, 0]))
 
     def extend_prefix(self, tokens: Sequence[int], cap: int) -> int:
         """Lazily page tiered prefix blocks back into the radix tree:
@@ -525,6 +653,7 @@ class TierManager:
         page = st.page
         restored = 0
         attempted: set = set()
+        shrinks = 0
         while True:
             j = st.prefix_cache.match_len(tokens, cap) // page
             end = (j + 1) * page
@@ -547,14 +676,36 @@ class TierManager:
             pages = st.alloc(1)
             if pages is None:
                 break
+            path = st.prefix_cache._walk(tokens, cap)
+            if len(path) != j:
+                # alloc's eviction ladder strips radix leaves first and
+                # match_len bumps no LRU stamps, so it can take the
+                # deepest node of the very path just matched. Inserting
+                # at depth j would then label this block's KV with block
+                # j-1's tokens and serve wrong bytes at temp 0. Release
+                # and restart from a fresh match (bounded: a pool too
+                # small to hold the chain oscillates, so give up after a
+                # few shrinks instead of thrashing).
+                st._release(pages)
+                attempted.discard(key)
+                shrinks += 1
+                if shrinks > 8:
+                    break
+                continue
             t0 = time.monotonic()
             self._scatter_device(pages, blk.k[:, None], blk.v[:, None])
-            path = st.prefix_cache._walk(tokens, cap)
             added = st.prefix_cache.insert(
                 prefix, [nd.page for nd in path] + pages)
             if not added:
                 st._release(pages)        # raced an insert; keep theirs
                 continue
+            # Drop alloc's base reference: the tree's reference must be
+            # the ONLY holder of a restored block (store-back reaches
+            # the same state when the inserting session later drops).
+            # Keeping the base ref pins the page at refcount 2 forever —
+            # _evictable_leaf needs exactly 1 — and a restart-warmed
+            # process would steadily lose pool capacity.
+            st._release(pages)
             restored += 1
             self.restored_prefix_pages += 1
             ms = (time.monotonic() - t0) * 1000
@@ -574,14 +725,21 @@ class TierManager:
 
     def demotable_bytes(self, page_bytes: int) -> int:
         """How many HBM bytes could move to the host tier right now
-        without losing state: every allocated (non-free, non-scratch)
-        page is demotable under tiering, bounded by the host budget's
-        remaining headroom. The QoS admission controller counts this as
-        reclaimable HBM headroom (serving/admission.py)."""
+        without losing state. Exact, not optimistic: reuses alloc's
+        attainability accounting over every resident session — victim-
+        exclusive pages plus cache leaves that would strip once the
+        victims' references drop. Pages held by in-flight adopters
+        (acquire() without a registered session) stay resident and are
+        NOT counted, so the QoS admission controller
+        (serving/admission.py) never sees headroom the eviction ladder
+        cannot deliver. Bounded by the host budget's remaining
+        headroom."""
         st = self.store
         with st.lock:
-            used = st.n_pages - 1 - len(st._free)
-        return min(used * page_bytes, self.host.headroom())
+            reclaimable = (st._attainable(list(st._sessions))
+                           - len(st._free))
+        return min(max(0, reclaimable) * page_bytes,
+                   self.host.headroom())
 
     def stats(self) -> dict:
         return {
@@ -593,4 +751,7 @@ class TierManager:
             "restored_sessions": self.restored_sessions,
             "restored_prefix_pages": self.restored_prefix_pages,
             "restore_failures": self.restore_failures,
+            "spill_queue": (self._spill_q.qsize()
+                            if self._spill_q is not None else 0),
+            "spill_drops": self.spill_drops,
         }

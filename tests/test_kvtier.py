@@ -238,9 +238,10 @@ def test_disk_store_warm_starts_restarted_process(tmp_path):
     p1 = enc(SYS + " task one")
     # "process 1": serve traffic; store-back persists prefix blocks
     e1 = make_engine()
-    e1.attach_tier(host_mb=64, disk_dir=d)
+    t1 = e1.attach_tier(host_mb=64, disk_dir=d)
     r1 = e1.generate([p1], temperature=0.0, max_new_tokens=16,
                      session_ids=["a"])
+    t1.flush_spills()          # disk writes are async (spill queue)
     files = glob.glob(os.path.join(d, "*", "*.npz"))
     assert files, "store-back persisted no prefix blocks"
     # oracle: tierless fresh engine
@@ -261,9 +262,10 @@ def test_disk_store_skips_and_unlinks_corrupt_entries(tmp_path):
     d = str(tmp_path / "kv")
     p1 = enc(SYS + " task one")
     e1 = make_engine()
-    e1.attach_tier(host_mb=64, disk_dir=d)
+    t1 = e1.attach_tier(host_mb=64, disk_dir=d)
     e1.generate([p1], temperature=0.0, max_new_tokens=16,
                 session_ids=["a"])
+    t1.flush_spills()
     files = glob.glob(os.path.join(d, "*", "*.npz"))
     assert files
     victim = files[0]
@@ -282,6 +284,7 @@ def test_disk_store_skips_and_unlinks_corrupt_entries(tmp_path):
     assert r3[0].token_ids == rc[0].token_ids
     assert t3.disk.corrupt >= 1
     assert t3.restored_prefix_pages == 0
+    t3.flush_spills()          # the clean re-persist is async too
     fresh = DiskPrefixStore(d, os.path.basename(os.path.dirname(victim)))
     key = os.path.splitext(os.path.basename(victim))[0]
     if fresh.has(key):
@@ -324,6 +327,134 @@ def test_disk_store_rejects_token_mismatch(tmp_path):
     assert s.corrupt == 1
 
 
+def test_disk_store_budget_prunes_oldest_and_touches_on_load(tmp_path):
+    """REVIEW fix: the store is byte-bounded — a save that overflows the
+    budget prunes oldest-mtime entries, and load() touches mtime so the
+    order approximates LRU, not FIFO."""
+    kk = np.ones((2, 16, 2, 8), np.float32)
+
+    def toks(i):
+        return [i * 1000 + j for j in range(16)]
+
+    s = DiskPrefixStore(str(tmp_path), "sig", model="m")
+    keys = []
+    for i in range(6):
+        key = s.block_key(toks(i))
+        keys.append(key)
+        assert s.save(key, toks(i), kk, kk)
+        os.utime(s._path(key), (1_000_000 + i, 1_000_000 + i))
+    per = os.path.getsize(s._path(keys[0]))
+    s.budget_bytes = 3 * per + per // 2
+    # loading key 0 touches it — despite the oldest write stamp it must
+    # survive the prune below
+    assert s.load(keys[0], toks(0)) is not None
+    assert os.stat(s._path(keys[0])).st_mtime > 1_000_000 + 5
+    key6 = s.block_key(toks(6))
+    assert s.save(key6, toks(6), kk, kk)      # overflows -> prune
+    assert s.pruned >= 1
+    assert s.stats()["bytes"] <= s.budget_bytes
+    assert s.has(keys[0]) and s.has(key6)     # touched + newest survive
+    assert not s.has(keys[1])                 # coldest entry pruned
+    # stats serves the incrementally-tracked size, not a fresh listdir
+    st = s.stats()
+    assert st["entries"] == sum(
+        1 for f in os.listdir(s.dir) if f.endswith(".npz"))
+    assert st["budget_bytes"] == s.budget_bytes
+
+
+# ---------------------------------------------------------------------------
+# extend_prefix refcount + poisoning regressions (REVIEW fixes)
+# ---------------------------------------------------------------------------
+
+def test_restored_prefix_pages_are_evictable(tmp_path):
+    """REVIEW fix: a disk/host-restored prefix block must end up with
+    the TREE as its only reference holder (like a store-back block after
+    its session drops) — the old code kept alloc's base ref and pinned
+    every restored page at refcount 2 forever."""
+    d = str(tmp_path / "kv")
+    p1 = enc(SYS + " task one")
+    e1 = make_engine()
+    t1 = e1.attach_tier(host_mb=64, disk_dir=d)
+    e1.generate([p1], temperature=0.0, max_new_tokens=16,
+                session_ids=["a"])
+    t1.flush_spills()
+    e2 = make_engine()
+    t2 = e2.attach_tier(host_mb=64, disk_dir=d)
+    e2.generate([p1], temperature=0.0, max_new_tokens=16,
+                session_ids=["b"])
+    assert t2.restored_prefix_pages > 0
+    e2.drop_session("b")
+    st = e2.sessions
+    with st.lock:
+        cached = list(st.prefix_cache._pages)
+        assert cached
+        for pg in cached:
+            assert st._refs.get(pg, 1) == 1, \
+                f"page {pg} pinned at refcount {st._refs.get(pg, 1)}"
+        # and the tier ladder can actually reclaim them all
+        freed = st.prefix_cache.evict(len(cached))
+    assert freed == len(cached)
+
+
+def test_extend_prefix_survives_alloc_evicting_matched_path():
+    """REVIEW fix: st.alloc inside extend_prefix can strip the deepest
+    node of the just-matched path (leaf-first eviction, and match_len
+    bumps no LRU stamps). The restored block must never be inserted
+    under the shorter re-walked path — that would label block j's KV
+    with block j-1's tokens and serve wrong bytes at temp 0."""
+    import jax.numpy as jnp
+
+    from quoracle_tpu.serving.kvtier import _HostBlock
+    page = 4
+    store = SessionStore(max_tokens=4 * page, page=page)
+    L, KV, HD = 2, 2, 4
+    store.k = jnp.zeros((L, store.n_pages, page, KV, HD), jnp.float32)
+    store.v = jnp.zeros_like(store.k)
+    tier = TierManager(store, model="m", host_mb=1)
+    store.tier = tier
+    tokens = list(range(2 * page))
+
+    def blk(depth):
+        return np.full((L, page, KV, HD), float(depth), np.float32)
+
+    # both blocks of the chain live in the host tier, content = depth
+    tier.host.put_prefix(tier._block_key(tokens[:page]),
+                         _HostBlock(tokens[:page], blk(1), blk(1)))
+    tier.host.put_prefix(tier._block_key(tokens),
+                         _HostBlock(tokens, blk(2), blk(2)))
+    # seed the tree with block 0 as a refcount-1 leaf (tree-only ref)
+    with store.lock:
+        seed = store.alloc(1)
+        store.k = store.k.at[:, seed[0]].set(1.0)
+        assert store.prefix_cache.insert(tokens[:page], seed) == 1
+        store._release(seed)            # tree keeps the only ref
+        # hog the remaining free pages so the extend's alloc(1) must
+        # evict — and the only evictable page is the matched leaf
+        hog = store.alloc(len(store._free))
+        assert hog
+        tier.extend_prefix(tokens, len(tokens) + 1)
+        # a pool this tight cannot hold the whole chain — that is fine;
+        # what must NEVER happen is a node whose page holds another
+        # depth's KV. The pre-fix code inserted the depth-2 block under
+        # the depth-1 label after alloc stripped the matched leaf.
+        depth_of = {}
+        stack = [(store.prefix_cache._root, 0)]
+        while stack:
+            node, depth = stack.pop()
+            for ch in node.children.values():
+                depth_of[ch.page] = depth + 1
+                stack.append((ch, depth + 1))
+        for pg, depth in depth_of.items():
+            got = np.asarray(jax.device_get(store.k[:, pg]))
+            assert np.all(got == float(depth)), \
+                f"page {pg} at depth {depth} holds wrong KV"
+        # and page accounting stayed exact through the shrink/retry
+        # dance: every usable page is free, cached, or hogged
+        assert (len(store._free) + len(store.prefix_cache._pages)
+                + len(hog)) == store.n_pages - 1
+        store._release(hog)
+
+
 # ---------------------------------------------------------------------------
 # Host budget + disk spill
 # ---------------------------------------------------------------------------
@@ -346,7 +477,8 @@ def test_host_budget_evicts_lru_and_spills_prefixes(tmp_path):
                              spill_fn=tier._spill_prefix_entry)
     assert tier.host.bytes <= tier.host.budget_bytes
     assert tier.host.evicted_prefixes == 2
-    # evicted blocks landed on disk, checksummed
+    # evicted blocks landed on disk, checksummed (async writer)
+    tier.flush_spills()
     for key in keys[:2]:
         assert tier.disk.has(key)
     for key in keys[2:]:
@@ -467,6 +599,34 @@ def test_effective_headroom_counts_demotable_pages(monkeypatch):
                    - 0.1) < 1e-9
     finally:
         eng.sessions.tier = untiered_eng_tier
+
+
+def test_demotable_bytes_excludes_unreclaimable_pages():
+    """REVIEW fix: the QoS headroom signal counts only pages the
+    eviction ladder could actually free — victim-exclusive session
+    pages plus strippable cache leaves. A page pinned by an in-flight
+    adopter reference (acquire() without a registered session) is not
+    reclaimable and must not be advertised as headroom."""
+    eng = make_engine()
+    tier = eng.attach_tier(host_mb=64)
+    st = eng.sessions
+    assert tier.demotable_bytes(1) == 0          # empty store
+    eng.generate([enc(SYS + " hold pages")], temperature=0.0,
+                 max_new_tokens=8, session_ids=["s"])
+    with st.lock:
+        base = st._attainable(list(st._sessions)) - len(st._free)
+    assert 0 < base <= st.n_pages - 1 - st.free_pages()
+    assert tier.demotable_bytes(1) == base
+    pinned = [p for p in st.get("s").pages if p][0]
+    st.acquire([pinned])                          # in-flight reader
+    try:
+        assert tier.demotable_bytes(1) == base - 1
+    finally:
+        st.release([pinned])
+    assert tier.demotable_bytes(1) == base
+    # still bounded by the remaining host budget
+    tier.host.budget_bytes = tier.host.bytes      # zero headroom
+    assert tier.demotable_bytes(1) == 0
 
 
 # ---------------------------------------------------------------------------
