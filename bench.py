@@ -1034,6 +1034,141 @@ def measure_kv_tiering(backend, pool, n_sessions: int = 6) -> dict:
     return result
 
 
+def measure_ragged_serving(backend, pool, n_short: int = 6,
+                           n_long: int = 3) -> dict:
+    """Config 15: the UNIFIED ragged serving kernel (ISSUE 8) under mixed
+    traffic — short interactive rows and long agent rows riding the SAME
+    continuous-batching ticks, unified vs gather over the same engine.
+
+    Each phase submits ``n_short`` short prompts (16 new tokens) and
+    ``n_long`` long agent prompts (MAX_NEW new tokens) into one member's
+    shared decode loop. Reported per phase: tokens/sec/chip, steady-state
+    compile count (CompileRegistry miss delta — the bucketed baseline
+    compiles one program pair per batch×prompt bucket, the unified path
+    one per token-budget bucket), real-vs-padded chunk tokens (the
+    quoracle_sched_*_tokens_total deltas — exactly what raggedness
+    reclaims), and decode HBM high-water (allocator peak delta; the
+    unified phase runs FIRST because the counter is cumulative, so a
+    jump attributes to the gather phase's working caches). Acceptance:
+    temp-0 outputs BIT-IDENTICAL across phases."""
+    import jax
+
+    from quoracle_tpu.models.runtime import TPUBackend
+    from quoracle_tpu.models.tokenizer import get_tokenizer
+
+    member = pool[0]
+    eng = backend.engines[member]
+    tok = get_tokenizer(member)
+    short_prompts = [
+        tok.encode(f"[user {i}] {TASKS[i % len(TASKS)][:48]}",
+                   add_bos=True)
+        for i in range(n_short)]
+    long_prompts = [
+        tok.encode(f"[agent {i}] long-context working state: "
+                   + " ".join(TASKS) + " " + TASKS[i % len(TASKS)],
+                   add_bos=True)
+        for i in range(n_long)]
+
+    def peak_hbm():
+        stats = getattr(jax.devices()[0], "memory_stats", lambda: None)()
+        return stats.get("peak_bytes_in_use") if stats else None
+
+    saved = (getattr(eng, "_force_gather_decode", False),
+             eng.unified_min_tokens, eng.prefix_sharing)
+    # prefix sharing OFF for the config: phase 1's radix-cache inserts
+    # would otherwise serve phase 2's prefills (fewer real tokens), and
+    # the real-vs-padded comparison must measure the SAME work twice
+    eng.prefix_sharing = False
+
+    def run(unified: bool) -> dict:
+        eng._force_gather_decode = not unified
+        eng.unified_min_tokens = 0 if unified else 1 << 30
+        b = TPUBackend([member], engines=backend.engines,
+                       embedder=backend.embedder, continuous=True,
+                       continuous_chunk=16, continuous_slots=8)
+        cb = b._cbatchers[member]
+        try:
+            # warmup: one short + one long row pays this phase's compiles
+            # for the single-row shapes; the measured window still counts
+            # the mixed-tick compiles — steady-state program count is the
+            # config's point, so it is REPORTED, not hidden
+            cb.submit(short_prompts[0], temperature=0.0,
+                      max_new_tokens=8).result(900)
+            misses0 = eng.compiles.misses
+            real0 = eng.pad_real_tokens
+            padded0 = eng.pad_padded_tokens
+            hbm0 = peak_hbm()
+            t0 = time.monotonic()
+            futs = [cb.submit(p, temperature=0.0, max_new_tokens=16)
+                    for p in short_prompts]
+            futs += [cb.submit(p, temperature=0.0, max_new_tokens=MAX_NEW)
+                     for p in long_prompts]
+            gens = [f.result(900) for f in futs]
+            wall = time.monotonic() - t0
+        finally:
+            b.close()
+        toks = sum(g.n_gen_tokens for g in gens)
+        real = eng.pad_real_tokens - real0
+        padded = eng.pad_padded_tokens - padded0
+        hbm1 = peak_hbm()
+        return {
+            "texts": [g.text for g in gens],
+            "wall_s": round(wall, 3),
+            "tokens": toks,
+            "tokens_per_s": round(toks / max(1e-9, wall), 1),
+            "compile_misses": eng.compiles.misses - misses0,
+            "real_tokens": real,
+            "padded_tokens": padded,
+            "pad_waste_ratio": (round(1 - real / padded, 4)
+                                if padded else None),
+            "peak_hbm_delta_bytes": (hbm1 - hbm0
+                                     if hbm0 is not None
+                                     and hbm1 is not None else None),
+        }
+
+    try:
+        unified = run(True)       # first: cumulative peak-HBM attribution
+        gather = run(False)
+    finally:
+        (eng._force_gather_decode, eng.unified_min_tokens,
+         eng.prefix_sharing) = saved
+
+    equal = unified["texts"] == gather["texts"]
+    n_chips = max(1, len(jax.devices()))
+    result = {
+        "n_short": n_short,
+        "n_long": n_long,
+        "max_new": MAX_NEW,
+        "tokens_per_s_unified": unified["tokens_per_s"],
+        "tokens_per_s_gather": gather["tokens_per_s"],
+        "tokens_per_s_chip_unified": round(
+            unified["tokens_per_s"] / n_chips, 1),
+        "tokens_per_s_chip_gather": round(
+            gather["tokens_per_s"] / n_chips, 1),
+        "speedup": round(unified["tokens_per_s"]
+                         / max(1e-9, gather["tokens_per_s"]), 3),
+        "compile_misses_unified": unified["compile_misses"],
+        "compile_misses_gather": gather["compile_misses"],
+        "pad_waste_unified": unified["pad_waste_ratio"],
+        "pad_waste_gather": gather["pad_waste_ratio"],
+        "padded_tokens_reclaimed": (gather["padded_tokens"]
+                                    - unified["padded_tokens"]),
+        "peak_hbm_delta_unified": unified["peak_hbm_delta_bytes"],
+        "peak_hbm_delta_gather": gather["peak_hbm_delta_bytes"],
+        "temp0_equal": equal,
+        "unified_detail": {k: unified[k] for k in
+                           ("wall_s", "tokens", "real_tokens",
+                            "padded_tokens")},
+        "gather_detail": {k: gather[k] for k in
+                          ("wall_s", "tokens", "real_tokens",
+                           "padded_tokens")},
+    }
+    assert equal, "config15: temp-0 outputs diverged unified vs gather"
+    assert unified["real_tokens"] == gather["real_tokens"], \
+        "config15: phases did not process the same real tokens"
+    return result
+
+
 def measure_quality_overhead(backend, pool,
                              n_decides: int = N_CYCLES) -> dict:
     """Config 12: consensus-quality instrumentation overhead (ISSUE 5).
@@ -1259,6 +1394,24 @@ def base_payload() -> dict:
         "config14_hbm_session_capacity": None,
         "config14_tiered_session_capacity": None,
         "config14_temp0_equal": None,
+        # config 15 — unified ragged serving kernel (ISSUE 8): mixed
+        # short-interactive + long-agent traffic through continuous
+        # batching, unified vs gather over the same engine —
+        # tokens/sec/chip, steady-state compile count, real-vs-padded
+        # chunk tokens (what raggedness reclaims), decode HBM high-water
+        # delta, and the temp-0 equality gate. Detail in the RAGGED
+        # sidecar (QUORACLE_BENCH_RAGGED).
+        "config15_tokens_per_s_chip_unified": None,
+        "config15_tokens_per_s_chip_gather": None,
+        "config15_speedup": None,
+        "config15_compile_misses_unified": None,
+        "config15_compile_misses_gather": None,
+        "config15_pad_waste_unified": None,
+        "config15_pad_waste_gather": None,
+        "config15_padded_tokens_reclaimed": None,
+        "config15_peak_hbm_delta_unified": None,
+        "config15_peak_hbm_delta_gather": None,
+        "config15_temp0_equal": None,
         "cycles": None,
         "rounds_per_cycle": None,
         "max_new_tokens": None,
@@ -1689,6 +1842,22 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             except OSError as e:
                 log(f"config14 sidecar write failed: {e}")
 
+    # config 15 rides backend's engines too (unified-vs-gather phases over
+    # the same continuous dispatch layer) — before the vision config
+    cfg15 = guard("config15",
+                  lambda: measure_ragged_serving(backend, pool))
+    if cfg15:
+        log(f"config15: {cfg15}")
+        sidecar = os.environ.get("QUORACLE_BENCH_RAGGED")
+        if sidecar:
+            try:
+                with open(sidecar, "w") as f:
+                    json.dump({"metric": "ragged_serving",
+                               "config15": cfg15}, f, indent=1)
+                log(f"config15 ragged detail written to {sidecar}")
+            except OSError as e:
+                log(f"config15 sidecar write failed: {e}")
+
     def vision_config():
         # config 5: vision pool — free the trio's HBM first (weights + KV
         # page pools), then serve llama + the VLM checkpoint with an
@@ -1898,6 +2067,27 @@ def _run(args, payload: dict, deadline_at: float) -> None:
                 cfg14["tiered_session_capacity"],
             "config14_temp0_equal": cfg14["temp0_equal"],
         })
+    if cfg15:
+        payload.update({
+            "config15_tokens_per_s_chip_unified":
+                cfg15["tokens_per_s_chip_unified"],
+            "config15_tokens_per_s_chip_gather":
+                cfg15["tokens_per_s_chip_gather"],
+            "config15_speedup": cfg15["speedup"],
+            "config15_compile_misses_unified":
+                cfg15["compile_misses_unified"],
+            "config15_compile_misses_gather":
+                cfg15["compile_misses_gather"],
+            "config15_pad_waste_unified": cfg15["pad_waste_unified"],
+            "config15_pad_waste_gather": cfg15["pad_waste_gather"],
+            "config15_padded_tokens_reclaimed":
+                cfg15["padded_tokens_reclaimed"],
+            "config15_peak_hbm_delta_unified":
+                cfg15["peak_hbm_delta_unified"],
+            "config15_peak_hbm_delta_gather":
+                cfg15["peak_hbm_delta_gather"],
+            "config15_temp0_equal": cfg15["temp0_equal"],
+        })
     if cfg10:
         payload.update({
             "config10_n_samples": cfg10["n_samples"],
@@ -1916,7 +2106,7 @@ def _run(args, payload: dict, deadline_at: float) -> None:
                     "config7": cfg7, "config8": cfg8, "config9": cfg9,
                     "config10": cfg10, "config11": cfg11,
                     "config12": cfg12, "config13": cfg13,
-                    "config14": cfg14},
+                    "config14": cfg14, "config15": cfg15},
                    indent=1, default=str))
     payload.update({
         "cycles": N_CYCLES,

@@ -608,6 +608,24 @@ SCHED_ADMIT_WAIT_MS = METRICS.histogram(
 SCHED_ROWS_TOTAL = METRICS.counter(
     "quoracle_sched_rows_total",
     "continuous-batcher rows by terminal status (retired | failed)")
+# -- ragged serving kernel (ISSUE 8) ----------------------------------------
+# Padding-waste accounting for the serving hot path: per generate call
+# (one continuous-batcher tick), the chunk-token slots the device actually
+# processed vs the tick's REAL tokens. The bucketed paths pad every tick
+# to a [batch-bucket × prompt-bucket] rectangle; the unified ragged kernel
+# processes per-row tq-aligned segments — the delta between these two
+# counters is exactly what raggedness reclaims (the bench's headline).
+SCHED_REAL_TOKENS_TOTAL = METRICS.counter(
+    "quoracle_sched_real_tokens_total",
+    "real chunk tokens submitted across generate ticks, per model")
+SCHED_PADDED_TOKENS_TOTAL = METRICS.counter(
+    "quoracle_sched_padded_tokens_total",
+    "device chunk-token slots processed across generate ticks (real + "
+    "padding), per model — [B·T] on the bucketed paths, the flat token "
+    "budget on the unified ragged path")
+SCHED_PAD_WASTE_RATIO = METRICS.gauge(
+    "quoracle_sched_pad_waste_ratio",
+    "last tick's (padded - real) / padded chunk-token waste, per model")
 WATCHDOG_STALLS = METRICS.counter(
     "quoracle_watchdog_stalls_total",
     "stall-watchdog trips (decode loop made no progress past deadline)")

@@ -28,7 +28,8 @@ from quoracle_tpu.infra.telemetry import (
 from quoracle_tpu.models.config import ModelConfig
 from quoracle_tpu.models.sampling import sample_tokens
 from quoracle_tpu.models.transformer import (
-    KVCache, forward_hidden, init_cache, project_logits,
+    KVCache, forward_hidden, forward_hidden_ragged, init_cache,
+    project_logits,
 )
 
 # Finite mask value: a whole-row -inf would NaN the sampling softmax; the
@@ -311,11 +312,111 @@ def decode_paged(
     return out, n_emitted, lens, tail_k, tail_v, jstate
 
 
+def decode_ragged(
+    params: dict,
+    cfg: ModelConfig,
+    k_pool: jax.Array,         # [L, n_pages, page, KV, hd] (donated by jit)
+    v_pool: jax.Array,
+    tables: jax.Array,         # [R, maxp] int32 dst page table per row
+    pool_lens: jax.Array,      # [R] int32 valid pool tokens (prompt+chunk)
+    kv_off: jax.Array,         # [R] int32 abs position of pool index 0
+    first_logits: jax.Array,   # [R, V]
+    rng: jax.Array,
+    temperature: jax.Array,
+    top_p: jax.Array,
+    max_new: int,
+    eos_id: int,
+    active: jax.Array,
+    row_limit: jax.Array,
+    pad_id: int = 0,
+    stop_ids: tuple = (),
+    json_table: Optional[jax.Array] = None,
+    json_state: Optional[jax.Array] = None,
+    shard: Optional[tuple] = None,
+    interpret: Optional[bool] = None,
+) -> tuple:
+    """Autoregressive decode through the UNIFIED ragged kernel (ISSUE 8):
+    same sampling/grammar semantics as decode()/decode_paged(), but each
+    step's KV scatters STRAIGHT into the row's pages before attention and
+    the kernel reads everything — prompt, chunk, and generated tokens —
+    off the pages. Neither the [B, maxp·page] working cache nor the
+    [L, B, max_new] tail buffer exists; decode HBM high-water is the pool
+    itself. Every step is one tq=1-block-per-row launch per layer of the
+    same kernel that served the mixed prefill chunk.
+
+    Returns (tokens [R, max_new], n_emitted [R], lens [R], k_pool,
+    v_pool, jstate) where lens counts the row's valid pool tokens
+    (prompt + chunk + emitted-and-forwarded)."""
+    R = first_logits.shape[0]
+    L, n_pages, page, KV, HD = k_pool.shape
+    n_tok = n_pages * page
+    maxp = tables.shape[1]
+    fns = _sampling_fns(json_table, eos_id, stop_ids)
+    is_stop, mask_logits, advance, _ = fns
+    tok0, n0, done0, jstate0, out0, rng = _first_token(
+        fns, first_logits, rng, temperature, top_p, active, row_limit,
+        json_state, max_new, pad_id)
+    lens0 = pool_lens.astype(jnp.int32)
+
+    def cond(carry):
+        i, done, *_ = carry
+        return (i < max_new) & ~jnp.all(done)
+
+    def body(carry):
+        (i, done, cur, out, n_emitted, lens, kp, vp, rng, jstate) = carry
+        live = (~done).astype(jnp.int32)
+        # this step's token writes at buffer slot lens; done rows (and
+        # any row at its page-table edge) drop via the OOB sentinel
+        pg = jnp.take_along_axis(
+            tables, jnp.minimum(lens // page, maxp - 1)[:, None],
+            axis=1)[:, 0]
+        flat = jnp.where(done | (lens // page >= maxp), n_tok,
+                         pg * page + lens % page)
+        meta = jnp.stack([
+            lens + live,              # kv_len incl. the token just written
+            lens - (1 - live),        # qpos0 (done rows: inert block)
+            live,                     # nq
+        ], axis=1)
+        positions = lens + kv_off.astype(jnp.int32)
+        hidden, kp, vp = forward_hidden_ragged(
+            params, cfg, cur[None], positions[None], kp, vp, tables, meta,
+            flat, tq=1, interpret=interpret, shard=shard)
+        logits = project_logits(params, cfg, hidden)[0]      # [R, V]
+        rng, k = jax.random.split(rng)
+        nxt = sample_tokens(mask_logits(logits, jstate), k, temperature,
+                            top_p)
+        nxt = jnp.where(done, pad_id, nxt)
+        out = jax.lax.dynamic_update_slice_in_dim(out, nxt[:, None], i,
+                                                  axis=1)
+        n_emitted = n_emitted + jnp.where(done, 0, 1).astype(jnp.int32)
+        lens = lens + jnp.where(done, 0, 1)
+        jstate = advance(jstate, nxt, done)
+        done = done | is_stop(nxt) | (n_emitted >= row_limit)
+        return (i + 1, done, nxt, out, n_emitted, lens, kp, vp, rng,
+                jstate)
+
+    init = (jnp.asarray(1, jnp.int32), done0, tok0, out0, n0, lens0,
+            k_pool, v_pool, rng, jstate0)
+    (_, done, _, out, n_emitted, lens, k_pool, v_pool, _, jstate) = \
+        jax.lax.while_loop(cond, body, init)
+    return out, n_emitted, lens, k_pool, v_pool, jstate
+
+
 def _round_up(n: int, buckets: Sequence[int]) -> int:
     for b in buckets:
         if n <= b:
             return b
     return n
+
+
+# Unified-kernel flat-layout constants (ISSUE 8): rows' query segments are
+# padded to RAGGED_TQ-token blocks (the f32 sublane tile) and the flat
+# token budget rounds to RAGGED_TOKEN_BUCKETS — the ONLY shape the unified
+# programs key on, so steady state compiles one (chunk, decode) pair per
+# token-budget bucket instead of prefill×decode per batch bucket.
+RAGGED_TQ = 8
+RAGGED_TOKEN_BUCKETS = (16, 32, 64, 128, 256, 512, 1024, 2048, 4096,
+                        8192, 16384, 32768)
 
 
 class ContextOverflowError(ValueError):
@@ -893,12 +994,35 @@ class GenerateEngine:
         # data. Beyond latency the direct paths cap peak HBM (no
         # [B, maxp·page] working cache), so memory-pressured deployments
         # may calibrate them on below the latency crossover.
-        from quoracle_tpu.utils.calibration import load_paged_gates
+        from quoracle_tpu.utils.calibration import (
+            load_paged_gates, resolve_unified_gate,
+        )
         gates = load_paged_gates()
         self.paged_gates = gates
         self.direct_decode_min_tokens = gates.decode_min_resident
         self.direct_prefill_min_tokens = gates.prefill_min_resident
         self.direct_prefill_max_chunk = gates.prefill_max_chunk
+        # UNIFIED ragged kernel (ISSUE 8): ONE launch per layer for the
+        # whole mixed tick — prefill suffixes, continuations, decode and
+        # verify rows in one token-major grid, KV written straight to
+        # pages. Unlike the direct paths this is ON by default on TPU
+        # (gather becomes the measured fallback): the calibration file
+        # can raise the threshold or disable it, absent key = auto
+        # (0 on TPU, off elsewhere — CPU serving sticks with the fused
+        # gather programs; tests force the unified path explicitly).
+        self.unified_min_tokens = resolve_unified_gate(gates)
+        # Padding-waste accounting (ISSUE 8 satellite): per generate call
+        # (one continuous-batcher tick), how many chunk-token slots the
+        # device actually processed vs the tick's real tokens. Ragged
+        # ticks reclaim the difference; /api/resources serves the totals.
+        self.pad_real_tokens = 0
+        self.pad_padded_tokens = 0
+        self.pad_ticks = 0
+        # Per-call hand-off from _run_unified to _record_telemetry /
+        # _note_padding. THREAD-LOCAL: sessionless calls (image rows) run
+        # concurrently with the batcher's sessioned chunks and must not
+        # steal a unified tick's shape key or padded-token count.
+        self._pending = threading.local()
         # Per-call phase diagnostics (read by the bench + dashboards):
         # wall seconds of the last prefill / decode device phases.
         self.last_prefill_s = 0.0
@@ -1023,6 +1147,17 @@ class GenerateEngine:
                            "dp" if int(mesh.shape.get("dp", 1)) > 1
                            else None)
         self._paged_shard = paged_shard
+        # Unified ragged kernel sharding: token-major flat layout can't
+        # ride a dp axis (rows interleave in one token axis), so the
+        # unified path runs on single-device engines and tp-only meshes
+        # (heads independent under shard_map); other meshes fall back.
+        ragged_shard = None
+        if (paged_shard is not None
+                and int(mesh.shape.get("sp", 1)) == 1
+                and int(mesh.shape.get("dp", 1)) == 1):
+            ragged_shard = (mesh, "tp")
+        self._ragged_shard = ragged_shard
+        self._ragged_ok = mesh is None or ragged_shard is not None
 
         @functools.partial(jax.jit, static_argnames=())
         def step_paged_prefill(params, k_pool, v_pool, src_pages, tokens,
@@ -1239,6 +1374,97 @@ class GenerateEngine:
             kf = kf.at[:, flat_idx].set(tail_k, mode="drop")
             vf = vf.at[:, flat_idx].set(tail_v, mode="drop")
             return (kf.reshape(k_pool.shape), vf.reshape(v_pool.shape))
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2),
+                           static_argnames=("tq",))
+        def step_paged_ragged(params, k_pool, v_pool, tokens_flat,
+                              positions_flat, block_tables, block_meta,
+                              flat_dst, last_idx, tq: int):
+            # UNIFIED mixed chunk forward (ISSUE 8): one ragged launch
+            # per layer over the token-major flattened tick — prefill
+            # suffixes, 1-token continuations, any mix of lengths — with
+            # chunk KV scattered to the rows' pages inside the forward.
+            # Shapes key on (flat token budget, page-table width) only:
+            # the batch-bucket × prompt-bucket program matrix collapses.
+            hidden, k_pool, v_pool = forward_hidden_ragged(
+                params, cfg, tokens_flat[None], positions_flat[None],
+                k_pool, v_pool, block_tables, block_meta, flat_dst,
+                tq=tq, shard=ragged_shard)
+            last_h = hidden[0][last_idx]                  # [R, D]
+            last = project_logits(params, cfg, last_h[:, None])[:, 0, :]
+            return last, k_pool, v_pool
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2),
+                           static_argnames=("tq", "kmax", "need_probs"))
+        def step_paged_ragged_verify(params, k_pool, v_pool, tokens_flat,
+                                     positions_flat, block_tables,
+                                     block_meta, flat_dst, widx,
+                                     temperature, json_table, json_state,
+                                     tq: int, kmax: int, need_probs: bool):
+            # Speculative VERIFY through the SAME unified kernel: the
+            # teacher-forced chunk rides the ragged forward (KV scattered
+            # to pages — committed prefixes resident for the next round,
+            # LCP resume is still the rollback) and verdict logits
+            # project at the flat indices of each row's last K positions.
+            hidden, k_pool, v_pool = forward_hidden_ragged(
+                params, cfg, tokens_flat[None], positions_flat[None],
+                k_pool, v_pool, block_tables, block_meta, flat_dst,
+                tq=tq, shard=ragged_shard)
+            wh = hidden[0][widx]                          # [R, kmax, D]
+            logits = project_logits(params, cfg, wh).astype(jnp.float32)
+            R = widx.shape[0]
+            if json_table is not None:
+                # per-position grammar states walk in-device over the
+                # window's draft tokens — identical recipe (and therefore
+                # identical masks) to step_paged_verify
+                wtok = tokens_flat[widx]                  # [R, kmax]
+
+                def adv(s, tok):
+                    nxt = json_table[jnp.clip(s, 0, None),
+                                     tok].astype(jnp.int32)
+                    s2 = jnp.where(s >= 0, nxt, s)
+                    return s2, s2
+
+                _, rest = jax.lax.scan(adv, json_state, wtok[:, 1:].T)
+                states = jnp.concatenate(
+                    [json_state[None, :], rest], axis=0).T
+                V = logits.shape[-1]
+                logits = grammar_mask(
+                    logits.reshape(R * kmax, V), states.reshape(-1),
+                    json_table, cfg.eos_token_id).reshape(R, kmax, V)
+            ids = jnp.argmax(logits, axis=-1)             # [R, kmax]
+            if need_probs:
+                probs = jax.nn.softmax(
+                    logits / jnp.maximum(temperature,
+                                         1e-6)[:, None, None], axis=-1)
+                probs = jnp.where(
+                    (temperature <= 0)[:, None, None],
+                    jax.nn.one_hot(ids, logits.shape[-1]), probs)
+            else:
+                probs = jnp.zeros((1, 1, 1), jnp.float32)
+            return ids, probs, k_pool, v_pool
+
+        @functools.partial(jax.jit, donate_argnums=(1, 2),
+                           static_argnames=("max_new",))
+        def step_paged_decode_ragged(params, k_pool, v_pool, tables,
+                                     pool_lens, kv_off, last_logits, rng,
+                                     temperature, top_p, active,
+                                     row_limit, json_table, json_state,
+                                     max_new: int):
+            # Decode continuation of the unified tick: KV written straight
+            # to pages inside the loop (no tail buffer, no tail scatter);
+            # attention is the same ragged kernel at tq=1.
+            return decode_ragged(
+                params, cfg, k_pool, v_pool, tables, pool_lens, kv_off,
+                last_logits, rng, temperature, top_p, max_new,
+                cfg.eos_token_id, active=active, row_limit=row_limit,
+                pad_id=self.tokenizer.pad_id, stop_ids=cfg.stop_token_ids,
+                json_table=json_table, json_state=json_state,
+                shard=ragged_shard)
+
+        self._step_paged_ragged = step_paged_ragged
+        self._step_paged_ragged_verify = step_paged_ragged_verify
+        self._step_paged_decode_ragged = step_paged_decode_ragged
 
         self._step_prefill = step_prefill
         self._step_decode = step_decode
@@ -1780,8 +2006,10 @@ class GenerateEngine:
                     else:
                         jstate[i] = offsets[enums[i]]
             json_args = (table, put(jstate, row))
+            jstate_np = jstate
         else:
             json_args = (None, None)
+            jstate_np = None
 
         vrun = None
         if verify is not None:
@@ -1798,7 +2026,9 @@ class GenerateEngine:
                     prompts, suffixes, sess_rows, reuse_abs, kv_off_host,
                     store_sids, B, maxp, tokens, pre_arr, off_arr,
                     chunk_arr, limits, rng_key, samp, json_args, max_new,
-                    put, mat, row, t0, verify=vrun)
+                    put, mat, row, t0, verify=vrun,
+                    samp_np=(temp_arr, top_arr, active, limits),
+                    jstate_np=jstate_np)
         else:
             if images is not None and any(i is not None for i in images):
                 vc = self.cfg.vision
@@ -1829,6 +2059,14 @@ class GenerateEngine:
         self.last_prefill_s = t_prefill - t0
         self.last_decode_s = now - t_prefill
         latency = now - t0
+        # Padding-waste telemetry (ISSUE 8 satellite): chunk-token slots
+        # the device processed this tick vs the tick's real tokens. The
+        # unified path overrides the [B, T] rectangle with its flat token
+        # budget (_run_unified sets the thread-local).
+        padded_toks = getattr(self._pending, "padded_tokens", None)
+        self._pending.padded_tokens = None
+        self._note_padding(sum(max(1, len(s)) for s in suffixes),
+                           B * T if padded_toks is None else padded_toks)
         self._record_telemetry(n, B, T, cache_len,
                                vrun[1] if vrun is not None else max_new,
                                "verify" if vrun is not None else paged,
@@ -1891,7 +2129,15 @@ class GenerateEngine:
         if steps > 0 and self.last_decode_s > 0:
             DECODE_STEP_MS.observe(self.last_decode_s * 1000 / steps,
                                    model=name)
-        shape = (B, T, cache_len, max_new, paged)
+        # The unified ragged path keys its programs on (flat token budget,
+        # page-table width, decode bound) — _run_paged stashes that exact
+        # key so CompileRegistry ledgers the REAL program identity (and
+        # the tier-1 collapse assertion can count it), not the meaningless
+        # [B, T] rectangle the flat layout never compiles.
+        shape = getattr(self._pending, "shape_key", None)
+        self._pending.shape_key = None
+        if shape is None:
+            shape = (B, T, cache_len, max_new, paged)
         if self.compiles.record(shape, latency * 1000):
             JIT_COMPILES.inc(model=name)
             TRACER.emit(
@@ -1899,6 +2145,38 @@ class GenerateEngine:
                 model=name, phase="compile",
                 shape=f"B{B}xT{T}xC{cache_len}xN{max_new}"
                       + ("p" if paged else ""))
+
+    def _note_padding(self, real: int, padded: int) -> None:
+        """Account one tick's chunk-token padding waste (ISSUE 8
+        satellite): ``real`` tokens the caller actually submitted vs
+        ``padded`` device slots the chosen path processed ([B·T] for the
+        bucketed paths, the flat token budget for the unified kernel).
+        Counters feed Prometheus; the cumulative totals ride
+        /api/resources via padding_stats()."""
+        from quoracle_tpu.infra.telemetry import (
+            SCHED_PAD_WASTE_RATIO, SCHED_PADDED_TOKENS_TOTAL,
+            SCHED_REAL_TOKENS_TOTAL,
+        )
+        name = self.cfg.name
+        self.pad_real_tokens += int(real)
+        self.pad_padded_tokens += int(padded)
+        self.pad_ticks += 1
+        SCHED_REAL_TOKENS_TOTAL.inc(int(real), model=name)
+        SCHED_PADDED_TOKENS_TOTAL.inc(int(padded), model=name)
+        SCHED_PAD_WASTE_RATIO.set(
+            (padded - real) / padded if padded else 0.0, model=name)
+
+    def padding_stats(self) -> dict:
+        """Cumulative padding-waste view for /api/resources: what
+        raggedness reclaims, quantified per engine."""
+        padded = self.pad_padded_tokens
+        return {
+            "ticks": self.pad_ticks,
+            "real_tokens": self.pad_real_tokens,
+            "padded_tokens": padded,
+            "waste_ratio": (round(1 - self.pad_real_tokens / padded, 4)
+                            if padded else None),
+        }
 
     def _ensure_pool(self) -> None:
         """Allocate the device page pool on first sessioned call (engines
@@ -1921,7 +2199,8 @@ class GenerateEngine:
     def _run_paged(self, prompts, suffixes, sess_rows, reuse_abs,
                    kv_off_host, store_sids, B, maxp, tokens, pre_arr,
                    off_arr, chunk_arr, limits, rng_key, samp, json_args,
-                   max_new, put, mat, row, t0, verify=None):
+                   max_new, put, mat, row, t0, verify=None, samp_np=None,
+                   jstate_np=None):
         """The paged-session call: gather resident pages in-device, prefill
         the suffix, decode, scatter prompt+response KV back to pages, then
         update session page lists host-side (ints only — no KV bytes move
@@ -1961,6 +2240,21 @@ class GenerateEngine:
                       and not getattr(self, "_force_gather_decode", False)
                       and max(len(p) for p in prompts)
                       >= self.direct_decode_min_tokens)
+        # UNIFIED ragged kernel (ISSUE 8) — the default serving path on
+        # TPU: prefill suffixes, continuations, decode steps and verify
+        # windows all dispatch through ONE token-major kernel, KV written
+        # straight to pages. Eligibility mirrors the direct paths' page
+        # discipline (every prefix-reusing row must read/write its OWN dst
+        # pages — there is no gather/scatter to relocate a prefix), plus
+        # the flat layout's mesh constraint (dp can't shard interleaved
+        # rows). _force_gather_decode is the shared equality/fallback
+        # seam; the per-engine threshold comes from the calibration file
+        # (utils/calibration.py resolve_unified_gate).
+        unified_ok = (self._ragged_ok
+                      and not getattr(self, "_force_gather_decode", False)
+                      and samp_np is not None
+                      and max(len(p) for p in prompts)
+                      >= self.unified_min_tokens)
         adopted_release: list[list[int]] = [[] for _ in range(n)]
         partial_swap = [False]      # a swapped boundary page forces the
                                     # gather prefill (see below)
@@ -2057,10 +2351,10 @@ class GenerateEngine:
                 st._release(tail_shared)        # our refs; adopters keep
                 dst_lists[i] = old
                 dst[i, :len(old)] = old
-            if use_direct:
-                # Direct decode reads EVERY row's prompt from pages, so
-                # rows without a stored session need TEMP pages for this
-                # call. Exhaustion falls back to the gather decode.
+            if use_direct or unified_ok:
+                # The direct AND unified paths read every row's prompt
+                # from pages, so rows without a stored session need TEMP
+                # pages for this call. Exhaustion falls back to gather.
                 for i in range(n):
                     if dst_lists[i] is not None:
                         continue
@@ -2072,10 +2366,11 @@ class GenerateEngine:
                                    protect=protect, evict=False)
                     if tmp is None:
                         use_direct = False
+                        unified_ok = False
                         break
                     temp_lists[i] = tmp
                     dst[i, :len(tmp)] = tmp
-                if not use_direct:
+                if not use_direct and not unified_ok:
                     for i, tmp in enumerate(temp_lists):
                         if tmp:
                             st._release(tmp)
@@ -2106,8 +2401,23 @@ class GenerateEngine:
             # full gather scatter fills (prefix sharing divergence)
             and not partial_swap[0])
 
+        # Final unified-kernel eligibility: every prefix-reusing row must
+        # read its prefix from the SAME dst pages the kernel writes (no
+        # gather exists to relocate it), and a swapped shared boundary
+        # page leaves a hole only the gather scatter fills.
+        use_unified = (unified_ok and not partial_swap[0]
+                       and all(sess_rows[i] is None
+                               or dst_lists[i] is not None
+                               for i in range(n)))
+
         vout = None
-        if verify is not None:
+        if use_unified:
+            (out, n_emitted, final_lens, jstate_f, vout, t_prefill,
+             now) = self._run_unified(
+                 n, suffixes, dst, pre_arr, off_arr, chunk_arr,
+                 samp_np, jstate_np, json_args[0], rng_key, max_new,
+                 maxp, verify)
+        elif verify is not None:
             # Speculative verify: ONE teacher-forced chunk forward with
             # window logits (no decode loop). The chunk KV scatters back
             # to the rows' own pages so committed tokens are resident for
@@ -2155,8 +2465,8 @@ class GenerateEngine:
             jax.block_until_ready(last_logits)  # phase fence: prefill done
             t_prefill = time.monotonic()
 
-        if verify is not None:
-            pass          # verdicts + scatter already done above
+        if use_unified or verify is not None:
+            pass          # handled above (unified runs its own decode)
         elif use_direct:
             # prompt KV → pages (unless the direct prefill already wrote
             # them there), free the working cache, decode straight off the
@@ -2254,6 +2564,133 @@ class GenerateEngine:
             if pages:
                 st.release(pages)
         return out, n_emitted, jstate_f, t_prefill, now, vout
+
+    def _run_unified(self, n, suffixes, dst, pre_arr, off_arr, chunk_arr,
+                     samp_np, jstate_np, json_table, rng_key,
+                     max_new, maxp, verify):
+        """One UNIFIED ragged tick (ISSUE 8): lay every row's suffix out
+        token-major (segments padded to RAGGED_TQ blocks so a block never
+        spans rows), run ONE mixed chunk forward through the ragged
+        kernel — KV written straight to each row's dst pages — then
+        either project verify-window verdicts or continue into the
+        ragged decode loop. Device work and compile keys scale with the
+        tick's real tokens (the flat budget), never with batch × max:
+        program identity is ("ragged", token budget, table width,
+        decode bound), which CompileRegistry ledgers for the collapse
+        assertion. Returns (out, n_emitted, final_lens, jstate_f, vout,
+        t_prefill, now) with all row-indexed arrays sized [NB] whose
+        first ``n`` slots are the batch rows in order."""
+        st = self.sessions
+        page = st.page
+        page_cap = maxp * page
+        n_tok = st.n_pages * page
+        TQ = RAGGED_TQ
+        segs, nb_rows = [], []
+        for i in range(n):
+            s = max(1, min(int(chunk_arr[i]), page_cap - int(pre_arr[i])))
+            segs.append(s)
+            nb_rows.append(-(-s // TQ))
+        raw = sum(b * TQ for b in nb_rows)
+        TB = _round_up(raw, RAGGED_TOKEN_BUCKETS)
+        if TB == raw and raw > RAGGED_TOKEN_BUCKETS[-1]:
+            TB = -(-raw // 4096) * 4096     # beyond the ladder: 4k steps
+        NB = TB // TQ                       # blocks; also the row slots
+        maxp_p2 = 1 << max(0, maxp - 1).bit_length()   # pow2 table width
+        pad_id = self.tokenizer.pad_id
+        flat_tok = np.full((TB,), pad_id, np.int32)
+        flat_pos = np.zeros((TB,), np.int32)
+        flat_dst = np.full((TB,), n_tok, np.int32)     # OOB = drop
+        btab = np.zeros((NB, maxp_p2), np.int32)
+        bmeta = np.zeros((NB, 3), np.int32)            # kv_len, qpos0, nq
+        last_idx = np.zeros((NB,), np.int32)
+        r_tables = np.zeros((NB, maxp_p2), np.int32)
+        r_pool_lens = np.zeros((NB,), np.int32)
+        r_off = np.zeros((NB,), np.int32)
+        temp_arr, top_arr, active, limits_np = samp_np
+        r_temp = np.zeros((NB,), np.float32)
+        r_top = np.ones((NB,), np.float32)
+        r_active = np.zeros((NB,), bool)
+        r_limits = np.ones((NB,), np.int32)
+        r_temp[:n] = temp_arr[:n]
+        r_top[:n] = top_arr[:n]
+        r_active[:n] = active[:n]
+        r_limits[:n] = limits_np[:n]
+        js_dev = None
+        if json_table is not None:
+            r_jstate = np.full((NB,), -1, np.int32)
+            r_jstate[:n] = jstate_np[:n]
+            js_dev = jnp.asarray(r_jstate)
+        if verify is not None:
+            k_arr, kmax, need_probs = verify
+            widx = np.zeros((NB, kmax), np.int32)
+        cur = 0
+        for i in range(n):
+            s, nb = segs[i], nb_rows[i]
+            pre = int(pre_arr[i])
+            toks = suffixes[i][:s]
+            flat_tok[cur:cur + len(toks)] = toks
+            pos = pre + np.arange(s, dtype=np.int32)
+            flat_pos[cur:cur + s] = int(off_arr[i]) + pos
+            flat_dst[cur:cur + s] = dst[i, pos // page] * page + pos % page
+            kv_len = pre + s
+            for b in range(nb):
+                blk = cur // TQ + b
+                btab[blk, :maxp] = dst[i]
+                bmeta[blk, 0] = kv_len
+                bmeta[blk, 1] = pre + b * TQ
+                bmeta[blk, 2] = min(TQ, s - b * TQ)
+            last_idx[i] = cur + s - 1
+            r_tables[i, :maxp] = dst[i]
+            r_pool_lens[i] = kv_len
+            r_off[i] = int(off_arr[i])
+            if verify is not None:
+                widx[i] = cur + np.clip(
+                    s - int(k_arr[i]) + np.arange(kmax, dtype=np.int32),
+                    0, s - 1)
+            cur += nb * TQ
+        self._pending.padded_tokens = TB
+
+        if verify is not None:
+            self._pending.shape_key = ("ragged_verify", TB, maxp_p2, kmax)
+            vids, vprobs, st.k, st.v = self._step_paged_ragged_verify(
+                self.params, st.k, st.v, jnp.asarray(flat_tok),
+                jnp.asarray(flat_pos), jnp.asarray(btab),
+                jnp.asarray(bmeta), jnp.asarray(flat_dst),
+                jnp.asarray(widx), jnp.asarray(r_temp), json_table,
+                js_dev, tq=TQ, kmax=kmax, need_probs=need_probs)
+            jax.block_until_ready(vids)  # phase fence: chunk forward done
+            t_prefill = time.monotonic()
+            vout = (np.asarray(vids),
+                    np.asarray(vprobs) if need_probs else None)
+            jax.block_until_ready(st.k)
+            now = time.monotonic()
+            out = np.zeros((NB, 0), np.int32)
+            n_emitted = np.zeros((NB,), np.int32)
+            jstate_f = np.full((NB,), -1, np.int32)
+            return (out, n_emitted, r_pool_lens, jstate_f, vout,
+                    t_prefill, now)
+
+        self._pending.shape_key = ("ragged", TB, maxp_p2, max_new)
+        last_logits, st.k, st.v = self._step_paged_ragged(
+            self.params, st.k, st.v, jnp.asarray(flat_tok),
+            jnp.asarray(flat_pos), jnp.asarray(btab), jnp.asarray(bmeta),
+            jnp.asarray(flat_dst), jnp.asarray(last_idx), tq=TQ)
+        jax.block_until_ready(last_logits)  # phase fence: prefill done
+        t_prefill = time.monotonic()
+        out, n_emitted, final_lens, st.k, st.v, jstate_f = \
+            self._step_paged_decode_ragged(
+                self.params, st.k, st.v, jnp.asarray(r_tables),
+                jnp.asarray(r_pool_lens), jnp.asarray(r_off), last_logits,
+                rng_key, jnp.asarray(r_temp), jnp.asarray(r_top),
+                jnp.asarray(r_active), jnp.asarray(r_limits), json_table,
+                js_dev, max_new=max_new)
+        out = np.asarray(out)
+        n_emitted = np.asarray(n_emitted)
+        jstate_f = np.asarray(jstate_f)
+        final_lens = np.asarray(final_lens)
+        jax.block_until_ready(st.k)
+        now = time.monotonic()
+        return out, n_emitted, final_lens, jstate_f, None, t_prefill, now
 
     def _json_table_device(self, enum_set: tuple):
         """Lazily build + cache grammar tables for this tokenizer (one

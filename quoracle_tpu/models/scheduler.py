@@ -29,11 +29,19 @@ re-derived for XLA's static shapes):
     prefix pages it prefilled stay adoptable in the cache until LRU
     eviction reclaims them.
 
-Static-shape discipline: batch sizes ride the engine's existing
-BATCH_BUCKETS and ``chunk`` is a fixed decode bound, so steady state
-compiles exactly two programs (prefill bucket × decode chunk) per batch
-bucket. Sampled rows draw fresh RNG per chunk — the stream differs from a
-one-shot call (same distribution); temperature-0 rows are bit-identical
+Static-shape discipline: on the bucketed paths batch sizes ride the
+engine's BATCH_BUCKETS and ``chunk`` is a fixed decode bound, so steady
+state compiles exactly two programs (prefill bucket × decode chunk) per
+batch bucket. With the UNIFIED ragged kernel engaged (ISSUE 8 — the TPU
+default), ticks are admitted truly RAGGED: the engine lays every row's
+suffix out token-major, device work and compile keys scale with the
+tick's total real tokens (one token-budget bucket), and the batch-bucket
+× prompt-bucket program matrix collapses to one (chunk, decode) program
+pair per token budget — CompileRegistry asserts the collapse in tier-1,
+and the per-tick real-vs-padded token counters
+(quoracle_sched_{real,padded}_tokens_total) quantify the reclaimed
+padding. Sampled rows draw fresh RNG per chunk — the stream differs from
+a one-shot call (same distribution); temperature-0 rows are bit-identical
 to one-shot (tests/test_scheduler.py equality).
 
 Admission ORDER is a policy (ISSUE 4): the batcher queues through a
@@ -256,6 +264,7 @@ class ContinuousBatcher:
     def stats(self) -> dict:
         """Point-in-time health snapshot for /api/resources (racy reads
         of worker-owned state — a snapshot, not an invariant)."""
+        padding = getattr(self.engine, "padding_stats", None)
         return {
             "queued": self._policy.qsize(),
             "live": len(self._live),
@@ -266,6 +275,9 @@ class ContinuousBatcher:
             "failed": self.failed,
             "closed": self._stop,
             "qos": self._policy.snapshot(),
+            # padding-waste accounting (ISSUE 8): real vs padded chunk
+            # tokens per tick — what ragged admission reclaims
+            "padding": padding() if padding is not None else None,
             "speculative": (self.speculator.stats()
                             if self.speculator is not None else None),
         }

@@ -384,6 +384,80 @@ def forward_hidden_paged_prefill(
     return x, new_k, new_v
 
 
+def forward_hidden_ragged(
+    params: dict,
+    cfg: ModelConfig,
+    tokens: jax.Array,       # [1, Tp] int32 token-major FLATTENED batch
+    positions: jax.Array,    # [1, Tp] int32 absolute positions per token
+    k_pool: jax.Array,       # [L, n_pages, page, n_kv, hd] (donated by jit)
+    v_pool: jax.Array,
+    block_tables: jax.Array,  # [NB, maxp] int32 — owning row's page table
+    block_meta: jax.Array,    # [NB, 3] int32: kv_len, qpos0, nq
+    flat_dst: jax.Array,     # [Tp] int32 flat pool token slot per flattened
+                             # token (OOB sentinel = drop), from the owning
+                             # row's DST page table
+    tq: int,
+    interpret: Optional[bool] = None,
+    shard: Optional[tuple] = None,   # (mesh, tp_axis)
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """UNIFIED ragged forward (ISSUE 8): one launch per layer over a
+    token-major flattened batch of rows with arbitrary query lengths —
+    T=1 decode rows, T=chunk continuations, T=suffix prefills and T=K
+    speculative-verify rows all in one grid. Each layer scatters the
+    chunk's KV into the rows' pages FIRST, then attention streams each
+    block's real pages (ops/paged_attention.ragged_attend_auto) — the
+    [B, maxp·page] working cache, the dense intra-chunk piece, and the
+    decode tail buffer all cease to exist. Returns
+    (hidden [1, Tp, D], k_pool, v_pool) with the chunk KV written."""
+    from quoracle_tpu.ops.paged_attention import ragged_attend_auto
+    B, Tp = tokens.shape       # B == 1: the flat layout is the batch
+    n_tok = k_pool.shape[1] * k_pool.shape[2]
+    x = params["embed"][tokens]
+    if cfg.scale_embeddings:
+        x = (x.astype(jnp.float32) * (cfg.dim ** 0.5)).astype(x.dtype)
+
+    def layer_body(x, scanned):
+        p, kp, vp = scanned          # kp/vp: [n_pages, page, kv, hd]
+        h = rmsnorm(x, p["attn_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
+        q = jnp.einsum("btd,dh->bth", h, p["wq"])
+        k = jnp.einsum("btd,dh->bth", h, p["wk"])
+        v = jnp.einsum("btd,dh->bth", h, p["wv"])
+        if cfg.attn_bias:
+            q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+        q = q.reshape(B, Tp, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(B, Tp, cfg.n_kv_heads, cfg.head_dim)
+        v = v.reshape(B, Tp, cfg.n_kv_heads, cfg.head_dim)
+        q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
+        k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
+        # KV → pages BEFORE attention (padding/overflow slots carry the
+        # OOB sentinel and drop): intra-chunk visibility is then pure
+        # causal masking inside the one kernel — no dense second piece.
+        kf = kp.reshape(n_tok, *kp.shape[2:])
+        vf = vp.reshape(n_tok, *vp.shape[2:])
+        kf = kf.at[flat_dst].set(k[0].astype(kp.dtype), mode="drop")
+        vf = vf.at[flat_dst].set(v[0].astype(vp.dtype), mode="drop")
+        kp2 = kf.reshape(kp.shape)
+        vp2 = vf.reshape(vp.shape)
+        attn = ragged_attend_auto(
+            q[0], kp2, vp2, block_tables, block_meta, tq=tq,
+            sliding_window=cfg.sliding_window, interpret=interpret,
+            shard=shard)[None]                           # [1, Tp, H, hd]
+        x = x + jnp.einsum("bthd,hdD->btD", attn.astype(x.dtype),
+                           p["wo"].reshape(cfg.n_heads, cfg.head_dim,
+                                           cfg.dim))
+        h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
+        gate = _activation(jnp.einsum("btd,df->btf", h, p["w_gate"]),
+                           cfg.activation)
+        up = jnp.einsum("btd,df->btf", h, p["w_up"])
+        x = x + jnp.einsum("btf,fd->btd", gate * up, p["w_down"])
+        return x, (kp2, vp2)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_body, x, (params["layers"], k_pool, v_pool))
+    x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
+    return x, new_k, new_v
+
+
 def project_logits(params: dict, cfg: ModelConfig, hidden: jax.Array) -> jax.Array:
     """Final hidden states [B, T, D] -> logits [B, T, vocab] fp32.
 

@@ -1,6 +1,11 @@
-"""Ragged paged decode attention (PAPERS.md: Ragged Paged Attention,
-arxiv 2604.15464 — pattern only, the kernel is written here for the
-engine's page-pool layout).
+"""Ragged paged attention (PAPERS.md: Ragged Paged Attention,
+arxiv 2604.15464 — pattern only, the kernels are written here for the
+engine's page-pool layout). Two generations live in this file: the
+PR-3/4 split kernels (paged decode piece ⊕ tail, paged prefill piece ⊕
+dense chunk, merged by online-softmax partials) and the PR-8 UNIFIED
+kernel (``ragged_attend``) that serves a token-major flattened batch of
+mixed prefill+decode rows in ONE launch with no partials to merge — see
+the "Unified RAGGED kernel" section below and ARCHITECTURE.md §10.
 
 The paged KV session cache (models/generate.py SessionStore) keeps every
 resident conversation as a PAGE LIST into one device pool. Until this op,
@@ -549,6 +554,284 @@ def paged_prefill_attend(
         interpret=interpret,
     )(tables.astype(jnp.int32), meta, q, kf, vf)
     return (acc[:, :T, :, :hd], stats[:, :T, 0], stats[:, :T, 1])
+
+
+# ---------------------------------------------------------------------------
+# Unified RAGGED kernel (ISSUE 8): mixed prefill+decode in ONE launch
+# ---------------------------------------------------------------------------
+#
+# Token-major flattened batch: the caller lays every row's query tokens out
+# contiguously in one [Tp, H, hd] array, each row's segment padded to a
+# multiple of ``tq`` tokens so a tq-token BLOCK never spans two rows. The
+# grid is (Tp // tq,): one program per block, so device work is
+# proportional to the tick's real tokens (rounded per row to tq), never to
+# a [B, T_max] rectangle. Per-block scalar-prefetched metadata names the
+# owning row's page table and three ints:
+#
+#   block_meta[i] = (kv_len, qpos0, nq)
+#     kv_len  row's valid KV tokens in its pages INCLUDING this chunk's
+#             queries (the layer scatters chunk KV to pages BEFORE the
+#             attention call — intra-chunk causality is pure masking);
+#     qpos0   buffer position of the block's first query
+#             (= kv_len_row - q_len_row + block_offset_in_row);
+#     nq      valid queries in this block (0 = inert padding block).
+#
+# Because every key the block can see — resident prefix, earlier chunk
+# tokens, its own tokens — already sits in the pages, there is no
+# tail/chunk partial to merge: the kernel streams only the row's real
+# ceil(visible/page) pages through VMEM (double-buffered, kv heads
+# flattened into lanes exactly like _paged_kernel) and normalizes the
+# online-softmax accumulator in-kernel. T=1 decode rows, T=chunk
+# continuation rows, T=suffix prefill rows and T=K speculative-verify
+# rows are just blocks with different (qpos0, nq) — one program shape
+# serves the whole mixed tick.
+
+
+def ragged_attend_ref(
+    q: jax.Array,            # [NB·tq, H, hd] token-major flattened queries
+    k_pages: jax.Array,      # [n_pages, page, KV, hd]
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [NB, maxp] int32 — owning row's page table
+    block_meta: jax.Array,    # [NB, 3] int32: kv_len, qpos0, nq
+    tq: int,
+    sliding_window: Optional[int] = None,
+) -> jax.Array:
+    """XLA gather reference for the unified ragged kernel (CPU serving
+    path + the kernel's numerical oracle). Same contract: normalized
+    output [NB·tq, H, hd] f32."""
+    NB, maxp = block_tables.shape
+    _, H, hd = q.shape
+    n_pages, page, KV, _ = k_pages.shape
+    G = H // KV
+    qb = (q.astype(jnp.float32) * hd ** -0.5).reshape(NB, tq, KV, G, hd)
+    k = k_pages[block_tables].reshape(NB, maxp * page, KV, hd)
+    v = v_pages[block_tables].reshape(NB, maxp * page, KV, hd)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qb, k.astype(jnp.float32))
+    kv_len = block_meta[:, 0][:, None, None]       # [NB,1,1]
+    qpos0 = block_meta[:, 1][:, None, None]
+    nq = block_meta[:, 2][:, None, None]
+    t_idx = jnp.arange(tq, dtype=jnp.int32)[None, :, None]
+    s_idx = jnp.arange(maxp * page, dtype=jnp.int32)[None, None, :]
+    qpos = qpos0 + t_idx                           # [NB,tq,1]
+    mask = (s_idx < kv_len) & (s_idx <= qpos) & (t_idx < nq)
+    if sliding_window is not None:
+        mask = mask & (qpos - s_idx < sliding_window)
+    mask = mask[:, None, None, :, :]               # [NB,1,1,tq,S]
+    scores = jnp.where(mask, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.where(jnp.broadcast_to(mask, scores.shape),
+                  jnp.exp(scores - m), 0.0)
+    l = jnp.sum(p, axis=-1)                        # [NB,KV,G,tq]
+    acc = jnp.einsum("bkgts,bskd->bkgtd", p, v.astype(jnp.float32))
+    out = acc / jnp.where(l > 0, l, 1.0)[..., None]
+    out = out.transpose(0, 3, 1, 2, 4).reshape(NB * tq, H, hd)
+    return out
+
+
+def _ragged_kernel(tables_ref, meta_ref, q_ref, k_hbm, v_hbm,
+                   out_ref, k_scr, v_scr, sems, *,
+                   page: int, n_kv: int, hd: int, tq: int,
+                   scale: float, window: int):
+    """One tq-token block of the flattened batch: stream the owning row's
+    VISIBLE pages through VMEM double-buffered (same DMA/layout recipe as
+    _paged_kernel — kv heads flattened into the lane dim) and write the
+    NORMALIZED attention output for the block. With the chunk KV already
+    scattered into the pages there is no second partial to merge, so the
+    online-softmax accumulator normalizes in-kernel."""
+    i = pl.program_id(0)
+    kv_len = meta_ref[i, 0]
+    qpos0 = meta_ref[i, 1]
+    nq = meta_ref[i, 2]
+    # last visible key + 1: nothing past the block's last query is visible
+    kv_hi = jnp.minimum(kv_len, qpos0 + nq)
+    if window >= 0:
+        p_lo = jnp.maximum(qpos0 + 1 - window, 0) // page
+    else:
+        p_lo = jnp.int32(0)
+    n = jnp.maximum((kv_hi + page - 1) // page - p_lo, 0)
+
+    q = q_ref[0].astype(jnp.float32) * scale             # [tq, H, hd]
+    H = q.shape[1]
+    G = H // n_kv
+
+    def start_dma(j, slot):
+        pid = tables_ref[i, p_lo + j]
+        pltpu.make_async_copy(k_hbm.at[pid], k_scr.at[slot],
+                              sems.at[slot, 0]).start()
+        pltpu.make_async_copy(v_hbm.at[pid], v_scr.at[slot],
+                              sems.at[slot, 1]).start()
+
+    def wait_dma(j, slot):
+        pid = tables_ref[i, p_lo + j]
+        pltpu.make_async_copy(k_hbm.at[pid], k_scr.at[slot],
+                              sems.at[slot, 0]).wait()
+        pltpu.make_async_copy(v_hbm.at[pid], v_scr.at[slot],
+                              sems.at[slot, 1]).wait()
+
+    @pl.when(n > 0)
+    def _():
+        start_dma(0, 0)
+
+    # per-score-row query index (tq·G rows, query-major like the prefill
+    # kernel) → buffer position and validity shared by every kv head
+    t_of_row = jax.lax.broadcasted_iota(
+        jnp.int32, (tq, G), 0).reshape(tq * G, 1)
+    qpos = qpos0 + t_of_row                              # [tq·G, 1]
+    q_ok = t_of_row < nq
+
+    def body(j, carry):
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < n)
+        def _():
+            start_dma(j + 1, jax.lax.rem(j + 1, 2))
+
+        wait_dma(j, slot)
+        k_blk = k_scr[slot].astype(jnp.float32)          # [page, KV·hd]
+        v_blk = v_scr[slot].astype(jnp.float32)
+        s_idx = (p_lo + j) * page + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page), 1)                     # [1, page]
+        valid = (s_idx < kv_len) & (s_idx <= qpos) & q_ok
+        if window >= 0:
+            valid = valid & (qpos - s_idx < window)
+        out = []
+        for kv in range(n_kv):
+            m, l, acc = carry[kv]
+            scores = jax.lax.dot_general(                # [tq·G, page]
+                q[:, kv * G:(kv + 1) * G].reshape(tq * G, hd),
+                k_blk[:, kv * hd:(kv + 1) * hd],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            scores = jnp.where(valid, scores, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(scores, axis=1, keepdims=True))
+            p = jnp.where(valid, jnp.exp(scores - m_new), 0.0)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+            pv = jax.lax.dot_general(                    # [tq·G, hd]
+                p, v_blk[:, kv * hd:(kv + 1) * hd],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            out.append((m_new, l_new, acc * corr + pv))
+        return tuple(out)
+
+    init = tuple((jnp.full((tq * G, 1), NEG_INF, jnp.float32),
+                  jnp.zeros((tq * G, 1), jnp.float32),
+                  jnp.zeros((tq * G, hd), jnp.float32))
+                 for _ in range(n_kv))
+    final = jax.lax.fori_loop(0, n, body, init)
+    for kv in range(n_kv):
+        _, l, acc = final[kv]
+        norm = acc / jnp.where(l > 0, l, 1.0)
+        out_ref[0, :, kv * G:(kv + 1) * G] = norm.reshape(tq, G, hd)
+
+
+@functools.partial(jax.jit, static_argnames=("tq", "sliding_window",
+                                             "interpret"))
+def ragged_attend(
+    q: jax.Array,            # [NB·tq, H, hd] token-major flattened queries
+    k_pages: jax.Array,      # [n_pages, page, KV, hd]
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [NB, maxp] int32
+    block_meta: jax.Array,    # [NB, 3] int32: kv_len, qpos0, nq
+    tq: int,
+    sliding_window: Optional[int] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Pallas unified ragged attention (same contract as ragged_attend_ref;
+    tests/test_ragged_attention.py asserts numerical agreement). Grid is
+    (NB,) — sized by the tick's real tokens / tq, never by batch × max."""
+    Tp, H, hd = q.shape
+    NB = block_tables.shape[0]
+    n_pages, page, KV, _ = k_pages.shape
+    hd_p = max(128, ((hd + 127) // 128) * 128)
+    if hd_p != hd:
+        q = jnp.pad(q, [(0, 0), (0, 0), (0, hd_p - hd)])
+        padkv = [(0, 0), (0, 0), (0, 0), (0, hd_p - hd)]
+        k_pages = jnp.pad(k_pages, padkv)
+        v_pages = jnp.pad(v_pages, padkv)
+    kf = k_pages.reshape(n_pages, page, KV * hd_p)
+    vf = v_pages.reshape(n_pages, page, KV * hd_p)
+    qb = q.reshape(NB, tq, H, hd_p)
+    scale = hd ** -0.5
+    kernel = functools.partial(
+        _ragged_kernel, page=page, n_kv=KV, hd=hd_p, tq=tq, scale=scale,
+        window=-1 if sliding_window is None else int(sliding_window))
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,                        # tables, meta
+            grid=(NB,),
+            in_specs=[
+                pl.BlockSpec((1, tq, H, hd_p), lambda i, *_: (i, 0, 0, 0)),
+                pl.BlockSpec(memory_space=pltpu.ANY),     # k pool in HBM
+                pl.BlockSpec(memory_space=pltpu.ANY),     # v pool in HBM
+            ],
+            out_specs=[
+                pl.BlockSpec((1, tq, H, hd_p), lambda i, *_: (i, 0, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((2, page, KV * hd_p), k_pages.dtype),
+                pltpu.VMEM((2, page, KV * hd_p), v_pages.dtype),
+                pltpu.SemaphoreType.DMA((2, 2)),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((NB, tq, H, hd_p), jnp.float32),
+        ],
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), block_meta.astype(jnp.int32), qb,
+      kf, vf)[0]
+    return out.reshape(NB * tq, H, hd_p)[..., :hd]
+
+
+def _ragged_tp_shard(inner, shard):
+    """shard_map wrapper for the unified ragged kernel on tp meshes: every
+    head attends independently (whole GQA groups per shard — callers gate
+    on divisibility), block tables/metadata replicate, no collective."""
+    try:
+        from jax import shard_map
+    except ImportError:                      # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    mesh, tp_ax = shard
+    head = P(None, tp_ax, None)              # [Tp, H, hd]
+    kv = P(None, None, tp_ax, None)          # [n_pages, page, KV, hd]
+    specs = dict(in_specs=(head, kv, kv, P(None, None), P(None, None)),
+                 out_specs=head)
+    try:
+        return shard_map(inner, mesh=mesh, check_rep=False, **specs)
+    except TypeError:
+        return shard_map(inner, mesh=mesh, **specs)
+
+
+def ragged_attend_auto(
+    q: jax.Array,            # [NB·tq, H, hd]
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_tables: jax.Array,
+    block_meta: jax.Array,
+    tq: int,
+    sliding_window: Optional[int] = None,
+    interpret: Optional[bool] = None,
+    shard: Optional[tuple] = None,   # (mesh, tp_axis)
+) -> jax.Array:
+    """Unified ragged attention dispatcher: Pallas kernel on TPU (or under
+    ``interpret``), XLA gather reference elsewhere (CPU tier-1 — same
+    numerics, no paging win). With ``shard``, runs per-tp-shard under
+    shard_map (heads independent)."""
+    if shard is not None:
+        inner = functools.partial(ragged_attend_auto, tq=tq,
+                                  sliding_window=sliding_window,
+                                  interpret=interpret, shard=None)
+        return _ragged_tp_shard(inner, shard)(
+            q, k_pages, v_pages, block_tables, block_meta)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu or interpret:
+        return ragged_attend(q, k_pages, v_pages, block_tables, block_meta,
+                             tq=tq, sliding_window=sliding_window,
+                             interpret=bool(interpret))
+    return ragged_attend_ref(q, k_pages, v_pages, block_tables, block_meta,
+                             tq=tq, sliding_window=sliding_window)
 
 
 def _tp_shard_map(inner, shard, q_rank4: bool):

@@ -80,11 +80,16 @@ def build_engine(resident: int, rounds: int, new_tokens: int, scale: str,
     return eng, tok
 
 
-PATHS = ("direct_full", "direct_decode", "gather")
+# Ascending expected-footprint order (the peak-HBM counter is cumulative):
+# unified holds only the pool (KV written straight to pages — no working
+# cache, no tail buffer), direct_full adds the tail, direct_decode adds
+# the working cache at prefill, gather keeps it through decode.
+PATHS = ("unified", "direct_full", "direct_decode", "gather")
 
 
 def _set_path(eng, path: str) -> None:
     eng._force_gather_decode = path == "gather"
+    eng.unified_min_tokens = 0 if path == "unified" else 1 << 30
     eng.direct_decode_min_tokens = 0 if path.startswith("direct") else 1 << 30
     eng.direct_prefill_min_tokens = 0 if path == "direct_full" else 1 << 30
 

@@ -3,11 +3,16 @@ the engine's gate file (utils/calibration.py; VERDICT r3 weak #2 — the
 gate must be a measurement, not a hardcoded constant).
 
 For each resident size in the sweep, times resumed rounds under the
-gather, direct_decode, and direct_full paths (tools/bench_longctx.py
-harness). The smallest resident size where a direct path's p50 beats
-gather becomes its ``*_min_resident`` gate; a path that never wins stays
-null (off). Writes the file the engine loads at startup
-(~/.cache/quoracle_tpu/paged_gates.json, or --out / QUORACLE_PAGED_CALIB).
+unified (ISSUE 8 ragged kernel), gather, direct_decode, and direct_full
+paths (tools/bench_longctx.py harness). The smallest resident size where
+a direct path's p50 beats gather becomes its ``*_min_resident`` gate; a
+path that never wins stays null (off). The UNIFIED gate works the other
+way around — the kernel is the TPU default without a file, so the sweep
+records where gather is the better fallback: unified winning at the
+smallest size writes 0 (explicit always-on), losing everywhere writes
+null (gather is the measured default on this host). Writes the file the
+engine loads at startup (~/.cache/quoracle_tpu/paged_gates.json, or
+--out / QUORACLE_PAGED_CALIB).
 
 Run on the serving host (ONE python process on TPU deployments):
 
@@ -96,6 +101,17 @@ def main() -> None:
 
     decode_gate = crossover("direct_decode")
     full_gate = crossover("direct_full")
+    # UNIFIED ragged kernel (ISSUE 8): measured unified-vs-gather per
+    # geometry. The engine's default is ON (threshold 0) on TPU without a
+    # file, so the calibration's job here is the REVERSE of the direct
+    # gates': record where gather is the better fallback. Unified winning
+    # at the smallest sweep size → gate 0 (always on, making the measured
+    # default explicit); winning only above some size → that size;
+    # losing everywhere → explicit off (JSON null — gather is the
+    # measured default on this host).
+    unified_gate = crossover("unified")
+    if unified_gate == sweep[0]:
+        unified_gate = 0
     # The engine's use_direct_pre requires use_direct (the gather decode
     # cannot read what the direct prefill wrote without a working cache),
     # so a winning direct_full must PULL THE DECODE GATE DOWN to its own
@@ -111,12 +127,14 @@ def main() -> None:
         for r, res in by_size.items())
     path = save_paged_gates(
         args.out, decode_min_resident=decode_gate,
-        prefill_min_resident=prefill_gate, device_kind=device_kind,
+        prefill_min_resident=prefill_gate,
+        unified_min_resident=unified_gate, device_kind=device_kind,
         note=note)
     summary = {
         "metric": "paged_gate_calibration",
         "decode_min_resident": decode_gate,
         "prefill_min_resident": prefill_gate,
+        "unified_min_resident": unified_gate,
         "gate_file": path,
         "device_kind": device_kind,
         "measurements": {str(k): {p: v["p50_round_ms"]

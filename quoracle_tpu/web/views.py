@@ -294,16 +294,29 @@ def resources_panel(res: dict) -> str:
             + rows + "</table>")
     sched = res.get("scheduler") or {}
     if sched:
+        def _pad(s):
+            # padding-waste roll-up (ISSUE 8): real / padded chunk tokens
+            # and the waste fraction raggedness reclaims
+            p = s.get("padding") or {}
+            if not p.get("padded_tokens"):
+                return "—"
+            ratio = p.get("waste_ratio")
+            pct = f" ({ratio * 100:.1f}% pad)" if ratio is not None else ""
+            return (f"{_e(p.get('real_tokens'))}/"
+                    f"{_e(p.get('padded_tokens'))}{pct}")
+
         rows = "".join(
             f"<tr class=\"sched-row\" data-model=\"{_e(spec)}\">"
             f"<td>{_e(spec)}</td><td>{_e(s.get('queued'))}</td>"
             f"<td>{_e(s.get('live'))}/{_e(s.get('max_slots'))}</td>"
             f"<td>{_e(s.get('retired'))}</td>"
-            f"<td>{_e(s.get('failed'))}</td></tr>"
+            f"<td>{_e(s.get('failed'))}</td>"
+            f"<td class=\"pad-cell\">{_pad(s)}</td></tr>"
             for spec, s in sorted(sched.items()))
         parts.append(
             "<table id=\"scheduler\"><tr><th>model</th><th>queued</th>"
-            "<th>slots</th><th>retired</th><th>failed</th></tr>"
+            "<th>slots</th><th>retired</th><th>failed</th>"
+            "<th>real/padded tok</th></tr>"
             + rows + "</table>")
     fr = res.get("flight_recorder") or {}
     if fr:

@@ -6,40 +6,46 @@
 # mid-calibration the round lost its primary bench record entirely; the
 # header claimed "commit immediately" but the script never committed.)
 cd /root/repo
-LOG=RELAY_POLL_r10.log
+LOG=RELAY_POLL_r11.log
 echo "$(date -u +%FT%TZ) direct run: device confirmed live (probe ok)" >> "$LOG"
 
 # Primary record first. If a previous run left calibration gates behind,
-# use them; their absence just means the paged direct paths stay off.
-# The artifact carries configs 9-12 (telemetry / resources / QoS /
-# quality, see r08), the ISSUE 6 speculative rows (configs 7 + 13, see
-# r09), and the ISSUE 7 tiered-KV row: config 14 hibernates sessions to
-# the host tier and measures restore-latency p95 vs cold re-prefill p95
-# at fixed HBM, demote/restore counts, resident-session capacity with
-# the tier on, and asserts temp-0 on/off bit-equality. Config 14's
-# detail lands in the KVTIER_r10_live.json sidecar, committed with the
-# bench record alongside the RESOURCES/QUALITY/SPEC sidecars.
+# use them; their absence just means the paged direct paths stay off —
+# the UNIFIED ragged kernel (ISSUE 8) is ON by default on TPU either
+# way (gather is the measured fallback; calibrate_paged below records
+# the unified-vs-gather crossover per geometry). The artifact carries
+# configs 9-12 (telemetry / resources / QoS / quality, see r08), the
+# ISSUE 6 speculative rows (configs 7 + 13, r09), the ISSUE 7 tiered-KV
+# row (config 14, r10), and NEW in r11 the ISSUE 8 ragged-serving row:
+# config 15 drives mixed short-interactive + long-agent traffic through
+# continuous batching unified vs gather — tokens/sec/chip, steady-state
+# compile count (the batch×prompt bucket matrix vs token-budget
+# buckets), real-vs-padded chunk tokens, decode HBM high-water, and the
+# temp-0 equality gate. Config 15's detail lands in the
+# RAGGED_r11_live.json sidecar, committed with the bench record
+# alongside the RESOURCES/QUALITY/SPEC/KVTIER sidecars.
 [ -f /root/repo/calib_v5e.json ] && export QUORACLE_PAGED_CALIB=/root/repo/calib_v5e.json
-export QUORACLE_BENCH_RESOURCES=/root/repo/RESOURCES_r10_live.json
-export QUORACLE_BENCH_QUALITY=/root/repo/QUALITY_r10_live.json
-export QUORACLE_BENCH_SPEC=/root/repo/SPEC_r10_live.json
-export QUORACLE_BENCH_KV=/root/repo/KVTIER_r10_live.json
-timeout 5400 python bench.py > /root/repo/BENCH_r10_live.json 2>> "$LOG"
+export QUORACLE_BENCH_RESOURCES=/root/repo/RESOURCES_r11_live.json
+export QUORACLE_BENCH_QUALITY=/root/repo/QUALITY_r11_live.json
+export QUORACLE_BENCH_SPEC=/root/repo/SPEC_r11_live.json
+export QUORACLE_BENCH_KV=/root/repo/KVTIER_r11_live.json
+export QUORACLE_BENCH_RAGGED=/root/repo/RAGGED_r11_live.json
+timeout 5400 python bench.py > /root/repo/BENCH_r11_live.json 2>> "$LOG"
 rc=$?
-echo "$(date -u +%FT%TZ) bench rc=$rc artifact=BENCH_r10_live.json" >> "$LOG"
+echo "$(date -u +%FT%TZ) bench rc=$rc artifact=BENCH_r11_live.json" >> "$LOG"
 if [ "$rc" -eq 0 ] && python - <<'EOF'
 import json
-d = json.load(open("/root/repo/BENCH_r10_live.json"))
+d = json.load(open("/root/repo/BENCH_r11_live.json"))
 ok = (not d.get("device_unavailable")) and d.get("value")
 raise SystemExit(0 if ok else 1)
 EOF
 then
     echo "$(date -u +%FT%TZ) BENCH SUCCESS — committing the record" >> "$LOG"
-    git add BENCH_r10_live.json RESOURCES_r10_live.json \
-        QUALITY_r10_live.json SPEC_r10_live.json \
-        KVTIER_r10_live.json "$LOG" 2>/dev/null
+    git add BENCH_r11_live.json RESOURCES_r11_live.json \
+        QUALITY_r11_live.json SPEC_r11_live.json \
+        KVTIER_r11_live.json RAGGED_r11_live.json "$LOG" 2>/dev/null
     git -c user.name=distsys-graft -c user.email=graft@localhost \
-        commit -m "Chip-verified BENCH_r10_live artifact (direct run)" >> "$LOG" 2>&1 \
+        commit -m "Chip-verified BENCH_r11_live artifact (direct run)" >> "$LOG" 2>&1 \
         || echo "$(date -u +%FT%TZ) commit failed (artifact still on disk)" >> "$LOG"
 else
     echo "$(date -u +%FT%TZ) bench artifact not clean; bonus captures may still run" >> "$LOG"
@@ -52,7 +58,7 @@ fi
 # realized row depends on.
 timeout 900 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m quoracle_tpu.tools.train_draft --check \
-    > /root/repo/SPEC_CHECK_r10.json 2>> "$LOG" \
+    > /root/repo/SPEC_CHECK_r11.json 2>> "$LOG" \
     && echo "$(date -u +%FT%TZ) draft check passed" >> "$LOG" \
     || echo "$(date -u +%FT%TZ) draft check FAILED (bench record already safe)" >> "$LOG"
 timeout 2400 python -m quoracle_tpu.tools.calibrate_paged \
@@ -61,9 +67,9 @@ timeout 2400 python -m quoracle_tpu.tools.calibrate_paged \
     || echo "$(date -u +%FT%TZ) calibration FAILED (bench record already safe)" >> "$LOG"
 timeout 1800 python -m quoracle_tpu.tools.bench_longctx \
     --resident 16384 --rounds 3 \
-    > /root/repo/LONGCTX_r10.json 2>> "$LOG" \
+    > /root/repo/LONGCTX_r11.json 2>> "$LOG" \
     || echo "$(date -u +%FT%TZ) longctx FAILED (bench record already safe)" >> "$LOG"
-git add calib_v5e.json LONGCTX_r10.json SPEC_CHECK_r10.json "$LOG" 2>/dev/null
+git add calib_v5e.json LONGCTX_r11.json SPEC_CHECK_r11.json "$LOG" 2>/dev/null
 git -c user.name=distsys-graft -c user.email=graft@localhost \
     commit -m "Post-bench chip captures: draft check + paged-gate calibration + long-context sweep" >> "$LOG" 2>&1 \
     || true
