@@ -1,0 +1,47 @@
+"""qlint — repo-native static analysis + runtime lock-order sanitizer
+(ISSUE 9).
+
+The serving plane is a deeply threaded system (scheduler, kvtier, prefix
+cache, bus, telemetry — dozens of lock acquisitions across over twenty
+threaded modules) whose dominant defect classes the PR 7 review round
+showed to be MECHANICAL: blocking device/disk I/O performed under a lock,
+lock-order inversions between SessionStore / TierManager / the radix
+cache, and compile-key churn that breaks PR 8's compile-collapse
+contract. This package turns those hand-enforced invariants into
+machine-checked ones:
+
+* :mod:`quoracle_tpu.analysis.lockdep` — the DECLARED lock hierarchy
+  (session → tier → cache → metrics, refined into numeric ranks), the
+  ``named_lock`` factory the serving plane creates its locks through,
+  and a ThreadSanitizer-lite runtime sanitizer: when enabled
+  (``QUORACLE_LOCKDEP=1`` or :func:`lockdep.enable`), every named-lock
+  acquisition is checked against the hierarchy per thread and any
+  inversion is recorded to the flight recorder — the tier-1 suite runs
+  with it on, so every existing concurrency test doubles as a race
+  check.
+* :mod:`quoracle_tpu.analysis.locks` — the static mirror: an AST pass
+  that builds the whole-repo lock-acquisition graph (``with`` blocks and
+  ``.acquire()`` sites resolved across call edges), reports cycles and
+  declared-rank violations as potential deadlocks, and flags blocking
+  calls (device transfers, file I/O, sleeps, subprocess, bus broadcast,
+  queue waits) made while a bookkeeping lock is held.
+* :mod:`quoracle_tpu.analysis.compilekeys` — jit/compile-key discipline
+  for the hot serving path (ops/, models/generate.py,
+  models/scheduler.py, serving/): jit wrappers built per call, jit
+  owners without a CompileRegistry ledger, unhashable static args, and
+  host-sync calls (``.item()`` / ``device_get``) inside hot functions.
+* :mod:`quoracle_tpu.analysis.registry` — single-authoritative-registry
+  cross-checks: every ``quoracle_*`` instrument resolves to its one
+  definition in infra/telemetry.py and is documented; bus topics are
+  defined once in infra/bus.py and referenced via the constants; flight
+  event kinds come from infra/flightrec.py ``FLIGHT_EVENTS``.
+* :mod:`quoracle_tpu.analysis.skips` — AST-level skip-marker detection
+  for tests/ (replaces the brittle CI grep; catches aliased imports).
+
+Findings run against a committed ``qlint_baseline.json`` via
+``python -m quoracle_tpu.tools.qlint`` (exit 0 clean / 1 new findings /
+2 internal error). Intentional exceptions are documented INLINE with
+``# qlint: allow[rule] reason`` comments, never silently baselined.
+"""
+
+from quoracle_tpu.analysis.common import Finding  # noqa: F401

@@ -1,0 +1,193 @@
+"""Shared plumbing for the qlint passes: findings, parsed-module cache,
+inline ``# qlint: allow[rule]`` suppressions, and the committed baseline.
+
+Kept stdlib-only on purpose — ``quoracle_tpu.analysis`` is imported by
+the serving plane (for :func:`lockdep.named_lock`) before jax or any
+heavyweight dependency loads.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Iterable, Optional
+
+# Rules a finding can carry; the CLI validates --rules against this.
+RULES: tuple = (
+    "lock-cycle",           # cycle in the static lock-acquisition graph
+    "lock-hierarchy",       # acquisition edge against the declared ranks
+    "lock-blocking",        # blocking call while a bookkeeping lock held
+    "jit-in-call-path",     # jax.jit wrapper built per call (key churn)
+    "jit-unregistered",     # hot-path jit owner with no CompileRegistry
+    "jit-unhashable-static",  # unhashable default/literal in static args
+    "hot-path-sync",        # .item()/device_get host sync in hot path
+    "instrument-unknown",   # quoracle_* name not in infra/telemetry.py
+    "instrument-undocumented",  # defined but absent from the docs
+    "instrument-unused",    # defined but never referenced outside infra/
+    "topic-foreign-definition",  # TOPIC_* assigned outside infra/bus.py
+    "topic-raw-string",     # topic value used as a literal, not the const
+    "topic-undocumented",   # TOPIC_* absent from the docs
+    "flight-event-unregistered",  # FLIGHT.record kind not in FLIGHT_EVENTS
+    "flight-event-orphaned",      # registered kind never recorded
+    "flight-event-undocumented",  # registered kind absent from the docs
+    "test-skip",            # pytest/unittest skip marker in tests/
+)
+
+_ALLOW_RE = re.compile(r"qlint:\s*allow\[([a-z0-9_,\s-]+)\]")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str               # repo-relative, forward slashes
+    line: int
+    symbol: str             # Class.method / function / metric name
+    message: str
+
+    @property
+    def fingerprint(self) -> str:
+        """Stable identity for baseline matching: everything but the
+        line number, so pure drift doesn't churn the baseline."""
+        h = hashlib.sha1(
+            f"{self.rule}|{self.path}|{self.symbol}|{self.message}"
+            .encode()).hexdigest()
+        return h[:16]
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "symbol": self.symbol, "message": self.message,
+                "fingerprint": self.fingerprint}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.rule}] {self.symbol}: "
+                f"{self.message}")
+
+
+class SourceModule:
+    """One parsed source file: AST + per-line allow-rule suppressions."""
+
+    def __init__(self, path: str, rel: str, text: str):
+        self.path = path
+        self.rel = rel
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        self.lines = text.splitlines()
+        # line -> set of allowed rules. Comments are read with tokenize
+        # so a '# qlint: allow[...]' inside a string literal is inert.
+        self.allows: dict[int, set] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(text).readline):
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _ALLOW_RE.search(tok.string)
+                if m:
+                    rules = {r.strip() for r in m.group(1).split(",")}
+                    self.allows.setdefault(tok.start[0], set()).update(
+                        rules)
+        except tokenize.TokenError:
+            pass
+
+    def allowed(self, rule: str, *lines: int) -> bool:
+        """True when any of ``lines`` (the finding site and, for lock
+        rules, the acquisition site) carries an allow for ``rule`` —
+        trailing on the line itself or as a comment on the line directly
+        above it."""
+        for ln in lines:
+            for candidate in (ln, ln - 1):
+                rules = self.allows.get(candidate)
+                if rules and (rule in rules or "*" in rules):
+                    return True
+        return False
+
+
+def iter_py_files(root: str, subdirs: Iterable[str]) -> Iterable[tuple]:
+    """Yield (abs_path, rel_path) for every .py under root/subdir,
+    skipping caches. Deterministic order (findings diff stably)."""
+    for sub in subdirs:
+        base = os.path.join(root, sub)
+        if os.path.isfile(base) and base.endswith(".py"):
+            yield base, os.path.relpath(base, root).replace(os.sep, "/")
+            continue
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    p = os.path.join(dirpath, fn)
+                    yield p, os.path.relpath(p, root).replace(os.sep, "/")
+
+
+def load_modules(root: str, subdirs: Iterable[str]) -> list[SourceModule]:
+    mods = []
+    for path, rel in iter_py_files(root, subdirs):
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+        mods.append(SourceModule(path, rel, text))
+    return mods
+
+
+def repo_root(start: Optional[str] = None) -> str:
+    """Nearest ancestor containing quoracle_tpu/ (the analyzers run from
+    anywhere inside the repo)."""
+    d = os.path.abspath(start or os.getcwd())
+    while True:
+        if os.path.isdir(os.path.join(d, "quoracle_tpu")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            raise FileNotFoundError(
+                "could not locate the repo root (no quoracle_tpu/ in any "
+                "ancestor directory)")
+        d = parent
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+
+BASELINE_NAME = "qlint_baseline.json"
+
+
+def load_baseline(path: str) -> dict:
+    """{fingerprint: entry}. A missing file is an empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def save_baseline(path: str, findings: list[Finding]) -> None:
+    payload = {
+        "comment": (
+            "qlint accepted-findings baseline. Every entry is a finding "
+            "the analyzers report today that is NOT being fixed in the "
+            "introducing PR; the goal is an EMPTY list — prefer an "
+            "inline '# qlint: allow[rule] reason' at the site, which "
+            "documents the exception where the code is."),
+        "findings": sorted((f.as_dict() for f in findings),
+                           key=lambda e: (e["rule"], e["path"],
+                                          e["symbol"])),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def diff_baseline(findings: list[Finding],
+                  baseline: dict) -> tuple[list, list]:
+    """(new, resolved): findings not in the baseline, and baseline
+    entries the analyzers no longer report (stale — prune them)."""
+    fps = {f.fingerprint for f in findings}
+    new = [f for f in findings if f.fingerprint not in baseline]
+    resolved = [e for fp, e in sorted(baseline.items())
+                if fp not in fps]
+    return new, resolved

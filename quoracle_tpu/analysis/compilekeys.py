@@ -1,0 +1,215 @@
+"""jit/compile-key discipline pass (ISSUE 9, rule family ``jit-*`` /
+``hot-path-sync``).
+
+PR 8's compile-collapse contract — "program shapes key ONLY on the
+ragged token-budget bucket" — and the CompileRegistry ledger only hold
+if jit wrappers are built ONCE (setup time) and every hot-path dispatch
+goes through an owner that records its compiles. This pass checks the
+mechanical half of that contract over the hot serving modules
+(``ops/``, ``models/generate.py``, ``models/scheduler.py``,
+``models/speculative.py``, ``serving/``):
+
+* ``jit-in-call-path`` — a ``jax.jit`` / ``pjit`` wrapper constructed
+  inside a non-setup function. A fresh wrapper per call means a fresh
+  compile-cache entry per call: the recompile storm the registry
+  exists to catch, created structurally.
+* ``jit-unregistered`` — a class in a hot module that builds jits but
+  never ledgers a dispatch through a CompileRegistry
+  (``self.compiles.record(...)``); its compile keys are invisible to
+  the storm gauge and the collapse assertion.
+* ``jit-unhashable-static`` — a static arg declared via
+  ``static_argnames``/``static_argnums`` whose DEFAULT at the jitted
+  function is a list/dict/set: unhashable statics raise at dispatch,
+  and mutable defaults that happen to hash (tuples of floats built per
+  call) churn the key.
+* ``hot-path-sync`` — ``.item()`` / ``float(<jax value>)`` /
+  ``jax.device_get`` host syncs inside hot-module functions that are
+  not setup/stats/debug surfaces. Each one is a device fence in the
+  serving path.
+
+Setup context = module level, ``__init__``, any ``_build*`` method, or
+a function carrying ``# qlint: allow[jit-in-call-path]``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from quoracle_tpu.analysis.common import Finding, SourceModule
+
+HOT_PATHS: tuple = (
+    "quoracle_tpu/ops/",
+    "quoracle_tpu/models/generate.py",
+    "quoracle_tpu/models/scheduler.py",
+    "quoracle_tpu/models/speculative.py",
+    "quoracle_tpu/serving/",
+)
+
+# functions whose purpose is host-side reporting: syncs are fine there
+_REPORT_NAMES = ("stats", "snapshot", "occupancy", "status", "progress",
+                 "padding_stats", "render", "__repr__")
+_SETUP_PREFIXES = ("__init__", "_build", "attach_", "close")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    """jax.jit(...), pjit(...), functools.partial(jax.jit, ...)."""
+    target = _dotted(node.func)
+    if target is None:
+        return False
+    if target in ("jax.jit", "jit", "pjit", "jax.experimental.pjit.pjit"):
+        return True
+    if target.endswith("partial") and node.args:
+        inner = _dotted(node.args[0])
+        return inner in ("jax.jit", "jit", "pjit")
+    return False
+
+
+def _hot(rel: str) -> bool:
+    return any(rel.startswith(p) or rel == p.rstrip("/")
+               for p in HOT_PATHS)
+
+
+def _enclosing_chain(tree: ast.AST) -> dict:
+    """node -> (class name | None, [enclosing function names])."""
+    out: dict = {}
+
+    def visit(node: ast.AST, cls: Optional[str], funcs: tuple) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                visit(child, child.name, funcs)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                out[child] = (cls, funcs)
+                visit(child, cls, funcs + (child.name,))
+                # decorators evaluate in the ENCLOSING scope — a
+                # module-level @partial(jax.jit, ...) is setup, not a
+                # per-call wrapper (overrides the body-context labels
+                # the recursion just assigned)
+                for dec in child.decorator_list:
+                    for sub in ast.walk(dec):
+                        out[sub] = (cls, funcs)
+            else:
+                out[child] = (cls, funcs)
+                visit(child, cls, funcs)
+
+    out[tree] = (None, ())
+    visit(tree, None, ())
+    return out
+
+
+def _setup_context(funcs: tuple) -> bool:
+    """True when any enclosing function is a setup surface — jits built
+    inside a nested def of _build_step are still setup."""
+    if not funcs:
+        return True                    # module level
+    return any(f.startswith(_SETUP_PREFIXES) for f in funcs)
+
+
+def run(modules: list) -> list:
+    findings: list = []
+    for mod in modules:
+        if not _hot(mod.rel):
+            continue
+        chain = _enclosing_chain(mod.tree)
+        jit_owner_classes: set = set()
+        registry_classes: set = set()
+        for node in ast.walk(mod.tree):
+            cls, funcs = chain.get(node, (None, ()))
+            if isinstance(node, ast.Call):
+                target = _dotted(node.func)
+                if _is_jit_call(node):
+                    if cls is not None:
+                        jit_owner_classes.add(cls)
+                    if not _setup_context(funcs):
+                        f = Finding(
+                            "jit-in-call-path", mod.rel, node.lineno,
+                            ".".join(filter(None, (cls,) + funcs)),
+                            "jax.jit wrapper constructed per call — a "
+                            "fresh compile key every invocation; build "
+                            "it once in __init__/_build*")
+                        if not mod.allowed(f.rule, node.lineno):
+                            findings.append(f)
+                    _check_static_defaults(mod, node, cls, funcs,
+                                           findings)
+                elif target is not None and target.endswith(
+                        "compiles.record"):
+                    if cls is not None:
+                        registry_classes.add(cls)
+                elif target is not None and target.rsplit(
+                        ".", 1)[-1] == "CompileRegistry":
+                    if cls is not None:
+                        registry_classes.add(cls)
+                elif (target in ("jax.device_get",)
+                      or (target is not None
+                          and target.endswith(".item"))):
+                    if funcs and not _setup_context(funcs) \
+                            and funcs[-1] not in _REPORT_NAMES \
+                            and not any(fn in _REPORT_NAMES
+                                        for fn in funcs):
+                        f = Finding(
+                            "hot-path-sync", mod.rel, node.lineno,
+                            ".".join(filter(None, (cls,) + funcs)),
+                            f"host sync {target}() in a hot-path "
+                            f"function — device fence per call")
+                        if not mod.allowed(f.rule, node.lineno):
+                            findings.append(f)
+        for cls in sorted(jit_owner_classes - registry_classes):
+            line = next((n.lineno for n in mod.tree.body
+                         if isinstance(n, ast.ClassDef)
+                         and n.name == cls), 1)
+            f = Finding(
+                "jit-unregistered", mod.rel, line, cls,
+                "class builds jax.jit programs but never ledgers a "
+                "dispatch through CompileRegistry — its compile keys "
+                "are invisible to the storm gauge")
+            if not mod.allowed(f.rule, line):
+                findings.append(f)
+    return findings
+
+
+def _check_static_defaults(mod: SourceModule, jit_call: ast.Call,
+                           cls: Optional[str], funcs: tuple,
+                           findings: list) -> None:
+    """For @functools.partial(jax.jit, static_argnames=(...)) decorating
+    ``def f(..., name=<unhashable literal>)`` — flag the default."""
+    static_names: set = set()
+    for kw in jit_call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value,
+                                                              str):
+                    static_names.add(n.value)
+    if not static_names:
+        return
+    # the decorated function is the parent FunctionDef whose decorator
+    # list contains this call
+    for node in ast.walk(mod.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and jit_call in getattr(node, "decorator_list", []):
+            args = node.args
+            defaults = args.defaults
+            names = [a.arg for a in args.args]
+            for name, default in zip(names[len(names) - len(defaults):],
+                                     defaults):
+                if name in static_names and isinstance(
+                        default, (ast.List, ast.Dict, ast.Set)):
+                    f = Finding(
+                        "jit-unhashable-static", mod.rel,
+                        default.lineno, node.name,
+                        f"static arg {name!r} defaults to an unhashable "
+                        f"{type(default).__name__.lower()} literal")
+                    if not mod.allowed(f.rule, default.lineno):
+                        findings.append(f)
+            return

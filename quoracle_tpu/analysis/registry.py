@@ -1,0 +1,252 @@
+"""Invariant-registry pass (ISSUE 9, rule families ``instrument-*``,
+``topic-*``, ``flight-event-*``).
+
+The observability surface is a CONTRACT: dashboards, alerting rules
+(DEPLOY.md), and tests all address instruments, bus topics, and flight
+events BY NAME. A name with two definition sites, a dashboard-only
+name nothing emits, or an emitted name the docs never mention is a
+silent contract break. This pass cross-checks all three namespaces
+against their single authoritative registries:
+
+* ``quoracle_*`` instruments — authoritative in
+  ``infra/telemetry.py`` (``METRICS.counter/gauge/histogram`` at import)
+  plus any ``METRICS.<ctor>("quoracle_...")`` call elsewhere, which is
+  itself flagged: one definition site each (``instrument-unknown`` for
+  references the registry doesn't know, ``instrument-undocumented``
+  for registered names absent from ARCHITECTURE.md and DEPLOY.md,
+  ``instrument-unused`` for registered names nothing references).
+* bus topics — ``TOPIC_*`` constants are defined in ``infra/bus.py``
+  only (``topic-foreign-definition``); topic VALUES used as raw string
+  literals outside bus.py should use the constant
+  (``topic-raw-string``); every topic is documented
+  (``topic-undocumented``).
+* flight events — every ``FLIGHT.record("<kind>")`` /
+  ``_flight_record("<kind>")`` literal kind appears in
+  ``infra/flightrec.py FLIGHT_EVENTS`` (``flight-event-unregistered``),
+  every registered kind is recorded somewhere
+  (``flight-event-orphaned``), and documented
+  (``flight-event-undocumented``).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Optional
+
+from quoracle_tpu.analysis.common import Finding
+
+TELEMETRY_REL = "quoracle_tpu/infra/telemetry.py"
+BUS_REL = "quoracle_tpu/infra/bus.py"
+FLIGHTREC_REL = "quoracle_tpu/infra/flightrec.py"
+
+_INSTRUMENT_RE = re.compile(r"^quoracle_[a-z0-9_]+$")
+# quoracle_-prefixed literals that are NOT instruments (package / module
+# / settings names that share the prefix).
+NON_INSTRUMENT = frozenset({
+    "quoracle_tpu", "quoracle_web", "quoracle_test_x",
+})
+_METRIC_CTORS = ("counter", "gauge", "histogram")
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _doc_text(root: str) -> str:
+    text = []
+    for doc in ("ARCHITECTURE.md", "DEPLOY.md",
+                os.path.join("docs", "DEPLOY.md"),
+                os.path.join("docs", "ARCHITECTURE.md")):
+        p = os.path.join(root, doc)
+        if os.path.exists(p):
+            with open(p, encoding="utf-8") as f:
+                text.append(f.read())
+    return "\n".join(text)
+
+
+def run(modules: list, root: str) -> list:
+    findings: list = []
+    docs = _doc_text(root)
+
+    by_rel = {m.rel: m for m in modules}
+
+    # -- authoritative registries ---------------------------------------
+    defined: dict = {}        # instrument -> (rel, line)
+    topics: dict = {}         # TOPIC_NAME -> (value, line)
+    flight_events: dict = {}  # kind -> line
+
+    tel = by_rel.get(TELEMETRY_REL)
+    if tel is not None:
+        for node in ast.walk(tel.tree):
+            if isinstance(node, ast.Call):
+                t = _dotted(node.func)
+                if t is not None and t.split(".")[-1] in _METRIC_CTORS \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str) \
+                        and _INSTRUMENT_RE.match(node.args[0].value):
+                    name = node.args[0].value
+                    if name not in defined:
+                        defined[name] = (tel.rel, node.lineno)
+
+    bus = by_rel.get(BUS_REL)
+    if bus is not None:
+        for node in bus.tree.body:
+            if isinstance(node, ast.Assign) \
+                    and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and node.targets[0].id.startswith("TOPIC_") \
+                    and isinstance(node.value, ast.Constant):
+                topics[node.targets[0].id] = (node.value.value,
+                                              node.lineno)
+
+    fr = by_rel.get(FLIGHTREC_REL)
+    if fr is not None:
+        for node in fr.tree.body:
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                tgt = (node.targets[0] if isinstance(node, ast.Assign)
+                       else node.target)
+                if isinstance(tgt, ast.Name) \
+                        and tgt.id == "FLIGHT_EVENTS" \
+                        and isinstance(node.value, ast.Dict):
+                    for k in node.value.keys:
+                        if isinstance(k, ast.Constant):
+                            flight_events[k.value] = k.lineno
+
+    # -- scan references -------------------------------------------------
+    referenced: dict = {}     # instrument -> set of referencing rels
+    recorded: dict = {}       # flight kind -> first (rel, line)
+    topic_values = {v: name for name, (v, _) in topics.items()}
+
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            # instrument / topic-value string literals
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str):
+                v = node.value
+                if _INSTRUMENT_RE.match(v) and v not in NON_INSTRUMENT:
+                    referenced.setdefault(v, set()).add(mod.rel)
+                    if v not in defined:
+                        f = Finding(
+                            "instrument-unknown", mod.rel, node.lineno,
+                            v,
+                            "references an instrument name that is not "
+                            "registered in infra/telemetry.py — "
+                            "orphaned (or dashboard-only) metric")
+                        if not mod.allowed(f.rule, node.lineno):
+                            findings.append(f)
+                elif v in topic_values and mod.rel != BUS_REL:
+                    f = Finding(
+                        "topic-raw-string", mod.rel, node.lineno,
+                        topic_values[v],
+                        f"bus topic {v!r} spelled as a raw string — "
+                        f"use bus.{topic_values[v]}")
+                    if not mod.allowed(f.rule, node.lineno):
+                        findings.append(f)
+            # foreign TOPIC_ definitions
+            if isinstance(node, ast.Assign) and mod.rel != BUS_REL:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) \
+                            and tgt.id.startswith("TOPIC_"):
+                        f = Finding(
+                            "topic-foreign-definition", mod.rel,
+                            node.lineno, tgt.id,
+                            "bus topics are defined in infra/bus.py "
+                            "only — a second definition site forks the "
+                            "namespace")
+                        if not mod.allowed(f.rule, node.lineno):
+                            findings.append(f)
+            # FLIGHT.record("<kind>") call sites
+            if isinstance(node, ast.Call):
+                t = _dotted(node.func)
+                if t is not None and (
+                        t.endswith("FLIGHT.record")
+                        or t.endswith("flight.record")
+                        or t.endswith("_flight_record")
+                        or (t == "self.record"
+                            and mod.rel == FLIGHTREC_REL)) \
+                        and node.args \
+                        and isinstance(node.args[0], ast.Constant) \
+                        and isinstance(node.args[0].value, str):
+                    kind = node.args[0].value
+                    recorded.setdefault(kind, (mod.rel, node.lineno))
+                    if flight_events and kind not in flight_events \
+                            and mod.rel != FLIGHTREC_REL:
+                        f = Finding(
+                            "flight-event-unregistered", mod.rel,
+                            node.lineno, kind,
+                            "flight event kind is not in "
+                            "infra/flightrec.FLIGHT_EVENTS — register "
+                            "it (with a description) before recording")
+                        if not mod.allowed(f.rule, node.lineno):
+                            findings.append(f)
+            # record_span-style literal events ({"kind": "span", ...})
+            # count as record sites inside flightrec.py itself
+            if isinstance(node, ast.Dict) and mod.rel == FLIGHTREC_REL:
+                for k, v in zip(node.keys, node.values):
+                    if isinstance(k, ast.Constant) and k.value == "kind" \
+                            and isinstance(v, ast.Constant) \
+                            and isinstance(v.value, str):
+                        recorded.setdefault(v.value,
+                                            (mod.rel, k.lineno))
+
+    # -- registry-side checks --------------------------------------------
+    for name, (rel, line) in sorted(defined.items()):
+        mod = by_rel.get(rel)
+        if name not in docs:
+            f = Finding(
+                "instrument-undocumented", rel, line, name,
+                "registered instrument absent from ARCHITECTURE.md "
+                "and DEPLOY.md — the observability contract is the "
+                "documented surface")
+            if mod is None or not mod.allowed(f.rule, line):
+                findings.append(f)
+        rels = referenced.get(name, set())
+        if not (rels - {rel}) and name not in docs:
+            # referenced only at its own definition site AND the docs
+            # never mention it: dead either way. (A name the docs/alerts
+            # address is a live external contract even when the Python
+            # side only touches it through the registry handle.)
+            f = Finding(
+                "instrument-unused", rel, line, name,
+                "registered instrument never referenced outside its "
+                "registry nor documented — dead metric or a rename "
+                "that missed the registry")
+            if mod is None or not mod.allowed(f.rule, line):
+                findings.append(f)
+
+    for tname, (value, line) in sorted(topics.items()):
+        if tname not in docs and value not in docs:
+            f = Finding(
+                "topic-undocumented", BUS_REL, line, tname,
+                f"bus topic {value!r} absent from ARCHITECTURE.md and "
+                f"DEPLOY.md")
+            if bus is None or not bus.allowed("topic-undocumented",
+                                              line):
+                findings.append(f)
+
+    for kind, line in sorted(flight_events.items()):
+        if kind not in recorded:
+            f = Finding(
+                "flight-event-orphaned", FLIGHTREC_REL, line, kind,
+                "registered flight event kind nothing records")
+            if fr is None or not fr.allowed(f.rule, line):
+                findings.append(f)
+        if kind not in docs:
+            f = Finding(
+                "flight-event-undocumented", FLIGHTREC_REL, line, kind,
+                "registered flight event kind absent from "
+                "ARCHITECTURE.md and DEPLOY.md")
+            if fr is None or not fr.allowed(f.rule, line):
+                findings.append(f)
+
+    return findings

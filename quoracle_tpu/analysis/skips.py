@@ -1,0 +1,106 @@
+"""Skip-marker pass (ISSUE 9 satellite, rule ``test-skip``).
+
+Replaces the CI grep gate ("No skipped tests" — a skipped test is a
+silently shrinking contract) with AST-level detection over ``tests/``:
+
+* ``@pytest.mark.skip`` / ``@pytest.mark.skipif`` decorators — through
+  ANY import alias (``import pytest as pt``, ``from pytest import mark
+  as m``, ``from pytest.mark import skipif``), which the grep missed;
+* ``@unittest.skip`` / ``skipIf`` / ``skipUnless`` the same way;
+* ``pytest.skip(...)`` / ``pytest.xfail(...)`` calls in test bodies;
+* ``pytestmark = pytest.mark.skip...`` module-level marks.
+
+``pytest.importorskip`` is NOT banned: it gates on a missing optional
+dependency (tests/test_loader.py's torch), not on the test's own
+contract — same stance as the original grep.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from quoracle_tpu.analysis.common import Finding
+
+_PYTEST_SKIPS = ("skip", "skipif", "xfail")
+_UNITTEST_SKIPS = ("skip", "skipIf", "skipUnless", "expectedFailure")
+
+
+def _alias_map(tree: ast.AST) -> dict:
+    """local name -> canonical dotted prefix, via imports."""
+    out: dict = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+def _canonical(node: ast.AST, aliases: dict) -> Optional[str]:
+    """Dotted path with the leading alias resolved to its import."""
+    parts: list = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Call):
+        # skipif(...)(...) or mark.skipif(reason=...) used as a call
+        return _canonical(node.func, aliases)
+    if not isinstance(node, ast.Name):
+        return None
+    head = aliases.get(node.id, node.id)
+    return ".".join([head] + list(reversed(parts)))
+
+
+def _is_skip(canon: Optional[str]) -> Optional[str]:
+    if canon is None:
+        return None
+    parts = canon.split(".")
+    if parts[0] == "pytest":
+        if len(parts) >= 2 and parts[1] == "mark" and len(parts) >= 3 \
+                and parts[2] in _PYTEST_SKIPS:
+            return f"pytest.mark.{parts[2]}"
+        if len(parts) == 2 and parts[1] in ("skip", "xfail"):
+            return f"pytest.{parts[1]}"
+    if parts[0] == "unittest" and len(parts) >= 2 \
+            and parts[1] in _UNITTEST_SKIPS:
+        return f"unittest.{parts[1]}"
+    # from pytest import mark as m → canon "pytest.mark"; handled above.
+    return None
+
+
+def run(modules: list) -> list:
+    findings: list = []
+    for mod in modules:
+        aliases = _alias_map(mod.tree)
+        for node in ast.walk(mod.tree):
+            sites: list = []
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                for dec in node.decorator_list:
+                    what = _is_skip(_canonical(dec, aliases))
+                    if what:
+                        sites.append((dec.lineno, node.name, what,
+                                      "decorator"))
+            elif isinstance(node, ast.Call):
+                what = _is_skip(_canonical(node.func, aliases))
+                if what and what in ("pytest.skip", "pytest.xfail"):
+                    sites.append((node.lineno, what, what, "call"))
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) \
+                            and tgt.id == "pytestmark":
+                        what = _is_skip(_canonical(node.value, aliases))
+                        if what:
+                            sites.append((node.lineno, "pytestmark",
+                                          what, "module mark"))
+            for line, symbol, what, how in sites:
+                f = Finding(
+                    "test-skip", mod.rel, line, symbol,
+                    f"{what} {how} — a skipped test is a silently "
+                    f"shrinking contract (CI gate)")
+                if not mod.allowed(f.rule, line):
+                    findings.append(f)
+    return findings

@@ -49,6 +49,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Iterable, Optional, Sequence
 
+from quoracle_tpu.analysis.lockdep import named_lock
 from quoracle_tpu.infra.telemetry import (
     CONSENSUS_ENTROPY, CONSENSUS_MARGIN, CONSENSUS_ROUNDS_TO_DECISION,
     CONSENSUS_SIM_MARGIN, MEMBER_AGREEMENTS, MEMBER_DECIDES, MEMBER_DISSENTS,
@@ -297,11 +298,11 @@ class ConsensusQuality:
         self.recent_alpha = recent_alpha
         self.min_samples = min_samples
         self.drift_threshold = drift_threshold
-        self._lock = threading.Lock()
+        self._lock = named_lock("quality")
         self._members: dict[str, _MemberStats] = {}
         self._decides = 0
         self._sinks: list[Callable[[dict], None]] = []
-        self._sink_lock = threading.Lock()
+        self._sink_lock = named_lock("quality.sinks")
 
     # -- sinks (Tracer-shaped) -------------------------------------------
 

@@ -21,6 +21,8 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from quoracle_tpu.analysis.lockdep import named_lock
+
 logger = logging.getLogger(__name__)
 
 Handler = Callable[[str, dict], None]
@@ -43,7 +45,7 @@ class EventBus:
 
     def __init__(self) -> None:
         self._subs: dict[str, list[Subscription]] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("bus")
 
     def subscribe(self, topic: str, handler: Handler) -> Subscription:
         """Subscribe to one topic, or to every broadcast with topic="*"

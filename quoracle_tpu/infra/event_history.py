@@ -8,10 +8,10 @@ subscribed to the bus; no GenServer needed.
 
 from __future__ import annotations
 
-import threading
 from collections import deque
 from typing import Optional
 
+from quoracle_tpu.analysis.lockdep import named_lock
 from quoracle_tpu.infra.bus import (
     EventBus, Subscription, TOPIC_ACTIONS, TOPIC_CONSENSUS, TOPIC_LIFECYCLE,
     TOPIC_RESOURCES, TOPIC_SERVING, TOPIC_TRACE,
@@ -50,7 +50,7 @@ class EventHistory:
         self._resources: deque = deque(maxlen=max_logs)
         self._consensus: deque = deque(maxlen=MAX_CONSENSUS_RECORDS)
         self._tasks: set[str] = set()
-        self._lock = threading.Lock()
+        self._lock = named_lock("history")
         self._closed = False
         self._subs: list[Subscription] = [
             bus.subscribe(TOPIC_LIFECYCLE, self._on_lifecycle),

@@ -36,6 +36,8 @@ import time
 from collections import deque
 from typing import Any, Optional
 
+from quoracle_tpu.analysis.lockdep import named_lock
+
 DEFAULT_CAPACITY = 2048
 DEFAULT_RETENTION = 12
 
@@ -50,7 +52,7 @@ class FlightRecorder:
         self.retention = retention
         self._dir = directory
         self._ring: deque = deque(maxlen=capacity)
-        self._lock = threading.Lock()
+        self._lock = named_lock("flight")
         self._installed = False
         self._crashed = False
         self._dumps = 0
@@ -179,3 +181,47 @@ class FlightRecorder:
 
 
 FLIGHT = FlightRecorder()
+
+
+# ---------------------------------------------------------------------------
+# Flight-event registry (ISSUE 9): the single authoritative list of event
+# kinds the ring may carry. qlint's registry pass cross-checks every
+# ``FLIGHT.record("<kind>", ...)`` call site against this table (and the
+# table against the call sites — an entry nothing records is dead), and
+# requires each kind to be documented in ARCHITECTURE.md or DEPLOY.md.
+# Adding a record site means adding a row here FIRST.
+# ---------------------------------------------------------------------------
+
+FLIGHT_EVENTS: dict = {
+    # process / crash capture
+    "crash": "unhandled exception captured by the chained sys.excepthook",
+    "span": "finished tracer span (Tracer sink → ring)",
+    "watchdog_stall": "stall watchdog tripped on a frozen progress source",
+    "resource_sample": "periodic device-memory / member-capacity sample",
+    # compile / serving health
+    "compile_storm": "CompileRegistry miss rate crossed the storm "
+                     "threshold inside its window",
+    "sched_admit": "continuous batcher admitted queued rows into slots",
+    "sched_retire": "continuous-batcher row retired",
+    "sched_row_failed": "continuous-batcher row failed in isolation",
+    # QoS
+    "qos_shed": "admission controller shed a request",
+    "qos_demote": "SLO tracker demoted bulk-class weights",
+    "qos_restore": "SLO tracker restored demoted weights",
+    "qos_deadline_drop": "queued row dropped at admit (deadline passed)",
+    # speculative serving
+    "spec_reprobe": "disengaged speculator re-probing acceptance",
+    "spec_disengage": "speculator disengaged to vanilla decode",
+    "spec_error": "speculative sub-tick failed; rows decoded vanilla",
+    # tiered KV
+    "kv_demote": "HBM victim demoted to the host tier",
+    "kv_restore": "hibernated session / prefix block paged back in",
+    "kv_disk_spill": "prefix block written to the disk store",
+    "kv_disk_corrupt": "checksum-rejected disk entry skipped + unlinked",
+    "kv_alloc_drift": "SessionStore.alloc accounting-drift refusal",
+    # consensus quality
+    "model_health_drift": "EWMA drift detector tripped for a member",
+    # lock discipline (analysis/lockdep.py)
+    "lockdep_inversion": "runtime lock-order sanitizer saw an "
+                         "acquisition against the declared hierarchy",
+}

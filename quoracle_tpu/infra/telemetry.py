@@ -38,6 +38,8 @@ import threading
 import time
 from typing import Any, Callable, Iterable, Optional, Sequence
 
+from quoracle_tpu.analysis.lockdep import named_lock
+
 # ---------------------------------------------------------------------------
 # Buckets
 # ---------------------------------------------------------------------------
@@ -113,7 +115,7 @@ class _Metric:
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics")
         # label-key tuple -> cell (shape depends on the metric kind)
         self._cells: dict[tuple, Any] = {}
 
@@ -284,7 +286,7 @@ class MetricsRegistry:
     never take a scrape (or the serving path behind it) down."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics.registry")
         self._metrics: dict[str, _Metric] = {}
         self._collectors: list[Callable[[], None]] = []
 
@@ -456,7 +458,7 @@ class Tracer:
     def __init__(self) -> None:
         self._tls = threading.local()
         self._sinks: list[Callable[[dict], None]] = []
-        self._sink_lock = threading.Lock()
+        self._sink_lock = named_lock("tracer.sinks")
 
     # -- sinks -----------------------------------------------------------
 
@@ -742,6 +744,17 @@ KV_ALLOC_DRIFT_TOTAL = METRICS.counter(
 # contestedness and the per-member scorecard counters. Registered at
 # import so the full quoracle_consensus_* surface scrapes before first
 # traffic, like everything above.
+# -- lock discipline (ISSUE 9) -----------------------------------------------
+# Runtime lock-order sanitizer (analysis/lockdep.py): inversions seen by
+# the tier-1 suite (conftest enables QUORACLE_LOCKDEP) or a production
+# process run with the env flag. Any nonzero value is a latent ABBA
+# deadlock report — alert on it like a crash, not like a latency burn.
+LOCKDEP_INVERSIONS = METRICS.counter(
+    "quoracle_lockdep_inversions_total",
+    "lock-order inversions observed by the runtime sanitizer, labeled "
+    "by the acquiring and held lock names — any nonzero value is a "
+    "latent ABBA deadlock report")
+
 CONSENSUS_ENTROPY = METRICS.histogram(
     "quoracle_consensus_vote_entropy_bits",
     "Shannon entropy (bits) of the cluster-share distribution per decide: "

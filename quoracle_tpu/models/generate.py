@@ -489,11 +489,11 @@ class SessionStore:
     paged steps (the pool buffers are donated through them)."""
 
     def __init__(self, max_tokens: int = 262_144, page: int = PAGE):
-        import threading
+        from quoracle_tpu.analysis.lockdep import named_lock
         self.page = page
         self.n_pages = max(3, -(-max_tokens // page) + 1)   # +1 scratch
         self.max_tokens = (self.n_pages - 1) * page
-        self.lock = threading.RLock()
+        self.lock = named_lock("session.store", rlock=True)
         self._sessions: dict[str, _Session] = {}
         self._free: list[int] = list(range(self.n_pages - 1, 0, -1))
         # Page refcounts (cross-session PREFIX SHARING): a page referenced
@@ -739,11 +739,11 @@ class CompileRegistry:
 
     def __init__(self, model: str, window_s: float = 120.0,
                  threshold: int = 4):
-        import threading
+        from quoracle_tpu.analysis.lockdep import named_lock
         self.model = model
         self.window_s = window_s
         self.threshold = threshold
-        self._lock = threading.Lock()
+        self._lock = named_lock("cache.compile")
         self._shapes: dict[tuple, dict] = {}
         self._miss_times: list[float] = []
         self.hits = 0
@@ -930,6 +930,8 @@ class GenerateEngine:
                  mesh=None, session_max_bytes: int = 2 << 30,
                  sp_window: Optional[int] = None):
         import threading
+
+        from quoracle_tpu.analysis.lockdep import named_lock
         self.cfg = cfg
         self.mesh = mesh
         self.last_prefill_tokens = 0   # diagnostics: suffix actually computed
@@ -949,7 +951,7 @@ class GenerateEngine:
                                 else None))
         self.prompt_buckets = tuple(b for b in prompt_buckets if b <= self.max_seq)
         self._rng = jax.random.PRNGKey(seed)
-        self._rng_lock = threading.Lock()
+        self._rng_lock = named_lock("engine.rng")
         # KV cache dtype follows the params (bf16 serving, fp32 parity tests)
         # — mixing dtypes would fail the in-place cache scatter.
         self.cache_dtype = jax.tree.leaves(params)[0].dtype
@@ -968,7 +970,7 @@ class GenerateEngine:
                                            # tier counters)
         # The paged steps donate the pool buffers; calls that touch the pool
         # must serialize (concurrent members use separate engines).
-        self._paged_lock = threading.Lock()
+        self._paged_lock = named_lock("engine.paged")
         # Cross-session prefix sharing (SessionStore.match_prefix, backed
         # by the radix prefix cache in models/prefix_cache.py): ON by
         # default for full-attention models; the windowed check lives at
@@ -981,7 +983,7 @@ class GenerateEngine:
         # the cache dict (build/evict) is their only shared mutable state.
         # Order: _paged_lock → _grammar_lock (sessioned path), never
         # reversed.
-        self._grammar_lock = threading.Lock()
+        self._grammar_lock = named_lock("cache.grammar")
         # Resident-size thresholds (max prompt tokens in the batch) for the
         # DIRECT (ragged-kernel) paged decode and paged PREFILL. These are
         # MEASURED gates, not constants: where the kernels win depends on

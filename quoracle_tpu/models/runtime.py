@@ -261,10 +261,10 @@ class _MemberBatcher:
     """
 
     def __init__(self, engine: GenerateEngine):
-        import threading
+        from quoracle_tpu.analysis.lockdep import named_lock
         self.engine = engine
-        self._serve = threading.Lock()
-        self._plock = threading.Lock()
+        self._serve = named_lock("member.serve")
+        self._plock = named_lock("member.pending")
         # pending SUBMISSIONS (one per query() caller), not flattened rows:
         # a merged-batch failure can then retry per submission, keeping one
         # agent's pathological round from poisoning its neighbors'.

@@ -62,6 +62,7 @@ import time
 from concurrent.futures import Future
 from typing import Optional, Sequence
 
+from quoracle_tpu.analysis.lockdep import named_lock
 from quoracle_tpu.infra.flightrec import FLIGHT
 from quoracle_tpu.infra.telemetry import (
     QOS_ADMIT_WAIT_MS, SCHED_ADMIT_WAIT_MS, SCHED_QUEUE_DEPTH,
@@ -139,7 +140,7 @@ class ContinuousBatcher:
         self.speculator = speculator
         self._live: list[_Row] = []
         self._seq = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("batcher")
         self._wake = threading.Event()
         self._stop = False
         # health telemetry (ISSUE 3): monotonic progress/outcome counters.

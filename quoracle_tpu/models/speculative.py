@@ -64,6 +64,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from quoracle_tpu.analysis.lockdep import named_lock
 from quoracle_tpu.infra.flightrec import FLIGHT
 from quoracle_tpu.infra.telemetry import (
     SPEC_ACCEPTANCE, SPEC_ACCEPTED, SPEC_DRAFTED, SPEC_ENGAGED,
@@ -105,6 +106,10 @@ class SpecResult:
         return self.n_gen_tokens / max(1, self.rounds)
 
 
+# Bench/baton-path decoder: compiles once at _build per (which,
+# cache_len); the production continuous path ledgers through the owning
+# engine's CompileRegistry instead (BatchedSpeculator + verify_chunk).
+# qlint: allow[jit-unregistered] batch-1 decoder; engines own the ledger
 class SpeculativeDecoder:
     """Draft/verify decoder over two models sharing one tokenizer.
 
@@ -140,7 +145,7 @@ class SpeculativeDecoder:
         # NOT thread-safe: sessions/caches/rng mutate per call. Callers
         # that share a decoder serialize through this lock (TPUBackend
         # try-acquires it and falls back to batched vanilla on contention)
-        self.lock = threading.Lock()
+        self.lock = named_lock("spec.decoder")
         self._build()
 
     # ------------------------------------------------------------------
@@ -614,7 +619,7 @@ class BatchedSpeculator:
         self.ewma_alpha = float(ewma_alpha)
         self.reprobe_after = int(reprobe_after)
         self._rng_np = np.random.default_rng(seed)
-        self._lock = threading.Lock()
+        self._lock = named_lock("spec.adaptive")
         self._k = self.k_init
         self._engaged = True
         self._ewma: Optional[float] = None

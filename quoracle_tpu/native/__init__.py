@@ -19,12 +19,13 @@ import ctypes
 import logging
 import os
 import subprocess
-import threading
 from typing import Optional, Sequence
 
 logger = logging.getLogger(__name__)
 
-_build_lock = threading.Lock()
+from quoracle_tpu.analysis.lockdep import named_lock
+
+_build_lock = named_lock("native.build")
 
 
 def build_and_load(src_path: str, so_path: str,

@@ -33,6 +33,7 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from quoracle_tpu.analysis.lockdep import named_lock
 from quoracle_tpu.infra.telemetry import (
     QOS_ADMITTED_TOTAL, QOS_SHED_TOTAL, SCHED_ADMIT_WAIT_MS, quantile,
 )
@@ -125,7 +126,7 @@ class AdmissionController:
                  model: str = ""):
         self.config = config or AdmissionConfig()
         self.model = model
-        self._lock = threading.Lock()
+        self._lock = named_lock("qos.admission")
         self._tenants: dict[str, TenantPolicy] = {}
         self._buckets: dict[str, Any] = {}
         for name, pol in (tenants or {}).items():
@@ -134,7 +135,7 @@ class AdmissionController:
         self._headroom_fn = headroom_fn
         self._depth_sources: dict[str, Callable[[], int]] = {}
         # cached signals (refreshed under _sig_lock, read without)
-        self._sig_lock = threading.Lock()
+        self._sig_lock = named_lock("qos.signals")
         self._t_refresh = 0.0
         self._t_hbm = 0.0
         self._wait_counts: Optional[list] = None
@@ -164,9 +165,21 @@ class AdmissionController:
     def refresh_signals(self, now: Optional[float] = None) -> None:
         """Refresh the cached overload signals if the window elapsed.
         Exceptions are swallowed — a broken sampler must never take
-        admission (and the serving path behind it) down."""
+        admission (and the serving path behind it) down.
+
+        The HBM headroom sampler runs OUTSIDE ``_sig_lock`` (qlint
+        lock-blocking, fixed in the pass's introducing PR): it
+        enumerates device allocator state — ``memory_stats()`` /
+        ``live_arrays()`` and, with a tier attached, the store-lock-
+        guarded demotable accounting — and every submit thread calls
+        admit → refresh_signals, so holding the signal lock through the
+        sample serialized ALL submitters behind one device query. The
+        window claim (``_t_hbm`` bump) stays under the lock, so exactly
+        one caller per window pays the sample and the rest read the
+        cached value."""
         now = time.monotonic() if now is None else now
         cfg = self.config
+        sample_hbm = False
         with self._sig_lock:
             if now - self._t_refresh < cfg.refresh_s:
                 return
@@ -185,12 +198,16 @@ class AdmissionController:
             except Exception:             # noqa: BLE001 — telemetry only
                 pass
             if now - self._t_hbm >= cfg.hbm_refresh_s:
-                self._t_hbm = now
-                try:
-                    fn = self._headroom_fn or self._default_headroom
-                    self.hbm_headroom = fn()
-                except Exception:         # noqa: BLE001 — optional signal
-                    self.hbm_headroom = None
+                self._t_hbm = now         # claim the window; sample after
+                sample_hbm = True
+        if sample_hbm:
+            try:
+                fn = self._headroom_fn or self._default_headroom
+                head = fn()
+            except Exception:             # noqa: BLE001 — optional signal
+                head = None
+            with self._sig_lock:
+                self.hbm_headroom = head
 
     def queue_depth(self) -> int:
         with self._lock:

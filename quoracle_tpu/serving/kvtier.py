@@ -70,6 +70,8 @@ from typing import Optional, Sequence
 import jax
 import numpy as np
 
+from quoracle_tpu.analysis.lockdep import named_lock
+
 logger = logging.getLogger(__name__)
 
 
@@ -228,7 +230,7 @@ class DiskPrefixStore:
         self.loads = 0
         self.corrupt = 0
         self.pruned = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("tier.disk")
         self._scan_entries = 0
         self._scan_bytes = 0
         self._scan_ts = 0.0
@@ -238,6 +240,10 @@ class DiskPrefixStore:
     def _rescan_locked(self) -> None:
         entries = nbytes = 0
         try:
+            # TTL-bounded (30 s) accounting scan of this store's own
+            # directory, under its own leaf lock — nothing on the
+            # serving path contends for it during the walk.
+            # qlint: allow[lock-blocking] TTL-bounded scan under the store's leaf lock
             for f in os.listdir(self.dir):
                 if not f.endswith(".npz"):
                     continue
@@ -256,6 +262,10 @@ class DiskPrefixStore:
         (load() touches mtime, so eviction order approximates LRU)."""
         files = []
         try:
+            # budget enforcement IS the lock's job: the prune must see a
+            # stable ledger, and it only runs on the (async) spill
+            # writer when a save overflows the byte budget.
+            # qlint: allow[lock-blocking] budget prune on the spill writer, leaf lock
             for f in os.listdir(self.dir):
                 if not f.endswith(".npz"):
                     continue
@@ -303,27 +313,45 @@ class DiskPrefixStore:
 
     def save(self, key: str, tokens: Sequence[int], k: np.ndarray,
              v: np.ndarray) -> bool:
+        """Write one block. The npz serialization and the tmp-file write
+        run OUTSIDE ``_lock`` (qlint lock-blocking: the spill writer
+        holding the lock through megabytes of compression would stall
+        every stats()/load() accounting touch for the duration); only
+        the atomic publish (rename) and the size accounting + budget
+        prune run under it. Two writers racing the same content-
+        addressed key both produce identical bytes under distinct tmp
+        names, and the exists-check under the lock keeps the accounting
+        single-counted."""
         path = self._path(key)
         if os.path.exists(path):
             return False                 # content-addressed: already there
         toks = np.asarray(tokens, np.int64)
-        tmp = f"{path}.tmp.{os.getpid()}"
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
         try:
+            with open(tmp, "wb") as f:
+                # KV payloads ship as RAW BYTES + dtype name + shape:
+                # npz round-trips extension dtypes (ml_dtypes
+                # bfloat16 — the serving cache dtype) as an opaque
+                # void dtype, which would silently strip the dtype a
+                # restore needs
+                np.savez(
+                    f, tokens=toks,
+                    k=np.ascontiguousarray(k).view(np.uint8)
+                    .reshape(-1),
+                    v=np.ascontiguousarray(v).view(np.uint8)
+                    .reshape(-1),
+                    dtype=str(k.dtype), shape=np.asarray(k.shape),
+                    crc=np.uint32(self._crc(toks, k, v)))
             with self._lock:
-                with open(tmp, "wb") as f:
-                    # KV payloads ship as RAW BYTES + dtype name + shape:
-                    # npz round-trips extension dtypes (ml_dtypes
-                    # bfloat16 — the serving cache dtype) as an opaque
-                    # void dtype, which would silently strip the dtype a
-                    # restore needs
-                    np.savez(
-                        f, tokens=toks,
-                        k=np.ascontiguousarray(k).view(np.uint8)
-                        .reshape(-1),
-                        v=np.ascontiguousarray(v).view(np.uint8)
-                        .reshape(-1),
-                        dtype=str(k.dtype), shape=np.asarray(k.shape),
-                        crc=np.uint32(self._crc(toks, k, v)))
+                if os.path.exists(path):
+                    # a concurrent writer published the same content
+                    # first: drop ours, count nothing
+                    os.unlink(tmp)
+                    return False
+                # atomic publish: one rename + one stat under the
+                # store's own leaf lock keeps the size ledger exact; the
+                # payload write already happened outside.
+                # qlint: allow[lock-blocking] single rename, not payload I/O
                 os.replace(tmp, path)
                 try:
                     self._scan_bytes += os.path.getsize(path)
@@ -350,6 +378,14 @@ class DiskPrefixStore:
         if not os.path.exists(path):
             return None
         try:
+            # Restore path by design (ARCHITECTURE §9): extend_prefix
+            # calls this under the store lock so match→alloc→scatter→
+            # insert stays atomic against concurrent alloc; the disk
+            # read is the price of a restore and is tracked by
+            # quoracle_kv_restore_ms. Sessioned callers already hold
+            # the engine's paged lock, so no decode work is stalled
+            # that wasn't already waiting on this restore.
+            # qlint: allow[lock-blocking] restore reads under the store lock by design
             with np.load(path) as z:
                 toks, crc = z["tokens"], int(z["crc"])
                 dt = jax.numpy.dtype(str(z["dtype"]))
@@ -361,6 +397,7 @@ class DiskPrefixStore:
                 raise ValueError("checksum/token mismatch")
             self.loads += 1
             try:
+                # qlint: allow[lock-blocking] one-syscall LRU touch on the restore path
                 os.utime(path)            # LRU touch for budget pruning
             except OSError:
                 pass
@@ -438,11 +475,20 @@ class TierManager:
 
     def _gather_host(self, pages: list[int]) -> tuple[np.ndarray,
                                                       np.ndarray]:
-        """One device_get per victim: the pages' KV as host numpy."""
+        """One device_get per victim: the pages' KV as host numpy.
+
+        Deliberately under the store lock (ARCHITECTURE §9 demote
+        invariant): eviction-as-demotion must copy the victim's pages
+        before alloc's ladder releases them, or a concurrent writer
+        could scribble the pool pages mid-copy. One victim per
+        device_get bounds the stall; the async spill queue keeps DISK
+        out of this window."""
         import jax
         st = self.store
         idx = np.asarray(pages, np.int32)
+        # qlint: allow[hot-path-sync, lock-blocking] demote copies one victim under the store lock by design
         k = np.asarray(jax.device_get(st.k[:, idx]))
+        # qlint: allow[hot-path-sync, lock-blocking] second half of the same bounded victim copy
         v = np.asarray(jax.device_get(st.v[:, idx]))
         return k, v
 

@@ -34,6 +34,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Optional
 
+from quoracle_tpu.analysis.lockdep import named_lock
 from quoracle_tpu.infra.telemetry import QOS_QUEUE_DEPTH
 
 
@@ -120,7 +121,7 @@ class TokenBucket:
         self.burst = max(float(burst), 1.0)
         self._tokens = self.burst
         self._t_last = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = named_lock("qos.bucket")
 
     def _refill(self, now: float) -> None:
         self._tokens = min(self.burst,
@@ -212,7 +213,7 @@ class FifoPolicy(AdmissionPolicy):
 
     def __init__(self) -> None:
         self._q: deque = deque()
-        self._lock = threading.Lock()
+        self._lock = named_lock("qos.queue")
 
     def put(self, row: Any) -> None:
         with self._lock:
@@ -275,7 +276,7 @@ class WeightedFairPolicy(AdmissionPolicy):
                                                 for p in self._order}
         self._cursor = 0
         self._fresh = True          # cursor just arrived (earn credit once)
-        self._lock = threading.Lock()
+        self._lock = named_lock("qos.queue")
         self.served: dict[Priority, int] = {p: 0 for p in self._order}
         self.aged_served = 0
 

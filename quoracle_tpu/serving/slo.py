@@ -23,6 +23,7 @@ import math
 import threading
 from typing import Optional
 
+from quoracle_tpu.analysis.lockdep import named_lock
 from quoracle_tpu.infra.telemetry import (
     QOS_CLASS_TAIL_MS, QOS_DEMOTIONS_TOTAL, QOS_WEIGHT_MULTIPLIER,
 )
@@ -61,7 +62,7 @@ class SLOTracker:
         self._count: dict[Priority, int] = {p: 0 for p in Priority}
         self._demoted = False
         self.demotions = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("qos.slo")
         for p in Priority:
             QOS_WEIGHT_MULTIPLIER.set(1.0, cls=p.name.lower())
 

@@ -1,0 +1,155 @@
+"""qlint CLI — run the repo-native analyzers against the baseline.
+
+    python -m quoracle_tpu.tools.qlint [--format=text|json]
+                                       [--rules lock-blocking,...]
+                                       [--baseline PATH]
+                                       [--update-baseline]
+                                       [--root PATH]
+                                       [--show-resolved]
+
+Exit-code contract (the CI gate depends on it):
+
+* ``0`` — clean: no findings outside the committed baseline (stale
+  baseline entries are reported as warnings, not failures, unless
+  ``--strict-baseline``).
+* ``1`` — NEW findings (not in the baseline). Fix them or, for a
+  deliberate exception, annotate the site with
+  ``# qlint: allow[rule] reason``; ``--update-baseline`` is the last
+  resort and the diff reviewer will ask why.
+* ``2`` — internal error (analyzer crash, unparseable source).
+
+Wall-time budget: the full repo must analyze in well under 30 s (it is
+pure-AST, no jax import on the analysis path) so the CI gates stage
+stays cheap; ``--timings`` prints per-pass wall time.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="qlint",
+        description="repo-native static analyzer (ISSUE 9)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--rules", default="",
+                   help="comma-separated rule filter (default: all)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline path (default: <root>/qlint_baseline"
+                        ".json)")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="write the current findings as the new baseline")
+    p.add_argument("--root", default=None,
+                   help="repo root (default: auto-detect)")
+    p.add_argument("--show-resolved", action="store_true",
+                   help="list baseline entries no longer reported")
+    p.add_argument("--strict-baseline", action="store_true",
+                   help="stale baseline entries fail the run too")
+    p.add_argument("--timings", action="store_true")
+    return p
+
+
+def run_passes(root: str, rules: set | None = None,
+               timings: dict | None = None) -> list:
+    """All findings over the repo at ``root`` (sorted, rule-filtered).
+    Imports stay inside so ``--help`` is instant."""
+    from quoracle_tpu.analysis import common, compilekeys, locks
+    from quoracle_tpu.analysis import registry as registry_pass
+    from quoracle_tpu.analysis import skips
+
+    t0 = time.monotonic()
+    pkg_modules = common.load_modules(root, ["quoracle_tpu"])
+    test_modules = common.load_modules(root, ["tests"])
+    if timings is not None:
+        timings["parse"] = time.monotonic() - t0
+
+    findings: list = []
+    for name, fn in (
+            ("locks", lambda: locks.run(pkg_modules)),
+            ("compilekeys", lambda: compilekeys.run(pkg_modules)),
+            ("registry", lambda: registry_pass.run(pkg_modules, root)),
+            ("skips", lambda: skips.run(test_modules))):
+        t = time.monotonic()
+        findings.extend(fn())
+        if timings is not None:
+            timings[name] = time.monotonic() - t
+    if rules:
+        findings = [f for f in findings if f.rule in rules]
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.symbol))
+    return findings
+
+
+def main(argv: list | None = None) -> int:
+    args = _build_parser().parse_args(argv)
+    try:
+        from quoracle_tpu.analysis import common
+
+        root = args.root or common.repo_root(
+            os.path.dirname(os.path.abspath(__file__)))
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        bad = rules - set(common.RULES)
+        if bad:
+            print(f"qlint: unknown rule(s): {', '.join(sorted(bad))}",
+                  file=sys.stderr)
+            return 2
+        timings: dict = {}
+        t0 = time.monotonic()
+        findings = run_passes(root, rules or None, timings)
+        wall = time.monotonic() - t0
+
+        baseline_path = args.baseline or os.path.join(
+            root, common.BASELINE_NAME)
+        if args.update_baseline:
+            common.save_baseline(baseline_path, findings)
+            print(f"qlint: baseline updated: {baseline_path} "
+                  f"({len(findings)} findings)")
+            return 0
+        baseline = common.load_baseline(baseline_path)
+        new, resolved = common.diff_baseline(findings, baseline)
+
+        if args.format == "json":
+            print(json.dumps({
+                "findings": [f.as_dict() for f in findings],
+                "new": [f.as_dict() for f in new],
+                "resolved_baseline": resolved,
+                "baseline_entries": len(baseline),
+                "wall_s": round(wall, 3),
+            }, indent=2))
+        else:
+            for f in new:
+                print(f.render())
+            n_known = len(findings) - len(new)
+            print(f"qlint: {len(findings)} finding(s) "
+                  f"({len(new)} new, {n_known} baselined), "
+                  f"{len(resolved)} stale baseline entr"
+                  f"{'y' if len(resolved) == 1 else 'ies'}, "
+                  f"{wall:.1f}s")
+            if args.timings:
+                for k, v in timings.items():
+                    print(f"  {k}: {v * 1000:.0f}ms")
+            if resolved and (args.show_resolved or args.strict_baseline):
+                for e in resolved:
+                    print(f"  stale: [{e['rule']}] {e['path']} "
+                          f"{e['symbol']}")
+                print("qlint: prune with --update-baseline")
+        if new:
+            return 1
+        if args.strict_baseline and resolved:
+            return 1
+        return 0
+    except KeyboardInterrupt:
+        raise
+    except Exception as e:                  # noqa: BLE001 — exit contract
+        import traceback
+        traceback.print_exc()
+        print(f"qlint: internal error: {e!r}", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -9,9 +9,10 @@ state; the reference needed a GenServer ETS owner, we don't).
 from __future__ import annotations
 
 import hashlib
-import threading
 import time
 from collections import OrderedDict
+
+from quoracle_tpu.analysis.lockdep import named_lock
 from typing import Any, Callable, Optional
 
 
@@ -28,7 +29,7 @@ class TTLCache:
         self.ttl_s = ttl_s
         self._clock = clock
         self._data: OrderedDict[str, tuple[float, Any]] = OrderedDict()
-        self._lock = threading.Lock()
+        self._lock = named_lock("cache.lru")
         self.hits = 0
         self.misses = 0
 

@@ -58,7 +58,67 @@ from quoracle_tpu.utils.compile_cache import enable_compilation_cache  # noqa: E
 
 enable_compilation_cache()
 
+import time  # noqa: E402
+
 import pytest  # noqa: E402
+
+# ---------------------------------------------------------------------------
+# Runtime lock-order sanitizer (ISSUE 9): ON for the whole suite unless
+# explicitly disabled, so every existing concurrency test doubles as a
+# race check. Must happen before any quoracle module creates its locks —
+# conftest imports before every test module, and named_lock reads the
+# sanitizer flag per acquisition (enable() is retroactive anyway).
+# ---------------------------------------------------------------------------
+
+from quoracle_tpu.analysis import lockdep  # noqa: E402
+
+if os.environ.get("QUORACLE_LOCKDEP", "").strip().lower() not in (
+        "0", "false", "off"):
+    lockdep.enable()
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_guard():
+    """Fail any test whose execution produced a lock-order inversion.
+    Tests that SEED inversions on purpose (tests/test_races.py) drain
+    the ledger themselves before returning."""
+    lockdep.LOCKDEP.drain()
+    yield
+    if not lockdep.enabled():
+        return
+    inversions = lockdep.LOCKDEP.drain()
+    assert not inversions, (
+        "lock-order inversion(s) observed (analysis/lockdep.py): "
+        + "; ".join(
+            f"{i['thread']}: acquiring {i['acquiring']!r} while holding "
+            f"{i['violates']} at {i['site']}" for i in inversions))
+
+
+@pytest.fixture(autouse=True)
+def _thread_leak_guard():
+    """No non-daemon thread created during a test may survive it (ISSUE
+    9 satellite): a leaked non-daemon thread keeps the process alive
+    after pytest finishes and is a shutdown bug in the component that
+    spawned it. Daemon workers (batcher loops, spill writers, watchdog)
+    are owned by objects whose close() the tests drive; the guard only
+    hunts the ones that would actually wedge an exit."""
+    import threading
+    before = {t.ident for t in threading.enumerate()}
+    yield
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t.ident not in before and not t.daemon
+                  and t.is_alive()]
+        if not leaked:
+            return
+        for t in leaked:
+            t.join(timeout=max(0.05, deadline - time.monotonic()))
+    leaked = [t for t in threading.enumerate()
+              if t.ident not in before and not t.daemon and t.is_alive()]
+    assert not leaked, (
+        "non-daemon thread(s) leaked by this test: "
+        + ", ".join(repr(t.name) for t in leaked))
 
 
 @pytest.fixture(scope="session")

@@ -13,6 +13,11 @@ AGENTS.md race catalog warns about, driven with real actors/threads.
   * bus subscriber death — a raising handler must never break delivery to
     other subscribers or the broadcaster (reference safe_broadcast,
     agent_events.ex:21-29)
+  * lock-order sanitizer (ISSUE 9, analysis/lockdep.py) — a seeded
+    inversion is detected and flight-recorded, and real scheduler +
+    kvtier + prefix-cache churn under QUORACLE_LOCKDEP reports ZERO
+    inversions (the conftest guard makes every other test in the suite
+    assert the same)
 """
 
 import asyncio
@@ -233,6 +238,112 @@ def test_bus_subscriber_death_does_not_break_delivery():
         events.agent_spawned(f"a{i}", None, "t1")   # must not raise
     assert len(got) == 5
     assert [e["agent_id"] for e in got] == [f"a{i}" for i in range(5)]
+
+
+def _drain_lockdep():
+    from quoracle_tpu.analysis import lockdep
+    return lockdep.LOCKDEP.drain()
+
+
+def test_lockdep_seeded_inversion_detected_and_flight_recorded():
+    """The sanitizer actually fires: acquiring UP the declared hierarchy
+    (metrics → session.store) on one thread is reported with the held
+    stack, lands in the flight recorder as ``lockdep_inversion``, and
+    increments the counter. Drained at the end so the conftest guard
+    stays green — the inversion is the test's own seed."""
+    from quoracle_tpu.analysis import lockdep
+    from quoracle_tpu.infra.flightrec import FLIGHT
+    from quoracle_tpu.infra.telemetry import LOCKDEP_INVERSIONS
+
+    assert lockdep.enabled(), "conftest must enable the sanitizer"
+    _drain_lockdep()
+    before = LOCKDEP_INVERSIONS.total()
+    inner = lockdep.named_lock("metrics")
+    outer = lockdep.named_lock("session.store", rlock=True)
+
+    def seed():
+        with inner:                     # rank 60
+            with outer:                 # rank 30: inversion
+                pass
+
+    t = threading.Thread(target=seed, name="lockdep-seed")
+    t.start()
+    t.join()
+    inv = _drain_lockdep()
+    assert len(inv) == 1, inv
+    assert inv[0]["acquiring"] == "session.store"
+    assert inv[0]["thread"] == "lockdep-seed"
+    assert ("metrics", 60) in inv[0]["violates"]
+    assert "test_races.py" in inv[0]["site"]
+    flight = [e for e in FLIGHT.snapshot()
+              if e.get("kind") == "lockdep_inversion"
+              and e.get("thread") == "lockdep-seed"]
+    assert flight and flight[-1]["acquiring"] == "session.store"
+    assert LOCKDEP_INVERSIONS.total() == before + 1
+
+
+def test_lockdep_clean_under_serving_churn():
+    """Scheduler + tiered-KV + prefix-cache churn with the sanitizer on:
+    concurrent continuous-batcher rows over shared prefixes, forced
+    hibernation (alloc pressure demotes sessions to the host tier), and
+    session restores — the full serving-plane lock nesting (batcher →
+    engine.paged → session.store → tier) — must observe ZERO
+    inversions. This is the declared hierarchy's proof-by-execution;
+    the static pass covers the paths this run doesn't thread."""
+    import jax
+    import jax.numpy as jnp
+
+    from quoracle_tpu.analysis import lockdep
+    from quoracle_tpu.models.config import get_model_config
+    from quoracle_tpu.models.generate import GenerateEngine
+    from quoracle_tpu.models.scheduler import ContinuousBatcher
+    from quoracle_tpu.models.tokenizer import ByteTokenizer
+    from quoracle_tpu.models.transformer import init_params
+
+    assert lockdep.enabled()
+    _drain_lockdep()
+    cfg = get_model_config("xla:tiny")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    tok = ByteTokenizer()
+    engine = GenerateEngine(cfg, params, tok, max_seq=512,
+                            prompt_buckets=(32, 64, 128, 256))
+    engine.attach_tier(host_mb=8)
+    cb = ContinuousBatcher(engine, chunk=8, max_slots=4)
+    try:
+        sys_prefix = "system: " + "policy rules apply here. " * 8
+        futs = []
+
+        def submit_burst(tag):
+            for i in range(3):
+                futs.append(cb.submit(
+                    tok.encode(f"{sys_prefix} task {tag}-{i}",
+                               add_bos=True),
+                    temperature=0.0, max_new_tokens=6,
+                    session_id=(f"sess-{tag}-{i}" if i % 2 == 0
+                                else None)))
+
+        threads = [threading.Thread(target=submit_burst, args=(t,))
+                   for t in range(3)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        # alloc pressure mid-churn: demote everything demotable, then
+        # let the still-live rows restore their sessions
+        st = engine.sessions
+        with engine._paged_lock:
+            with st.lock:
+                got = st.alloc(max(1, st.n_pages // 2))
+                if got:
+                    st._release(got)
+        for f in futs:
+            f.result(timeout=120)
+        # a hibernated session resumes by page-in
+        engine.prefetch_session("sess-0-0")
+    finally:
+        cb.close()
+    inversions = _drain_lockdep()
+    assert inversions == [], inversions
 
 
 def test_bus_subscriber_death_does_not_kill_agents():
