@@ -1169,6 +1169,126 @@ def measure_ragged_serving(backend, pool, n_short: int = 6,
     return result
 
 
+def measure_cluster_disagg(backend, pool, n_interactive: int = 6,
+                           n_agent: int = 3) -> dict:
+    """Config 16: the disaggregated serving plane (ISSUE 10) under
+    mixed interactive+agent traffic — ONE monolithic continuous replica
+    vs a 2-replica prefill/decode cluster over the same total device
+    budget (both phases see every local chip; on a single host the
+    cluster's replicas interleave on the device queue, so the smoke
+    number is a routing-overhead measurement, the multi-chip run the
+    real scaling one).
+
+    Each phase serves ``n_interactive`` short INTERACTIVE rows (16 new
+    tokens) and ``n_agent`` long sessioned AGENT rows (MAX_NEW tokens)
+    through the production query() path. Reported per phase:
+    tokens/sec/chip and interactive TTFT p95 (a max_tokens=1 request —
+    first token out the door, which in the cluster phase includes the
+    prefill→decode handoff). Plus: handoff latency p95 (count deltas of
+    quoracle_cluster_handoff_ms) vs the cold re-prefill it replaces
+    (the monolithic TTFT probe), and the acceptance gate — temp-0
+    outputs BIT-IDENTICAL monolithic vs disaggregated."""
+    import jax
+
+    from quoracle_tpu.infra.telemetry import CLUSTER_HANDOFF_MS, quantile
+    from quoracle_tpu.models.runtime import QueryRequest, TPUBackend
+    from quoracle_tpu.serving.cluster import ClusterPlane
+
+    member = pool[0]
+    inter_msgs = [[{"role": "user",
+                    "content": f"[user {i}] {TASKS[i % len(TASKS)][:48]}"}]
+                  for i in range(n_interactive)]
+    agent_msgs = [[{"role": "user",
+                    "content": f"[agent {i}] working state: "
+                               + " ".join(TASKS)}]
+                  for i in range(n_agent)]
+
+    def reqs():
+        rs = [QueryRequest(member, m, temperature=0.0, max_tokens=16,
+                           priority=0) for m in inter_msgs]
+        rs += [QueryRequest(member, m, temperature=0.0,
+                            max_tokens=MAX_NEW, session_id=f"agent{j}",
+                            constrain_json=True, priority=1)
+               for j, m in enumerate(agent_msgs)]
+        return rs
+
+    def run(b) -> dict:
+        # warmup pays the phase's compiles; the measured window is
+        # steady-state serving
+        b.query([QueryRequest(member, inter_msgs[0], temperature=0.0,
+                              max_tokens=4)])
+        ttfts = []
+        for m in inter_msgs:
+            t0 = time.monotonic()
+            b.query([QueryRequest(member, m, temperature=0.0,
+                                  max_tokens=1)])
+            ttfts.append((time.monotonic() - t0) * 1000)
+        t0 = time.monotonic()
+        out = b.query(reqs())
+        wall = time.monotonic() - t0
+        assert all(r.ok for r in out), [r.error for r in out if not r.ok]
+        toks = sum(r.usage.completion_tokens for r in out)
+        ttfts.sort()
+        return {
+            "texts": [r.text for r in out],
+            "wall_s": round(wall, 3),
+            "tokens": toks,
+            "tokens_per_s": round(toks / max(1e-9, wall), 1),
+            "ttft_p95_ms": round(
+                ttfts[min(len(ttfts) - 1,
+                          int(0.95 * len(ttfts)))], 1),
+        }
+
+    mono_b = TPUBackend([member], engines=backend.engines,
+                        embedder=backend.embedder, continuous=True,
+                        continuous_chunk=16, continuous_slots=8)
+    try:
+        mono = run(mono_b)
+    finally:
+        mono_b.close()
+    for j in range(n_agent):           # free the monolithic sessions
+        backend.engines[member].drop_session(f"agent{j}")
+
+    ho_counts0, ho_buckets = CLUSTER_HANDOFF_MS.counts()[0], \
+        CLUSTER_HANDOFF_MS.buckets
+    cluster = ClusterPlane.build([member], replicas=2, disaggregate=True,
+                                 continuous=True, continuous_chunk=16,
+                                 continuous_slots=8)
+    try:
+        disagg = run(cluster)
+        handoff_stats = cluster.handoff.stats()
+    finally:
+        cluster.close()
+    ho_delta = [a - b for a, b in zip(CLUSTER_HANDOFF_MS.counts()[0],
+                                      ho_counts0)]
+    handoff_p95 = (quantile(ho_buckets, ho_delta, 0.95)
+                   if sum(ho_delta) else None)
+
+    equal = mono["texts"] == disagg["texts"]
+    n_chips = max(1, len(jax.devices()))
+    result = {
+        "n_interactive": n_interactive,
+        "n_agent": n_agent,
+        "max_new": MAX_NEW,
+        "tokens_per_s_chip_mono": round(mono["tokens_per_s"] / n_chips,
+                                        1),
+        "tokens_per_s_chip_disagg": round(
+            disagg["tokens_per_s"] / n_chips, 1),
+        "ttft_p95_ms_mono": mono["ttft_p95_ms"],
+        "ttft_p95_ms_disagg": disagg["ttft_p95_ms"],
+        "handoff_p95_ms": handoff_p95,
+        # the monolithic TTFT probe IS a cold prefill + first token —
+        # the work a handoff-restored decode replica never repeats
+        "cold_prefill_p95_ms": mono["ttft_p95_ms"],
+        "handoffs": handoff_stats,
+        "temp0_equal": equal,
+        "mono_detail": {k: mono[k] for k in ("wall_s", "tokens")},
+        "disagg_detail": {k: disagg[k] for k in ("wall_s", "tokens")},
+    }
+    assert equal, "config16: temp-0 outputs diverged mono vs cluster"
+    return result
+
+
 def measure_quality_overhead(backend, pool,
                              n_decides: int = N_CYCLES) -> dict:
     """Config 12: consensus-quality instrumentation overhead (ISSUE 5).
@@ -1412,6 +1532,19 @@ def base_payload() -> dict:
         "config15_peak_hbm_delta_unified": None,
         "config15_peak_hbm_delta_gather": None,
         "config15_temp0_equal": None,
+        # config 16 — disaggregated serving plane (ISSUE 10): mixed
+        # interactive+agent traffic, one monolithic continuous replica
+        # vs a 2-replica prefill/decode cluster on the same device
+        # budget — tokens/sec/chip, interactive TTFT p95, handoff p95
+        # vs the cold re-prefill it replaces, and the temp-0 equality
+        # gate. Detail in the CLUSTER sidecar (QUORACLE_BENCH_CLUSTER).
+        "config16_tokens_per_s_chip_mono": None,
+        "config16_tokens_per_s_chip_disagg": None,
+        "config16_ttft_p95_ms_mono": None,
+        "config16_ttft_p95_ms_disagg": None,
+        "config16_handoff_p95_ms": None,
+        "config16_cold_prefill_p95_ms": None,
+        "config16_temp0_equal": None,
         "cycles": None,
         "rounds_per_cycle": None,
         "max_new_tokens": None,
@@ -1858,6 +1991,23 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             except OSError as e:
                 log(f"config15 sidecar write failed: {e}")
 
+    # config 16 builds its own 2-replica cluster (fresh engine sets —
+    # replicas never share a page pool by design) and reuses backend's
+    # engines for the monolithic phase — before the vision config
+    cfg16 = guard("config16",
+                  lambda: measure_cluster_disagg(backend, pool))
+    if cfg16:
+        log(f"config16: {cfg16}")
+        sidecar = os.environ.get("QUORACLE_BENCH_CLUSTER")
+        if sidecar:
+            try:
+                with open(sidecar, "w") as f:
+                    json.dump({"metric": "cluster_disagg",
+                               "config16": cfg16}, f, indent=1)
+                log(f"config16 cluster detail written to {sidecar}")
+            except OSError as e:
+                log(f"config16 sidecar write failed: {e}")
+
     def vision_config():
         # config 5: vision pool — free the trio's HBM first (weights + KV
         # page pools), then serve llama + the VLM checkpoint with an
@@ -2087,6 +2237,19 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             "config15_peak_hbm_delta_gather":
                 cfg15["peak_hbm_delta_gather"],
             "config15_temp0_equal": cfg15["temp0_equal"],
+        })
+    if cfg16:
+        payload.update({
+            "config16_tokens_per_s_chip_mono":
+                cfg16["tokens_per_s_chip_mono"],
+            "config16_tokens_per_s_chip_disagg":
+                cfg16["tokens_per_s_chip_disagg"],
+            "config16_ttft_p95_ms_mono": cfg16["ttft_p95_ms_mono"],
+            "config16_ttft_p95_ms_disagg": cfg16["ttft_p95_ms_disagg"],
+            "config16_handoff_p95_ms": cfg16["handoff_p95_ms"],
+            "config16_cold_prefill_p95_ms":
+                cfg16["cold_prefill_p95_ms"],
+            "config16_temp0_equal": cfg16["temp0_equal"],
         })
     if cfg10:
         payload.update({

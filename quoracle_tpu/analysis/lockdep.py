@@ -52,7 +52,13 @@ from typing import Any, Optional
 # ---------------------------------------------------------------------------
 
 HIERARCHY: tuple = (
-    # -- admission / scheduling plane (outermost) -----------------------
+    # -- cluster plane (outermost — the router sits in FRONT of every
+    #    replica's batcher, so its locks must release before any
+    #    replica-internal lock is taken) -------------------------------
+    ("cluster.plane",   4, False),  # ClusterPlane replica table / seq
+    ("router",          6, False),  # ClusterRouter affinity + liveness
+    ("handoff",         8, False),  # KVHandoff in-flight envelope ledger
+    # -- admission / scheduling plane -----------------------------------
     ("batcher",        10, False),  # ContinuousBatcher queue/close lock
     ("qos.admission",  12, False),  # AdmissionController tenant table
     ("qos.signals",    14, False),  # AdmissionController cached signals

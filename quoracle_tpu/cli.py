@@ -78,7 +78,9 @@ async def cmd_run(args: argparse.Namespace) -> int:
                                qos=args.qos or None,
                                host_kv_mb=args.host_kv_mb,
                                disk_kv_dir=args.disk_kv_dir,
-                               disk_kv_gb=args.disk_kv_gb))
+                               disk_kv_gb=args.disk_kv_gb,
+                               replicas=args.replicas,
+                               disaggregate=args.disaggregate))
     _attach_printer(rt)
     if pool is None and args.profile is None:
         pool = rt.default_pool()
@@ -110,7 +112,9 @@ async def cmd_resume(args: argparse.Namespace) -> int:
                                qos=args.qos or None,
                                host_kv_mb=args.host_kv_mb,
                                disk_kv_dir=args.disk_kv_dir,
-                               disk_kv_gb=args.disk_kv_gb))
+                               disk_kv_gb=args.disk_kv_gb,
+                               replicas=args.replicas,
+                               disaggregate=args.disaggregate))
     _attach_printer(rt)
     result = await rt.boot()
     print(json.dumps(result), flush=True)
@@ -137,7 +141,8 @@ async def cmd_serve(args: argparse.Namespace) -> int:
         draft_k=args.draft_k,
         continuous=args.continuous, qos=args.qos or None,
         host_kv_mb=args.host_kv_mb, disk_kv_dir=args.disk_kv_dir,
-        disk_kv_gb=args.disk_kv_gb))
+        disk_kv_gb=args.disk_kv_gb,
+        replicas=args.replicas, disaggregate=args.disaggregate))
     # Validate host/token BEFORE boot so a refused bind exits with a clean
     # message instead of a traceback over a half-started runtime.
     try:
@@ -231,6 +236,19 @@ def build_parser() -> argparse.ArgumentParser:
                              "pool member (GiB): oldest-LRU entries "
                              "prune when a write overflows it; 0 = "
                              "unbounded")
+        sp.add_argument("--replicas", type=int, default=1,
+                        help="disaggregated serving plane "
+                             "(serving/cluster.py): run N full replicas "
+                             "of the pool, each on its own slice of the "
+                             "local devices, behind a QoS-aware router; "
+                             "scale = raise this number")
+        sp.add_argument("--disaggregate", action="store_true",
+                        help="role-tag the replicas into prefill "
+                             "(MFU-optimized, first token + KV) and "
+                             "decode (continuous batching + "
+                             "speculation) tiers with KV handoff "
+                             "between them; implies --replicas 2 when "
+                             "unset")
         sp.add_argument("--qos", action="store_true",
                         help="serving QoS (ISSUE 4): weighted-fair "
                              "admission + overload shedding + SLO "

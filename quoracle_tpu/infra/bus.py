@@ -125,6 +125,12 @@ TOPIC_RESOURCES = "resources:events"
 # writer persists audit records to the consensus_audit table, and the
 # SSE stream tails drift alerts live.
 TOPIC_CONSENSUS = "consensus:audit"
+# Disaggregated serving plane (ISSUE 10): cluster incidents — replica
+# death, handoff rejects, all-replicas-shed at the router — broadcast by
+# serving/cluster.py and ring-buffered by EventHistory (the /api/history
+# "cluster" key); the SSE stream tails them live so an open dashboard
+# sees a replica drop the moment the router marks it dead.
+TOPIC_CLUSTER = "cluster:events"
 
 
 def topic_agent_state(agent_id: str) -> str:

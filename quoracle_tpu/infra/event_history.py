@@ -13,8 +13,8 @@ from typing import Optional
 
 from quoracle_tpu.analysis.lockdep import named_lock
 from quoracle_tpu.infra.bus import (
-    EventBus, Subscription, TOPIC_ACTIONS, TOPIC_CONSENSUS, TOPIC_LIFECYCLE,
-    TOPIC_RESOURCES, TOPIC_SERVING, TOPIC_TRACE,
+    EventBus, Subscription, TOPIC_ACTIONS, TOPIC_CLUSTER, TOPIC_CONSENSUS,
+    TOPIC_LIFECYCLE, TOPIC_RESOURCES, TOPIC_SERVING, TOPIC_TRACE,
 )
 
 MAX_LOGS_PER_AGENT = 100      # reference ui/event_history.ex:17-20
@@ -49,6 +49,7 @@ class EventHistory:
         self._traces: deque = deque(maxlen=MAX_TRACE_SPANS)
         self._resources: deque = deque(maxlen=max_logs)
         self._consensus: deque = deque(maxlen=MAX_CONSENSUS_RECORDS)
+        self._cluster: deque = deque(maxlen=max_logs)
         self._tasks: set[str] = set()
         self._lock = named_lock("history")
         self._closed = False
@@ -59,6 +60,7 @@ class EventHistory:
             bus.subscribe(TOPIC_TRACE, self._on_trace),
             bus.subscribe(TOPIC_RESOURCES, self._on_resource),
             bus.subscribe(TOPIC_CONSENSUS, self._on_consensus),
+            bus.subscribe(TOPIC_CLUSTER, self._on_cluster),
         ]
 
     # Agent log/message topics are per-agent; the runtime calls track_agent
@@ -131,6 +133,10 @@ class EventHistory:
         with self._lock:
             self._consensus.append(event)
 
+    def _on_cluster(self, topic: str, event: dict) -> None:
+        with self._lock:
+            self._cluster.append(event)
+
     def _on_task_message(self, topic: str, event: dict) -> None:
         # topic is "tasks:<id>:messages". Ring under the TASK key always
         # (the mailbox replay), and ALSO under the SENDER when the message
@@ -185,6 +191,13 @@ class EventHistory:
         if task_id is None:
             return records
         return [r for r in records if r.get("task_id") == task_id]
+
+    def replay_cluster(self) -> list[dict]:
+        """Recent cluster incidents (replica death, handoff rejects,
+        router all-shed — TOPIC_CLUSTER, serving/cluster.py). Backs the
+        /api/history "cluster" key."""
+        with self._lock:
+            return list(self._cluster)
 
     def replay_traces(self, trace_id: Optional[str] = None) -> list[dict]:
         """Recent finished spans (infra/telemetry.py), optionally filtered

@@ -219,6 +219,19 @@ FLIGHT_EVENTS: dict = {
     "kv_disk_spill": "prefix block written to the disk store",
     "kv_disk_corrupt": "checksum-rejected disk entry skipped + unlinked",
     "kv_alloc_drift": "SessionStore.alloc accounting-drift refusal",
+    # disaggregated serving plane (ISSUE 10)
+    "kv_handoff_export": "prefill-side session hibernated into a "
+                         "handoff envelope",
+    "kv_handoff_adopt": "decode-side replica adopted a handed-off "
+                        "session by page-in",
+    "kv_handoff_reject": "handoff rejected (engine KV signature "
+                         "mismatch or export failure)",
+    "kv_handoff_replace": "row re-placed onto another decode replica "
+                          "after its first decode replica failed",
+    "cluster_replica_dead": "router marked a replica dead after a "
+                            "serving failure",
+    "router_all_shed": "every eligible replica shed a submission at "
+                       "the cluster front door",
     # consensus quality
     "model_health_drift": "EWMA drift detector tripped for a member",
     # lock discipline (analysis/lockdep.py)

@@ -212,7 +212,11 @@ def hbm_attribution(backend) -> dict:
             # role (ISSUE 6): pool member, speculative draft (never
             # serves directly — its weights exist to accelerate
             # ``draft_for``), or aux (e.g. a dedicated embed model)
-            role = ("member" if not pool or spec in pool
+            # cluster engines key as "<replica>@<spec>" (serving/
+            # cluster.py): the bare spec decides pool membership
+            role = ("member"
+                    if not pool or spec in pool
+                    or spec.rsplit("@", 1)[-1] in pool
                     else "draft" if spec in draft_for else "aux")
             members[spec] = {
                 "role": role,

@@ -739,6 +739,40 @@ KV_ALLOC_DRIFT_TOTAL = METRICS.counter(
     "SessionStore.alloc accounting-drift refusals (the formerly silent "
     "defensive branch), per model — any nonzero value is a bug report")
 
+# -- disaggregated serving plane (ISSUE 10) ----------------------------------
+# Cluster/router/handoff instruments (serving/cluster.py, router.py,
+# handoff.py): replica topology, placement flow, and the prefill→decode
+# KV handoff — the observability contract of the multi-replica layer.
+CLUSTER_REPLICAS = METRICS.gauge(
+    "quoracle_cluster_replicas",
+    "replicas registered in the cluster plane, by role "
+    "(prefill | decode | unified) and liveness (alive | dead)")
+CLUSTER_REQUESTS_TOTAL = METRICS.counter(
+    "quoracle_cluster_requests_total",
+    "requests the cluster plane served, by replica and path "
+    "(disagg | affinity | unified | image | failover)")
+CLUSTER_HANDOFFS_TOTAL = METRICS.counter(
+    "quoracle_cluster_handoffs_total",
+    "prefill→decode KV handoffs by status (ok | export_failed | "
+    "signature_mismatch | replaced | replace_failed), per model")
+CLUSTER_HANDOFF_MS = METRICS.histogram(
+    "quoracle_cluster_handoff_ms",
+    "KV handoff latency (ms): prefill-side hibernate through decode-side "
+    "adopt — compare against quoracle_prefill_ms for the re-prefill it "
+    "replaces")
+ROUTER_PLACEMENTS_TOTAL = METRICS.counter(
+    "quoracle_router_placements_total",
+    "router placement decisions, by role and reason "
+    "(affinity | least_loaded | only | failover)")
+ROUTER_SHED_TOTAL = METRICS.counter(
+    "quoracle_router_shed_total",
+    "submissions shed at the cluster front door because every eligible "
+    "replica's admission controller rejected them, by class and tenant")
+ROUTER_SIGNAL_AGE_MS = METRICS.histogram(
+    "quoracle_router_signal_age_ms",
+    "age of the per-replica admission signal snapshot at placement time "
+    "(ms) — large values mean the router is steering on stale load data")
+
 # -- consensus quality (ISSUE 5) ---------------------------------------------
 # Decision-quality instruments (consensus/quality.py): per-decide
 # contestedness and the per-member scorecard counters. Registered at

@@ -730,8 +730,7 @@ class TPUBackend(ModelBackend):
                            results: list[Optional[QueryResult]]) -> None:
         """Writes into disjoint ``results`` positions — safe from
         concurrent member threads."""
-        engine = self.engines.get(spec)
-        if engine is None or spec not in self._batchers:
+        if spec not in self.engines or spec not in self._batchers:
             # not a pool member — includes draft engines, which load into
             # self.engines but never serve directly
             for i in idxs:
@@ -740,6 +739,24 @@ class TPUBackend(ModelBackend):
                     permanent_error=True)
             return
         t0 = time.monotonic()
+        rows, live_idxs = self._build_rows(spec, idxs, requests, results,
+                                           t0)
+        if not live_idxs:
+            return
+        self._dispatch_rows(spec, rows, live_idxs, results, t0)
+
+    def _build_rows(self, spec: str, idxs: list[int],
+                    requests: Sequence[QueryRequest],
+                    results: list, t0: float) -> tuple[list[dict],
+                                                       list[int]]:
+        """Row preparation for one member: chat-template encode (VLM
+        splice included), session-token splice, per-row overflow /
+        deadline checks (failed rows get their QueryResult written into
+        ``results`` here), and the output-budget math. Split out of
+        ``_query_member_impl`` so the cluster plane (serving/cluster.py)
+        prepares IDENTICAL rows for its disaggregated prefill→decode
+        flow — one row-construction semantics, zero drift."""
+        engine = self.engines[spec]
         rows: list[dict] = []
         live_idxs: list[int] = []
         max_seq = engine.max_seq
@@ -814,8 +831,14 @@ class TPUBackend(ModelBackend):
                 "deadline_s": deadline_s,
             })
             live_idxs.append(i)
-        if not live_idxs:
-            return
+        return rows, live_idxs
+
+    def _dispatch_rows(self, spec: str, rows: list[dict],
+                       live_idxs: list[int], results: list,
+                       t0: float) -> None:
+        """Serve prepared rows through this backend's dispatch mode
+        (continuous / speculative batch-1 / baton)."""
+        engine = self.engines[spec]
         if self.continuous:
             self._query_member_continuous(spec, rows, live_idxs, results,
                                           t0)

@@ -161,14 +161,22 @@ class ContinuousBatcher:
                constrain_json: bool = False,
                action_enum: Optional[Sequence[str]] = None,
                priority=None, tenant: str = "default",
-               deadline_s: Optional[float] = None) -> Future:
+               deadline_s: Optional[float] = None,
+               initial_json_state: Optional[int] = None) -> Future:
+        """``initial_json_state`` resumes a constrained row MID-GRAMMAR:
+        the prompt's tail already contains generated JSON (a prefill-tier
+        replica's first token after a KV handoff, serving/cluster.py) and
+        decoding must continue from that grammar state, not from the
+        block start — exactly the state the chunked loop already threads
+        between its own chunks via GenResult.json_state."""
         row = _Row(prompt=list(prompt), temperature=temperature,
                    top_p=top_p, max_new=max(1, max_new_tokens),
                    session_id=session_id or self._own_session_id(),
                    constrain=constrain_json, action_enum=action_enum,
                    future=Future(), t_submit=time.monotonic(),
                    priority=int(coerce_priority(priority)),
-                   tenant=tenant, deadline_s=deadline_s)
+                   tenant=tenant, deadline_s=deadline_s,
+                   json_state=initial_json_state)
         row.owns_session = session_id is None
         # Per-row admission check: an over-window prompt must fail ONLY
         # its own future — inside a shared chunk the engine's

@@ -82,6 +82,29 @@ def pool_submeshes(
     return meshes
 
 
+def replica_device_groups(
+    n_replicas: int,
+    devices: Optional[Sequence] = None,
+) -> list[list]:
+    """Static partition of the slice into one contiguous device group
+    per REPLICA (ISSUE 10, serving/cluster.py): each group is then
+    sub-partitioned per pool member by :func:`pool_submeshes`, so a
+    2-replica 3-member pool on 8 chips is 2 × (4 chips → 3 sub-meshes).
+    Contiguity keeps every replica's intra-member tp collectives on
+    neighboring ICI links and replicas fully independent (no cross-
+    replica collective exists — the router is the only coupling). With
+    fewer devices than replicas, replicas share devices round-robin
+    (degenerates to everyone-on-one-chip at n=1 — the CPU test case)."""
+    devs = list(devices) if devices is not None else jax.devices()
+    per = max(1, len(devs) // n_replicas)
+    groups = []
+    for i in range(n_replicas):
+        lo = (i * per) % len(devs)
+        sub = devs[lo:lo + per] or devs[:per]
+        groups.append(sub)
+    return groups
+
+
 V5E_HBM_BYTES = 16 * 1024 ** 3          # 16 GiB per v5e chip (public spec)
 POOL_TAIL_RESERVE = 1.25 * 1024 ** 3    # activations + compiled programs +
                                         # grammar tables + fragmentation
@@ -111,7 +134,9 @@ def pool_sizing(pool: Sequence[str], n_devices: int = 8,
                 dtype_bytes: int = 2,
                 host_kv_mb: int = 0,
                 disk_kv_gb: float = 0.0,
-                page: int = 128) -> dict:
+                page: int = 128,
+                replicas: int = 1,
+                disaggregate: bool = False) -> dict:
     """Explicit HBM budget for a model pool on a v5e sub-mesh partition
     (VERDICT r4 item 4): per member — chips (= recommended_tp), bf16
     weight bytes per chip, the page-pool bytes left after the tail
@@ -128,6 +153,18 @@ def pool_sizing(pool: Sequence[str], n_devices: int = 8,
     tokens, so ``--plan`` output matches what the serving path actually
     holds. Host/disk copies are UNSHARDED (full KV bytes per token),
     hence the tp=1 byte rate in those rows.
+
+    With ``replicas`` > 1 (ISSUE 10, serving/cluster.py) the plan grows
+    a ``replica_tiers`` section matching the disaggregated topology:
+    the slice splits into ``replicas`` contiguous device groups
+    (``replica_device_groups``), each holding the WHOLE pool, and —
+    under ``disaggregate`` — the first ``max(1, replicas // 2)`` groups
+    form the prefill tier, the rest the decode tier (the cluster
+    builder's split). Per role: replica count, device count, HBM
+    budget, and resident-session capacity (sessions of one context
+    window each, summed over the role's replicas; prefill replicas hold
+    sessions only transiently — pages hibernate out at handoff — so
+    steady-state resident capacity is attributed to the decode tier).
 
     Returns {"members": [...], "chips_used", "fits", "hbm_per_chip"};
     ``fits`` is False when the pool needs more chips than the slice has
@@ -172,12 +209,66 @@ def pool_sizing(pool: Sequence[str], n_devices: int = 8,
             },
             "fits": m_fits,
         })
-    fits = fits and used <= n_devices
-    return {"members": members, "chips_used": used,
-            "n_devices": n_devices, "fits": fits,
-            "hbm_per_chip_gb": round(hbm_per_chip / 1024 ** 3, 2),
-            "tail_reserve_gb": round(POOL_TAIL_RESERVE / 1024 ** 3, 2),
-            "host_kv_mb_per_member": host_kv_mb}
+    fits = fits and used * max(1, replicas) <= n_devices
+    out = {"members": members, "chips_used": used * max(1, replicas),
+           "n_devices": n_devices, "fits": fits,
+           "hbm_per_chip_gb": round(hbm_per_chip / 1024 ** 3, 2),
+           "tail_reserve_gb": round(POOL_TAIL_RESERVE / 1024 ** 3, 2),
+           "host_kv_mb_per_member": host_kv_mb}
+    if replicas > 1:
+        out["replica_tiers"] = _replica_tiers(
+            list(pool), members, used, n_devices, replicas, disaggregate,
+            hbm_per_chip, host_kv_mb)
+    return out
+
+
+def _replica_tiers(pool: list, members: list, chips_per_replica: int,
+                   n_devices: int, replicas: int, disaggregate: bool,
+                   hbm_per_chip: int, host_kv_mb: int) -> dict:
+    """The per-role capacity block of a multi-replica --plan (ISSUE 10
+    satellite). Session capacity is denominated in resident sessions of
+    ONE full context window per member (the conservative agent-serving
+    unit); the host tier extends the decode tier's figure exactly as in
+    the single-replica tiers rows."""
+    n_prefill = max(1, replicas // 2) if disaggregate else 0
+    n_decode = replicas - n_prefill
+
+    def _tier(name: str, n_reps: int, resident: bool) -> dict:
+        from quoracle_tpu.models.config import get_model_config
+        sessions = 0
+        host_sessions = 0
+        for spec, m in zip(pool, members):
+            cfg = get_model_config(spec)
+            window = max(1, cfg.context_window)
+            sessions += m["resident_kv_tokens"] // window
+            if host_kv_mb:
+                kv_tok_host = cfg.kv_bytes_per_token(1, 2)
+                host_sessions += int(host_kv_mb * (1 << 20)
+                                     // kv_tok_host) // window
+        return {
+            "role": name,
+            "replicas": n_reps,
+            "devices": n_reps * chips_per_replica,
+            "hbm_budget_gb": round(
+                n_reps * chips_per_replica * hbm_per_chip / 1024 ** 3,
+                2),
+            # prefill replicas park nothing: sessions hibernate out at
+            # handoff, so steady-state residency is a decode-tier number
+            "resident_sessions": (sessions * n_reps if resident else 0),
+            "host_tier_sessions": (host_sessions * n_reps
+                                   if resident else 0),
+        }
+
+    tiers = {}
+    if disaggregate:
+        tiers["prefill"] = _tier("prefill", n_prefill, resident=False)
+        tiers["decode"] = _tier("decode", n_decode, resident=True)
+    else:
+        tiers["unified"] = _tier("unified", replicas, resident=True)
+    tiers["total_devices_needed"] = replicas * chips_per_replica
+    tiers["fits"] = replicas * chips_per_replica <= n_devices
+    tiers["disaggregate"] = disaggregate
+    return tiers
 
 
 def _largest_tp_divisor(n_kv_heads: int, tp_size: int) -> int:
@@ -244,3 +335,45 @@ def shard_params(params: dict, mesh: Mesh, cfg: ModelConfig) -> dict:
         lambda p, s: jax.device_put(p, NamedSharding(mesh, s)), params, specs,
         is_leaf=lambda x: isinstance(x, P),
     )
+
+
+def _main(argv=None) -> int:
+    """``python -m quoracle_tpu.parallel.mesh --plan``: print the pool's
+    HBM/capacity plan as JSON — including the replica-tier section when
+    ``--replicas`` > 1, so capacity planning matches the disaggregated
+    topology (ISSUE 10 satellite)."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(prog="quoracle_tpu.parallel.mesh")
+    ap.add_argument("--plan", action="store_true",
+                    help="print the pool_sizing plan as JSON")
+    ap.add_argument("--pool", default=None,
+                    help="comma-separated model specs (default: the "
+                         "bench pool)")
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--host-kv-mb", dest="host_kv_mb", type=int,
+                    default=0)
+    ap.add_argument("--disk-kv-gb", dest="disk_kv_gb", type=float,
+                    default=0.0)
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="data-parallel replicas of the whole pool "
+                         "(serving/cluster.py)")
+    ap.add_argument("--disaggregate", action="store_true",
+                    help="split replicas into prefill/decode tiers")
+    args = ap.parse_args(argv)
+    if args.pool:
+        pool = args.pool.split(",")
+    else:
+        from quoracle_tpu.models.config import BENCH_POOL
+        pool = list(BENCH_POOL)
+    plan = pool_sizing(pool, args.devices, host_kv_mb=args.host_kv_mb,
+                       disk_kv_gb=args.disk_kv_gb,
+                       replicas=args.replicas,
+                       disaggregate=args.disaggregate)
+    print(json.dumps(plan, indent=2))
+    return 0 if plan["fits"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

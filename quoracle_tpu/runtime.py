@@ -231,6 +231,16 @@ class RuntimeConfig:
     # entries prune when a write overflows it, so a long-running fleet
     # cannot fill the disk. Matches pool_sizing's disk_kv_gb knob.
     disk_kv_gb: float = 8.0
+    # Disaggregated serving plane (ISSUE 10, serving/cluster.py):
+    # ``replicas`` > 1 builds a ClusterPlane of N full per-member engine
+    # sets, each on its own contiguous slice of the local devices
+    # (parallel/mesh.replica_device_groups → pool_submeshes per
+    # replica). ``disaggregate`` role-tags them into prefill/decode
+    # tiers with KV handoff between them; off, replicas are uniform
+    # data-parallel copies routed by session affinity + load. Scale
+    # from here on means raising --replicas, not re-architecting.
+    replicas: int = 1
+    disaggregate: bool = False
 
 
 class Runtime:
@@ -326,13 +336,15 @@ class Runtime:
         if config.backend != "tpu":
             if (config.checkpoints or config.tp or config.draft_map
                     or config.coordinator_address or config.num_processes
-                    or config.process_id is not None):
+                    or config.process_id is not None
+                    or config.replicas > 1 or config.disaggregate):
                 # Silent fallback to mock would make the user believe their
                 # checkpoint (or cluster, or speculative draft) is serving
                 # while scripted responses come back.
                 raise ValueError(
                     "--checkpoint/--tp/--draft/--coordinator/"
-                    "--num-processes/--process-id require --backend tpu "
+                    "--num-processes/--process-id/--replicas/"
+                    "--disaggregate require --backend tpu "
                     f"(backend is {config.backend!r})")
             return MockBackend()
         from quoracle_tpu.utils.compile_cache import (
@@ -388,6 +400,34 @@ class Runtime:
         if isinstance(qos, dict):
             from quoracle_tpu.serving.qos import QoSConfig
             qos = QoSConfig(**qos)
+        if config.replicas > 1 or config.disaggregate:
+            # Disaggregated / multi-replica plane (ISSUE 10): partition
+            # the local devices per replica, then per pool member inside
+            # each replica — replicas never share a collective, so the
+            # host-local serving rule above holds per replica unchanged.
+            from quoracle_tpu.parallel.mesh import (
+                pool_submeshes, replica_device_groups,
+            )
+            from quoracle_tpu.serving.cluster import ClusterPlane
+            n_rep = max(config.replicas,
+                        2 if config.disaggregate else 1)
+            submeshes_by_replica = None
+            if len(jax.local_devices()) > 1:
+                submeshes_by_replica = [
+                    pool_submeshes(len(pool), tp=config.tp, devices=grp)
+                    for grp in replica_device_groups(
+                        n_rep, jax.local_devices())]
+            return ClusterPlane.build(
+                pool, replicas=n_rep,
+                disaggregate=config.disaggregate, seed=config.seed,
+                submeshes_by_replica=submeshes_by_replica,
+                qos=qos, draft_map=draft_map or None,
+                draft_k=config.draft_k,
+                continuous=config.continuous or config.disaggregate,
+                host_kv_mb=config.host_kv_mb,
+                disk_kv_dir=config.disk_kv_dir,
+                disk_kv_gb=config.disk_kv_gb,
+                embed_model=config.embed_model)
         return TPUBackend(pool, seed=config.seed, draft_k=config.draft_k,
                           embed_model=config.embed_model,
                           submeshes=submeshes,
@@ -425,9 +465,12 @@ class Runtime:
     def default_pool(self) -> list[str]:
         """The pool used when a task names neither pool nor profile: the
         backend's POOL members — engines can also hold speculative draft
-        models, which never serve directly."""
-        if isinstance(self.backend, TPUBackend):
-            return list(self.backend.pool)
+        models, which never serve directly. ClusterPlane (ISSUE 10)
+        exposes the same ``pool`` surface, so a disaggregated runtime
+        needs no special case."""
+        pool = getattr(self.backend, "pool", None)
+        if pool:
+            return list(pool)
         return list(MockBackend.DEFAULT_POOL)
 
     def list_groves(self) -> list:

@@ -1,7 +1,12 @@
-"""Serving-plane subsystems: QoS (ISSUE 4) and tiered KV (ISSUE 7).
+"""Serving-plane subsystems: QoS (ISSUE 4), tiered KV (ISSUE 7), and
+the disaggregated cluster plane (ISSUE 10).
 
-Four modules, one dependency direction (serving → infra, never →
-models — the scheduler and SessionStore import *us*):
+Seven modules. The QoS/tier layers keep the original dependency
+direction (serving → infra, never → models — the scheduler and
+SessionStore import *them*); the CLUSTER layer sits ABOVE the model
+runtime by design (cluster → models.runtime → scheduler → qos/kvtier):
+it composes whole TPUBackends into replicas, so it is the one serving
+module allowed to import models:
 
 * :mod:`quoracle_tpu.serving.qos` — priority classes, per-tenant token
   buckets, and the deficit-round-robin weighted-fair queue that replaces
@@ -17,11 +22,22 @@ models — the scheduler and SessionStore import *us*):
 * :mod:`quoracle_tpu.serving.kvtier` — the KV tier ladder (HBM → pinned
   host RAM → disk): session hibernation with bit-exact restore, and the
   checksummed disk prefix store that warm-starts a restarted process.
+* :mod:`quoracle_tpu.serving.cluster` — the disaggregated multi-replica
+  plane: role-tagged prefill/decode/unified replica tiers behind the
+  ModelBackend seam, temp-0 bit-identical to a monolithic Runtime.
+* :mod:`quoracle_tpu.serving.router` — the QoS-aware cluster front
+  door: session affinity, signal-driven placement, aggregate shedding.
+* :mod:`quoracle_tpu.serving.handoff` — prefill→decode KV handoff:
+  PR 7's hibernate/restore split across two engines, signature-checked.
+
+The cluster trio is imported lazily (see bottom) — importing serving.qos
+from the scheduler must not drag jax-heavy models code in transitively.
 """
 
 from quoracle_tpu.serving.admission import (       # noqa: F401
     AdmissionConfig, AdmissionController, AdmissionError,
     DeadlineExceededError, OverloadedError, RateLimitedError,
+    SignalSnapshot,
 )
 from quoracle_tpu.serving.qos import (             # noqa: F401
     AdmissionPolicy, FifoPolicy, Priority, QoSConfig, TenantPolicy,
@@ -31,3 +47,20 @@ from quoracle_tpu.serving.kvtier import (          # noqa: F401
     DiskPrefixStore, HostPageStore, TierManager,
 )
 from quoracle_tpu.serving.slo import SLOTracker    # noqa: F401
+
+
+def __getattr__(name: str):
+    """Lazy cluster exports: serving.cluster imports models.runtime
+    (jax-heavy), and eager re-export here would turn every
+    ``from quoracle_tpu.serving.qos import …`` in the scheduler into a
+    transitive models import — a cycle AND a startup cost."""
+    if name in ("ClusterPlane", "Replica", "ReplicaFailedError"):
+        from quoracle_tpu.serving import cluster
+        return getattr(cluster, name)
+    if name == "ClusterRouter":
+        from quoracle_tpu.serving.router import ClusterRouter
+        return ClusterRouter
+    if name in ("KVHandoff", "HandoffEnvelope", "HandoffError"):
+        from quoracle_tpu.serving import handoff
+        return getattr(handoff, name)
+    raise AttributeError(name)

@@ -94,6 +94,40 @@ class DeadlineExceededError(AdmissionError):
                          priority=priority)
 
 
+@dataclasses.dataclass(frozen=True)
+class SignalSnapshot:
+    """One timestamped, structured view of the controller's sampled
+    overload signals (ISSUE 10 satellite): EXACTLY the numbers the shed
+    ladder reads — ``admit_wait_p95_ms`` and ``hbm_headroom`` are the
+    same cached fields ``admit()`` consults, ``queue_depth`` the same
+    live max over registered depth sources — so the cluster router
+    places traffic on the very signals admission sheds on; there is one
+    source of truth, not a parallel estimate. ``ts`` is the monotonic
+    time the snapshot was BUILT; ``refreshed_ts`` when the p95/HBM
+    window last refreshed (queue depth is always live)."""
+
+    ts: float
+    refreshed_ts: float
+    queue_depth: int
+    admit_wait_p95_ms: Optional[float]
+    hbm_headroom: Optional[float]
+    admitted: int
+    shed: int
+
+    def age_s(self, now: Optional[float] = None) -> float:
+        """Seconds since the cached signal window refreshed — the
+        router's staleness guard input."""
+        now = time.monotonic() if now is None else now
+        return max(0.0, now - self.refreshed_ts)
+
+    def stale(self, max_age_s: float,
+              now: Optional[float] = None) -> bool:
+        return self.age_s(now) > max_age_s
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
 @dataclasses.dataclass
 class AdmissionConfig:
     """Shed thresholds. ``max_queue_depth`` is the soft bound: past it
@@ -208,6 +242,31 @@ class AdmissionController:
                 head = None
             with self._sig_lock:
                 self.hbm_headroom = head
+
+    def signals(self, now: Optional[float] = None,
+                max_age_s: Optional[float] = None) -> SignalSnapshot:
+        """The sampled signal state as a structured, timestamped
+        :class:`SignalSnapshot` (ISSUE 10 satellite). Refreshes the
+        cached window first (rate-limited exactly like ``admit()``'s
+        refresh, so calling this costs nothing extra in steady state);
+        with ``max_age_s`` set, a window older than that forces a
+        refresh even inside ``refresh_s`` — the router's staleness
+        guard."""
+        now0 = time.monotonic() if now is None else now
+        if max_age_s is not None:
+            with self._sig_lock:
+                if now0 - self._t_refresh > max_age_s:
+                    # expire the window so the refresh below re-samples
+                    self._t_refresh = 0.0
+        self.refresh_signals(now0)
+        depth = self.queue_depth()
+        with self._sig_lock:
+            return SignalSnapshot(
+                ts=now0, refreshed_ts=self._t_refresh,
+                queue_depth=depth,
+                admit_wait_p95_ms=self.admit_wait_p95_ms,
+                hbm_headroom=self.hbm_headroom,
+                admitted=self.admitted, shed=self.shed)
 
     def queue_depth(self) -> int:
         with self._lock:

@@ -540,6 +540,39 @@ class TierManager:
                       ms=round((time.monotonic() - t0) * 1000, 2))
         return True
 
+    def export_session(self, key: str) -> Optional[_HostSession]:
+        """Page-export seam for cross-replica KV handoff (ISSUE 10,
+        serving/handoff.py): hibernate the session OUT of this engine —
+        exactly the eviction ladder's demote (one device_get, refcounted
+        release, adopters/radix readers untouched) — and hand the host
+        copy to the caller instead of parking it in this tier's store.
+        A session already hibernated is handed over directly. Returns
+        None when the session exists nowhere (caller re-prefills on the
+        destination — always correct). Assumes the engine's paged lock
+        is held, like every pool-touching method here."""
+        st = self.store
+        with st.lock:
+            sess = st._sessions.get(key)
+            if sess is None:
+                return self.host.pop_session(key)
+            if not self.demote_session(key, sess):
+                return None
+            del st._sessions[key]
+            st._release(sess.pages)
+            return self.host.pop_session(key)
+
+    def adopt_session(self, key: str, entry: _HostSession) -> None:
+        """Page-adopt seam (ISSUE 10): accept a handed-off host-side
+        session copy into THIS tier's host store, after which the normal
+        restore machinery (restore_session via prefetch or the engine's
+        session lookup) pages it in — "hibernate on the prefill replica,
+        restore on the decode replica". Replaces any stale copy under
+        the same key; the live store is untouched (the caller drops or
+        never had a resident session under this key)."""
+        with self.store.lock:
+            self.host.put_session(key, entry,
+                                  spill_fn=self._spill_prefix_entry)
+
     def has_session(self, key: str) -> bool:
         return key in self.host.sessions
 

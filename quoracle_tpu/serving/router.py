@@ -1,0 +1,254 @@
+"""QoS-aware cluster router (ISSUE 10 tentpole, part c).
+
+The cluster front door: every submission entering a multi-replica
+serving plane (serving/cluster.py) is PLACED here before any replica
+lock is touched. Placement inputs, in priority order:
+
+  1. **Session affinity** — a decode row whose session's pages are
+     resident on a replica goes back to that replica; moving it would
+     pay a handoff (or worse, a re-prefill) for nothing. Affinity is
+     recorded when a handoff lands and cleared when the session drops.
+     The DiskPrefixStore signature dir is the complementary SHARED
+     medium: replicas over the same ``--disk-kv-dir`` lazily adopt each
+     other's persisted prefix blocks, so affinity is a latency
+     optimization, never a correctness requirement.
+  2. **Role** — prefill work goes to prefill-tier replicas, decode work
+     to decode-tier replicas; "unified" replicas accept both (the
+     non-disaggregated data-parallel mode).
+  3. **Live load signals** — the SAME numbers each replica's admission
+     controller sheds on (:class:`~quoracle_tpu.serving.admission.
+     SignalSnapshot`: queue depth, admit-wait p95, effective HBM
+     headroom with demotable bytes counted): least-loaded wins, with a
+     staleness guard that forces a signal refresh rather than steering
+     on stale load data.
+  4. **Tenant / priority** — admission itself stays per-replica (each
+     replica's controller enforces rates and shed ladders exactly as in
+     the single-Runtime world); the router's ``admit`` aggregates: a
+     submission is shed at the front door only when EVERY eligible
+     replica sheds it, and the propagated 429 carries the MAX
+     retry-after across replicas — the earliest moment a retry could
+     possibly succeed anywhere.
+
+Liveness: a replica that fails a serving call is marked dead
+(``mark_failed``) and drops out of placement; its in-flight rows are
+re-placed through the retained handoff envelopes (cluster.py).
+
+Locking: the router lock ("router", rank 6) sits ABOVE every replica-
+internal lock (batcher 10, admission 12, …) in the declared hierarchy —
+placement reads per-replica signals (signal lock 14) and that is the
+only downward edge it ever takes.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from quoracle_tpu.analysis.lockdep import named_lock
+from quoracle_tpu.infra.flightrec import FLIGHT
+from quoracle_tpu.infra.telemetry import (
+    ROUTER_PLACEMENTS_TOTAL, ROUTER_SHED_TOTAL, ROUTER_SIGNAL_AGE_MS,
+)
+from quoracle_tpu.serving.admission import AdmissionError, OverloadedError
+from quoracle_tpu.serving.qos import class_name, coerce_priority
+
+# A signal window older than this forces a refresh at placement time —
+# matches the admission controller's own refresh cadence (refresh_s=1.0)
+# with headroom for the scrape jitter.
+DEFAULT_MAX_SIGNAL_AGE_S = 5.0
+
+
+class ClusterRouter:
+    """Placement + affinity + liveness for one ClusterPlane. Replicas
+    are registered once at build; all methods are thread-safe."""
+
+    def __init__(self, max_signal_age_s: float = DEFAULT_MAX_SIGNAL_AGE_S):
+        self._lock = named_lock("router")
+        self._replicas: dict[str, Any] = {}      # id -> Replica
+        self._affinity: dict[str, str] = {}      # session_id -> replica id
+        self.max_signal_age_s = float(max_signal_age_s)
+        self.placements = 0
+        self.shed = 0
+
+    # -- topology --------------------------------------------------------
+
+    def register(self, replica) -> None:
+        with self._lock:
+            self._replicas[replica.replica_id] = replica
+
+    def replicas(self, role: Optional[str] = None,
+                 alive_only: bool = True) -> list:
+        """Replicas eligible for ``role`` ("prefill" / "decode" / None =
+        all): exact-role matches first, then "unified" (which serves
+        both), dead replicas excluded."""
+        with self._lock:
+            reps = list(self._replicas.values())
+        out = [r for r in reps
+               if (not alive_only or r.alive)
+               and (role is None or r.role == role
+                    or r.role == "unified")]
+        out.sort(key=lambda r: (r.role == "unified", r.replica_id))
+        return out
+
+    def mark_failed(self, replica_id: str, error: str = "") -> None:
+        """A serving call against this replica raised: drop it from
+        placement. Recorded loudly — a silently shrinking cluster is an
+        incident, not a detail."""
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is None or not rep.alive:
+                return
+            rep.alive = False
+            # purge affinities pointing at the corpse: their sessions
+            # are gone; the next round re-places (handoff envelopes
+            # cover rows mid-flight)
+            stale = [sid for sid, rid in self._affinity.items()
+                     if rid == replica_id]
+            for sid in stale:
+                del self._affinity[sid]
+        FLIGHT.record("cluster_replica_dead", replica=replica_id,
+                      error=error[:200], dropped_affinities=len(stale))
+
+    def alive_count(self, role: Optional[str] = None) -> int:
+        return len(self.replicas(role))
+
+    # -- affinity --------------------------------------------------------
+
+    def affinity_of(self, session_id: Optional[str]):
+        """The live replica holding this session's pages, or None."""
+        if not session_id:
+            return None
+        with self._lock:
+            rid = self._affinity.get(session_id)
+            rep = self._replicas.get(rid) if rid else None
+        return rep if rep is not None and rep.alive else None
+
+    def set_affinity(self, session_id: str, replica_id: str) -> None:
+        with self._lock:
+            self._affinity[session_id] = replica_id
+
+    def drop_affinity(self, session_id: str) -> None:
+        with self._lock:
+            self._affinity.pop(session_id, None)
+
+    # -- placement -------------------------------------------------------
+
+    def _load_score(self, rep) -> tuple:
+        """Lower is better. Ranks by the admission controller's own
+        sampled signals; a replica without QoS wiring scores by queue
+        depth alone (scheduler stats)."""
+        now = time.monotonic()
+        ctrl = getattr(rep.backend, "qos_controller", None)
+        if ctrl is not None:
+            snap = ctrl.signals(max_age_s=self.max_signal_age_s)
+            ROUTER_SIGNAL_AGE_MS.observe(snap.age_s(now) * 1000,
+                                         replica=rep.replica_id)
+            head = snap.hbm_headroom
+            return (snap.queue_depth,
+                    snap.admit_wait_p95_ms or 0.0,
+                    -(head if head is not None else 1.0))
+        depth = 0
+        try:
+            for st in rep.backend.scheduler_stats().values():
+                depth += int(st.get("queued", 0)) + int(st.get("live", 0))
+        except Exception:                 # noqa: BLE001 — best-effort
+            pass
+        return (depth, 0.0, -1.0)
+
+    def place(self, role: str, session_id: Optional[str] = None,
+              exclude: tuple = ()):
+        """Pick the replica a submission runs on. Affinity first (decode
+        rows stick to the replica holding their pages), then the
+        least-loaded eligible replica by live signals. Returns a
+        Replica; raises :class:`OverloadedError` when no live replica is
+        eligible (every caller maps that to the structured 429)."""
+        rep = self.affinity_of(session_id)
+        if rep is not None and rep.replica_id not in exclude \
+                and (role is None or rep.role in (role, "unified")):
+            self._note_place(rep, role, "affinity")
+            return rep
+        cands = [r for r in self.replicas(role)
+                 if r.replica_id not in exclude]
+        if not cands:
+            raise OverloadedError(
+                f"no live {role or 'serving'} replica "
+                f"(cluster degraded)", retry_after_ms=5000)
+        if len(cands) == 1:
+            self._note_place(cands[0], role, "only")
+            return cands[0]
+        best = min(cands, key=self._load_score)
+        self._note_place(best, role,
+                         "failover" if exclude else "least_loaded")
+        return best
+
+    def _note_place(self, rep, role: str, reason: str) -> None:
+        with self._lock:
+            self.placements += 1
+        ROUTER_PLACEMENTS_TOTAL.inc(role=role or "any", reason=reason,
+                                    replica=rep.replica_id)
+
+    # -- front-door admission --------------------------------------------
+
+    def admit(self, tenant: str = "default", priority: Any = None,
+              deadline_s: Optional[float] = None, role: str = "decode"):
+        """Cluster-level admission (the web edge calls this exactly like
+        a single backend's controller): try each eligible replica's
+        admission controller in load order; the FIRST that admits wins
+        and its (possibly tenant-clamped) priority is returned. Only
+        when every eligible replica sheds does the front door shed —
+        with the MAX retry-after across their individual rejections, and
+        the most urgent rejection's class attribution."""
+        cands = self.replicas(role)
+        controllers = [
+            (r, getattr(r.backend, "qos_controller", None))
+            for r in cands]
+        controllers = [(r, c) for r, c in controllers if c is not None]
+        if not controllers:
+            if not cands:
+                raise OverloadedError("no live replica", retry_after_ms=5000)
+            return coerce_priority(priority)     # QoS off: admit all
+        errors: list[AdmissionError] = []
+        for rep, ctrl in sorted(
+                ((r, c) for r, c in controllers),
+                key=lambda rc: self._load_score(rc[0])):
+            try:
+                return ctrl.admit(tenant=tenant, priority=priority,
+                                  deadline_s=deadline_s)
+            except AdmissionError as e:
+                errors.append(e)
+        cls = coerce_priority(priority)
+        retry = max(e.retry_after_ms for e in errors)
+        with self._lock:
+            self.shed += 1
+        ROUTER_SHED_TOTAL.inc(cls=class_name(cls), tenant=tenant)
+        FLIGHT.record("router_all_shed", tenant=tenant,
+                      cls=class_name(cls), replicas=len(errors),
+                      retry_after_ms=retry)
+        raise OverloadedError(
+            f"all {len(errors)} {role} replicas shed "
+            f"({'; '.join(sorted({e.reason for e in errors}))})",
+            retry_after_ms=retry, tenant=tenant, priority=cls)
+
+    # -- reads -----------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            reps = list(self._replicas.values())
+            affinity = len(self._affinity)
+            placements, shed = self.placements, self.shed
+        out = {
+            "replicas": {},
+            "affinity_sessions": affinity,
+            "placements": placements,
+            "shed": shed,
+            "max_signal_age_s": self.max_signal_age_s,
+        }
+        for rep in reps:
+            ctrl = getattr(rep.backend, "qos_controller", None)
+            out["replicas"][rep.replica_id] = {
+                "role": rep.role,
+                "alive": rep.alive,
+                "signals": (ctrl.signals().as_dict()
+                            if ctrl is not None else None),
+            }
+        return out

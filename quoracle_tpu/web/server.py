@@ -27,6 +27,11 @@ Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
                             occupancy (HBM/host/disk), demote/restore/
                             spill counters, restore-latency quantiles
                             (serving/kvtier.py)
+  GET  /api/cluster         disaggregated serving plane (ISSUE 10):
+                            replica topology + roles + liveness, router
+                            placement/affinity/shed state with the
+                            per-replica admission signals, KV-handoff
+                            counters (serving/cluster.py)
   GET  /api/models          consensus-quality scorecards (ISSUE 5): rolling
                             per-member agreement/dissent/failure-by-kind/
                             recovery rates, proposal latency, drift state
@@ -198,6 +203,9 @@ class DashboardServer:
             # drift alerts, same bearer gating + token redaction as the
             # trace ring (both ride the generic gated-GET path)
             "consensus": h.replay_consensus(),
+            # cluster incidents (ISSUE 10): replica death, handoff
+            # rejects, router all-shed — TOPIC_CLUSTER ring
+            "cluster": h.replay_cluster(),
         }
         if agent_id:
             payload["logs"] = h.replay_logs(agent_id)
@@ -414,6 +422,25 @@ class DashboardServer:
         }
         return payload
 
+    def cluster_payload(self) -> dict:
+        """GET /api/cluster: the disaggregated-plane panel (ISSUE 10) —
+        replica topology, router placement/affinity state (with each
+        replica's live admission-signal snapshot), and the handoff
+        counters. ``enabled`` False on single-backend runtimes."""
+        from quoracle_tpu.infra.telemetry import (
+            CLUSTER_HANDOFF_MS, CLUSTER_HANDOFFS_TOTAL,
+            ROUTER_PLACEMENTS_TOTAL,
+        )
+        backend = self.runtime.backend
+        stats = getattr(backend, "cluster_stats", None)
+        payload = stats() if stats is not None else {"enabled": False}
+        payload["counters"] = {
+            "handoffs": CLUSTER_HANDOFFS_TOTAL._snapshot(),
+            "handoff_ms": CLUSTER_HANDOFF_MS._snapshot(),
+            "placements": ROUTER_PLACEMENTS_TOTAL._snapshot(),
+        }
+        return payload
+
     def qos_payload(self) -> dict:
         """GET /api/qos: the serving-QoS panel (ISSUE 4) — admission
         controller state (signals, thresholds, tenant buckets), the
@@ -601,6 +628,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.qos_payload())
             elif parsed.path == "/api/kv":
                 self._send_json(d.kv_payload())
+            elif parsed.path == "/api/cluster":
+                self._send_json(d.cluster_payload())
             elif parsed.path == "/api/models":
                 self._send_json(d.models_payload())
             elif parsed.path == "/api/consensus":
