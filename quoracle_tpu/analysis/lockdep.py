@@ -82,6 +82,12 @@ HIERARCHY: tuple = (
     ("cache.lru",      42, False),  # utils/cache.TTLCache
     ("engine.rng",     43, False),  # engine RNG split
     ("native.build",   45, True),   # serialize native toolchain builds
+    # -- chaos plane (ISSUE 11) -----------------------------------------
+    ("chaos.plan",     48, False),  # ChaosPlane armed-plan + fire ledger:
+                                    # fire() is called under store/tier
+                                    # locks (30/35) and records to
+                                    # flight/metrics (58/60), so it sits
+                                    # strictly between them
     # -- observability plane (leaves) -----------------------------------
     ("quality",        50, False),  # consensus scorecards/drift
     ("quality.sinks",  51, False),  # quality sink list

@@ -1,0 +1,9 @@
+"""Chaos plane (ISSUE 11): deterministic fault injection
+(chaos/faults.py), machine-checked recovery invariants
+(chaos/invariants.py), and the scenario harness that drives the full
+disaggregated stack through scripted storms (chaos/scenarios.py)."""
+
+from quoracle_tpu.chaos.faults import (  # noqa: F401
+    CHAOS, ChaosPlane, Fault, FaultPlan, FaultRule, InjectedFault,
+    INJECTION_POINTS,
+)

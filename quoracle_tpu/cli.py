@@ -80,7 +80,8 @@ async def cmd_run(args: argparse.Namespace) -> int:
                                disk_kv_dir=args.disk_kv_dir,
                                disk_kv_gb=args.disk_kv_gb,
                                replicas=args.replicas,
-                               disaggregate=args.disaggregate))
+                               disaggregate=args.disaggregate,
+                               chaos_plan=args.chaos_plan))
     _attach_printer(rt)
     if pool is None and args.profile is None:
         pool = rt.default_pool()
@@ -114,7 +115,8 @@ async def cmd_resume(args: argparse.Namespace) -> int:
                                disk_kv_dir=args.disk_kv_dir,
                                disk_kv_gb=args.disk_kv_gb,
                                replicas=args.replicas,
-                               disaggregate=args.disaggregate))
+                               disaggregate=args.disaggregate,
+                               chaos_plan=args.chaos_plan))
     _attach_printer(rt)
     result = await rt.boot()
     print(json.dumps(result), flush=True)
@@ -142,7 +144,8 @@ async def cmd_serve(args: argparse.Namespace) -> int:
         continuous=args.continuous, qos=args.qos or None,
         host_kv_mb=args.host_kv_mb, disk_kv_dir=args.disk_kv_dir,
         disk_kv_gb=args.disk_kv_gb,
-        replicas=args.replicas, disaggregate=args.disaggregate))
+        replicas=args.replicas, disaggregate=args.disaggregate,
+        chaos_plan=args.chaos_plan))
     # Validate host/token BEFORE boot so a refused bind exits with a clean
     # message instead of a traceback over a half-started runtime.
     try:
@@ -249,6 +252,13 @@ def build_parser() -> argparse.ArgumentParser:
                              "speculation) tiers with KV handoff "
                              "between them; implies --replicas 2 when "
                              "unset")
+        sp.add_argument("--chaos-plan", dest="chaos_plan", default=None,
+                        metavar="PLAN.json",
+                        help="chaos plane (quoracle_tpu/chaos): arm this "
+                             "JSON fault plan ({'seed': N, 'faults': "
+                             "[{'point', 'kind', ...}]}) at boot — "
+                             "deterministic game-day fault injection "
+                             "against a canary; see ARCHITECTURE.md §14")
         sp.add_argument("--qos", action="store_true",
                         help="serving QoS (ISSUE 4): weighted-fair "
                              "admission + overload shedding + SLO "

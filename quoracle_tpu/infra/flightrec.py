@@ -12,6 +12,11 @@ cost (one deque append per event) and serializes them on:
   * a stall-watchdog trip (runtime.StallWatchdog → ``dump(reason=...)``),
   * an unhandled crash — ``sys.excepthook`` is chained, with an
     ``atexit`` backstop for crashes the hook saw but could not persist,
+  * a termination signal (ISSUE 11 satellite): SIGTERM / SIGQUIT are
+    chained in ``install()`` so a chaos kill, an operator drain, or a
+    supervisor timeout leaves a post-mortem artifact before the process
+    honors the signal — the chained previous disposition (SIG_DFL
+    included) still runs, so delivery semantics are unchanged,
   * demand: ``POST /api/flightrec/dump`` (web/server.py).
 
 Dumps land in ``QUORACLE_FLIGHTREC_DIR`` (default: a per-uid directory
@@ -142,8 +147,9 @@ class FlightRecorder:
 
     def install(self) -> None:
         """Idempotently chain ``sys.excepthook`` (+ an ``atexit``
-        backstop) and register the recorder as a tracer sink so finished
-        spans enter the ring. Called by Runtime.__init__; never
+        backstop), chain SIGTERM/SIGQUIT dump handlers (ISSUE 11
+        satellite), and register the recorder as a tracer sink so
+        finished spans enter the ring. Called by Runtime.__init__; never
         uninstalled — crash capture is process-scoped by nature."""
         with self._lock:
             if self._installed:
@@ -151,6 +157,7 @@ class FlightRecorder:
             self._installed = True
         from quoracle_tpu.infra.telemetry import TRACER
         TRACER.add_sink(self.record_span)
+        self._install_signal_hooks()
 
         prev_hook = sys.excepthook
 
@@ -179,6 +186,50 @@ class FlightRecorder:
 
         atexit.register(backstop)
 
+    def _install_signal_hooks(self) -> None:
+        """Chain SIGTERM/SIGQUIT so a chaos kill or an operator drain
+        leaves a post-mortem dump (retention-pruned like every other
+        dump) BEFORE the process honors the signal. The previous
+        disposition always runs afterwards — a chained Python handler is
+        called directly; SIG_DFL/SIG_IGN are restored and the signal
+        re-raised, so delivery semantics (exit status included) are
+        exactly what they were without the hook. Signal handlers can
+        only be set from the main thread; a Runtime constructed on a
+        worker thread simply skips them (the excepthook/atexit capture
+        above still applies)."""
+        import signal
+
+        if threading.current_thread() is not threading.main_thread():
+            return
+        for signum in (signal.SIGTERM, signal.SIGQUIT):
+            try:
+                prev = signal.getsignal(signum)
+            except (ValueError, OSError):   # unsupported platform
+                continue
+
+            def handler(got_signum, frame, _prev=prev):
+                name = signal.Signals(got_signum).name
+                self.record("signal_dump", signal=name)
+                try:
+                    self.dump(reason=f"signal-{name}")
+                except Exception:         # noqa: BLE001 — dying anyway
+                    pass
+                if callable(_prev):
+                    _prev(got_signum, frame)
+                else:
+                    # SIG_DFL / SIG_IGN: restore and re-deliver so the
+                    # default action (termination, exit status −signum)
+                    # happens exactly as without the hook
+                    signal.signal(got_signum,
+                                  _prev if _prev is not None
+                                  else signal.SIG_DFL)
+                    os.kill(os.getpid(), got_signum)
+
+            try:
+                signal.signal(signum, handler)
+            except (ValueError, OSError):
+                pass
+
 
 FLIGHT = FlightRecorder()
 
@@ -195,6 +246,8 @@ FLIGHT = FlightRecorder()
 FLIGHT_EVENTS: dict = {
     # process / crash capture
     "crash": "unhandled exception captured by the chained sys.excepthook",
+    "signal_dump": "SIGTERM/SIGQUIT received — post-mortem dump written "
+                   "before the signal's previous disposition runs",
     "span": "finished tracer span (Tracer sink → ring)",
     "watchdog_stall": "stall watchdog tripped on a frozen progress source",
     "resource_sample": "periodic device-memory / member-capacity sample",
@@ -234,6 +287,17 @@ FLIGHT_EVENTS: dict = {
                        "the cluster front door",
     # consensus quality
     "model_health_drift": "EWMA drift detector tripped for a member",
+    # chaos plane (ISSUE 11, chaos/faults.py + chaos/scenarios.py)
+    "chaos_armed": "a FaultPlan was armed or disarmed on the chaos "
+                   "plane (armed=true|false, seed, rules)",
+    "chaos_fault": "the chaos plane fired one fault at an injection "
+                   "point (point, fault_kind, key, n) — the sorted "
+                   "(point, key, n, fault_kind) tuples ARE the "
+                   "deterministic fault schedule a seed reproduces",
+    "chaos_scenario_start": "a chaos scenario began driving traffic "
+                            "(scenario, seed, phase=clean|storm)",
+    "chaos_scenario_end": "a chaos scenario finished; carries the "
+                          "per-invariant pass/fail verdicts",
     # lock discipline (analysis/lockdep.py)
     "lockdep_inversion": "runtime lock-order sanitizer saw an "
                          "acquisition against the declared hierarchy",

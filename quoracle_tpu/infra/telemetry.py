@@ -773,6 +773,29 @@ ROUTER_SIGNAL_AGE_MS = METRICS.histogram(
     "age of the per-replica admission signal snapshot at placement time "
     "(ms) — large values mean the router is steering on stale load data")
 
+# -- chaos plane (ISSUE 11) --------------------------------------------------
+# Deterministic fault injection (chaos/faults.py) + the scenario harness
+# (chaos/scenarios.py): every fired fault and every machine-checked
+# invariant verdict is a first-class series, so a game-day run is
+# attributable from /metrics alone.
+CHAOS_ARMED = METRICS.gauge(
+    "quoracle_chaos_armed",
+    "1 while a FaultPlan is armed on the process-wide chaos plane — "
+    "production should alert on this outside announced game-day windows")
+CHAOS_FAULTS_TOTAL = METRICS.counter(
+    "quoracle_chaos_faults_total",
+    "faults fired by the chaos plane, by injection point and kind "
+    "(crash | slow | garbage | drop | delay | corrupt | poison | fail | "
+    "demote)")
+CHAOS_SCENARIOS_TOTAL = METRICS.counter(
+    "quoracle_chaos_scenarios_total",
+    "chaos scenario runs by scenario name and result (pass | fail)")
+CHAOS_INVARIANT_FAILURES = METRICS.counter(
+    "quoracle_chaos_invariant_failures_total",
+    "invariant checks that FAILED during a chaos scenario, by scenario "
+    "and invariant name — any nonzero value is a recovery-path bug "
+    "report, alert like a crash")
+
 # -- consensus quality (ISSUE 5) ---------------------------------------------
 # Decision-quality instruments (consensus/quality.py): per-decide
 # contestedness and the per-member scorecard counters. Registered at

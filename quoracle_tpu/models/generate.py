@@ -755,6 +755,14 @@ class CompileRegistry:
         """Record one dispatch; returns True on a MISS (first sight of
         this shape bucket — the call paid the compile)."""
         from quoracle_tpu.infra.telemetry import COMPILE_HITS, COMPILE_MISSES
+        # Chaos seam (ISSUE 11): "poison" salts the ledger key so every
+        # dispatch books as a fresh miss — a ledger-level recompile
+        # storm (the gauge/alerting path end-to-end) with zero actual
+        # XLA compiles and zero effect on served bits.
+        from quoracle_tpu.chaos.faults import CHAOS
+        d = CHAOS.fire("compile.key", model=self.model)
+        if d is not None and d.kind == "poison":
+            shape = tuple(shape) + ("chaos-poison", d.n)
         now = time.monotonic()
         with self._lock:
             entry = self._shapes.get(shape)

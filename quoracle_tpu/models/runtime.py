@@ -26,6 +26,7 @@ from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
+from quoracle_tpu.chaos.faults import CHAOS, InjectedFault
 from quoracle_tpu.models.config import (
     OUTPUT_FLOOR, ModelConfig, get_model_config,
 )
@@ -738,12 +739,29 @@ class TPUBackend(ModelBackend):
                     model_spec=spec, error=f"unknown model {spec!r}",
                     permanent_error=True)
             return
+        # Chaos seam (ISSUE 11): member crash / slow / garbage at the
+        # per-member query entry — a crash fails this member's rows with
+        # the structured InjectedFault text (the consensus layer counts
+        # it like any transport failure), a garbage directive perturbs
+        # the member's OUTPUT after serving (drift-detection food).
+        try:
+            chaos = CHAOS.fire("pool.member", model=spec)
+        except InjectedFault as e:
+            for i in idxs:
+                results[i] = QueryResult(model_spec=spec, error=str(e))
+            return
         t0 = time.monotonic()
         rows, live_idxs = self._build_rows(spec, idxs, requests, results,
                                            t0)
         if not live_idxs:
             return
         self._dispatch_rows(spec, rows, live_idxs, results, t0)
+        if chaos is not None and chaos.kind == "garbage":
+            for i in live_idxs:
+                r = results[i]
+                if r is not None and r.ok:
+                    results[i] = dataclasses.replace(
+                        r, text=f"{r.text} [chaos-garbage:{chaos.n}]")
 
     def _build_rows(self, spec: str, idxs: list[int],
                     requests: Sequence[QueryRequest],
@@ -1071,6 +1089,16 @@ class MockBackend(ModelBackend):
         out = []
         for r in requests:
             self.calls.append(r)
+            # Chaos seam (ISSUE 11): the SAME pool.member injection
+            # point as TPUBackend, so member crash/slow/garbage
+            # scenarios (drift storms feeding PR 5 detection) run on the
+            # mock pool in tier-1 at zero device cost.
+            try:
+                chaos = CHAOS.fire("pool.member", model=r.model_spec)
+            except InjectedFault as e:
+                out.append(QueryResult(model_spec=r.model_spec,
+                                       error=str(e)))
+                continue
             # same span shape as the TPU backend so span-linkage tests
             # (and trace consumers) see decide → round → member on mocks
             with TRACER.span("backend.member", model=r.model_spec):
@@ -1082,6 +1110,18 @@ class MockBackend(ModelBackend):
                 else:
                     text = ('{"action": "wait", "params": {"duration": 1}, '
                             '"reasoning": "mock default"}')
+            if chaos is not None and chaos.kind == "garbage":
+                # a VALID but divergent proposal (a real registered
+                # action, different from the healthy members' answer):
+                # clusters away from them → dissent, which is what the
+                # drift detector keys on. An unknown action would book
+                # as a parse failure instead — a different signal.
+                text = ('{"action": "orient", "params": '
+                        '{"current_understanding": '
+                        f'"chaos divergence {chaos.n}", '
+                        '"progress_assessment": "diverging"}, '
+                        '"wait": 30, '
+                        '"reasoning": "chaos-injected divergence"}')
             if text == "__error__":
                 out.append(QueryResult(model_spec=r.model_spec,
                                        error="scripted failure"))

@@ -57,6 +57,7 @@ or off (tests/test_qos.py).
 from __future__ import annotations
 
 import dataclasses
+import logging
 import threading
 import time
 from concurrent.futures import Future
@@ -75,6 +76,8 @@ from quoracle_tpu.serving.admission import (
 from quoracle_tpu.serving.qos import (
     AdmissionPolicy, FifoPolicy, class_name, coerce_priority,
 )
+
+logger = logging.getLogger(__name__)
 
 
 @dataclasses.dataclass
@@ -353,6 +356,7 @@ class ContinuousBatcher:
             except Exception:             # noqa: BLE001 — isolate, don't
                 self._live = self._isolate_failure(self._live)  # nuke all
             self.steps += 1               # watchdog progress signal
+            self._chaos_tick()
         # worker exit (close()): the worker owns _live, so it fails any
         # remaining rows itself — close() only takes over when this
         # thread is confirmed dead
@@ -367,6 +371,26 @@ class ContinuousBatcher:
         # gauge reset on the worker-exit path too (ISSUE 4 satellite):
         # whichever of close()/worker runs last, the scrape reads zero
         SCHED_SLOTS_BUSY.set(0, model=self._model)
+
+    def _chaos_tick(self) -> None:
+        """Chaos seam (ISSUE 11): per-tick fault hook in the decode
+        loop. ``demote`` forces the eviction ladder to hibernate every
+        demotable session MID-TRAFFIC (the still-live rows restore by
+        page-in next tick — PR 7's invariants under hostile
+        interleaving); ``delay`` stretches the tick. Worker-thread
+        exceptions here must never kill the loop — the faults this seam
+        injects are tier churn, not thread death."""
+        from quoracle_tpu.chaos.faults import (
+            CHAOS, chaos_demote_churn,
+        )
+        if not CHAOS.armed():
+            return
+        try:
+            d = CHAOS.fire("sched.tick", model=self._model)
+            if d is not None and d.kind == "demote":
+                chaos_demote_churn(self.engine)
+        except Exception:                 # noqa: BLE001 — isolate
+            logger.exception("chaos tick hook failed")
 
     def _isolate_failure(self, rows: list) -> list:
         """A shared chunk raised. One poisoned row must not discard every

@@ -52,19 +52,30 @@ class StallWatchdog:
     even if the process is killed moments later.
 
     A tripped source un-trips itself when progress resumes or the work
-    drains (gauge back to 0); each distinct wedge trips once, not once
-    per poll."""
+    drains (gauge back to 0); each distinct wedge trips once per
+    ``rearm_cooldown_s``, not once per poll — and not once per PROCESS:
+    after the cooldown a still-frozen (or newly re-frozen) source
+    re-trips and re-dumps (ISSUE 11 satellite; the old one-shot
+    behavior meant a second stall after the first was silently
+    undetected and a day-long wedge produced exactly one artifact)."""
 
     def __init__(self, bus: Optional[EventBus] = None,
                  deadline_s: float = 30.0,
-                 poll_s: Optional[float] = None):
+                 poll_s: Optional[float] = None,
+                 rearm_cooldown_s: Optional[float] = None):
         self.bus = bus
         self.deadline_s = deadline_s
         self.poll_s = poll_s if poll_s is not None \
             else max(0.5, deadline_s / 4)
+        # default: re-arm after 4 deadlines — long enough that one wedge
+        # doesn't dump-storm, short enough that an operator watching a
+        # multi-hour incident gets fresh evidence
+        self.rearm_cooldown_s = (rearm_cooldown_s
+                                 if rearm_cooldown_s is not None
+                                 else 4 * deadline_s)
         self._sources: dict[str, Callable[[], tuple]] = {}
         self._last: dict[str, tuple] = {}     # name -> (progress, since)
-        self._tripped: set[str] = set()
+        self._tripped: dict[str, float] = {}  # name -> last trip time
         self.trips = 0
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -109,9 +120,15 @@ class StallWatchdog:
                 self._last[name] = (progress, now)
                 self._untrip(name)
                 continue
-            if (now - last[1] >= self.deadline_s
-                    and name not in self._tripped):
-                self._tripped.add(name)
+            if now - last[1] < self.deadline_s:
+                continue
+            last_trip = self._tripped.get(name)
+            # first trip fires immediately; a source STILL frozen past
+            # the cooldown re-trips (fresh dump — the wedge is ongoing
+            # and the first artifact may be long pruned)
+            if last_trip is None \
+                    or now - last_trip >= self.rearm_cooldown_s:
+                self._tripped[name] = now
                 self.trips += 1
                 tripped.append(name)
                 self._trip(name, now - last[1])
@@ -119,7 +136,7 @@ class StallWatchdog:
 
     def _untrip(self, name: str) -> None:
         if name in self._tripped:
-            self._tripped.discard(name)
+            self._tripped.pop(name, None)
             from quoracle_tpu.infra.telemetry import WATCHDOG_STALLED
             WATCHDOG_STALLED.set(0.0, source=name)
 
@@ -155,6 +172,7 @@ class StallWatchdog:
         with self._lock:
             return {
                 "deadline_s": self.deadline_s,
+                "rearm_cooldown_s": self.rearm_cooldown_s,
                 "sources": sorted(self._sources),
                 "tripped": sorted(self._tripped),
                 "trips": self.trips,
@@ -241,6 +259,12 @@ class RuntimeConfig:
     # from here on means raising --replicas, not re-architecting.
     replicas: int = 1
     disaggregate: bool = False
+    # Chaos plane (ISSUE 11, quoracle_tpu/chaos/): path to a JSON fault
+    # plan ({"seed": N, "faults": [{"point", "kind", ...}]}) armed on
+    # the process-wide CHAOS plane at boot — game-day runs against a
+    # canary. None (the default) injects nothing and costs one
+    # attribute read per seam hit.
+    chaos_plan: Optional[str] = None
 
 
 class Runtime:
@@ -288,6 +312,11 @@ class Runtime:
         # decode loops. The collector detaches in close() (the recorder's
         # hooks are process-scoped by design and stay).
         FLIGHT.install()
+        # Chaos plane (ISSUE 11): arm the configured fault plan before
+        # any traffic — a game-day canary injects from its first row.
+        if config.chaos_plan:
+            from quoracle_tpu.chaos.faults import CHAOS, FaultPlan
+            CHAOS.arm(FaultPlan.from_json(config.chaos_plan))
         from quoracle_tpu.infra.resources import ResourceCollector
         self._resource_collector = ResourceCollector(self)
         METRICS.register_collector(self._resource_collector)

@@ -31,6 +31,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
+import zlib
 from typing import Any, Callable, Optional
 
 from quoracle_tpu.analysis.lockdep import named_lock
@@ -72,6 +73,32 @@ class RateLimitedError(AdmissionError):
     """Tenant token bucket empty; retry_after_ms = time to refill."""
 
     reason = "rate_limit"
+
+
+# hard ceiling on any escalated retry hint: past a minute the client
+# should re-resolve capacity, not keep a stale backoff alive
+BACKOFF_CAP_MS = 60_000
+
+
+def escalate_retry_ms(base_ms: int, attempt: int,
+                      cap_ms: int = BACKOFF_CAP_MS,
+                      salt: int = 0) -> int:
+    """Capped exponential backoff with DETERMINISTIC jitter (ISSUE 11
+    satellite) for repeated aggregate sheds: attempt 1 returns
+    ``base_ms``; each further consecutive shed doubles it, plus a
+    0–25% jitter derived from ``crc32(salt, attempt)`` — crc32, not
+    ``random``, so a retry storm de-synchronizes identically on every
+    replay and tests can assert exact values. Monotonic by
+    construction up to the cap: the doubling (×2) always dominates the
+    worst-case jitter (×1.25), so successive 429s carry non-decreasing
+    hints until both clamp at ``cap_ms``."""
+    base_ms = max(1, int(base_ms))
+    attempt = max(1, int(attempt))
+    # cap the exponent before shifting — a long outage must not build
+    # a bignum just to clamp it
+    scaled = base_ms << min(attempt - 1, 24)
+    jitter = (zlib.crc32(f"{salt}:{attempt}".encode()) % 1000) / 4000.0
+    return int(min(cap_ms, scaled * (1.0 + jitter)))
 
 
 class OverloadedError(AdmissionError):
@@ -211,6 +238,15 @@ class AdmissionController:
         window claim (``_t_hbm`` bump) stays under the lock, so exactly
         one caller per window pays the sample and the rest read the
         cached value."""
+        # Chaos seam (ISSUE 11): "drop" skips the refresh entirely (the
+        # shed ladder keeps steering on the stale window — what a wedged
+        # sampler looks like); "delay" stretches it. Both fire BEFORE
+        # the signal lock, so injected latency never serializes
+        # submitters the way the real bug this guards against did.
+        from quoracle_tpu.chaos.faults import CHAOS
+        d = CHAOS.fire("admission.signals", model=self.model)
+        if d is not None and d.kind == "drop":
+            return
         now = time.monotonic() if now is None else now
         cfg = self.config
         sample_hbm = False

@@ -59,6 +59,7 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from quoracle_tpu.analysis.lockdep import named_lock
+from quoracle_tpu.chaos.faults import CHAOS
 from quoracle_tpu.infra.telemetry import (
     CLUSTER_REPLICAS, CLUSTER_REQUESTS_TOTAL, TRACER,
 )
@@ -313,6 +314,10 @@ class ClusterPlane(ModelBackend):
                   path: str) -> QueryResult:
         CLUSTER_REQUESTS_TOTAL.inc(replica=rep.replica_id, path=path)
         try:
+            # Chaos seam (ISSUE 11): a "crash" here is a replica dying
+            # while serving a delegated request — recovered through the
+            # SAME mark-failed path a real device/transport death takes.
+            CHAOS.fire("cluster.serve", replica=rep.replica_id)
             out = rep.backend.query([r])
         except Exception as e:            # noqa: BLE001 — replica-fatal
             self._mark_failed(rep, repr(e))
@@ -467,6 +472,11 @@ class ClusterPlane(ModelBackend):
         replica: through its continuous batcher when it runs one (the
         production path — speculation included), a direct engine call
         otherwise."""
+        # Chaos seam (ISSUE 11): decode-replica death AFTER the handoff
+        # landed — the retained envelope must re-place the row onto a
+        # survivor with bit-identical output (kv_handoff_replace), or
+        # fail it with a structured error naming replica + phase.
+        CHAOS.fire("cluster.decode", replica=dec.replica_id)
         continuation = list(row["prompt"]) + list(g1.token_ids)
         remaining = row["budget"] - len(g1.token_ids)
         js = g1.json_state if row["constrain_json"] else None

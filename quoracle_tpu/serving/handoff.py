@@ -105,6 +105,18 @@ class KVHandoff:
         """Hibernate ``session_id`` out of ``engine`` into an envelope.
         Raises :class:`HandoffError` when the engine holds no such
         session (nothing prefilled — caller re-prefills downstream)."""
+        # Chaos seam (ISSUE 11): a "fail" directive aborts the export
+        # before any pages move — the caller's contract (degrade to a
+        # cold re-prefill on the decode side, request still served) is
+        # exactly what the scenario harness asserts.
+        from quoracle_tpu.chaos.faults import CHAOS
+        d = CHAOS.fire("handoff.export", model=model_spec)
+        if d is not None and d.kind == "fail":
+            CLUSTER_HANDOFFS_TOTAL.inc(model=model_spec,
+                                       status="export_failed")
+            raise HandoffError(
+                f"chaos-injected export failure for session "
+                f"{session_id!r}", reason="export_failed")
         tier = engine.sessions.tier
         if tier is None:
             raise HandoffError(

@@ -377,6 +377,14 @@ class DiskPrefixStore:
         path = self._path(key)
         if not os.path.exists(path):
             return None
+        # Chaos seam (ISSUE 11): a "corrupt" directive flips bytes in
+        # the FILE before the normal load path runs, so the crc32
+        # boundary below is what catches it — end-to-end proof that a
+        # torn/rotted entry is skipped, unlinked, and never served.
+        from quoracle_tpu.chaos.faults import CHAOS
+        d = CHAOS.fire("kvtier.disk_load", model=self.model)
+        if d is not None and d.kind == "corrupt":
+            self._chaos_corrupt(path)
         try:
             # Restore path by design (ARCHITECTURE §9): extend_prefix
             # calls this under the store lock so match→alloc→scatter→
@@ -417,6 +425,24 @@ class DiskPrefixStore:
                 pass
             self._scan_ts = 0.0           # stale; rescan on next stats
             return None
+
+    @staticmethod
+    def _chaos_corrupt(path: str) -> None:
+        """Flip a byte mid-payload in place (chaos "corrupt" directive).
+        Best-effort: a vanished file is already the degraded case."""
+        try:
+            # qlint: allow[lock-blocking] chaos-only byte flip; armed plans never run on the production hot path
+            with open(path, "r+b") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                if size < 1:
+                    return
+                f.seek(size // 2)
+                b = f.read(1)
+                f.seek(size // 2)
+                f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+        except OSError:
+            pass
 
     def stats(self) -> dict:
         with self._lock:
@@ -590,6 +616,15 @@ class TierManager:
         it. Returns the live session or None (pool unattainable / entry
         gone — the caller re-prefills, which is always correct). Assumes
         the engine's paged lock is held."""
+        # Chaos seam (ISSUE 11): a "fail" directive exercises the
+        # degrade-to-re-prefill path the docstring promises — the entry
+        # stays in the host tier (a later touch may still restore it),
+        # only THIS restore reports failure.
+        from quoracle_tpu.chaos.faults import CHAOS
+        d = CHAOS.fire("kvtier.restore", model=self.model)
+        if d is not None and d.kind == "fail":
+            self.restore_failures += 1
+            return None
         st = self.store
         with st.lock:
             e = self.host.sessions.get(key)

@@ -49,7 +49,9 @@ from quoracle_tpu.infra.flightrec import FLIGHT
 from quoracle_tpu.infra.telemetry import (
     ROUTER_PLACEMENTS_TOTAL, ROUTER_SHED_TOTAL, ROUTER_SIGNAL_AGE_MS,
 )
-from quoracle_tpu.serving.admission import AdmissionError, OverloadedError
+from quoracle_tpu.serving.admission import (
+    AdmissionError, OverloadedError, escalate_retry_ms,
+)
 from quoracle_tpu.serving.qos import class_name, coerce_priority
 
 # A signal window older than this forces a refresh at placement time —
@@ -69,6 +71,14 @@ class ClusterRouter:
         self.max_signal_age_s = float(max_signal_age_s)
         self.placements = 0
         self.shed = 0
+        # retry-after backoff state (ISSUE 11 satellite): consecutive
+        # aggregate sheds escalate the propagated hint exponentially
+        # (deterministic jitter, capped, monotone non-decreasing) and
+        # one successful admit resets the streak — without this a
+        # saturated cluster tells every rejected client the same small
+        # retry_after and they re-arrive in lockstep, re-saturating it.
+        self._shed_streak = 0
+        self._last_retry_ms = 0
 
     # -- topology --------------------------------------------------------
 
@@ -138,6 +148,13 @@ class ClusterRouter:
         sampled signals; a replica without QoS wiring scores by queue
         depth alone (scheduler stats)."""
         now = time.monotonic()
+        # Chaos seam (ISSUE 11): a "drop" directive loses this replica's
+        # signal snapshot — the router must degrade to worst-rank
+        # placement for it, never crash or stall the front door.
+        from quoracle_tpu.chaos.faults import CHAOS
+        d = CHAOS.fire("router.signals", replica=rep.replica_id)
+        if d is not None and d.kind == "drop":
+            return (1 << 20, 0.0, 0.0)
         ctrl = getattr(rep.backend, "qos_controller", None)
         if ctrl is not None:
             snap = ctrl.signals(max_age_s=self.max_signal_age_s)
@@ -212,14 +229,26 @@ class ClusterRouter:
                 ((r, c) for r, c in controllers),
                 key=lambda rc: self._load_score(rc[0])):
             try:
-                return ctrl.admit(tenant=tenant, priority=priority,
-                                  deadline_s=deadline_s)
+                cls = ctrl.admit(tenant=tenant, priority=priority,
+                                 deadline_s=deadline_s)
+                with self._lock:
+                    self._shed_streak = 0
+                    self._last_retry_ms = 0
+                return cls
             except AdmissionError as e:
                 errors.append(e)
         cls = coerce_priority(priority)
-        retry = max(e.retry_after_ms for e in errors)
+        base = max(e.retry_after_ms for e in errors)
         with self._lock:
             self.shed += 1
+            self._shed_streak += 1
+            # per-replica rejections may shrink between sheds (the
+            # ladder's own hint tracks depth) — clamp to the previous
+            # propagated hint so successive 429s NEVER tell a client to
+            # come back sooner while the cluster is still saturated
+            retry = max(self._last_retry_ms,
+                        escalate_retry_ms(base, self._shed_streak))
+            self._last_retry_ms = retry
         ROUTER_SHED_TOTAL.inc(cls=class_name(cls), tenant=tenant)
         FLIGHT.record("router_all_shed", tenant=tenant,
                       cls=class_name(cls), replicas=len(errors),
@@ -236,11 +265,14 @@ class ClusterRouter:
             reps = list(self._replicas.values())
             affinity = len(self._affinity)
             placements, shed = self.placements, self.shed
+            streak, last_retry = self._shed_streak, self._last_retry_ms
         out = {
             "replicas": {},
             "affinity_sessions": affinity,
             "placements": placements,
             "shed": shed,
+            "shed_streak": streak,
+            "last_retry_after_ms": last_retry,
             "max_signal_age_s": self.max_signal_age_s,
         }
         for rep in reps:

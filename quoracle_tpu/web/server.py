@@ -441,6 +441,24 @@ class DashboardServer:
         }
         return payload
 
+    def chaos_payload(self) -> dict:
+        """GET /api/chaos: the chaos plane (ISSUE 11) — armed plan,
+        injection-point catalog, recent fired faults, the last scenario
+        report's invariant verdicts, and the fault/invariant counter
+        series."""
+        from quoracle_tpu.chaos.faults import CHAOS
+        from quoracle_tpu.infra.telemetry import (
+            CHAOS_FAULTS_TOTAL, CHAOS_INVARIANT_FAILURES,
+            CHAOS_SCENARIOS_TOTAL,
+        )
+        payload = CHAOS.status()
+        payload["counters"] = {
+            "faults": CHAOS_FAULTS_TOTAL._snapshot(),
+            "scenarios": CHAOS_SCENARIOS_TOTAL._snapshot(),
+            "invariant_failures": CHAOS_INVARIANT_FAILURES._snapshot(),
+        }
+        return payload
+
     def qos_payload(self) -> dict:
         """GET /api/qos: the serving-QoS panel (ISSUE 4) — admission
         controller state (signals, thresholds, tenant buckets), the
@@ -593,7 +611,7 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_html(views.telemetry_page(
                     d.metrics_payload(), d.resources_payload(),
                     d.qos_payload(), d.models_payload(),
-                    d.kv_payload()))
+                    d.kv_payload(), d.chaos_payload()))
             elif parsed.path == "/settings":
                 from quoracle_tpu.web import views
                 self._send_html(views.settings_page(
@@ -630,6 +648,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.kv_payload())
             elif parsed.path == "/api/cluster":
                 self._send_json(d.cluster_payload())
+            elif parsed.path == "/api/chaos":
+                self._send_json(d.chaos_payload())
             elif parsed.path == "/api/models":
                 self._send_json(d.models_payload())
             elif parsed.path == "/api/consensus":

@@ -499,10 +499,47 @@ def kv_panel(kv: dict) -> str:
     return "".join(parts)
 
 
+def chaos_panel(chaos: dict) -> str:
+    """Chaos-plane panel (ISSUE 11): armed-plan state, fired-fault
+    counts per injection point, and the last scenario's invariant
+    verdicts — the /api/chaos payload as tables. Renders nothing while
+    the plane has never been armed and no scenario has run."""
+    chaos = chaos or {}
+    armed = chaos.get("armed")
+    last = chaos.get("last_scenario")
+    fired = chaos.get("fired") or []
+    if not armed and not last and not fired:
+        return ""
+    parts = [f"<h2 class=\"meta\">chaos plane "
+             f"({'ARMED' if armed else 'disarmed'})</h2>"]
+    plan = chaos.get("plan") or {}
+    if plan:
+        parts.append(
+            f"<p class=\"meta\" id=\"chaos-plan\">seed {_e(plan.get('seed'))}"
+            f" · {_e(len(plan.get('rules') or []))} rule(s)"
+            f" · {_e(plan.get('fired'))} fault(s) fired</p>")
+    if last:
+        rows = "".join(
+            f"<tr class=\"chaos-inv\" data-ok=\"{int(bool(r.get('ok')))}\">"
+            f"<td>{_e(r.get('name'))}</td>"
+            f"<td>{'pass' if r.get('ok') else 'FAIL'}</td>"
+            f"<td>{_e((r.get('detail') or '')[:120])}</td></tr>"
+            for r in last.get("invariants") or [])
+        parts.append(
+            f"<h3 class=\"meta\">last scenario: {_e(last.get('name'))} "
+            f"(seed {_e(last.get('seed'))}, "
+            f"{'PASS' if last.get('passed') else 'FAIL'}, "
+            f"{_e(last.get('faults_fired'))} faults)</h3>"
+            "<table id=\"chaos-invariants\"><tr><th>invariant</th>"
+            "<th>verdict</th><th>detail</th></tr>" + rows + "</table>")
+    return "".join(parts)
+
+
 def telemetry_page(metrics: dict, resources: Optional[dict] = None,
                    qos: Optional[dict] = None,
                    quality: Optional[dict] = None,
-                   kv: Optional[dict] = None) -> str:
+                   kv: Optional[dict] = None,
+                   chaos: Optional[dict] = None) -> str:
     """Dev telemetry view (reference LiveDashboard at /dev/dashboard):
     the /api/metrics snapshot as readable tables, led by the latency
     histogram panel, the live resources panel, the QoS panel, the
@@ -524,6 +561,7 @@ def telemetry_page(metrics: dict, resources: Optional[dict] = None,
             + resources_panel(resources or {})
             + qos_panel(qos or {})
             + kv_panel(kv or {})
+            + chaos_panel(chaos or {})
             + quality_panel(quality or {})
             + spec_panel((quality or {}).get("speculative") or {})
             + (table("runtime", flat) if flat else "")

@@ -1,0 +1,238 @@
+"""Chaos plane (quoracle_tpu/chaos/, ISSUE 11).
+
+Covers the tentpole's acceptance bar:
+
+  * the five scenarios run SEEDED on the mock-device (CPU tiny-engine)
+    cluster, each asserting its full invariant set — zero silent row
+    loss, structured failures only, temp-0 survivor bit-equality,
+    audit coherence, zero lockdep inversions (the conftest sanitizer is
+    on for the whole suite) — and the deterministic-rerun scenarios
+    prove an identical fault schedule under the same seed;
+  * FaultPlan mechanics: pure seeded decisions (no wall clock, no
+    process-salted hash), per-(point, key) streams, windowing
+    (start/every/max_fires), ctx match filters, unknown-point
+    rejection, disarmed no-op;
+  * the plane's surfaces: flight-event registration, instruments,
+    GET /api/chaos payload + telemetry panel, RuntimeConfig.chaos_plan
+    arming, and the --chaos-plan CLI flag.
+"""
+
+import json
+
+import pytest
+
+from quoracle_tpu.chaos.faults import (
+    CHAOS, FaultPlan, FaultRule, InjectedFault, INJECTION_POINTS,
+)
+from quoracle_tpu.chaos.scenarios import SCENARIOS, run_scenario
+
+pytestmark = pytest.mark.chaos
+
+
+# ---------------------------------------------------------------------------
+# FaultPlan mechanics
+# ---------------------------------------------------------------------------
+
+def test_fault_decisions_are_pure_and_seeded():
+    """The same (seed, point, key, n) always decides the same way —
+    across plans, processes, and time — and different seeds genuinely
+    differ."""
+    rule = FaultRule("pool.member", "crash", prob=0.5)
+
+    def schedule(seed):
+        plan = FaultPlan(seed, [rule])
+        return [plan._decide(0, rule, "pool.member", "m1", n)
+                for n in range(64)]
+
+    a, b = schedule(7), schedule(7)
+    assert a == b
+    assert any(a) and not all(a)          # prob actually partitions
+    assert schedule(7) != schedule(8)
+
+
+def test_fire_windowing_match_and_ledger():
+    plan = FaultPlan(0, [
+        FaultRule("pool.member", "garbage", start=2, every=2,
+                  max_fires=2, match={"model": "m1"}),
+    ])
+    CHAOS.arm(plan)
+    try:
+        fired = []
+        for _ in range(8):
+            d = CHAOS.fire("pool.member", model="m1")
+            fired.append(d.kind if d else None)
+            assert CHAOS.fire("pool.member", model="m2") is None
+        # n=2 and n=4 fire; max_fires stops n=6
+        assert fired == [None, None, "garbage", None, "garbage",
+                         None, None, None]
+        assert plan.schedule() == [("pool.member", "m1", 2, "garbage"),
+                                   ("pool.member", "m1", 4, "garbage")]
+        # m2's stream advanced independently and fired nothing
+        assert plan.counts[("pool.member", "m2")] == 8
+    finally:
+        CHAOS.disarm()
+
+
+def test_crash_kind_raises_structured_injected_fault():
+    plan = FaultPlan(0, [FaultRule("cluster.serve", "crash")])
+    CHAOS.arm(plan)
+    try:
+        with pytest.raises(InjectedFault) as ei:
+            CHAOS.fire("cluster.serve", replica="decode-1")
+        assert "chaos_injected" in str(ei.value)
+        assert ei.value.point == "cluster.serve"
+        assert ei.value.key == "decode-1"
+    finally:
+        CHAOS.disarm()
+
+
+def test_disarmed_fire_is_a_noop_and_counts_nothing():
+    assert not CHAOS.armed()
+    assert CHAOS.fire("pool.member", model="m1") is None
+    plan = FaultPlan(3, [])
+    CHAOS.arm(plan)
+    CHAOS.disarm()
+    assert CHAOS.fire("pool.member", model="m1") is None
+    assert plan.counts == {}              # disarmed streams never advance
+
+
+def test_plan_json_round_trip_and_unknown_point_rejected(tmp_path):
+    spec = {"seed": 42, "faults": [
+        {"point": "admission.signals", "kind": "drop", "prob": 0.25},
+        {"point": "cluster.decode", "kind": "crash", "start": 5,
+         "max_fires": 2},
+    ]}
+    p = tmp_path / "plan.json"
+    p.write_text(json.dumps(spec))
+    plan = FaultPlan.from_json(str(p))
+    assert plan.seed == 42 and len(plan.rules) == 2
+    assert plan.rules[1].start == 5
+    with pytest.raises(ValueError, match="unknown injection point"):
+        FaultPlan.from_dict({"faults": [{"point": "nope",
+                                         "kind": "crash"}]})
+
+
+def test_flight_events_and_instruments_registered():
+    from quoracle_tpu.infra.flightrec import FLIGHT_EVENTS
+    from quoracle_tpu.infra.telemetry import METRICS
+    for kind in ("chaos_armed", "chaos_fault", "chaos_scenario_start",
+                 "chaos_scenario_end", "signal_dump"):
+        assert kind in FLIGHT_EVENTS
+    text = METRICS.render_prometheus()
+    for name in ("quoracle_chaos_armed", "quoracle_chaos_faults_total",
+                 "quoracle_chaos_scenarios_total",
+                 "quoracle_chaos_invariant_failures_total"):
+        assert name in text
+    # every scenario's injection points exist in the catalog
+    assert set(SCENARIOS) == {"traffic_storm", "kill_mid_handoff",
+                              "restart_warm_start", "drift_storm",
+                              "hbm_pressure_churn"}
+    assert "pool.member" in INJECTION_POINTS
+
+
+# ---------------------------------------------------------------------------
+# The five scenarios (the tier-1 acceptance gate)
+# ---------------------------------------------------------------------------
+
+def _assert_scenario(name: str, seed: int):
+    report = run_scenario(name, seed=seed)
+    detail = {r.name: (r.ok, r.detail) for r in report.invariants}
+    assert report.passed, f"{name} seed={seed}: {detail}"
+    assert not CHAOS.armed()              # the harness always disarms
+    assert report.schedule, f"{name}: storm fired no faults"
+    return report
+
+
+def test_scenario_drift_storm():
+    report = _assert_scenario("drift_storm", seed=7)
+    # the rerun invariant ran: same seed reproduced the schedule
+    names = [r.name for r in report.invariants]
+    assert names.count("fault_schedule") == 2
+    assert report.evidence["garbage_drift"]["tripped"] is True
+
+
+def test_scenario_hbm_pressure_churn():
+    report = _assert_scenario("hbm_pressure_churn", seed=11)
+    assert report.evidence["tier"]["demoted_sessions"] >= 1
+    assert report.evidence["storms"] >= 1
+
+
+def test_scenario_restart_warm_start():
+    report = _assert_scenario("restart_warm_start", seed=11)
+    assert report.evidence["corrupt_fired"] >= 1
+    assert report.evidence["disk"]["corrupt_skipped"] >= 1
+
+
+def test_scenario_kill_mid_handoff():
+    report = _assert_scenario("kill_mid_handoff", seed=5)
+    assert report.evidence["handoff"]["replaced"] >= 1
+    assert report.evidence["dead_replicas"]
+
+
+def test_scenario_traffic_storm():
+    report = _assert_scenario("traffic_storm", seed=5)
+    names = [r.name for r in report.invariants]
+    assert names.count("fault_schedule") == 2      # deterministic rerun
+    kinds = {t[3] for t in report.schedule}
+    assert "drop" in kinds                # signal loss actually injected
+
+
+# ---------------------------------------------------------------------------
+# Surfaces: /api/chaos, telemetry panel, Runtime/CLI arming
+# ---------------------------------------------------------------------------
+
+def test_api_chaos_payload_and_panel():
+    from types import SimpleNamespace
+
+    from quoracle_tpu.web import views
+    from quoracle_tpu.web.server import DashboardServer
+
+    d = DashboardServer(SimpleNamespace(backend=object()))
+    payload = d.chaos_payload()
+    assert payload["armed"] is False
+    assert set(payload["points"]) == set(INJECTION_POINTS)
+    assert {"faults", "scenarios", "invariant_failures"} \
+        <= set(payload["counters"])
+    # scenario tests above left a last_scenario report behind
+    last = payload["last_scenario"]
+    assert last is not None and "invariants" in last
+    html = views.chaos_panel(payload)
+    assert "chaos plane" in html and "chaos-invariants" in html
+    # armed plans render their seed
+    plan = FaultPlan(99, [FaultRule("pool.member", "slow")])
+    CHAOS.arm(plan)
+    try:
+        html = views.chaos_panel(d.chaos_payload())
+        assert "ARMED" in html and "99" in html
+    finally:
+        CHAOS.disarm()
+    assert views.chaos_panel({"armed": False, "last_scenario": None,
+                              "fired": []}) == ""
+
+
+def test_runtime_arms_chaos_plan_at_boot(tmp_path):
+    from quoracle_tpu.runtime import Runtime, RuntimeConfig
+
+    p = tmp_path / "plan.json"
+    p.write_text(json.dumps({"seed": 1, "faults": [
+        {"point": "pool.member", "kind": "slow", "prob": 0.1}]}))
+    rt = Runtime(RuntimeConfig(chaos_plan=str(p)))
+    try:
+        assert CHAOS.armed()
+    finally:
+        CHAOS.disarm()
+        rt.close()
+    with pytest.raises(ValueError, match="unknown injection point"):
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps({"faults": [{"point": "x",
+                                               "kind": "crash"}]}))
+        Runtime(RuntimeConfig(chaos_plan=str(bad)))
+
+
+def test_cli_chaos_plan_flag_parses():
+    from quoracle_tpu.cli import build_parser
+
+    ns = build_parser().parse_args(
+        ["serve", "--chaos-plan", "/etc/quoracle/gameday.json"])
+    assert ns.chaos_plan == "/etc/quoracle/gameday.json"
+    assert build_parser().parse_args(["run", "x"]).chaos_plan is None

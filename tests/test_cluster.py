@@ -247,7 +247,13 @@ def test_all_decode_replicas_shed_propagates_max_retry_after():
             except OverloadedError as e:
                 retries.append(e.retry_after_ms)
         assert len(retries) == 2
-        assert ei.value.retry_after_ms == max(retries)
+        # the MAX across replicas is the backoff BASE (ISSUE 11
+        # satellite): the first consecutive shed propagates it with
+        # deterministic jitter applied, never less than the max itself
+        from quoracle_tpu.serving.admission import escalate_retry_ms
+        assert ei.value.retry_after_ms == escalate_retry_ms(
+            max(retries), 1)
+        assert ei.value.retry_after_ms >= max(retries)
         assert cl.router.shed == 1
         # and through the serving path: a structured reject, not a hang
         got = cl.query([req(priority=Priority.INTERACTIVE)])[0]
@@ -255,6 +261,63 @@ def test_all_decode_replicas_shed_propagates_max_retry_after():
         assert "admission_rejected" in got.error
     finally:
         cl.close()
+
+
+def test_router_retry_after_backs_off_monotonically():
+    """ISSUE 11 satellite: under REPEATED aggregate shed the router's
+    propagated retry_after_ms escalates exponentially with
+    deterministic jitter — successive 429s are non-decreasing up to
+    the cap, so a saturated cluster de-synchronizes its retry storm
+    instead of re-summoning it; one successful admit resets the
+    streak."""
+    from types import SimpleNamespace
+
+    from quoracle_tpu.serving.admission import (
+        BACKOFF_CAP_MS, AdmissionController, OverloadedError,
+        escalate_retry_ms,
+    )
+    from quoracle_tpu.serving.router import ClusterRouter
+
+    def make_rep(rid):
+        ctrl = AdmissionController()
+        ctrl.config.max_queue_depth = 0          # shed everything
+        ctrl.register_depth_source("q", lambda: 1)
+        return SimpleNamespace(replica_id=rid, role="decode",
+                               alive=True,
+                               backend=SimpleNamespace(
+                                   qos_controller=ctrl))
+
+    router = ClusterRouter()
+    reps = [make_rep("decode-1"), make_rep("decode-2")]
+    for r in reps:
+        router.register(r)
+
+    hints = []
+    for _ in range(10):
+        with pytest.raises(OverloadedError) as ei:
+            router.admit(tenant="t1")
+        hints.append(ei.value.retry_after_ms)
+    assert hints == sorted(hints), hints          # non-decreasing
+    assert hints[-1] == BACKOFF_CAP_MS            # reaches the cap
+    assert hints[0] < hints[3] < hints[-1]        # actually escalates
+    assert router.stats()["shed_streak"] == 10
+    assert router.stats()["last_retry_after_ms"] == BACKOFF_CAP_MS
+
+    # one successful admit resets the streak — the next shed starts
+    # from the base hint again
+    for r in reps:
+        r.backend.qos_controller.config.max_queue_depth = 64
+    router.admit(tenant="t1")
+    assert router.stats()["shed_streak"] == 0
+    for r in reps:
+        r.backend.qos_controller.config.max_queue_depth = 0
+    with pytest.raises(OverloadedError) as ei:
+        router.admit(tenant="t1")
+    assert ei.value.retry_after_ms == hints[0]
+
+    # the jitter is deterministic: same (base, attempt) → same hint
+    assert [escalate_retry_ms(1000, n) for n in range(1, 8)] \
+        == [escalate_retry_ms(1000, n) for n in range(1, 8)]
 
 
 # ---------------------------------------------------------------------------

@@ -227,6 +227,74 @@ def test_watchdog_trip_dumps_flight_recorder(tmp_path, monkeypatch):
     wd.close()
 
 
+def test_watchdog_rearms_after_cooldown(tmp_path, monkeypatch):
+    """Regression (ISSUE 11 satellite): the watchdog used to trip once
+    per wedge per PROCESS — a second stall (or a wedge outliving the
+    first dump) went undetected. Now a still-frozen source re-trips
+    after ``rearm_cooldown_s``, and a resolve → re-stall cycle trips
+    again immediately."""
+    monkeypatch.setenv("QUORACLE_FLIGHTREC_DIR", str(tmp_path))
+    import quoracle_tpu.runtime as rt_mod
+    flight = FlightRecorder(directory=str(tmp_path))
+    monkeypatch.setattr(rt_mod, "FLIGHT", flight)
+
+    progress = {"active": True, "n": 1}
+    wd = StallWatchdog(None, deadline_s=0.05, poll_s=10.0,
+                       rearm_cooldown_s=0.2)
+    wd.add_source("decode-loop:test",
+                  lambda: (progress["active"], progress["n"]))
+    assert wd.check_now() == []
+    time.sleep(0.08)
+    assert wd.check_now() == ["decode-loop:test"]
+    assert wd.check_now() == []           # inside the cooldown: armed off
+    assert wd.trips == 1
+    # the SAME wedge persists past the cooldown: fresh trip, fresh dump
+    time.sleep(0.25)
+    assert wd.check_now() == ["decode-loop:test"]
+    assert wd.trips == 2
+    # resolve, then a SECOND distinct stall in the same process
+    progress["n"] = 2
+    wd.check_now()
+    assert wd.status()["tripped"] == []
+    time.sleep(0.08)
+    assert wd.check_now() == ["decode-loop:test"]
+    assert wd.trips == 3
+    assert wd.status()["rearm_cooldown_s"] == 0.2
+    wd.close()
+
+
+def test_flightrec_dumps_on_sigterm(tmp_path):
+    """ISSUE 11 satellite: a SIGTERM (chaos kill, operator drain,
+    supervisor timeout) leaves a post-mortem flight dump BEFORE the
+    process honors the signal — and the default disposition still runs
+    (exit status is the signal's, exactly as without the hook)."""
+    import signal
+    import subprocess
+    import sys
+
+    code = (
+        "import os, signal\n"
+        "from quoracle_tpu.infra.flightrec import FlightRecorder\n"
+        f"fr = FlightRecorder(directory={str(tmp_path)!r})\n"
+        "fr.install()\n"
+        "fr.record('resource_sample', marker='pre-sigterm')\n"
+        "os.kill(os.getpid(), signal.SIGTERM)\n"
+        "raise SystemExit('signal did not terminate the process')\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, timeout=120)
+    assert proc.returncode == -signal.SIGTERM, (proc.returncode,
+                                                proc.stderr[-500:])
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("flightrec-") and "signal-SIGTERM" in f]
+    assert dumps, os.listdir(tmp_path)
+    with open(os.path.join(tmp_path, dumps[0])) as f:
+        dump = json.load(f)
+    kinds = [e["kind"] for e in dump["events"]]
+    assert "signal_dump" in kinds and "resource_sample" in kinds
+    assert dump["reason"] == "signal-SIGTERM"
+
+
 def test_flight_recorder_ring_bound_retention_and_status(tmp_path):
     fr = FlightRecorder(capacity=8, directory=str(tmp_path), retention=3)
     for i in range(20):
