@@ -1421,6 +1421,188 @@ def measure_chaos_storm(pool, n_interactive: int = 6,
     return result
 
 
+def measure_fabric(pool, n_rows: int = 6, n_router_peers: int = 3,
+                   n_router_rows: int = 9) -> dict:
+    """Config 18: the cross-host cluster fabric (ISSUE 12) on the
+    loopback wire — every byte rides the real frame codec, no sockets,
+    so the numbers isolate SERIALIZATION + PROTOCOL cost from network
+    cost. Three measurements:
+
+    1. **handoff p95, wire vs in-process** — the same ``n_rows``
+       disaggregated requests through a 2-replica in-process
+       ClusterPlane and through a prefill+decode FabricPlane over
+       loopback transports; handoff latency from count deltas of
+       ``quoracle_cluster_handoff_ms`` per phase (both phases adopt
+       through the same broker), outputs asserted temp-0 BIT-EQUAL.
+    2. **fleet prefix hit rate cold-start** — a donor publishes its
+       prefix blocks to an in-process prefixd service; two FRESH peers
+       serve the same long-preamble prompts, one reading through the
+       fleet, one not: cached-token fraction with vs without.
+    3. **front-door throughput at N loopback peers** — ``n_router_rows``
+       concurrent rows through a FabricPlane over ``n_router_peers``
+       unified peers: rows/s + placement spread.
+    """
+    import tempfile
+
+    from quoracle_tpu.infra.telemetry import CLUSTER_HANDOFF_MS, quantile
+    from quoracle_tpu.models.runtime import QueryRequest
+    from quoracle_tpu.serving.cluster import ClusterPlane, RemoteReplica
+    from quoracle_tpu.serving.fabric.frontdoor import FabricPlane
+    from quoracle_tpu.serving.fabric.peer import FabricPeer
+    from quoracle_tpu.serving.fabric.prefixd import PrefixService
+    from quoracle_tpu.serving.fabric.transport import LoopbackTransport
+
+    member = pool[0]
+
+    def reqs():
+        return [QueryRequest(
+            member, [{"role": "user",
+                      "content": f"[fabric {i}] "
+                                 + TASKS[i % len(TASKS)][:64]}],
+            temperature=0.0, max_tokens=16, constrain_json=(i % 3 == 2))
+            for i in range(n_rows)]
+
+    def handoff_window(fn):
+        c0, buckets = CLUSTER_HANDOFF_MS.counts()[0], \
+            CLUSTER_HANDOFF_MS.buckets
+        t0 = time.monotonic()
+        out = fn()
+        wall = time.monotonic() - t0
+        delta = [a - b for a, b in zip(CLUSTER_HANDOFF_MS.counts()[0],
+                                       c0)]
+        p95 = quantile(buckets, delta, 0.95) if sum(delta) else None
+        return out, p95, wall
+
+    # -- 1. handoff p95: in-process vs loopback wire ---------------------
+    cl = ClusterPlane.build([member], replicas=2, disaggregate=True,
+                            continuous=True, continuous_chunk=16)
+    try:
+        inproc, inproc_p95, inproc_wall = handoff_window(
+            lambda: cl.query(reqs()))
+        assert all(r.ok for r in inproc), \
+            [r.error for r in inproc if not r.ok]
+    finally:
+        cl.close()
+    peers = [FabricPeer.build([member], role="prefill",
+                              replica_id="prefill-0",
+                              continuous_chunk=16),
+             FabricPeer.build([member], role="decode",
+                              replica_id="decode-0",
+                              continuous_chunk=16)]
+    plane = FabricPlane([
+        RemoteReplica(LoopbackTransport(p.handle, p.replica_id))
+        for p in peers])
+    try:
+        wired, wire_p95, wire_wall = handoff_window(
+            lambda: plane.query(reqs()))
+        assert all(r.ok for r in wired), \
+            [r.error for r in wired if not r.ok]
+        wire_handoffs = plane.wire_handoffs
+    finally:
+        plane.close()
+        for p in peers:
+            p.close()
+    equal = [r.text for r in inproc] == [r.text for r in wired]
+    assert equal, "config18: temp-0 outputs diverged in-process vs wire"
+
+    # -- 2. fleet prefix hit rate: cold-start with vs without prefixd ----
+    preamble = ("system: shared fleet policy preamble for every agent "
+                "session. " * 6)
+    warm_reqs = [QueryRequest(
+        member, [{"role": "user",
+                  "content": preamble + f"task {i}: restate briefly."}],
+        temperature=0.0, max_tokens=12, session_id=f"warm{i}")
+        for i in range(3)]
+    with tempfile.TemporaryDirectory(prefix="bench-prefixd-") as root:
+        svc = PrefixService(root)
+
+        def fleet_transport():
+            return LoopbackTransport(svc.handle, "prefixd",
+                                     lock_name="fabric.prefixd")
+
+        donor = FabricPeer.build([member], replica_id="donor",
+                                 continuous_chunk=16, host_kv_mb=64)
+        donor.attach_prefixd(fleet_transport())
+        donor.backend.query(warm_reqs)
+        for i in range(len(warm_reqs)):
+            donor.backend.drop_session(f"warm{i}")
+        donor.backend.engines[member].sessions.tier.flush_spills()
+        donor.close()
+
+        def cold_start(with_fleet: bool) -> dict:
+            peer = FabricPeer.build([member], replica_id="cold",
+                                    continuous_chunk=16, host_kv_mb=64)
+            if with_fleet:
+                peer.attach_prefixd(fleet_transport())
+            try:
+                out = peer.backend.query(warm_reqs)
+                assert all(r.ok for r in out)
+                cached = sum(r.cached_tokens for r in out)
+                prompt = sum(r.usage.prompt_tokens for r in out)
+                return {"cached_tokens": cached,
+                        "prompt_tokens": prompt,
+                        "hit_frac": round(cached / max(1, prompt), 3),
+                        "texts": [r.text for r in out]}
+            finally:
+                peer.close()
+
+        with_fleet = cold_start(True)
+        without = cold_start(False)
+        assert with_fleet["texts"] == without["texts"], \
+            "config18: prefixd warm-start changed output bits"
+
+    # -- 3. front-door throughput at N loopback peers --------------------
+    router_peers = [FabricPeer.build([member], role="unified",
+                                     replica_id=f"unified-{i}",
+                                     continuous_chunk=16)
+                    for i in range(n_router_peers)]
+    door = FabricPlane([
+        RemoteReplica(LoopbackTransport(p.handle, p.replica_id))
+        for p in router_peers])
+    try:
+        rows = [QueryRequest(
+            member, [{"role": "user",
+                      "content": f"[door {i}] "
+                                 + TASKS[i % len(TASKS)][:48]}],
+            temperature=0.0, max_tokens=12)
+            for i in range(n_router_rows)]
+        t0 = time.monotonic()
+        out = door.query(rows)
+        door_wall = time.monotonic() - t0
+        assert all(r.ok for r in out), \
+            [r.error for r in out if not r.ok]
+        placements = door.router.stats()["placements"]
+    finally:
+        door.close()
+        for p in router_peers:
+            p.close()
+
+    return {
+        "n_rows": n_rows,
+        # the in-process histogram window spans export→adopt (front-door
+        # time included); the wire peer re-anchors at decode, so its
+        # window is the adopt leg alone — the honest wire-vs-in-process
+        # number is the per-row wall delta below
+        "handoff_p95_ms_inprocess": inproc_p95,
+        "handoff_adopt_p95_ms_wire": wire_p95,
+        "wire_overhead_ms_per_row": round(
+            (wire_wall - inproc_wall) * 1000 / max(1, n_rows), 1),
+        "wire_handoffs": wire_handoffs,
+        "wall_s_inprocess": round(inproc_wall, 3),
+        "wall_s_wire": round(wire_wall, 3),
+        "prefix_hit_frac_with_prefixd": with_fleet["hit_frac"],
+        "prefix_hit_frac_without": without["hit_frac"],
+        "prefix_cached_tokens_with": with_fleet["cached_tokens"],
+        "prefix_cached_tokens_without": without["cached_tokens"],
+        "router_peers": n_router_peers,
+        "router_rows": n_router_rows,
+        "router_rows_per_s": round(n_router_rows
+                                   / max(1e-9, door_wall), 2),
+        "router_placements": placements,
+        "temp0_equal": equal,
+    }
+
+
 def measure_quality_overhead(backend, pool,
                              n_decides: int = N_CYCLES) -> dict:
     """Config 12: consensus-quality instrumentation overhead (ISSUE 5).
@@ -1692,6 +1874,20 @@ def base_payload() -> dict:
         "config17_faults_fired": None,
         "config17_replicas_replaced": None,
         "config17_invariants_pass": None,
+        # config 18 — cross-host cluster fabric (ISSUE 12): the same
+        # disaggregated traffic through an in-process ClusterPlane vs
+        # a prefill+decode FabricPlane over the loopback wire (handoff
+        # p95 + serialization overhead, temp-0 equality ASSERT), fleet
+        # prefix hit rate cold-start with/without prefixd, and front-
+        # door throughput at N loopback peers. Detail in the FABRIC
+        # sidecar (QUORACLE_BENCH_FABRIC).
+        "config18_handoff_p95_ms_inprocess": None,
+        "config18_handoff_adopt_p95_ms_wire": None,
+        "config18_wire_overhead_ms_per_row": None,
+        "config18_prefix_hit_frac_with_prefixd": None,
+        "config18_prefix_hit_frac_without": None,
+        "config18_router_rows_per_s": None,
+        "config18_temp0_equal": None,
         "cycles": None,
         "rounds_per_cycle": None,
         "max_new_tokens": None,
@@ -2171,6 +2367,21 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             except OSError as e:
                 log(f"config17 sidecar write failed: {e}")
 
+    # config 18 builds its own peers (fresh engine sets per "process" —
+    # the loopback fabric is the multi-process topology in one process)
+    cfg18 = guard("config18", lambda: measure_fabric(pool))
+    if cfg18:
+        log(f"config18: {cfg18}")
+        sidecar = os.environ.get("QUORACLE_BENCH_FABRIC")
+        if sidecar:
+            try:
+                with open(sidecar, "w") as f:
+                    json.dump({"metric": "fabric",
+                               "config18": cfg18}, f, indent=1)
+                log(f"config18 fabric detail written to {sidecar}")
+            except OSError as e:
+                log(f"config18 sidecar write failed: {e}")
+
     def vision_config():
         # config 5: vision pool — free the trio's HBM first (weights + KV
         # page pools), then serve llama + the VLM checkpoint with an
@@ -2427,6 +2638,21 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             "config17_faults_fired": cfg17["faults_fired"],
             "config17_replicas_replaced": cfg17["replicas_replaced"],
             "config17_invariants_pass": cfg17["invariants_pass"],
+        })
+    if cfg18:
+        payload.update({
+            "config18_handoff_p95_ms_inprocess":
+                cfg18["handoff_p95_ms_inprocess"],
+            "config18_handoff_adopt_p95_ms_wire":
+                cfg18["handoff_adopt_p95_ms_wire"],
+            "config18_wire_overhead_ms_per_row":
+                cfg18["wire_overhead_ms_per_row"],
+            "config18_prefix_hit_frac_with_prefixd":
+                cfg18["prefix_hit_frac_with_prefixd"],
+            "config18_prefix_hit_frac_without":
+                cfg18["prefix_hit_frac_without"],
+            "config18_router_rows_per_s": cfg18["router_rows_per_s"],
+            "config18_temp0_equal": cfg18["temp0_equal"],
         })
     if cfg10:
         payload.update({

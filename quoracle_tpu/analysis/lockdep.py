@@ -57,7 +57,16 @@ HIERARCHY: tuple = (
     #    replica-internal lock is taken) -------------------------------
     ("cluster.plane",   4, False),  # ClusterPlane replica table / seq
     ("router",          6, False),  # ClusterRouter affinity + liveness
+    ("fabric.plane",    7, False),  # FabricPlane peer table + retained
+                                    # envelope-bytes ledger (below the
+                                    # router it serves, above every
+                                    # peer-side lock)
     ("handoff",         8, False),  # KVHandoff in-flight envelope ledger
+    ("fabric.transport", 9, True),  # one wire request in flight per
+                                    # transport: socket I/O under it is
+                                    # its purpose (coarse), taken under
+                                    # plane/router/handoff, never above
+                                    # a replica-internal lock
     # -- admission / scheduling plane -----------------------------------
     ("batcher",        10, False),  # ContinuousBatcher queue/close lock
     ("qos.admission",  12, False),  # AdmissionController tenant table
@@ -75,6 +84,11 @@ HIERARCHY: tuple = (
                                     # paged steps serialize through it
     ("session.store",  30, False),  # SessionStore pages/refs/radix tree
     # -- tier plane -----------------------------------------------------
+    ("fabric.prefixd", 32, True),   # fleet prefix-service client: its
+                                    # wire I/O serializer, acquired on
+                                    # the restore path under
+                                    # session.store (30); the loopback
+                                    # handler then takes tier.disk (35)
     ("tier.disk",      35, False),  # DiskPrefixStore size accounting
     # -- cache plane ----------------------------------------------------
     ("cache.grammar",  40, False),  # grammar-table cache

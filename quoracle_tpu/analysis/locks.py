@@ -110,6 +110,9 @@ KNOWN_ATTR_TYPES: dict = {
     ("BatchedSpeculator", "target"): "GenerateEngine",
     ("BatchedSpeculator", "draft"): "GenerateEngine",
     ("RadixPrefixCache", "store"): "SessionStore",
+    ("TierManager", "prefixd"): "PrefixdClient",
+    ("PrefixdClient", "transport"): "Transport",
+    ("FabricPeer", "handoff"): "KVHandoff",
 }
 
 

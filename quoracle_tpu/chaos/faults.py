@@ -47,6 +47,15 @@ cluster.decode          ClusterPlane._decode_on (decode-       crash, slow
                         replica death mid-row → envelope
                         re-place)
 handoff.export          KVHandoff.export                       fail
+fabric.send             fabric/transport.Transport.request     drop, delay,
+                        (per attempt — the bounded retry       corrupt
+                        absorbs a flap; a corrupt frame is
+                        rejected by the RECEIVER's crc
+                        boundary end-to-end)
+fabric.prefixd          fabric/prefixd.PrefixdClient           unavailable,
+                        (fetch/publish degrade to local-       slow
+                        only — warm-start becomes prefill,
+                        never an error)
 ======================  =====================================  ==========
 
 ``crash`` kinds raise :class:`InjectedFault` out of ``fire()`` — a
@@ -94,6 +103,12 @@ INJECTION_POINTS: dict = {
                       "handoff landed",
     "handoff.export": "prefill-side handoff export failure (cold "
                       "re-prefill degrade)",
+    "fabric.send": "peer link fault per wire attempt — drop / delay / "
+                   "corrupt-frame (the receiver's crc boundary rejects "
+                   "it; bounded retry absorbs transient flaps)",
+    "fabric.prefixd": "fleet prefix service unavailable / slow — the "
+                      "read-through client degrades to local tiers "
+                      "and cold prefill",
 }
 
 

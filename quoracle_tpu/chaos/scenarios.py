@@ -28,6 +28,10 @@ engines. The registry:
   drift_storm         member garbage/crash feeding PR 5 drift detection
   hbm_pressure_churn  forced demote churn + restore failures + a
                       compile-key poisoning storm
+  fabric_partition    peer links flap mid-handoff over the loopback
+                      fabric (ISSUE 12) — drops and corrupt frames;
+                      bounded retry absorbs the flap or the row
+                      degrades/re-places structurally
 """
 
 from __future__ import annotations
@@ -582,8 +586,109 @@ class HbmPressureChurn(Scenario):
         return out
 
 
+# ---------------------------------------------------------------------------
+# 6. Fabric partition (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+
+class FabricPartition(Scenario):
+    """Three replica "processes" (1 prefill + 2 decode FabricPeers)
+    joined to a front door over loopback transports — every byte rides
+    the real wire codec — while the peer links FLAP: frames drop and
+    corrupt mid-handoff. The transport's bounded retry must absorb
+    transient faults; persistent ones must degrade structurally (cold
+    re-prefill, envelope re-place onto a survivor, or a structured
+    failure naming peer + phase) — and every surviving row must be
+    BIT-IDENTICAL to the fault-free run. No silent loss, ever."""
+
+    name = "fabric_partition"
+    description = ("peer link flap (drop + corrupt frames) over the "
+                   "loopback fabric mid-handoff")
+
+    N_ROWS = 4
+
+    def build(self, ctx: dict) -> None:
+        from quoracle_tpu.serving.cluster import RemoteReplica
+        from quoracle_tpu.serving.fabric.frontdoor import FabricPlane
+        from quoracle_tpu.serving.fabric.peer import FabricPeer
+        from quoracle_tpu.serving.fabric.transport import (
+            LoopbackTransport,
+        )
+        peers = [
+            FabricPeer.build([MEMBER], role="prefill",
+                             replica_id="prefill-0", continuous_chunk=8),
+            FabricPeer.build([MEMBER], role="decode",
+                             replica_id="decode-1", continuous_chunk=8),
+            FabricPeer.build([MEMBER], role="decode",
+                             replica_id="decode-2", continuous_chunk=8),
+        ]
+        plane = FabricPlane([
+            RemoteReplica(LoopbackTransport(p.handle, p.replica_id,
+                                            backoff_ms=5.0))
+            for p in peers])
+        ctx["plane"] = plane
+        ctx["peers"] = peers
+        ctx["backends"] = [plane] + peers
+
+    def rules(self, ctx: dict) -> list:
+        # bounded fault families: the flap must be survivable by
+        # design — a permanently partitioned fleet tests mark-failed,
+        # not recovery. start=2 skips the build-time hellos so the
+        # faults land on serving traffic (handoff legs included).
+        return [
+            FaultRule("fabric.send", "drop", prob=0.5, start=2,
+                      max_fires=5),
+            FaultRule("fabric.send", "corrupt", prob=0.6, start=3,
+                      max_fires=5),
+            FaultRule("fabric.send", "delay", prob=0.25, delay_ms=10,
+                      start=2),
+        ]
+
+    def traffic(self, ctx: dict, phase: str) -> dict:
+        plane = ctx["plane"]
+        results = []
+        for i in range(self.N_ROWS):
+            results += plane.query([_req(
+                _msgs(f"fabric row {i}: explain link-flap recovery"),
+                cj=(i == 3), max_tokens=10)])
+        return {"submitted": self.N_ROWS, "results": results,
+                "eq": results}
+
+    def check(self, ctx, clean, storm, plan, flight_slice) -> list:
+        plane = ctx["plane"]
+        retried = sum(p.transport.stats()["retried"]
+                      for p in plane.peers)
+        survivors = sum(1 for r in storm["results"]
+                        if getattr(r, "ok", False))
+        recovered = (retried >= 1 or plane.replaced >= 1
+                     or plane.cold_failovers >= 1)
+        out = [
+            inv.no_silent_loss(storm["submitted"], storm["results"],
+                               backends=ctx["peers"]),
+            inv.structured_failures(storm["results"]),
+            inv.temp0_equality(clean["eq"], storm["eq"]),
+            inv.lockdep_clean(),
+            inv.fault_schedule(plan, flight_slice),
+            inv.InvariantResult(
+                "flap_absorbed_or_degraded",
+                recovered if plan.schedule() else True,
+                f"retried={retried} replaced={plane.replaced} "
+                f"cold_failovers={plane.cold_failovers} "
+                f"survivors={survivors}/{len(storm['results'])}"),
+        ]
+        storm["evidence"] = {
+            "retried": retried,
+            "replaced": plane.replaced,
+            "cold_failovers": plane.cold_failovers,
+            "dead_peers": [p.replica_id for p in plane.peers
+                           if not p.alive],
+            "survivors": survivors,
+        }
+        return out
+
+
 SCENARIOS: dict = {
     sc.name: sc for sc in (TrafficStorm, KillMidHandoff,
                            RestartWarmStart, DriftStorm,
-                           HbmPressureChurn)
+                           HbmPressureChurn, FabricPartition)
 }

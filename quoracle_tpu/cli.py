@@ -81,6 +81,10 @@ async def cmd_run(args: argparse.Namespace) -> int:
                                disk_kv_gb=args.disk_kv_gb,
                                replicas=args.replicas,
                                disaggregate=args.disaggregate,
+                               fabric_listen=args.fabric_listen,
+                               fabric_peers=(args.fabric_peers.split(",")
+                                             if args.fabric_peers else None),
+                               prefixd=args.prefixd,
                                chaos_plan=args.chaos_plan))
     _attach_printer(rt)
     if pool is None and args.profile is None:
@@ -116,6 +120,10 @@ async def cmd_resume(args: argparse.Namespace) -> int:
                                disk_kv_gb=args.disk_kv_gb,
                                replicas=args.replicas,
                                disaggregate=args.disaggregate,
+                               fabric_listen=args.fabric_listen,
+                               fabric_peers=(args.fabric_peers.split(",")
+                                             if args.fabric_peers else None),
+                               prefixd=args.prefixd,
                                chaos_plan=args.chaos_plan))
     _attach_printer(rt)
     result = await rt.boot()
@@ -145,6 +153,10 @@ async def cmd_serve(args: argparse.Namespace) -> int:
         host_kv_mb=args.host_kv_mb, disk_kv_dir=args.disk_kv_dir,
         disk_kv_gb=args.disk_kv_gb,
         replicas=args.replicas, disaggregate=args.disaggregate,
+        fabric_listen=args.fabric_listen,
+        fabric_peers=(args.fabric_peers.split(",")
+                      if args.fabric_peers else None),
+        prefixd=args.prefixd,
         chaos_plan=args.chaos_plan))
     # Validate host/token BEFORE boot so a refused bind exits with a clean
     # message instead of a traceback over a half-started runtime.
@@ -252,6 +264,28 @@ def build_parser() -> argparse.ArgumentParser:
                              "speculation) tiers with KV handoff "
                              "between them; implies --replicas 2 when "
                              "unset")
+        sp.add_argument("--fabric-listen", dest="fabric_listen",
+                        default=None, metavar="[ROLE@]HOST:PORT",
+                        help="cluster fabric (serving/fabric/): serve "
+                             "this node's backend as a network replica "
+                             "peer at this address (role: prefill | "
+                             "decode | unified, default unified); the "
+                             "front door process places work here over "
+                             "the wire")
+        sp.add_argument("--fabric-peers", dest="fabric_peers",
+                        default=None, metavar="[ROLE@]HOST:PORT,...",
+                        help="cluster fabric: run this node as the "
+                             "standalone router front door over these "
+                             "remote peers (no local engines; "
+                             "SignalSnapshot poll protocol, aggregate "
+                             "admission, wire KV handoff)")
+        sp.add_argument("--prefixd", default=None, metavar="HOST:PORT",
+                        help="cluster fabric: fleet prefix service "
+                             "address — every engine tier reads "
+                             "through it, so this replica warm-starts "
+                             "from the fleet's prefixes (serve one "
+                             "with python -m quoracle_tpu.serving."
+                             "fabric.prefixd)")
         sp.add_argument("--chaos-plan", dest="chaos_plan", default=None,
                         metavar="PLAN.json",
                         help="chaos plane (quoracle_tpu/chaos): arm this "

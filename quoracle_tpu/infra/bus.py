@@ -131,6 +131,13 @@ TOPIC_CONSENSUS = "consensus:audit"
 # "cluster" key); the SSE stream tails them live so an open dashboard
 # sees a replica drop the moment the router marks it dead.
 TOPIC_CLUSTER = "cluster:events"
+# Cross-host cluster fabric (ISSUE 12): wire-layer incidents — a peer
+# link going silent/dead at the front door, frame-level rejects, a
+# degraded fleet prefix service — broadcast by serving/fabric/ and
+# ring-buffered by EventHistory (the /api/history "fabric" key); the
+# SSE stream tails them live so an open dashboard sees a partition the
+# moment the transport gives up on it.
+TOPIC_FABRIC = "fabric:events"
 
 
 def topic_agent_state(agent_id: str) -> str:

@@ -14,6 +14,7 @@ from typing import Optional
 from quoracle_tpu.analysis.lockdep import named_lock
 from quoracle_tpu.infra.bus import (
     EventBus, Subscription, TOPIC_ACTIONS, TOPIC_CLUSTER, TOPIC_CONSENSUS,
+    TOPIC_FABRIC,
     TOPIC_LIFECYCLE, TOPIC_RESOURCES, TOPIC_SERVING, TOPIC_TRACE,
 )
 
@@ -50,6 +51,7 @@ class EventHistory:
         self._resources: deque = deque(maxlen=max_logs)
         self._consensus: deque = deque(maxlen=MAX_CONSENSUS_RECORDS)
         self._cluster: deque = deque(maxlen=max_logs)
+        self._fabric: deque = deque(maxlen=max_logs)
         self._tasks: set[str] = set()
         self._lock = named_lock("history")
         self._closed = False
@@ -61,6 +63,7 @@ class EventHistory:
             bus.subscribe(TOPIC_RESOURCES, self._on_resource),
             bus.subscribe(TOPIC_CONSENSUS, self._on_consensus),
             bus.subscribe(TOPIC_CLUSTER, self._on_cluster),
+            bus.subscribe(TOPIC_FABRIC, self._on_fabric),
         ]
 
     # Agent log/message topics are per-agent; the runtime calls track_agent
@@ -137,6 +140,10 @@ class EventHistory:
         with self._lock:
             self._cluster.append(event)
 
+    def _on_fabric(self, topic: str, event: dict) -> None:
+        with self._lock:
+            self._fabric.append(event)
+
     def _on_task_message(self, topic: str, event: dict) -> None:
         # topic is "tasks:<id>:messages". Ring under the TASK key always
         # (the mailbox replay), and ALSO under the SENDER when the message
@@ -198,6 +205,13 @@ class EventHistory:
         /api/history "cluster" key."""
         with self._lock:
             return list(self._cluster)
+
+    def replay_fabric(self) -> list[dict]:
+        """Recent fabric incidents (peer death, frame rejects, prefixd
+        degrades — TOPIC_FABRIC, serving/fabric/). Backs the
+        /api/history "fabric" key."""
+        with self._lock:
+            return list(self._fabric)
 
     def replay_traces(self, trace_id: Optional[str] = None) -> list[dict]:
         """Recent finished spans (infra/telemetry.py), optionally filtered

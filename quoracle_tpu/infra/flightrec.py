@@ -285,6 +285,20 @@ FLIGHT_EVENTS: dict = {
                             "serving failure",
     "router_all_shed": "every eligible replica shed a submission at "
                        "the cluster front door",
+    # cluster fabric (ISSUE 12, serving/fabric/)
+    "fabric_frame_reject": "a wire frame was rejected at the codec "
+                           "boundary (crc / truncation / magic / "
+                           "version skew) — corrupt bytes never adopted",
+    "fabric_peer_dead": "the front door marked a remote peer failed "
+                        "(silent signals or exhausted transport "
+                        "retries); its rows re-place through retained "
+                        "envelopes",
+    "fabric_handoff_wire": "a HandoffEnvelope crossed the wire "
+                           "(prefill peer → front door → decode peer), "
+                           "with byte size and per-leg latency",
+    "fabric_prefixd_degraded": "the fleet prefix-service client "
+                               "degraded a fetch/publish to local-only "
+                               "after a transport failure",
     # consensus quality
     "model_health_drift": "EWMA drift detector tripped for a member",
     # chaos plane (ISSUE 11, chaos/faults.py + chaos/scenarios.py)

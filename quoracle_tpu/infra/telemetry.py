@@ -773,6 +773,44 @@ ROUTER_SIGNAL_AGE_MS = METRICS.histogram(
     "age of the per-replica admission signal snapshot at placement time "
     "(ms) — large values mean the router is steering on stale load data")
 
+# -- cluster fabric (ISSUE 12) -----------------------------------------------
+# Wire-layer instruments (serving/fabric/): every cross-host exchange —
+# handoffs, placements, prefix fetches — is one framed request/response,
+# so the fabric's health is legible as request/retry/reject series plus
+# an RTT histogram per operation.
+FABRIC_REQUESTS_TOTAL = METRICS.counter(
+    "quoracle_fabric_requests_total",
+    "fabric wire requests by op (serve | prefill | decode | signals | "
+    "admit | prefix_get | prefix_put | hello | stats | ...) and status "
+    "(ok | error | unreachable)")
+FABRIC_RTT_MS = METRICS.histogram(
+    "quoracle_fabric_rtt_ms",
+    "round-trip latency (ms) of one fabric request by op — includes "
+    "retries/backoff, so a flapping link widens this tail before it "
+    "trips unreachable")
+FABRIC_RETRIES_TOTAL = METRICS.counter(
+    "quoracle_fabric_retries_total",
+    "fabric request retry attempts by op — a rising rate means a lossy "
+    "or flapping peer link the bounded backoff is still absorbing")
+FABRIC_FRAME_REJECTS_TOTAL = METRICS.counter(
+    "quoracle_fabric_frame_rejects_total",
+    "wire frames rejected at the codec boundary, by reason (crc | "
+    "truncated | magic | version | oversize) — corruption and version "
+    "skew are rejected structurally, never adopted")
+FABRIC_BYTES_TOTAL = METRICS.counter(
+    "quoracle_fabric_bytes_total",
+    "bytes moved over fabric TCP transports, by direction "
+    "(sent | received)")
+FABRIC_PEERS = METRICS.gauge(
+    "quoracle_fabric_peers",
+    "remote peers registered at the fabric front door, by role "
+    "(prefill | decode | unified) and liveness (alive | dead)")
+FABRIC_PREFIXD_TOTAL = METRICS.counter(
+    "quoracle_fabric_prefixd_total",
+    "fleet prefix-service client operations, by op (get | put) and "
+    "status (hit | miss | stored | dup | error) — the error rate is "
+    "the prefixd-unavailable alert input")
+
 # -- chaos plane (ISSUE 11) --------------------------------------------------
 # Deterministic fault injection (chaos/faults.py) + the scenario harness
 # (chaos/scenarios.py): every fired fault and every machine-checked

@@ -661,7 +661,11 @@ class SessionStore:
         a restarted process warm-starts from these prefixes."""
         with self.lock:
             added = self.prefix_cache.insert(tokens, pages)
-            if (self.tier is not None and self.tier.disk is not None):
+            # durable targets: the local disk store and/or the fleet
+            # prefix service (ISSUE 12) — persist_block fans out to both
+            if (self.tier is not None
+                    and (self.tier.disk is not None
+                         or self.tier.prefixd is not None)):
                 for j in range(len(tokens) // self.page):
                     if j < len(pages) and pages[j]:
                         self.tier.persist_block(

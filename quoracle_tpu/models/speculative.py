@@ -319,11 +319,22 @@ class SpeculativeDecoder:
 
     def session_tokens(self, session_id: str) -> Optional[list]:
         """The session's resident conversation ids, or None — mirrors
-        GenerateEngine.session_tokens so callers can splice prompts
-        against whichever store holds the session."""
+        GenerateEngine.session_tokens EXACTLY so callers splice the next
+        round's prompt identically against whichever store holds the
+        session. Engine parity detail: on a "length" finish the final
+        emitted token was sampled but never forwarded (no KV), and the
+        engine's store-back retains only KV-valid ids — so the view
+        drops ctx's trailing pending token for length-finished sessions
+        (a "stop" finish already popped its unforwarded terminal).
+        Splicing from a different id set than the engine would let the
+        next prompt's BPE merge differently and silently fork temp-0
+        bits between the speculative and vanilla paths."""
         with self.lock:
             s = self._sessions.get(session_id)
-            return list(s["ctx"]) if s else None
+            if s is None:
+                return None
+            ctx = s["ctx"]
+            return list(ctx[:-1] if s.get("finish") == "length" else ctx)
 
     def generate(self, prompt, *, max_new_tokens: int = 128,
                  temperature: float = 0.0, top_p: float = 1.0,
@@ -533,6 +544,7 @@ class SpeculativeDecoder:
                 "t": tcache._replace(lens=norm),
                 "d": dcache._replace(lens=norm),
                 "ctx": ctx_out, "cache_len": cache_len,
+                "finish": finish,
             }
         return SpecResult(
             token_ids=emitted,

@@ -105,6 +105,57 @@ def replica_device_groups(
     return groups
 
 
+def host_layout(n_hosts: int, chips_per_host: int,
+                tp: Optional[int] = None,
+                fsdp: Optional[int] = None) -> dict:
+    """Canonical dp/fsdp/tp sizing for an ``n_hosts x chips_per_host``
+    deployment (ISSUE 12; SNIPPETS.md [2]/[3], PAPERS.md "Scalable
+    Training of Language Models using JAX pjit and TPUv4"): tp stays
+    INSIDE a host (its collectives ride ICI every step), fsdp spans the
+    hosts (its all-gathers amortize over a layer, so DCN-class links
+    carry them), and dp takes whatever remains. Returns
+    ``{"dp", "fsdp", "tp", "n_hosts", "chips_per_host", "total"}``
+    with ``dp * fsdp * tp == n_hosts * chips_per_host``."""
+    n_hosts = max(1, int(n_hosts))
+    chips_per_host = max(1, int(chips_per_host))
+    total = n_hosts * chips_per_host
+    tp = min(chips_per_host, tp or chips_per_host)
+    while chips_per_host % tp:
+        tp -= 1
+    fsdp = fsdp if fsdp is not None else n_hosts
+    fsdp = max(1, min(fsdp, total // tp))
+    while (total // tp) % fsdp:
+        fsdp -= 1
+    dp = total // (tp * fsdp)
+    return {"dp": dp, "fsdp": fsdp, "tp": tp, "n_hosts": n_hosts,
+            "chips_per_host": chips_per_host, "total": total}
+
+
+def make_host_mesh(n_hosts: int, chips_per_host: int,
+                   tp: Optional[int] = None,
+                   fsdp: Optional[int] = None,
+                   devices: Optional[Sequence] = None) -> Mesh:
+    """A ("dp", "fsdp", "tp") mesh laid out HOST-MAJOR per
+    :func:`host_layout`: the fastest-varying axis (tp) walks one host's
+    chips, so device i*chips_per_host..(i+1)*chips_per_host-1 — host
+    i's local devices in a multi-process jax.devices() ordering — hold
+    whole tp groups, and dp/fsdp boundaries land on host boundaries
+    wherever the layout allows. SPMD jobs (training, dryruns) shard
+    over it; the serving plane stays host-local by design
+    (runtime.py) and sizes itself with :func:`pool_sizing`'s ``hosts``
+    dimension instead."""
+    lay = host_layout(n_hosts, chips_per_host, tp=tp, fsdp=fsdp)
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) < lay["total"]:
+        raise ValueError(
+            f"host mesh needs {lay['total']} devices "
+            f"({n_hosts} hosts x {chips_per_host}); only "
+            f"{len(devs)} visible")
+    arr = np.array(devs[:lay["total"]]).reshape(
+        lay["dp"], lay["fsdp"], lay["tp"])
+    return Mesh(arr, axis_names=("dp", "fsdp", "tp"))
+
+
 V5E_HBM_BYTES = 16 * 1024 ** 3          # 16 GiB per v5e chip (public spec)
 POOL_TAIL_RESERVE = 1.25 * 1024 ** 3    # activations + compiled programs +
                                         # grammar tables + fragmentation
@@ -136,7 +187,8 @@ def pool_sizing(pool: Sequence[str], n_devices: int = 8,
                 disk_kv_gb: float = 0.0,
                 page: int = 128,
                 replicas: int = 1,
-                disaggregate: bool = False) -> dict:
+                disaggregate: bool = False,
+                hosts: int = 1) -> dict:
     """Explicit HBM budget for a model pool on a v5e sub-mesh partition
     (VERDICT r4 item 4): per member — chips (= recommended_tp), bf16
     weight bytes per chip, the page-pool bytes left after the tail
@@ -165,6 +217,15 @@ def pool_sizing(pool: Sequence[str], n_devices: int = 8,
     window each, summed over the role's replicas; prefill replicas hold
     sessions only transiently — pages hibernate out at handoff — so
     steady-state resident capacity is attributed to the decode tier).
+
+    With ``hosts`` > 1 (ISSUE 12, serving/fabric/) the plan answers
+    "N hosts x M chips" instead of assuming one device set:
+    ``n_devices`` becomes PER-HOST chips, replicas stay HOST-LOCAL
+    (serving never spans a collective across hosts — the fabric wire is
+    the only cross-host coupling), and a ``hosts`` block reports
+    replicas-per-host packing, the host count the topology needs, and
+    the canonical dp/fsdp/tp layout (:func:`host_layout`) an SPMD job
+    of the same footprint would shard over.
 
     Returns {"members": [...], "chips_used", "fits", "hbm_per_chip"};
     ``fits`` is False when the pool needs more chips than the slice has
@@ -209,16 +270,38 @@ def pool_sizing(pool: Sequence[str], n_devices: int = 8,
             },
             "fits": m_fits,
         })
-    fits = fits and used * max(1, replicas) <= n_devices
+    hosts = max(1, int(hosts))
+    total_devices = hosts * n_devices
+    fits = fits and used * max(1, replicas) <= total_devices
     out = {"members": members, "chips_used": used * max(1, replicas),
            "n_devices": n_devices, "fits": fits,
            "hbm_per_chip_gb": round(hbm_per_chip / 1024 ** 3, 2),
            "tail_reserve_gb": round(POOL_TAIL_RESERVE / 1024 ** 3, 2),
            "host_kv_mb_per_member": host_kv_mb}
+    if hosts > 1:
+        # replicas are host-local: a replica's engines never issue a
+        # cross-host collective, so packing is per-host chips / chips
+        # per replica, and the host count the topology needs follows
+        per_host = n_devices // used if used else 0
+        hosts_needed = (-(-max(1, replicas) // per_host) if per_host
+                        else hosts + 1)
+        fits = fits and per_host >= 1 and hosts_needed <= hosts
+        out["fits"] = fits
+        out["hosts"] = {
+            "hosts": hosts,
+            "chips_per_host": n_devices,
+            "total_chips": total_devices,
+            "replicas_per_host": per_host,
+            "hosts_needed": hosts_needed,
+            "fits": per_host >= 1 and hosts_needed <= hosts,
+            "layout": host_layout(hosts, n_devices,
+                                  tp=max((m["tp"] for m in members),
+                                         default=1)),
+        }
     if replicas > 1:
         out["replica_tiers"] = _replica_tiers(
-            list(pool), members, used, n_devices, replicas, disaggregate,
-            hbm_per_chip, host_kv_mb)
+            list(pool), members, used, total_devices, replicas,
+            disaggregate, hbm_per_chip, host_kv_mb)
     return out
 
 
@@ -361,6 +444,11 @@ def _main(argv=None) -> int:
                          "(serving/cluster.py)")
     ap.add_argument("--disaggregate", action="store_true",
                     help="split replicas into prefill/decode tiers")
+    ap.add_argument("--hosts", type=int, default=1,
+                    help="cross-host fabric topology (ISSUE 12): plan "
+                         "over N hosts x --devices chips each; "
+                         "replicas stay host-local, the wire is the "
+                         "only cross-host coupling")
     args = ap.parse_args(argv)
     if args.pool:
         pool = args.pool.split(",")
@@ -370,7 +458,8 @@ def _main(argv=None) -> int:
     plan = pool_sizing(pool, args.devices, host_kv_mb=args.host_kv_mb,
                        disk_kv_gb=args.disk_kv_gb,
                        replicas=args.replicas,
-                       disaggregate=args.disaggregate)
+                       disaggregate=args.disaggregate,
+                       hosts=args.hosts)
     print(json.dumps(plan, indent=2))
     return 0 if plan["fits"] else 1
 

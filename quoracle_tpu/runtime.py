@@ -265,6 +265,24 @@ class RuntimeConfig:
     # canary. None (the default) injects nothing and costs one
     # attribute read per seam hit.
     chaos_plan: Optional[str] = None
+    # Cross-host cluster fabric (ISSUE 12, serving/fabric/). Three
+    # process roles, mutually composable:
+    #   fabric_peers  — this node is the standalone ROUTER FRONT DOOR:
+    #                   no local engines; serve through a FabricPlane
+    #                   over these "[role@]host:port" peers (the
+    #                   SignalSnapshot poll protocol drives placement
+    #                   and aggregate admission).
+    #   fabric_listen — this node is a REPLICA PEER: serve the local
+    #                   backend over the wire at "[role@]host:port"
+    #                   (role prefill|decode|unified; default unified)
+    #                   beside its normal local serving.
+    #   prefixd       — "host:port" of the fleet prefix service: every
+    #                   engine tier gets a read-through client, so this
+    #                   replica warm-starts from the fleet's prefixes,
+    #                   not only its own disk.
+    fabric_peers: Optional[list[str]] = None
+    fabric_listen: Optional[str] = None
+    prefixd: Optional[str] = None
 
 
 class Runtime:
@@ -284,6 +302,9 @@ class Runtime:
         self.escrow = Escrow()
         self.costs = CostRecorder(escrow=self.escrow, events=self.events,
                                   persist_fn=self.store.persist_cost)
+        # Fabric peer server (ISSUE 12, --fabric-listen): set by
+        # _build_backend when this node serves its backend over the wire
+        self._fabric_peer = None
         self.backend = backend or self._build_backend(config)
         # serving telemetry (prefix-cache counters, phase timings) rides
         # the bus into EventHistory's ring + the dashboard SSE tail
@@ -366,16 +387,31 @@ class Runtime:
             if (config.checkpoints or config.tp or config.draft_map
                     or config.coordinator_address or config.num_processes
                     or config.process_id is not None
-                    or config.replicas > 1 or config.disaggregate):
+                    or config.replicas > 1 or config.disaggregate
+                    or config.fabric_peers or config.fabric_listen
+                    or config.prefixd):
                 # Silent fallback to mock would make the user believe their
-                # checkpoint (or cluster, or speculative draft) is serving
+                # checkpoint (or cluster, or fabric peer) is serving
                 # while scripted responses come back.
                 raise ValueError(
                     "--checkpoint/--tp/--draft/--coordinator/"
                     "--num-processes/--process-id/--replicas/"
-                    "--disaggregate require --backend tpu "
+                    "--disaggregate/--fabric-listen/--fabric-peers/"
+                    "--prefixd require --backend tpu "
                     f"(backend is {config.backend!r})")
             return MockBackend()
+        if config.fabric_peers:
+            # The standalone router front door (ISSUE 12): no local
+            # engines, no device runtime — placement, aggregate
+            # admission, and the wire handoff flow over remote peers.
+            if (config.replicas > 1 or config.disaggregate
+                    or config.fabric_listen):
+                raise ValueError(
+                    "--fabric-peers is the front-door role: it excludes "
+                    "--replicas/--disaggregate/--fabric-listen (peers "
+                    "carry the engines)")
+            from quoracle_tpu.serving.fabric.frontdoor import FabricPlane
+            return FabricPlane.connect(list(config.fabric_peers))
         from quoracle_tpu.utils.compile_cache import (
             enable_compilation_cache,
         )
@@ -438,6 +474,12 @@ class Runtime:
                 pool_submeshes, replica_device_groups,
             )
             from quoracle_tpu.serving.cluster import ClusterPlane
+            if config.fabric_listen:
+                raise ValueError(
+                    "--fabric-listen serves ONE replica backend over "
+                    "the wire; run one peer process per replica "
+                    "instead of combining it with --replicas/"
+                    "--disaggregate")
             n_rep = max(config.replicas,
                         2 if config.disaggregate else 1)
             submeshes_by_replica = None
@@ -446,7 +488,7 @@ class Runtime:
                     pool_submeshes(len(pool), tp=config.tp, devices=grp)
                     for grp in replica_device_groups(
                         n_rep, jax.local_devices())]
-            return ClusterPlane.build(
+            built = ClusterPlane.build(
                 pool, replicas=n_rep,
                 disaggregate=config.disaggregate, seed=config.seed,
                 submeshes_by_replica=submeshes_by_replica,
@@ -457,14 +499,73 @@ class Runtime:
                 disk_kv_dir=config.disk_kv_dir,
                 disk_kv_gb=config.disk_kv_gb,
                 embed_model=config.embed_model)
-        return TPUBackend(pool, seed=config.seed, draft_k=config.draft_k,
-                          embed_model=config.embed_model,
-                          submeshes=submeshes,
-                          draft_map=draft_map or None,
-                          continuous=config.continuous,
-                          qos=qos, host_kv_mb=config.host_kv_mb,
-                          disk_kv_dir=config.disk_kv_dir,
-                          disk_kv_gb=config.disk_kv_gb)
+        else:
+            built = TPUBackend(
+                pool, seed=config.seed, draft_k=config.draft_k,
+                embed_model=config.embed_model,
+                submeshes=submeshes,
+                draft_map=draft_map or None,
+                continuous=config.continuous,
+                qos=qos, host_kv_mb=config.host_kv_mb,
+                disk_kv_dir=config.disk_kv_dir,
+                disk_kv_gb=config.disk_kv_gb)
+        if config.prefixd:
+            self._attach_prefixd(built, config.prefixd)
+        if config.fabric_listen:
+            self._fabric_peer = self._listen_fabric(built, config)
+        return built
+
+    @staticmethod
+    def _attach_prefixd(backend, addr: str) -> None:
+        """Wire the fleet prefix service (ISSUE 12) into every pool
+        engine's tier — one shared TCP transport, one read-through
+        client per engine signature."""
+        from quoracle_tpu.serving.fabric.prefixd import PrefixdClient
+        from quoracle_tpu.serving.fabric.transport import (
+            TcpTransport, parse_addr,
+        )
+        _, host, port = parse_addr(addr)
+        transport = TcpTransport(host, port, peer_name="prefixd",
+                                 lock_name="fabric.prefixd")
+        reps = getattr(backend, "replicas", None)
+        backends = ([rep.backend for rep in reps]
+                    if reps is not None else [backend])
+        for b in backends:
+            for spec in b.pool:
+                eng = b.engines[spec]
+                tier = getattr(eng.sessions, "tier", None)
+                if tier is None:
+                    tier = eng.attach_tier(host_mb=256)
+                tier.attach_prefixd(
+                    PrefixdClient(transport, eng.kv_signature()))
+
+    @staticmethod
+    def _listen_fabric(backend, config: RuntimeConfig):
+        """Serve this node's backend as a fabric peer (ISSUE 12,
+        --fabric-listen "[role@]host:port"): the front door process
+        places prefill/decode/whole-request work here over the wire."""
+        from quoracle_tpu.serving.fabric.peer import FabricPeer
+        from quoracle_tpu.serving.fabric.transport import parse_addr
+        role, host, port = parse_addr(config.fabric_listen)
+        role = role or "unified"
+        if role == "prefill":
+            for spec in backend.pool:
+                backend.engines[spec].role = "prefill"
+        elif role == "decode":
+            for spec in backend.pool:
+                backend.engines[spec].role = "decode"
+        # handoff needs a KV tier on every pool engine (the transport
+        # medium); a bare backend gets the default host tier
+        for spec in backend.pool:
+            eng = backend.engines[spec]
+            if getattr(eng.sessions, "tier", None) is None:
+                eng.attach_tier(host_mb=256)
+        peer = FabricPeer(backend, replica_id=f"{role}@{host}:{port}",
+                          role=role)
+        peer.listen(host, port)
+        logger.info("fabric peer %s serving at %s", peer.replica_id,
+                    peer._server.addr)
+        return peer
 
     async def boot(self) -> dict:
         """Boot-time revival of persisted running tasks (reference
@@ -478,6 +579,9 @@ class Runtime:
         self.close()
 
     def close(self) -> None:
+        if self._fabric_peer is not None and \
+                self._fabric_peer._server is not None:
+            self._fabric_peer._server.close()
         self.watchdog.close()
         METRICS.remove_collector(self._resource_collector)
         TRACER.remove_sink(self._trace_sink)

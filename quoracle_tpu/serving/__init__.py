@@ -29,9 +29,14 @@ module allowed to import models:
   door: session affinity, signal-driven placement, aggregate shedding.
 * :mod:`quoracle_tpu.serving.handoff` — prefill→decode KV handoff:
   PR 7's hibernate/restore split across two engines, signature-checked.
+* :mod:`quoracle_tpu.serving.fabric` — the cross-host cluster fabric
+  (ISSUE 12): wire codec + transports, the FabricPeer/FabricPlane
+  process roles, and the fleet prefix service — replicas as network
+  peers with the same temp-0 bit-equality gate.
 
-The cluster trio is imported lazily (see bottom) — importing serving.qos
-from the scheduler must not drag jax-heavy models code in transitively.
+The cluster trio (and the fabric package) is imported lazily (see
+bottom) — importing serving.qos from the scheduler must not drag
+jax-heavy models code in transitively.
 """
 
 from quoracle_tpu.serving.admission import (       # noqa: F401
@@ -63,4 +68,7 @@ def __getattr__(name: str):
     if name in ("KVHandoff", "HandoffEnvelope", "HandoffError"):
         from quoracle_tpu.serving import handoff
         return getattr(handoff, name)
+    if name in ("FabricPlane", "FabricPeer"):
+        from quoracle_tpu.serving import fabric
+        return getattr(fabric, name)
     raise AttributeError(name)

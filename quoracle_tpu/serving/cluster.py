@@ -99,6 +99,150 @@ class Replica:
         self.backend.close()
 
 
+class RemoteReplica:
+    """A replica that is a NETWORK PEER (ISSUE 12, serving/fabric/):
+    the same replica interface — ``replica_id`` / ``role`` / ``alive``
+    / ``backend`` — over a fabric transport to a FabricPeer process,
+    so the ClusterRouter's placement, affinity, liveness, and
+    aggregate-admission logic run unchanged whether a replica lives in
+    this process or on another host. ``backend`` is a thin facade:
+    ``query`` delegates whole requests over the wire (the unified /
+    affinity / failover paths), ``qos_controller`` is the
+    SignalSnapshot poll proxy the router scores and admits through.
+    The split prefill→handoff→decode flow rides the dedicated
+    ``prefill``/``adopt_decode`` ops (fabric/frontdoor.FabricPlane
+    drives those)."""
+
+    def __init__(self, transport, replica_id: Optional[str] = None,
+                 role: Optional[str] = None):
+        from quoracle_tpu.serving.fabric import wire
+        from quoracle_tpu.serving.fabric.frontdoor import (
+            RemoteSignalsProxy,
+        )
+        self.transport = transport
+        _, payload = transport.request(wire.MSG_HELLO,
+                                       wire.encode_json({}))
+        hello = wire.decode_json(payload)
+        self.replica_id = replica_id or hello.get("replica_id", "peer")
+        self.role = role or hello.get("role", "unified")
+        self.pool = list(hello.get("pool") or ())
+        self.signatures = dict(hello.get("signatures") or {})
+        self.alive = True
+        self._signals = RemoteSignalsProxy(transport)
+        self.backend = _RemoteBackendFacade(self)
+
+    # -- wire ops ---------------------------------------------------------
+
+    def serve(self, request):
+        from quoracle_tpu.serving.fabric import wire
+        _, payload = self.transport.request(
+            wire.MSG_SERVE,
+            wire.encode_json(wire.request_to_dict(request)))
+        return wire.result_from_dict(wire.decode_json(payload))
+
+    def prefill(self, request, handoff_id: str) -> tuple[dict, bytes]:
+        """The prefill phase on this peer: returns (meta, envelope
+        bytes) — or (meta-with-"result", b"") for rows that never
+        dispatched (overflow / deadline)."""
+        from quoracle_tpu.serving.fabric import wire
+        _, payload = self.transport.request(
+            wire.MSG_PREFILL,
+            wire.encode_json({
+                "request": wire.request_to_dict(request),
+                "handoff_id": handoff_id}))
+        meta, body = wire.unpack_blob(payload)
+        return meta, bytes(body)
+
+    def adopt_decode(self, meta: dict, env_bytes: bytes,
+                     owns: bool = False) -> dict:
+        """Ship the retained envelope bytes + row metadata; the peer
+        gates on its own kv_signature BEFORE parsing a page byte,
+        adopts, and decodes the continuation through its production
+        batcher."""
+        from quoracle_tpu.serving.fabric import wire
+        header = {"handoff_id": meta["handoff_id"],
+                  "model_spec": meta["model_spec"],
+                  "prompt": meta["prompt"], "row": meta["row"],
+                  "g1": meta["g1"], "owns": owns}
+        _, payload = self.transport.request(
+            wire.MSG_DECODE, wire.pack_blob(header, env_bytes))
+        return wire.decode_json(payload)
+
+    def session_resident(self, request) -> bool:
+        """Affinity guard: does the peer still hold this session (LRU
+        churn can outlive the affinity entry)? Unreachable peers answer
+        False — fresh placement handles them."""
+        from quoracle_tpu.serving.fabric import wire
+        if not request.session_id:
+            return False
+        try:
+            _, payload = self.transport.request(
+                wire.MSG_META,
+                wire.encode_json({"op": "session_resident",
+                                  "model_spec": request.model_spec,
+                                  "session_id": request.session_id}))
+        except wire.WireError:
+            return False
+        return bool(wire.decode_json(payload).get("value"))
+
+    def drop_session(self, session_id: str) -> None:
+        from quoracle_tpu.serving.fabric import wire
+        self.transport.request(
+            wire.MSG_DROP_SESSION,
+            wire.encode_json({"session_id": session_id}))
+
+    def meta(self, op: str, **kw):
+        from quoracle_tpu.serving.fabric import wire
+        _, payload = self.transport.request(
+            wire.MSG_META, wire.encode_json({"op": op, **kw}))
+        return wire.decode_json(payload).get("value")
+
+    def embed(self, texts):
+        from quoracle_tpu.serving.fabric import wire
+        _, payload = self.transport.request(
+            wire.MSG_EMBED, wire.encode_json({"texts": list(texts)}))
+        header, body = wire.unpack_blob(payload)
+        arr = wire._array_from(body, wire._np_dtype(header["dtype"]),
+                               tuple(header["shape"]))
+        return np.copy(arr)
+
+    def stats(self) -> dict:
+        from quoracle_tpu.serving.fabric import wire
+        _, payload = self.transport.request(wire.MSG_STATS,
+                                            wire.encode_json({}))
+        return wire.decode_json(payload)
+
+    def close(self) -> None:
+        self.transport.close()
+
+
+class _RemoteBackendFacade:
+    """Just enough ``backend`` surface for the router (signals, stats),
+    ClusterPlane._delegate (query), and the resource layer (an empty
+    ``engines`` map — a remote peer attributes its own HBM)."""
+
+    def __init__(self, replica: RemoteReplica):
+        self._replica = replica
+        self.pool = list(replica.pool)
+        self.engines: dict = {}
+
+    @property
+    def qos_controller(self):
+        return self._replica._signals
+
+    def query(self, requests):
+        return [self._replica.serve(r) for r in requests]
+
+    def scheduler_stats(self) -> dict:
+        try:
+            return self._replica.stats().get("scheduler", {})
+        except Exception:                 # noqa: BLE001 — silent peer
+            return {}
+
+    def close(self) -> None:
+        self._replica.close()
+
+
 class ClusterPlane(ModelBackend):
     """N replicas + a router + a handoff broker behind the ModelBackend
     seam — the consensus/agent layers cannot tell it from a single

@@ -1,0 +1,49 @@
+"""Cross-host cluster fabric (ISSUE 12 tentpole).
+
+PR 10's ClusterPlane proved disaggregated serving inside one process;
+this package makes the replica boundary a WIRE boundary while keeping
+temp-0 bit-equality with the monolithic path. Four pieces:
+
+* :mod:`quoracle_tpu.serving.fabric.wire` — the length-prefixed,
+  crc-framed, versioned binary codec: frames, JSON control messages,
+  and the HandoffEnvelope blob whose KV signature is checked BEFORE any
+  page bytes are accepted.
+* :mod:`quoracle_tpu.serving.fabric.transport` — how frames move:
+  a threaded TCP peer with connect/read/write deadlines and bounded
+  retry-with-backoff, plus the :class:`LoopbackTransport` tier-1 runs
+  every wire path through without real sockets.
+* :mod:`quoracle_tpu.serving.fabric.prefixd` — the fleet prefix
+  service: the content-addressed DiskPrefixStore exposed over the wire
+  (GET/PUT by block hash under the model-geometry-dtype signature dir,
+  crc32-reject semantics preserved) with a per-replica read-through
+  client wired into ``TierManager.extend_prefix``.
+* :mod:`quoracle_tpu.serving.fabric.peer` /
+  :mod:`quoracle_tpu.serving.fabric.frontdoor` — the two process
+  roles: a FabricPeer serves one replica's backend over the wire
+  (``--fabric-listen``); the FabricPlane front door places, admits,
+  and hands off across remote peers (``--fabric-peers``), running the
+  ClusterRouter as its own process over the SignalSnapshot poll
+  protocol.
+
+Everything jax-heavy is imported lazily — ``wire`` and ``transport``
+are importable dependency-free (tools/qlint.py runs without jax).
+"""
+
+
+def __getattr__(name: str):
+    if name in ("WireError", "TransportError"):
+        from quoracle_tpu.serving.fabric import wire
+        return getattr(wire, name)
+    if name in ("LoopbackTransport", "TcpTransport", "PeerServer"):
+        from quoracle_tpu.serving.fabric import transport
+        return getattr(transport, name)
+    if name in ("PrefixService", "PrefixdClient"):
+        from quoracle_tpu.serving.fabric import prefixd
+        return getattr(prefixd, name)
+    if name == "FabricPeer":
+        from quoracle_tpu.serving.fabric.peer import FabricPeer
+        return FabricPeer
+    if name == "FabricPlane":
+        from quoracle_tpu.serving.fabric.frontdoor import FabricPlane
+        return FabricPlane
+    raise AttributeError(name)

@@ -1,0 +1,488 @@
+"""The standalone router front door (ISSUE 12 tentpole, part 3).
+
+PR 10's ClusterRouter placed traffic over in-process replicas by
+reading each admission controller's own :class:`SignalSnapshot`. This
+module runs the SAME router in its own process over REMOTE peers:
+
+* :class:`RemoteSignalsProxy` — the wire twin of a local
+  ``qos_controller``: ``signals()`` polls the peer (MSG_SIGNALS_POLL),
+  rebuilds the snapshot with the reported AGE re-anchored to the local
+  clock (monotonic timestamps do not cross processes — ages do), and
+  caches it under the router's own staleness guard so placement does
+  not pay a round trip per candidate per request. ``admit()`` crosses
+  the wire too, so the front door's aggregate shed (only when EVERY
+  eligible peer sheds, MAX retry-after propagated) runs unchanged.
+* :class:`FabricPlane` — a ModelBackend over
+  :class:`~quoracle_tpu.serving.cluster.RemoteReplica` peers: the
+  ClusterPlane request flow (affinity → role → least-loaded; split
+  prefill→handoff→decode when disaggregated) with the handoff envelope
+  retained as WIRE BYTES at the front door. A decode peer dying
+  mid-row re-places those bytes onto a survivor — the PR 10 death
+  path, now over the wire — or fails with the structured error naming
+  peer + phase. A peer whose signals go silent is scored worst-rank by
+  the router and marked failed after a bounded silence streak
+  (serving/router.py).
+
+Degraded modes mirror the in-process plane exactly: signature-mismatch
+or corrupt-frame rejects at adopt degrade to a cold re-prefill on the
+decode tier; an unreachable prefill tier degrades to cold decode-tier
+serving; all-peers-shed propagates the 429 with the escalating
+retry-after. Temp-0 outputs never move (tier-1 asserted,
+tests/test_fabric.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional, Sequence
+
+import numpy as np
+
+from quoracle_tpu.analysis.lockdep import named_lock
+from quoracle_tpu.infra.flightrec import FLIGHT
+from quoracle_tpu.infra.telemetry import (
+    CLUSTER_REQUESTS_TOTAL, FABRIC_PEERS, TRACER,
+)
+from quoracle_tpu.models.runtime import (
+    ModelBackend, QueryRequest, QueryResult,
+)
+from quoracle_tpu.serving.admission import AdmissionError, SignalSnapshot
+from quoracle_tpu.serving.cluster import ReplicaFailedError
+from quoracle_tpu.serving.fabric import wire
+from quoracle_tpu.serving.fabric.wire import (
+    MSG_ADMIT, MSG_SIGNALS_POLL, TransportError, WireError,
+)
+from quoracle_tpu.serving.router import ClusterRouter
+
+logger = logging.getLogger(__name__)
+
+# reasons that mean "this peer is gone", not "this request was refused"
+_PEER_FATAL_REASONS = frozenset({"transport", "remote"})
+
+
+class RemoteSignalsProxy:
+    """``qos_controller``-shaped facade over one peer's admission
+    controller. Snapshot polls are cached ``min_poll_s`` so placement
+    scoring N candidates costs at most one poll per peer per window;
+    ``max_age_s`` (the router's staleness guard) forces a refresh
+    through the cache exactly like it forces one through the local
+    controller's window."""
+
+    def __init__(self, transport, min_poll_s: float = 0.25):
+        self.transport = transport
+        self.min_poll_s = float(min_poll_s)
+        self._cached: Optional[SignalSnapshot] = None
+        self._cached_at = 0.0
+
+    def signals(self, max_age_s: Optional[float] = None) -> SignalSnapshot:
+        now = time.monotonic()
+        cached = self._cached
+        if cached is not None:
+            age = now - self._cached_at
+            if age < self.min_poll_s and (max_age_s is None
+                                          or cached.age_s(now) <= max_age_s):
+                return cached
+        _, payload = self.transport.request(
+            MSG_SIGNALS_POLL, wire.encode_json({"max_age_s": max_age_s}))
+        d = wire.decode_json(payload)
+        now = time.monotonic()
+        snap = SignalSnapshot(
+            ts=now,
+            refreshed_ts=now - float(d.get("age_s", 0.0)),
+            queue_depth=int(d.get("queue_depth", 0)),
+            admit_wait_p95_ms=d.get("admit_wait_p95_ms"),
+            hbm_headroom=d.get("hbm_headroom"),
+            admitted=int(d.get("admitted", 0)),
+            shed=int(d.get("shed", 0)))
+        self._cached, self._cached_at = snap, now
+        return snap
+
+    def admit(self, tenant: str = "default", priority=None,
+              deadline_s: Optional[float] = None):
+        """Remote admission: sheds reconstruct as the peer's structured
+        AdmissionError (wire.raise_remote_error); an UNREACHABLE peer
+        counts as an overload shed — it cannot admit anything, and the
+        silence path is already marching it toward mark-failed."""
+        from quoracle_tpu.serving.admission import OverloadedError
+        from quoracle_tpu.serving.qos import coerce_priority
+        left = None
+        if deadline_s is not None:
+            left = max(0.0, (deadline_s - time.monotonic()) * 1000)
+        try:
+            _, payload = self.transport.request(
+                MSG_ADMIT, wire.encode_json({
+                    "tenant": tenant,
+                    "priority": (int(priority) if priority is not None
+                                 else None),
+                    "deadline_ms_left": left}))
+        except TransportError as e:
+            raise OverloadedError(
+                f"peer unreachable at admission: {e}",
+                retry_after_ms=1000, tenant=tenant) from None
+        return coerce_priority(wire.decode_json(payload).get("priority"))
+
+
+class FabricPlane(ModelBackend):
+    """N remote peers + the router + a retained-envelope-bytes ledger
+    behind the ModelBackend seam — the standalone front door process
+    (``--fabric-peers``). The consensus/agent/web layers cannot tell it
+    from a single TPUBackend, which is the point."""
+
+    def __init__(self, peers: Sequence, router: Optional[ClusterRouter] = None):
+        if not peers:
+            raise ValueError("a fabric plane needs at least one peer")
+        self.peers = list(peers)
+        self.router = router or ClusterRouter()
+        for p in self.peers:
+            self.router.register(p)
+        self.disaggregated = any(p.role == "prefill" for p in self.peers)
+        if self.disaggregated and not any(p.role == "decode"
+                                          for p in self.peers):
+            raise ValueError("fabric has prefill peers but no decode "
+                             "peer")
+        self.pool = list(self.peers[0].pool)
+        self._lock = named_lock("fabric.plane")
+        self._seq = 0
+        self._bus = None
+        self._meta_cache: dict = {}       # (op, spec) -> value
+        self.wire_handoffs = 0
+        self.replaced = 0
+        self.cold_failovers = 0
+        self._refresh_peer_gauges()
+
+    @classmethod
+    def connect(cls, peer_addrs: Sequence[str], *,
+                connect_timeout: float = 2.0, io_timeout: float = 60.0,
+                retries: int = 2) -> "FabricPlane":
+        """Front door over TCP: one transport per ``[role@]host:port``
+        peer (role is confirmed — or discovered — via the hello)."""
+        from quoracle_tpu.serving.cluster import RemoteReplica
+        from quoracle_tpu.serving.fabric.transport import (
+            TcpTransport, parse_addr,
+        )
+        peers = []
+        for spec in peer_addrs:
+            role, host, port = parse_addr(spec)
+            t = TcpTransport(host, port, connect_timeout=connect_timeout,
+                             io_timeout=io_timeout, retries=retries)
+            peers.append(RemoteReplica(t, role=role))
+        return cls(peers)
+
+    def close(self) -> None:
+        for p in self.peers:
+            try:
+                p.close()
+            except Exception:             # noqa: BLE001 — best-effort
+                logger.exception("peer %s close failed", p.replica_id)
+
+    # -- bookkeeping ------------------------------------------------------
+
+    def _refresh_peer_gauges(self) -> None:
+        counts: dict = {}
+        for p in self.peers:
+            key = (p.role, "alive" if p.alive else "dead")
+            counts[key] = counts.get(key, 0) + 1
+        for role in ("prefill", "decode", "unified"):
+            for liveness in ("alive", "dead"):
+                FABRIC_PEERS.set(counts.get((role, liveness), 0),
+                                 role=role, liveness=liveness)
+
+    def _own_session_id(self) -> str:
+        with self._lock:
+            self._seq += 1
+            return f"__fabric{self._seq}"
+
+    def _broadcast(self, event: dict) -> None:
+        if self._bus is None:
+            return
+        try:
+            from quoracle_tpu.infra.bus import TOPIC_FABRIC
+            self._bus.broadcast(TOPIC_FABRIC,
+                                {"ts": time.time(), **event})
+        except Exception:                 # noqa: BLE001 — telemetry only
+            logger.exception("fabric broadcast failed")
+
+    def _mark_failed(self, peer, error: str, phase: str) -> None:
+        self.router.mark_failed(peer.replica_id, error)
+        peer.alive = False
+        self._refresh_peer_gauges()
+        FLIGHT.record("fabric_peer_dead", peer=peer.replica_id,
+                      role=peer.role, phase=phase, error=error[:200])
+        self._broadcast({"event": "peer_failed",
+                         "peer": peer.replica_id, "role": peer.role,
+                         "phase": phase, "error": error[:200]})
+
+    # -- ModelBackend -----------------------------------------------------
+
+    def query(self, requests: Sequence[QueryRequest]) -> list[QueryResult]:
+        results: list[Optional[QueryResult]] = [None] * len(requests)
+        parent = TRACER.current()
+        if len(requests) > 1:
+            from concurrent.futures import ThreadPoolExecutor
+            with ThreadPoolExecutor(
+                    max_workers=len(requests),
+                    thread_name_prefix="fabric-row") as ex:
+                list(ex.map(
+                    lambda i: self._serve_one(i, requests[i], results,
+                                              parent),
+                    range(len(requests))))
+        else:
+            for i, r in enumerate(requests):
+                self._serve_one(i, r, results, parent)
+        return [r for r in results if r is not None]
+
+    def _serve_one(self, i: int, r: QueryRequest, results: list,
+                   parent=None) -> None:
+        with TRACER.use(parent):
+            try:
+                results[i] = self._route(r)
+            except AdmissionError as e:
+                results[i] = QueryResult(
+                    model_spec=r.model_spec,
+                    error=f"admission_rejected: {e} "
+                          f"(retry_after_ms={e.retry_after_ms})")
+            except ReplicaFailedError as e:
+                results[i] = QueryResult(
+                    model_spec=r.model_spec,
+                    error=f"replica_failed: {e} "
+                          f"(replica={e.replica_id}, phase={e.phase})")
+            except Exception as e:        # noqa: BLE001 — row-level error
+                results[i] = QueryResult(
+                    model_spec=r.model_spec,
+                    error=f"fabric query failed: {e}")
+
+    def _route(self, r: QueryRequest) -> QueryResult:
+        if r.model_spec not in self.pool:
+            return QueryResult(model_spec=r.model_spec,
+                               error=f"unknown model {r.model_spec!r}",
+                               permanent_error=True)
+        if not self.disaggregated:
+            rep = self.router.place("unified", session_id=r.session_id)
+            return self._delegate(rep, r, path="unified")
+        affinity = self.router.affinity_of(r.session_id)
+        if affinity is not None and affinity.session_resident(r):
+            # decode rows stick to the peer holding their pages — the
+            # suffix prefill of a resumed conversation is a
+            # continuation on the decode peer, not tier work
+            return self._delegate(affinity, r, path="affinity")
+        return self._disagg(r)
+
+    def _delegate(self, peer, r: QueryRequest, path: str) -> QueryResult:
+        CLUSTER_REQUESTS_TOTAL.inc(replica=peer.replica_id, path=path)
+        try:
+            out = peer.serve(r)
+        except AdmissionError:
+            raise                          # a shed is not a death
+        except WireError as e:
+            self._mark_failed(peer, str(e), phase=path)
+            raise ReplicaFailedError(
+                f"peer {peer.replica_id} failed serving a {path} "
+                f"request: {e}", replica_id=peer.replica_id, phase=path)
+        if r.session_id and out.ok:
+            self.router.set_affinity(r.session_id, peer.replica_id)
+        return out
+
+    # -- the disaggregated wire flow --------------------------------------
+
+    def _disagg(self, r: QueryRequest) -> QueryResult:
+        spec = r.model_spec
+        t0 = time.monotonic()
+        pre = self.router.place("prefill")
+        hid = r.session_id or self._own_session_id()
+        owns = r.session_id is None
+        CLUSTER_REQUESTS_TOTAL.inc(replica=pre.replica_id, path="disagg")
+        try:
+            meta, env_bytes = pre.prefill(r, hid)
+        except AdmissionError:
+            raise
+        except WireError as e:
+            if e.reason in ("export_failed", "no_tier"):
+                # the peer served the prefill but could not hand it
+                # off: cold re-prefill on the decode tier — correctness
+                # never depends on the handoff succeeding
+                logger.warning("wire handoff export failed (%s); cold "
+                               "re-prefill", e)
+            else:
+                self._mark_failed(pre, str(e), phase="prefill")
+            with self._lock:
+                self.cold_failovers += 1
+            rep = self.router.place("decode", session_id=r.session_id)
+            return self._delegate(rep, r, path="failover")
+        if "result" in meta:
+            # overflow / pre-dispatch deadline: structured, nothing
+            # prefilled
+            return wire.result_from_dict(meta["result"])
+        with self._lock:
+            self.wire_handoffs += 1
+        FLIGHT.record("fabric_handoff_wire", model=spec, session=hid,
+                      src=pre.replica_id, bytes=len(env_bytes),
+                      ms=round((time.monotonic() - t0) * 1000, 2))
+        return self._decode_phase(r, meta, env_bytes, hid, owns, t0)
+
+    def _decode_phase(self, r: QueryRequest, meta: dict,
+                      env_bytes: bytes, hid: str, owns: bool, t0: float,
+                      exclude: tuple = ()) -> QueryResult:
+        spec = r.model_spec
+        dec = self.router.place("decode", exclude=exclude)
+        try:
+            d = dec.adopt_decode(meta, env_bytes, owns=owns)
+        except AdmissionError:
+            # the chosen peer shed: the front door only sheds when
+            # EVERY eligible decode peer does (the final re-raise
+            # propagates the reject with the escalated retry hint)
+            remaining = [p for p in self.router.replicas("decode")
+                         if p.replica_id not in exclude
+                         + (dec.replica_id,)]
+            if not remaining:
+                raise
+            return self._decode_phase(r, meta, env_bytes, hid, owns, t0,
+                                      exclude=exclude
+                                      + (dec.replica_id,))
+        except WireError as e:
+            if e.reason == "signature":
+                # version-skewed pair: the BYTES are rejected before
+                # the peer parsed a single page — the request is not
+                with self._lock:
+                    self.cold_failovers += 1
+                rep = self.router.place("decode",
+                                        session_id=r.session_id,
+                                        exclude=exclude)
+                return self._delegate(rep, r, path="failover")
+            self._mark_failed(dec, str(e), phase="decode")
+            survivors = self.router.alive_count("decode")
+            if survivors:
+                # re-place through the retained envelope BYTES: the
+                # surviving peer adopts the SAME prefill KV and decode
+                # reruns from the handoff point — bit-identical at
+                # temperature 0, so mid-stream peer death is invisible
+                # in the output
+                with self._lock:
+                    self.replaced += 1
+                FLIGHT.record("kv_handoff_replace", model=spec,
+                              session=hid, failed=dec.replica_id)
+                self._broadcast({"event": "row_replaced", "model": spec,
+                                 "failed_peer": dec.replica_id})
+                return self._decode_phase(
+                    r, meta, env_bytes, hid, owns, t0,
+                    exclude=exclude + (dec.replica_id,))
+            raise ReplicaFailedError(
+                f"decode peer {dec.replica_id} died mid-stream and no "
+                f"surviving decode peer could adopt the row: {e}",
+                replica_id=dec.replica_id, phase="decode")
+        CLUSTER_REQUESTS_TOTAL.inc(replica=dec.replica_id, path="disagg")
+        if not owns and r.session_id:
+            self.router.set_affinity(r.session_id, dec.replica_id)
+        res = wire.result_from_dict(d)
+        res.latency_ms = (time.monotonic() - t0) * 1000
+        return res
+
+    # -- pool-wide backend surface ---------------------------------------
+
+    @property
+    def qos_controller(self):
+        """The web edge's shed gate: the ROUTER is the fabric's
+        admission surface (sheds only when every eligible peer sheds,
+        MAX retry-after) — peers answer admission over the wire."""
+        return self.router
+
+    def attach_bus(self, bus) -> None:
+        self._bus = bus
+
+    def _meta(self, op: str, model_spec: str, cacheable: bool = True):
+        key = (op, model_spec)
+        if cacheable and key in self._meta_cache:
+            return self._meta_cache[key]
+        v = self.peers[0].meta(op, model_spec=model_spec)
+        if cacheable:
+            self._meta_cache[key] = v
+        return v
+
+    def embed(self, texts: Sequence[str]) -> list[np.ndarray]:
+        arr = self.peers[0].embed(texts)
+        return [np.asarray(row) for row in arr]
+
+    def count_tokens(self, model_spec: str, text: str) -> int:
+        return int(self.peers[0].meta("count_tokens",
+                                      model_spec=model_spec, text=text))
+
+    def context_window(self, model_spec: str) -> int:
+        return int(self._meta("context_window", model_spec))
+
+    def output_limit(self, model_spec: str) -> int:
+        return int(self._meta("output_limit", model_spec))
+
+    def drop_session(self, session_id: str,
+                     model_specs: Optional[Sequence[str]] = None) -> None:
+        for p in self.peers:
+            if p.alive:
+                try:
+                    p.drop_session(session_id)
+                except WireError:
+                    pass                  # a dead peer holds nothing
+        if model_specs is None:
+            self.router.drop_affinity(session_id)
+
+    def scheduler_stats(self) -> dict:
+        out = {}
+        for p in self.peers:
+            if not p.alive:
+                continue
+            try:
+                st = p.stats().get("scheduler", {})
+            except WireError:
+                continue
+            for spec, s in st.items():
+                out[f"{p.replica_id}/{spec}"] = s
+        return out
+
+    def fabric_stats(self) -> dict:
+        """GET /api/fabric payload: peer topology + router + wire
+        counters in one read."""
+        self._refresh_peer_gauges()
+        with self._lock:
+            counters = {"wire_handoffs": self.wire_handoffs,
+                        "replaced": self.replaced,
+                        "cold_failovers": self.cold_failovers}
+        return {
+            "enabled": True,
+            "disaggregated": self.disaggregated,
+            "pool": list(self.pool),
+            "peers": [{
+                "replica_id": p.replica_id,
+                "role": p.role,
+                "alive": p.alive,
+                "transport": p.transport.stats(),
+            } for p in self.peers],
+            "router": self.router.stats(),
+            **counters,
+        }
+
+    def watchdog_sources(self) -> list:
+        return []                          # peers watchdog themselves
+
+
+def _main(argv=None) -> int:
+    """``python -m quoracle_tpu.serving.fabric.frontdoor --peers
+    role@host:port,... [--probe]`` — connect to the fleet and print the
+    topology + per-peer signal snapshots as JSON. The full serving
+    front door is a Runtime with ``--fabric-peers`` (cli.py); this
+    entry point is the operator's reachability probe (DEPLOY.md §13)."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog="quoracle_tpu.serving.fabric.frontdoor")
+    ap.add_argument("--peers", required=True,
+                    help="comma-separated [role@]host:port peer list")
+    args = ap.parse_args(argv)
+    plane = FabricPlane.connect(args.peers.split(","))
+    try:
+        print(json.dumps(plane.fabric_stats(), indent=2, default=str))
+    finally:
+        plane.close()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -1,0 +1,449 @@
+"""Wire codec for the cluster fabric (ISSUE 12 tentpole, part 1a).
+
+Every byte that crosses a replica boundary rides ONE frame format:
+
+  ============  =======  ====================================
+  field         size     meaning
+  ============  =======  ====================================
+  magic         2        ``b"QW"`` — reject foreign streams
+  version       1        :data:`WIRE_VERSION`; mismatch is a
+                         structured reject, never a guess
+  msg_type      1        :data:`MSG_*` opcode
+  length        4 (BE)   payload byte count; bounded by
+                         :data:`MAX_FRAME_BYTES` BEFORE any
+                         allocation (an attacker-sized length
+                         prefix must not OOM the peer)
+  crc32         4 (BE)   crc32 of the payload; a flipped byte
+                         anywhere in the payload is a
+                         structured ``crc`` reject
+  payload       length   opcode-specific
+  ============  =======  ====================================
+
+Hostile-input contract (tier-1 tested, tests/test_fabric_wire.py):
+truncated, bit-flipped, version-skewed, or oversized-length frames all
+raise :class:`WireError` with a machine-readable ``reason`` — never a
+hang, never a partial message adopted.
+
+The HandoffEnvelope blob is the one KV-bearing payload. Its layout —
+``u32 header_len | header JSON | K bytes | V bytes`` — exists so the
+kv_signature check happens on the HEADER, before a single page byte is
+parsed (:func:`decode_envelope` with ``expect_signature``): a
+version-skewed replica pair degrades to a cold re-prefill exactly like
+the in-process reject path (serving/handoff.py), it never adopts
+plausible-looking garbage KV.
+
+This module is dependency-free by design (numpy only, no jax): the
+front door, tools/qlint.py, and the codec property tests all run
+without touching an accelerator runtime.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import zlib
+from typing import Any, Optional
+
+import numpy as np
+
+WIRE_MAGIC = b"QW"
+WIRE_VERSION = 1
+# Hard bound on one frame: a 256 MiB envelope holds ~128k tokens of
+# tiny-engine KV and far more than one session ever ships; a length
+# prefix past it is rejected before allocation.
+MAX_FRAME_BYTES = 256 * (1 << 20)
+
+_HEADER = struct.Struct("!2sBBII")
+HEADER_BYTES = _HEADER.size
+
+# -- opcodes -----------------------------------------------------------------
+MSG_ERROR = 0            # JSON {"error", "reason", ...extras}
+MSG_HELLO = 1            # JSON {} -> {"replica_id", "role", "pool", ...}
+MSG_OK = 2               # JSON ack
+MSG_SERVE = 10           # JSON QueryRequest -> MSG_RESULT
+MSG_RESULT = 11          # JSON QueryResult
+MSG_PREFILL = 12         # JSON QueryRequest + handoff id -> MSG_PREFILLED
+MSG_PREFILLED = 13       # blob: {meta JSON} + envelope bytes
+MSG_DECODE = 14          # blob: {row meta JSON} + envelope bytes
+MSG_DECODED = 15         # JSON result
+MSG_SIGNALS_POLL = 16    # JSON {"max_age_s"} -> MSG_SIGNALS
+MSG_SIGNALS = 17         # JSON SignalSnapshot + {"age_s", "qos"}
+MSG_ADMIT = 18           # JSON {"tenant", "priority", "deadline_s"}
+MSG_ADMITTED = 19        # JSON {"priority"}
+MSG_STATS = 20           # JSON {} -> JSON stats
+MSG_DROP_SESSION = 22    # JSON {"session_id"} -> MSG_OK
+MSG_EMBED = 24           # JSON {"texts"} -> MSG_EMBEDDED blob
+MSG_EMBEDDED = 25        # blob: {dtype, shape} + bytes
+MSG_META = 26            # JSON {"op", ...} -> MSG_OK (tokens/window/...)
+MSG_PREFIX_GET = 30      # JSON {signature, key, tokens} -> HIT | MISS
+MSG_PREFIX_HIT = 31      # blob: {dtype, shape} + K bytes + V bytes
+MSG_PREFIX_MISS = 32     # JSON {}
+MSG_PREFIX_PUT = 33      # blob: {signature, key, tokens, dtype, shape}+K+V
+MSG_PREFIX_STATS = 34    # JSON {} -> JSON per-signature store stats
+
+# metric label per opcode (quoracle_fabric_requests_total / _rtt_ms)
+OP_NAMES: dict = {
+    MSG_ERROR: "error", MSG_HELLO: "hello", MSG_OK: "ok",
+    MSG_SERVE: "serve", MSG_RESULT: "serve",
+    MSG_PREFILL: "prefill", MSG_PREFILLED: "prefill",
+    MSG_DECODE: "decode", MSG_DECODED: "decode",
+    MSG_SIGNALS_POLL: "signals", MSG_SIGNALS: "signals",
+    MSG_ADMIT: "admit", MSG_ADMITTED: "admit",
+    MSG_STATS: "stats", MSG_DROP_SESSION: "drop_session",
+    MSG_EMBED: "embed", MSG_EMBEDDED: "embed", MSG_META: "meta",
+    MSG_PREFIX_GET: "prefix_get", MSG_PREFIX_HIT: "prefix_get",
+    MSG_PREFIX_MISS: "prefix_get", MSG_PREFIX_PUT: "prefix_put",
+    MSG_PREFIX_STATS: "prefix_stats",
+}
+
+
+def op_name(msg_type: int) -> str:
+    return OP_NAMES.get(msg_type, f"op{msg_type}")
+
+
+class WireError(RuntimeError):
+    """A frame or payload the codec refuses. ``reason`` is the
+    machine-readable taxonomy every caller branches on:
+
+    * ``magic`` / ``version`` / ``oversize`` / ``truncated`` / ``crc``
+      — frame-level rejects (the hostile-input surface);
+    * ``decode`` — a structurally valid frame whose payload does not
+      parse (bad JSON, malformed blob);
+    * ``signature`` — a HandoffEnvelope whose KV signature does not
+      match the adopting engine (rejected before page bytes);
+    * ``remote`` — the peer answered MSG_ERROR (its structured reason
+      rides in ``detail``);
+    * ``transport`` — see :class:`TransportError`.
+    """
+
+    def __init__(self, message: str, reason: str = "decode",
+                 detail: Optional[dict] = None):
+        super().__init__(message)
+        self.reason = reason
+        self.detail = detail or {}
+
+
+class TransportError(WireError):
+    """The peer could not be reached (connect/read/write deadline or
+    refused connection) after the transport's bounded retries. Callers
+    degrade — cold re-prefill, worst-rank placement, replica
+    mark-failed — exactly like an in-process replica death; a silent
+    hang is never an option."""
+
+    def __init__(self, message: str, detail: Optional[dict] = None):
+        super().__init__(message, reason="transport", detail=detail)
+
+
+# ---------------------------------------------------------------------------
+# Frames
+# ---------------------------------------------------------------------------
+
+
+def encode_frame(msg_type: int, payload: bytes) -> bytes:
+    if len(payload) > MAX_FRAME_BYTES:
+        raise WireError(
+            f"payload {len(payload)} bytes exceeds the "
+            f"{MAX_FRAME_BYTES}-byte frame bound", reason="oversize")
+    return _HEADER.pack(WIRE_MAGIC, WIRE_VERSION, msg_type & 0xFF,
+                        len(payload),
+                        zlib.crc32(payload) & 0xFFFFFFFF) + payload
+
+
+def decode_header(header: bytes) -> tuple[int, int, int]:
+    """Validate one 12-byte header; returns (msg_type, length, crc).
+    Order matters: magic, then version, then the length bound — each a
+    distinct structured reject BEFORE any payload is read."""
+    if len(header) < HEADER_BYTES:
+        raise WireError(
+            f"frame header truncated: {len(header)} < {HEADER_BYTES} "
+            f"bytes", reason="truncated")
+    magic, version, msg_type, length, crc = _HEADER.unpack(
+        header[:HEADER_BYTES])
+    if magic != WIRE_MAGIC:
+        raise WireError(f"bad frame magic {magic!r}", reason="magic")
+    if version != WIRE_VERSION:
+        raise WireError(
+            f"wire version {version} != {WIRE_VERSION} — version-skewed "
+            f"peer", reason="version")
+    if length > MAX_FRAME_BYTES:
+        raise WireError(
+            f"length prefix {length} exceeds the {MAX_FRAME_BYTES}-byte "
+            f"frame bound", reason="oversize")
+    return msg_type, length, crc
+
+
+def decode_frame(data: bytes) -> tuple[int, bytes]:
+    """Decode one whole frame from a buffer (the loopback path; sockets
+    use :func:`read_frame`). Trailing bytes are a reject — one frame is
+    one message."""
+    msg_type, length, crc = decode_header(data)
+    payload = data[HEADER_BYTES:]
+    if len(payload) != length:
+        raise WireError(
+            f"frame payload truncated/overlong: {len(payload)} != "
+            f"declared {length}", reason="truncated")
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise WireError("frame crc mismatch — corrupt payload",
+                        reason="crc")
+    return msg_type, bytes(payload)
+
+
+def read_frame(read_exact) -> tuple[int, bytes]:
+    """Read one frame through ``read_exact(n) -> bytes`` (which raises
+    :class:`WireError` ``truncated`` on EOF/short read — sockets wrap
+    recv; files wrap read)."""
+    msg_type, length, crc = decode_header(read_exact(HEADER_BYTES))
+    payload = read_exact(length)
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise WireError("frame crc mismatch — corrupt payload",
+                        reason="crc")
+    return msg_type, payload
+
+
+# ---------------------------------------------------------------------------
+# JSON control payloads
+# ---------------------------------------------------------------------------
+
+
+def encode_json(obj: Any) -> bytes:
+    return json.dumps(obj, separators=(",", ":")).encode("utf-8")
+
+
+def decode_json(payload: bytes) -> Any:
+    try:
+        return json.loads(payload.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise WireError(f"payload is not valid JSON: {e}",
+                        reason="decode") from None
+
+
+def error_payload(message: str, reason: str = "remote",
+                  **extras: Any) -> bytes:
+    return encode_json({"error": message, "reason": reason, **extras})
+
+
+def raise_remote_error(payload: bytes) -> None:
+    """Turn a MSG_ERROR payload back into the structured exception the
+    peer raised. Admission rejects reconstruct as AdmissionError
+    subclasses so the front door's aggregate-shed logic treats a remote
+    shed exactly like a local one."""
+    info = decode_json(payload)
+    reason = info.get("reason", "remote")
+    msg = info.get("error", "remote peer error")
+    if info.get("error_type") == "admission":
+        from quoracle_tpu.serving.admission import (
+            AdmissionError, DeadlineExceededError, OverloadedError,
+            RateLimitedError,
+        )
+        cls = {"overload": OverloadedError,
+               "rate_limit": RateLimitedError,
+               "deadline": DeadlineExceededError}.get(reason,
+                                                      AdmissionError)
+        if cls is DeadlineExceededError:
+            raise cls(msg)
+        raise cls(msg, retry_after_ms=int(info.get("retry_after_ms",
+                                                   1000)))
+    raise WireError(msg, reason=reason, detail=info)
+
+
+# ---------------------------------------------------------------------------
+# Blobs: JSON header + raw byte sections
+# ---------------------------------------------------------------------------
+
+
+def pack_blob(header: dict, *chunks: bytes) -> bytes:
+    h = encode_json(header)
+    return struct.pack("!I", len(h)) + h + b"".join(chunks)
+
+
+def unpack_blob(payload: bytes) -> tuple[dict, memoryview]:
+    """Parse the header WITHOUT touching the byte sections — the
+    signature gate reads only this; the body stays an unparsed view."""
+    if len(payload) < 4:
+        raise WireError("blob truncated before header length",
+                        reason="truncated")
+    (hlen,) = struct.unpack("!I", payload[:4])
+    if len(payload) < 4 + hlen:
+        raise WireError(
+            f"blob header truncated: {len(payload) - 4} < {hlen}",
+            reason="truncated")
+    header = decode_json(bytes(payload[4:4 + hlen]))
+    if not isinstance(header, dict):
+        raise WireError("blob header is not an object", reason="decode")
+    return header, memoryview(payload)[4 + hlen:]
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name, including the ml_dtypes extension types
+    (bfloat16 — the serving cache dtype) without importing jax."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _array_from(view: memoryview, dtype: np.dtype,
+                shape: tuple) -> np.ndarray:
+    want = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+    if len(view) < want:
+        raise WireError(
+            f"KV section truncated: {len(view)} < {want} bytes",
+            reason="truncated")
+    return np.frombuffer(view[:want], dtype=np.uint8).view(
+        dtype).reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# The HandoffEnvelope blob
+# ---------------------------------------------------------------------------
+
+
+def encode_envelope(env) -> bytes:
+    """Serialize a serving/handoff.HandoffEnvelope (entry = the kvtier
+    host-side ``_HostSession``). K and V ship as raw bytes + dtype name
+    + shape (npz-style round-trip of extension dtypes, see
+    DiskPrefixStore.save)."""
+    e = env.entry
+    k = np.ascontiguousarray(e.k)
+    v = np.ascontiguousarray(e.v)
+    header = {
+        "session_id": env.session_id,
+        "model_spec": env.model_spec,
+        "signature": env.signature,
+        "json_state": env.json_state,
+        "src_replica": env.src_replica,
+        "start_pos": int(e.start_pos),
+        "tokens": [int(t) for t in e.tokens],
+        "dtype": str(k.dtype),
+        "k_shape": list(k.shape),
+        "v_shape": list(v.shape),
+    }
+    return pack_blob(header, k.view(np.uint8).reshape(-1).tobytes(),
+                     v.view(np.uint8).reshape(-1).tobytes())
+
+
+def peek_envelope(payload: bytes) -> dict:
+    """The envelope HEADER alone — signature, session, token count —
+    with zero KV bytes parsed. The adopt gate reads this first."""
+    header, _ = unpack_blob(payload)
+    for field in ("session_id", "model_spec", "signature", "tokens",
+                  "dtype", "k_shape", "v_shape"):
+        if field not in header:
+            raise WireError(f"envelope header missing {field!r}",
+                            reason="decode")
+    return header
+
+
+def decode_envelope(payload: bytes, expect_signature: Optional[str] = None):
+    """Rebuild the HandoffEnvelope. With ``expect_signature`` the KV
+    signature in the HEADER is checked first and a mismatch raises
+    ``WireError(reason="signature")`` BEFORE any page byte is parsed —
+    the wire twin of serving/handoff.KVHandoff.adopt's reject-the-bytes
+    contract."""
+    header = peek_envelope(payload)
+    if expect_signature is not None \
+            and header["signature"] != expect_signature:
+        raise WireError(
+            f"KV signature mismatch: envelope carries "
+            f"{header['signature']!r}, engine expects "
+            f"{expect_signature!r} — version-skewed replica pair",
+            reason="signature")
+    _, body = unpack_blob(payload)
+    dt = _np_dtype(header["dtype"])
+    k_shape = tuple(int(s) for s in header["k_shape"])
+    v_shape = tuple(int(s) for s in header["v_shape"])
+    k = _array_from(body, dt, k_shape)
+    k_bytes = k.nbytes
+    v = _array_from(body[k_bytes:], dt, v_shape)
+    if len(body) != k_bytes + v.nbytes:
+        raise WireError(
+            f"envelope body {len(body)} bytes != declared "
+            f"{k_bytes + v.nbytes}", reason="truncated")
+    from quoracle_tpu.serving.handoff import HandoffEnvelope
+    from quoracle_tpu.serving.kvtier import _HostSession
+    entry = _HostSession(list(header["tokens"]),
+                         int(header["start_pos"]),
+                         np.copy(k), np.copy(v))
+    return HandoffEnvelope(
+        session_id=header["session_id"],
+        model_spec=header["model_spec"],
+        signature=header["signature"],
+        entry=entry,
+        json_state=header.get("json_state"),
+        src_replica=header.get("src_replica", ""))
+
+
+# ---------------------------------------------------------------------------
+# QueryRequest / QueryResult JSON codecs
+# ---------------------------------------------------------------------------
+
+
+def request_to_dict(r) -> dict:
+    """A QueryRequest as a wire dict. Deadlines ship as REMAINING ms —
+    absolute monotonic times do not cross process boundaries."""
+    return {
+        "model_spec": r.model_spec,
+        "messages": r.messages,
+        "temperature": r.temperature,
+        "top_p": r.top_p,
+        "max_tokens": r.max_tokens,
+        "session_id": r.session_id,
+        "constrain_json": r.constrain_json,
+        "action_enum": list(r.action_enum) if r.action_enum else None,
+        "tenant": r.tenant,
+        "priority": r.priority,
+        # remaining budget re-anchors at the peer's query() entry, so
+        # wire latency eats into the client's wait, not the row's
+        # deadline accounting
+        "deadline_ms": r.deadline_ms,
+    }
+
+
+def request_from_dict(d: dict):
+    from quoracle_tpu.models.runtime import QueryRequest
+    ae = d.get("action_enum")
+    return QueryRequest(
+        model_spec=d["model_spec"], messages=d["messages"],
+        temperature=d.get("temperature", 1.0),
+        top_p=d.get("top_p", 1.0), max_tokens=d.get("max_tokens"),
+        session_id=d.get("session_id"),
+        constrain_json=bool(d.get("constrain_json")),
+        action_enum=tuple(ae) if ae else None,
+        tenant=d.get("tenant", "default"), priority=d.get("priority"),
+        deadline_ms=d.get("deadline_ms"))
+
+
+def result_to_dict(res) -> dict:
+    return {
+        "model_spec": res.model_spec,
+        "text": res.text,
+        "usage": {"prompt_tokens": res.usage.prompt_tokens,
+                  "completion_tokens": res.usage.completion_tokens,
+                  "cost": res.usage.cost},
+        "latency_ms": res.latency_ms,
+        "prefill_ms": res.prefill_ms,
+        "decode_ms": res.decode_ms,
+        "cached_tokens": res.cached_tokens,
+        "spec_rounds": res.spec_rounds,
+        "spec_accepted_tokens": res.spec_accepted_tokens,
+        "error": res.error,
+        "permanent_error": res.permanent_error,
+    }
+
+
+def result_from_dict(d: dict):
+    from quoracle_tpu.models.runtime import QueryResult, Usage
+    u = d.get("usage") or {}
+    return QueryResult(
+        model_spec=d["model_spec"], text=d.get("text", ""),
+        usage=Usage(u.get("prompt_tokens", 0),
+                    u.get("completion_tokens", 0), u.get("cost", 0.0)),
+        latency_ms=d.get("latency_ms", 0.0),
+        prefill_ms=d.get("prefill_ms", 0.0),
+        decode_ms=d.get("decode_ms", 0.0),
+        cached_tokens=d.get("cached_tokens", 0),
+        spec_rounds=d.get("spec_rounds", 0),
+        spec_accepted_tokens=d.get("spec_accepted_tokens", 0),
+        error=d.get("error"),
+        permanent_error=bool(d.get("permanent_error")))

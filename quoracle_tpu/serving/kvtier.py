@@ -469,13 +469,19 @@ class TierManager:
         self.store = store
         self.model = model
         self.paged_lock = paged_lock
+        self.signature = signature or (model.replace("/", "_")
+                                       or "default")
         self.host = HostPageStore(int(host_mb) * (1 << 20), model=model)
         self.disk: Optional[DiskPrefixStore] = None
         if disk_dir:
             self.disk = DiskPrefixStore(
-                disk_dir, signature or (model.replace("/", "_")
-                                        or "default"), model=model,
+                disk_dir, self.signature, model=model,
                 budget_bytes=int(disk_gb * (1 << 30)))
+        # Fleet prefix service (ISSUE 12, serving/fabric/prefixd.py):
+        # a read-through client attached via attach_prefixd — the
+        # restore ladder's last rung (host → disk → FLEET) and the
+        # spill writer's second publish target.
+        self.prefixd = None
         # monotonic counters (stats() → /api/kv + bench config 14)
         self.demoted_sessions = 0
         self.demoted_prefix_pages = 0
@@ -492,10 +498,21 @@ class TierManager:
         # drops the spill (the block is reconstructible by prefill).
         self._spill_q: Optional[queue.Queue] = None
         if self.disk is not None:
+            self._ensure_spill_writer()
+
+    def _ensure_spill_writer(self) -> None:
+        if self._spill_q is None:
             self._spill_q = queue.Queue(maxsize=512)
             threading.Thread(
                 target=self._spill_loop, daemon=True,
-                name=f"kvtier-spill-{model or 'default'}").start()
+                name=f"kvtier-spill-{self.model or 'default'}").start()
+
+    def attach_prefixd(self, client) -> None:
+        """Wire the fleet prefix-service client (ISSUE 12): reads join
+        extend_prefix's restore ladder, writes ride the async spill
+        writer (wire I/O never happens under the serving locks)."""
+        self.prefixd = client
+        self._ensure_spill_writer()
 
     # -- device <-> host plumbing ---------------------------------------
 
@@ -673,13 +690,18 @@ class TierManager:
 
     def _write_block(self, key: str, entry: _HostBlock) -> None:
         """Writer-thread side of a spill: the actual (atomic, content-
-        addressed) disk write, never under the store/paged locks."""
-        if self.disk.save(key, entry.tokens, entry.k, entry.v):
+        addressed) disk write — and, with a fleet prefix service
+        attached, the publish to it — never under the store/paged
+        locks."""
+        if self.disk is not None \
+                and self.disk.save(key, entry.tokens, entry.k, entry.v):
             from quoracle_tpu.infra.flightrec import FLIGHT
             from quoracle_tpu.infra.telemetry import KV_DISK_SPILLS_TOTAL
             KV_DISK_SPILLS_TOTAL.inc(model=self.model)
             FLIGHT.record("kv_disk_spill", model=self.model,
                           tokens=len(entry.tokens))
+        if self.prefixd is not None:
+            self.prefixd.publish(key, entry.tokens, entry.k, entry.v)
 
     def _enqueue_spill(self, key: str, entry: _HostBlock) -> None:
         if self._spill_q is None:
@@ -739,10 +761,10 @@ class TierManager:
         Only the device→host copy happens here (the caller holds the
         store lock, so the page content is stable); the npz write rides
         the spill queue."""
-        if self.disk is None:
+        if self.disk is None and self.prefixd is None:
             return
         key = self._block_key(tokens)
-        if self.disk.has(key):
+        if self.disk is not None and self.disk.has(key):
             return
         st = self.store
         if st.k is None:
@@ -785,6 +807,16 @@ class TierManager:
                 if loaded is not None:
                     blk = _HostBlock(prefix, *loaded)
                     source = "disk"
+            if blk is None and self.prefixd is not None:
+                # The fleet rung (ISSUE 12): same restore-path-by-design
+                # argument as the disk read above — sessioned callers
+                # already hold the paged lock waiting on this restore,
+                # and the fetch degrades to a miss on any failure.
+                # qlint: allow[lock-blocking] fleet prefix fetch on the restore path by design
+                fetched = self.prefixd.fetch(key, prefix)
+                if fetched is not None:
+                    blk = _HostBlock(prefix, *fetched)
+                    source = "prefixd"
             if blk is None:
                 break
             pages = st.alloc(1)
@@ -868,4 +900,6 @@ class TierManager:
             "spill_queue": (self._spill_q.qsize()
                             if self._spill_q is not None else 0),
             "spill_drops": self.spill_drops,
+            "prefixd": (self.prefixd.stats()
+                        if self.prefixd is not None else None),
         }

@@ -59,6 +59,13 @@ from quoracle_tpu.serving.qos import class_name, coerce_priority
 # with headroom for the scrape jitter.
 DEFAULT_MAX_SIGNAL_AGE_S = 5.0
 
+# Consecutive silent signal polls (fabric TransportError — the peer's
+# admission controller is unreachable, ISSUE 12) before the router stops
+# scoring the replica worst-rank and marks it FAILED outright: its
+# in-flight rows re-place through the retained handoff envelopes — the
+# PR 10 death path, now over the wire.
+SILENT_SIGNALS_LIMIT = 3
+
 
 class ClusterRouter:
     """Placement + affinity + liveness for one ClusterPlane. Replicas
@@ -79,6 +86,10 @@ class ClusterRouter:
         # retry_after and they re-arrive in lockstep, re-saturating it.
         self._shed_streak = 0
         self._last_retry_ms = 0
+        # per-replica consecutive silent-signal polls (ISSUE 12): a
+        # network peer whose SignalSnapshot poll fails is scored
+        # worst-rank; past SILENT_SIGNALS_LIMIT it is marked failed
+        self._silent: dict[str, int] = {}
 
     # -- topology --------------------------------------------------------
 
@@ -157,7 +168,21 @@ class ClusterRouter:
             return (1 << 20, 0.0, 0.0)
         ctrl = getattr(rep.backend, "qos_controller", None)
         if ctrl is not None:
-            snap = ctrl.signals(max_age_s=self.max_signal_age_s)
+            try:
+                snap = ctrl.signals(max_age_s=self.max_signal_age_s)
+            except Exception as e:        # noqa: BLE001 — see guard below
+                from quoracle_tpu.serving.fabric.wire import (
+                    TransportError,
+                )
+                if not isinstance(e, TransportError):
+                    raise
+                # silent peer (ISSUE 12): worst-rank now; mark failed
+                # after a bounded silence streak — never crash or stall
+                # the front door on a partitioned link
+                self._note_silent(rep, str(e))
+                return (1 << 20, 0.0, 0.0)
+            with self._lock:
+                self._silent.pop(rep.replica_id, None)
             ROUTER_SIGNAL_AGE_MS.observe(snap.age_s(now) * 1000,
                                          replica=rep.replica_id)
             head = snap.hbm_headroom
@@ -171,6 +196,20 @@ class ClusterRouter:
         except Exception:                 # noqa: BLE001 — best-effort
             pass
         return (depth, 0.0, -1.0)
+
+    def _note_silent(self, rep, error: str) -> None:
+        with self._lock:
+            streak = self._silent.get(rep.replica_id, 0) + 1
+            self._silent[rep.replica_id] = streak
+        if streak >= SILENT_SIGNALS_LIMIT:
+            FLIGHT.record("fabric_peer_dead", peer=rep.replica_id,
+                          role=getattr(rep, "role", "?"),
+                          phase="signals",
+                          silent_polls=streak, error=error[:160])
+            self.mark_failed(rep.replica_id,
+                             f"signals silent x{streak}: {error[:120]}")
+            if hasattr(rep, "alive"):
+                rep.alive = False
 
     def place(self, role: str, session_id: Optional[str] = None,
               exclude: tuple = ()):
@@ -275,12 +314,19 @@ class ClusterRouter:
             "last_retry_after_ms": last_retry,
             "max_signal_age_s": self.max_signal_age_s,
         }
+        with self._lock:
+            out["silent"] = dict(self._silent)
         for rep in reps:
             ctrl = getattr(rep.backend, "qos_controller", None)
+            sig = None
+            if ctrl is not None:
+                try:
+                    sig = ctrl.signals().as_dict()
+                except Exception:         # noqa: BLE001 — silent peer
+                    sig = {"unreachable": True}
             out["replicas"][rep.replica_id] = {
                 "role": rep.role,
                 "alive": rep.alive,
-                "signals": (ctrl.signals().as_dict()
-                            if ctrl is not None else None),
+                "signals": sig,
             }
         return out

@@ -27,6 +27,9 @@ Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
                             occupancy (HBM/host/disk), demote/restore/
                             spill counters, restore-latency quantiles
                             (serving/kvtier.py)
+  GET  /api/fabric          cross-host fabric panel (ISSUE 12): peer
+                            topology, wire request/retry/frame-reject
+                            counters, prefixd client stats
   GET  /api/cluster         disaggregated serving plane (ISSUE 10):
                             replica topology + roles + liveness, router
                             placement/affinity/shed state with the
@@ -206,6 +209,9 @@ class DashboardServer:
             # cluster incidents (ISSUE 10): replica death, handoff
             # rejects, router all-shed — TOPIC_CLUSTER ring
             "cluster": h.replay_cluster(),
+            # fabric incidents (ISSUE 12): peer death, frame rejects,
+            # prefixd degrades — TOPIC_FABRIC ring
+            "fabric": h.replay_fabric(),
         }
         if agent_id:
             payload["logs"] = h.replay_logs(agent_id)
@@ -441,6 +447,43 @@ class DashboardServer:
         }
         return payload
 
+    def fabric_payload(self) -> dict:
+        """GET /api/fabric: the cross-host fabric panel (ISSUE 12) —
+        peer topology + per-peer transport counters (front-door
+        runtimes), the wire request/retry/frame-reject series, and
+        prefixd client stats rolled up from the engine tiers.
+        ``enabled`` False when this runtime neither fronts peers nor
+        serves as one."""
+        from quoracle_tpu.infra.telemetry import (
+            FABRIC_FRAME_REJECTS_TOTAL, FABRIC_PREFIXD_TOTAL,
+            FABRIC_REQUESTS_TOTAL, FABRIC_RETRIES_TOTAL, FABRIC_RTT_MS,
+        )
+        backend = self.runtime.backend
+        stats = getattr(backend, "fabric_stats", None)
+        if stats is not None:
+            payload = stats()
+        else:
+            peer = getattr(self.runtime, "_fabric_peer", None)
+            payload = ({"enabled": True, "peer": peer.stats()}
+                       if peer is not None else {"enabled": False})
+        prefixd = {}
+        engines = getattr(backend, "engines", None) or {}
+        for name, eng in engines.items():
+            tier = getattr(getattr(eng, "sessions", None), "tier", None)
+            client = getattr(tier, "prefixd", None)
+            if client is not None:
+                prefixd[name] = client.stats()
+        if prefixd:
+            payload["prefixd"] = prefixd
+        payload["counters"] = {
+            "requests": FABRIC_REQUESTS_TOTAL._snapshot(),
+            "retries": FABRIC_RETRIES_TOTAL._snapshot(),
+            "frame_rejects": FABRIC_FRAME_REJECTS_TOTAL._snapshot(),
+            "rtt_ms": FABRIC_RTT_MS._snapshot(),
+            "prefixd": FABRIC_PREFIXD_TOTAL._snapshot(),
+        }
+        return payload
+
     def chaos_payload(self) -> dict:
         """GET /api/chaos: the chaos plane (ISSUE 11) — armed plan,
         injection-point catalog, recent fired faults, the last scenario
@@ -648,6 +691,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.kv_payload())
             elif parsed.path == "/api/cluster":
                 self._send_json(d.cluster_payload())
+            elif parsed.path == "/api/fabric":
+                self._send_json(d.fabric_payload())
             elif parsed.path == "/api/chaos":
                 self._send_json(d.chaos_payload())
             elif parsed.path == "/api/models":

@@ -126,8 +126,10 @@ def test_flight_events_and_instruments_registered():
     # every scenario's injection points exist in the catalog
     assert set(SCENARIOS) == {"traffic_storm", "kill_mid_handoff",
                               "restart_warm_start", "drift_storm",
-                              "hbm_pressure_churn"}
+                              "hbm_pressure_churn", "fabric_partition"}
     assert "pool.member" in INJECTION_POINTS
+    assert "fabric.send" in INJECTION_POINTS
+    assert "fabric.prefixd" in INJECTION_POINTS
 
 
 # ---------------------------------------------------------------------------
@@ -167,6 +169,20 @@ def test_scenario_kill_mid_handoff():
     report = _assert_scenario("kill_mid_handoff", seed=5)
     assert report.evidence["handoff"]["replaced"] >= 1
     assert report.evidence["dead_replicas"]
+
+
+def test_scenario_fabric_partition():
+    """ISSUE 12 satellite: peer links flap (drops + corrupt frames)
+    over the loopback fabric mid-handoff — no silent loss, survivors
+    bit-equal, recovery via retry-absorb / envelope re-place / cold
+    failover, all structured."""
+    report = _assert_scenario("fabric_partition", seed=5)
+    kinds = {t[3] for t in report.schedule}
+    assert kinds & {"drop", "corrupt"}    # the link really flapped
+    ev = report.evidence
+    assert (ev["retried"] >= 1 or ev["replaced"] >= 1
+            or ev["cold_failovers"] >= 1)
+    assert ev["survivors"] >= 1
 
 
 def test_scenario_traffic_storm():

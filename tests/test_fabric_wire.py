@@ -1,0 +1,322 @@
+"""Fabric wire codec + transports (serving/fabric/, ISSUE 12).
+
+The hostile-input satellite: every malformed frame — truncated,
+bit-flipped, version-skewed, oversized-length, bad-magic — must produce
+a STRUCTURED :class:`WireError` with a machine-readable reason, never a
+hang and never a partially adopted message. Plus the envelope codec's
+signature-before-bytes contract, the loopback/TCP transports' retry and
+deadline behavior, and the chaos ``fabric.send`` seam.
+"""
+
+import struct
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from quoracle_tpu.serving.fabric import wire
+from quoracle_tpu.serving.fabric.transport import (
+    LoopbackTransport, PeerServer, TcpTransport,
+)
+from quoracle_tpu.serving.fabric.wire import TransportError, WireError
+
+pytestmark = pytest.mark.fabric
+
+
+# ---------------------------------------------------------------------------
+# Frame round trips + hostile inputs
+# ---------------------------------------------------------------------------
+
+def test_frame_round_trip_property():
+    """Every (msg_type, payload) round-trips exactly — sizes from empty
+    through several KB, all opcodes, seeded-random bytes."""
+    rng = np.random.default_rng(7)
+    sizes = [0, 1, 2, 11, 12, 13, 255, 4096, 70_001]
+    for msg_type in list(wire.OP_NAMES) + [200, 255]:
+        for n in sizes:
+            payload = rng.integers(0, 256, n, np.uint8).tobytes()
+            t, p = wire.decode_frame(wire.encode_frame(msg_type, payload))
+            assert t == msg_type and p == payload
+
+
+def test_truncated_frames_reject_structurally():
+    frame = wire.encode_frame(wire.MSG_SERVE, b"x" * 64)
+    # every truncation point: header cut or payload cut — never a hang,
+    # never a partial message
+    for cut in (0, 1, wire.HEADER_BYTES - 1, wire.HEADER_BYTES,
+                wire.HEADER_BYTES + 5, len(frame) - 1):
+        with pytest.raises(WireError) as ei:
+            wire.decode_frame(frame[:cut])
+        assert ei.value.reason == "truncated"
+    # trailing garbage is equally a reject: one frame is one message
+    with pytest.raises(WireError) as ei:
+        wire.decode_frame(frame + b"!")
+    assert ei.value.reason == "truncated"
+
+
+def test_flipped_byte_anywhere_is_a_crc_reject():
+    payload = b"the quick brown fabric frame"
+    frame = wire.encode_frame(wire.MSG_RESULT, payload)
+    for i in range(wire.HEADER_BYTES, len(frame)):
+        bad = frame[:i] + bytes([frame[i] ^ 0x01]) + frame[i + 1:]
+        with pytest.raises(WireError) as ei:
+            wire.decode_frame(bad)
+        assert ei.value.reason == "crc", f"offset {i}"
+
+
+def test_wrong_version_and_magic_reject():
+    frame = bytearray(wire.encode_frame(wire.MSG_OK, b"{}"))
+    skew = bytes(frame[:2]) + bytes([wire.WIRE_VERSION + 1]) \
+        + bytes(frame[3:])
+    with pytest.raises(WireError) as ei:
+        wire.decode_frame(skew)
+    assert ei.value.reason == "version"
+    with pytest.raises(WireError) as ei:
+        wire.decode_frame(b"XX" + bytes(frame[2:]))
+    assert ei.value.reason == "magic"
+
+
+def test_oversized_length_prefix_rejects_before_allocation():
+    """An attacker-sized length prefix must reject from the HEADER
+    alone — reading it must not try to allocate or consume the declared
+    bytes."""
+    hdr = struct.pack("!2sBBII", wire.WIRE_MAGIC, wire.WIRE_VERSION,
+                      wire.MSG_SERVE, wire.MAX_FRAME_BYTES + 1,
+                      zlib.crc32(b""))
+    with pytest.raises(WireError) as ei:
+        wire.decode_header(hdr)
+    assert ei.value.reason == "oversize"
+    with pytest.raises(WireError) as ei:
+        wire.encode_frame(wire.MSG_SERVE,
+                          b"\x00" * (wire.MAX_FRAME_BYTES + 1))
+    assert ei.value.reason == "oversize"
+
+    calls = []
+
+    def read_exact(n):
+        calls.append(n)
+        return hdr[:n]
+
+    with pytest.raises(WireError):
+        wire.read_frame(read_exact)
+    assert calls == [wire.HEADER_BYTES]   # payload never requested
+
+
+def test_bad_json_payload_is_a_decode_reject():
+    with pytest.raises(WireError) as ei:
+        wire.decode_json(b"\xff{not json")
+    assert ei.value.reason == "decode"
+
+
+# ---------------------------------------------------------------------------
+# Envelope codec: signature gated BEFORE page bytes
+# ---------------------------------------------------------------------------
+
+def _envelope(dtype="float32"):
+    from quoracle_tpu.serving.handoff import HandoffEnvelope
+    from quoracle_tpu.serving.kvtier import _HostSession
+    rng = np.random.default_rng(3)
+    k = rng.standard_normal((2, 3, 8, 2, 4)).astype(dtype)
+    v = rng.standard_normal((2, 3, 8, 2, 4)).astype(dtype)
+    entry = _HostSession([1, 2, 3, 4], 0, k, v)
+    return HandoffEnvelope(session_id="s1", model_spec="xla:tiny",
+                           signature="tiny-sig-p128", entry=entry,
+                           json_state=7, src_replica="prefill-0")
+
+
+def test_envelope_round_trip_bit_exact():
+    import ml_dtypes
+    for dtype in ("float32", ml_dtypes.bfloat16):
+        env = _envelope(dtype)
+        out = wire.decode_envelope(wire.encode_envelope(env),
+                                   expect_signature=env.signature)
+        assert out.session_id == env.session_id
+        assert out.signature == env.signature
+        assert out.json_state == 7
+        assert out.entry.tokens == env.entry.tokens
+        assert out.entry.start_pos == env.entry.start_pos
+        assert out.entry.k.dtype == env.entry.k.dtype
+        assert np.array_equal(
+            out.entry.k.view(np.uint8), env.entry.k.view(np.uint8))
+        assert np.array_equal(
+            out.entry.v.view(np.uint8), env.entry.v.view(np.uint8))
+
+
+def test_envelope_signature_checked_before_kv_bytes():
+    """A mismatched signature must reject from the HEADER — even when
+    the KV body is truncated garbage that could never parse."""
+    env = _envelope()
+    blob = wire.encode_envelope(env)
+    header, _ = wire.unpack_blob(blob)
+    hdr_len = 4 + len(wire.encode_json(header))
+    torn = blob[:hdr_len + 3]             # header intact, body destroyed
+    with pytest.raises(WireError) as ei:
+        wire.decode_envelope(torn, expect_signature="other-geometry")
+    assert ei.value.reason == "signature"  # not "truncated": gate first
+    # with the right signature the torn body IS a truncation reject
+    with pytest.raises(WireError) as ei:
+        wire.decode_envelope(torn, expect_signature=env.signature)
+    assert ei.value.reason == "truncated"
+    assert wire.peek_envelope(blob)["signature"] == env.signature
+
+
+# ---------------------------------------------------------------------------
+# Transports
+# ---------------------------------------------------------------------------
+
+def _echo_handler(msg_type, payload):
+    if msg_type == wire.MSG_META:
+        raise WireError("no such op", reason="decode")
+    if msg_type == wire.MSG_ADMIT:
+        from quoracle_tpu.serving.admission import OverloadedError
+        raise OverloadedError("synthetic shed", retry_after_ms=2345)
+    return wire.MSG_OK, payload
+
+
+def test_loopback_round_trip_and_remote_errors():
+    t = LoopbackTransport(_echo_handler, "echo")
+    rtype, payload = t.request(wire.MSG_HELLO, b'{"a":1}')
+    assert rtype == wire.MSG_OK and payload == b'{"a":1}'
+    # a non-retryable remote WireError reconstructs structurally
+    with pytest.raises(WireError) as ei:
+        t.request(wire.MSG_META, b"{}")
+    assert ei.value.reason == "decode"
+    # remote admission sheds reconstruct as AdmissionError with the
+    # peer's retry hint — the front door's aggregate-shed input
+    from quoracle_tpu.serving.admission import OverloadedError
+    with pytest.raises(OverloadedError) as ei:
+        t.request(wire.MSG_ADMIT, b"{}")
+    assert ei.value.retry_after_ms == 2345
+    assert t.stats()["requests"] == 1
+
+
+def test_chaos_corrupt_frame_is_absorbed_by_retry():
+    """The fabric.send 'corrupt' directive flips a byte in the encoded
+    request frame; the RECEIVER's crc boundary rejects it and the
+    bounded retry re-sends a clean frame — transient corruption is
+    invisible to the caller."""
+    from quoracle_tpu.chaos.faults import CHAOS, FaultPlan, FaultRule
+    from quoracle_tpu.infra.telemetry import METRICS
+
+    t = LoopbackTransport(_echo_handler, "flappy", retries=2,
+                          backoff_ms=1.0)
+    plan = FaultPlan(11, [FaultRule("fabric.send", "corrupt",
+                                    max_fires=1)])
+    with CHAOS.arming(plan):
+        rtype, payload = t.request(wire.MSG_HELLO, b'{"x":2}')
+    assert rtype == wire.MSG_OK and payload == b'{"x":2}'
+    assert t.retried == 1
+    assert plan.schedule() == [("fabric.send", "flappy", 0, "corrupt")]
+    text = METRICS.render_prometheus()
+    assert "quoracle_fabric_frame_rejects_total" in text
+
+
+def test_chaos_persistent_drop_exhausts_retries_structurally():
+    from quoracle_tpu.chaos.faults import CHAOS, FaultPlan, FaultRule
+
+    t = LoopbackTransport(_echo_handler, "dead", retries=2,
+                          backoff_ms=1.0)
+    plan = FaultPlan(0, [FaultRule("fabric.send", "drop")])
+    with CHAOS.arming(plan):
+        with pytest.raises(TransportError) as ei:
+            t.request(wire.MSG_HELLO, b"{}")
+    assert ei.value.detail["attempts"] == 3
+    assert ei.value.reason == "transport"
+
+
+def test_tcp_transport_round_trip_and_deadlines():
+    """Real sockets on localhost: request/response, a slow handler
+    tripping the read deadline, and reconnect-after-timeout."""
+    def handler(msg_type, payload):
+        if msg_type == wire.MSG_STATS:
+            time.sleep(0.5)               # beyond the io deadline below
+        return wire.MSG_OK, payload
+
+    server = PeerServer(handler, name="t")
+    t = TcpTransport(server.host, server.port, retries=0,
+                     io_timeout=5.0)
+    try:
+        rtype, payload = t.request(wire.MSG_HELLO, b'{"hi":1}')
+        assert rtype == wire.MSG_OK and payload == b'{"hi":1}'
+        with pytest.raises(TransportError):
+            t.request(wire.MSG_STATS, b"{}", timeout=0.1)
+        # the connection was dropped and rebuilt: next request is clean
+        rtype, _ = t.request(wire.MSG_HELLO, b"{}")
+        assert rtype == wire.MSG_OK
+    finally:
+        t.close()
+        server.close()
+
+
+def test_tcp_connect_refused_retries_then_structured():
+    server = PeerServer(_echo_handler, name="gone")
+    host, port = server.host, server.port
+    server.close()
+    t = TcpTransport(host, port, retries=1, backoff_ms=1.0,
+                     connect_timeout=0.3)
+    t0 = time.monotonic()
+    with pytest.raises(TransportError) as ei:
+        t.request(wire.MSG_HELLO, b"{}")
+    assert time.monotonic() - t0 < 5.0    # bounded, not hanging
+    assert ei.value.detail["attempts"] == 2
+
+
+def test_tcp_server_rejects_corrupt_frame_and_keeps_serving():
+    """A corrupt frame on the socket answers MSG_ERROR (crc) and the
+    connection stays usable for the next clean frame."""
+    import socket
+
+    server = PeerServer(_echo_handler, name="srv")
+    try:
+        s = socket.create_connection((server.host, server.port),
+                                     timeout=5)
+        s.settimeout(5)
+        frame = bytearray(wire.encode_frame(wire.MSG_HELLO, b'{"k":1}'))
+        frame[-1] ^= 0xFF
+        s.sendall(bytes(frame))
+
+        def read_exact(n):
+            buf = b""
+            while len(buf) < n:
+                chunk = s.recv(n - len(buf))
+                assert chunk, "server closed unexpectedly"
+                buf += chunk
+            return buf
+
+        rtype, payload = wire.read_frame(read_exact)
+        assert rtype == wire.MSG_ERROR
+        assert wire.decode_json(payload)["reason"] == "crc"
+        s.sendall(wire.encode_frame(wire.MSG_HELLO, b'{"k":2}'))
+        rtype, payload = wire.read_frame(read_exact)
+        assert rtype == wire.MSG_OK and payload == b'{"k":2}'
+        s.close()
+    finally:
+        server.close()
+
+
+def test_parse_addr():
+    from quoracle_tpu.serving.fabric.transport import parse_addr
+    assert parse_addr("prefill@10.0.0.2:9400") == ("prefill",
+                                                   "10.0.0.2", 9400)
+    assert parse_addr("localhost:9400") == (None, "localhost", 9400)
+    with pytest.raises(ValueError):
+        parse_addr("nonsense")
+
+
+def test_request_result_codec_round_trip():
+    from quoracle_tpu.models.runtime import QueryRequest, QueryResult, Usage
+    r = QueryRequest("xla:tiny", [{"role": "user", "content": "hi"}],
+                     temperature=0.0, max_tokens=9, session_id="s",
+                     constrain_json=True, action_enum=("a", "b"),
+                     tenant="t1", priority=2, deadline_ms=1500.0)
+    r2 = wire.request_from_dict(wire.decode_json(
+        wire.encode_json(wire.request_to_dict(r))))
+    assert r2 == r
+    res = QueryResult("xla:tiny", text="out", usage=Usage(3, 4, 0.5),
+                      cached_tokens=2, spec_rounds=1,
+                      spec_accepted_tokens=3)
+    d = wire.result_from_dict(wire.decode_json(
+        wire.encode_json(wire.result_to_dict(res))))
+    assert d.text == "out" and d.usage.completion_tokens == 4
+    assert d.ok and d.cached_tokens == 2
