@@ -1603,6 +1603,158 @@ def measure_fabric(pool, n_rows: int = 6, n_router_peers: int = 3,
     }
 
 
+def measure_quant(pool, n_prompts: int = 6) -> dict:
+    """Config 19: quantized serving (ISSUE 13) — int8 weights + int8 KV
+    pages vs the bf16 baseline at the same device budget. Four
+    measurements:
+
+    1. **byte economy** — the exact per-token KV rate (int8+scales vs
+       dense), the resident-token figures pool_sizing plans at fixed
+       HBM, and the MEASURED handoff-envelope and disk-spill byte
+       ratios (one real session exported through the wire codec, one
+       real prefix block spilled, per mode);
+    2. **throughput** — the same sessioned greedy workload through a
+       quantized and an unquantized backend: tokens/sec each;
+    3. **quality** — per-member scorecard-style deltas: greedy
+       token-agreement fraction (longest common prefix / emitted) and
+       exact-match fraction, quantized vs unquantized outputs;
+    4. **self-consistency ASSERT** — two independently built quantized
+       backends must produce bit-identical outputs (the quantized twin
+       of the temp-0 equality gates; the mono==cluster==wire-peer gate
+       lives in tier-1 tests/test_quant.py).
+    """
+    import tempfile
+
+    from quoracle_tpu.models.runtime import QueryRequest, TPUBackend
+    from quoracle_tpu.parallel.mesh import pool_sizing
+    from quoracle_tpu.serving.fabric import wire
+    from quoracle_tpu.serving.handoff import KVHandoff
+
+    member = pool[0]
+    long_pre = ("system: shared policy preamble for every session. " * 6)
+
+    def reqs(tag):
+        return [QueryRequest(
+            member, [{"role": "user",
+                      "content": long_pre + f"[{tag} {i}] "
+                                 + TASKS[i % len(TASKS)][:64]}],
+            temperature=0.0, max_tokens=16, session_id=f"{tag}-{i}")
+            for i in range(n_prompts)]
+
+    def run(quant, tag, seed=0):
+        b = TPUBackend([member], continuous=True, continuous_chunk=16,
+                       host_kv_mb=64, seed=seed,
+                       quantize_weights=quant, quantize_kv=quant)
+        try:
+            t0 = time.monotonic()
+            out = b.query(reqs(tag))
+            wall = time.monotonic() - t0
+            assert all(r.ok for r in out), \
+                [r.error for r in out if not r.ok]
+            toks = sum(r.usage.completion_tokens for r in out)
+            eng = b.engines[member]
+            # one real handoff envelope through the wire codec: a
+            # directly-sessioned probe of the same preamble, exported
+            # via the production hibernate path
+            probe = eng.tokenizer.encode(long_pre + " envelope probe",
+                                         add_bos=True)
+            eng.generate([probe], temperature=0.0, max_new_tokens=4,
+                         session_ids=["envprobe"])
+            h = KVHandoff()
+            env = h.export(eng, "envprobe", member)
+            env_bytes = len(wire.encode_envelope(env))
+            # one real prefix-block spill file
+            spill_bytes = 0
+            with tempfile.TemporaryDirectory() as d:
+                tier = eng.sessions.tier
+                from quoracle_tpu.serving.kvtier import DiskPrefixStore
+                tier.disk = DiskPrefixStore(
+                    d, eng.kv_signature(), model=member)
+                tier._ensure_spill_writer()
+                r2 = b.query(reqs(tag + "b"))
+                assert all(x.ok for x in r2)
+                tier.flush_spills()
+                for root, _, files in os.walk(d):
+                    spill_bytes += sum(
+                        os.path.getsize(os.path.join(root, f))
+                        for f in files)
+            return {
+                "texts": [r.text for r in out],
+                "tok_s": round(toks / max(1e-9, wall), 1),
+                "env_bytes": env_bytes,
+                "spill_bytes": spill_bytes,
+                "kv_bytes_per_token": eng.kv_token_pool_bytes(),
+                "resident_kv_tokens": eng.sessions.max_tokens,
+            }
+        finally:
+            b.close()
+
+    base = run(False, "q19")
+    quant = run(True, "q19")
+    quant2 = run(True, "q19")             # fresh build, same config
+    self_consistent = quant2["texts"] == quant["texts"]
+    assert self_consistent, "quantized runs diverged between builds"
+
+    # per-member scorecard-style deltas: token agreement + exact match
+    def lcp_frac(a, b):
+        n = min(len(a), len(b))
+        i = 0
+        while i < n and a[i] == b[i]:
+            i += 1
+        return i / max(1, max(len(a), len(b)))
+
+    agreements = [lcp_frac(x, y)
+                  for x, y in zip(base["texts"], quant["texts"])]
+    scorecard = {member: {
+        "exact_match_frac": round(
+            sum(x == y for x, y in zip(base["texts"], quant["texts"]))
+            / n_prompts, 3),
+        "token_agreement_frac": round(
+            sum(agreements) / n_prompts, 3),
+    }}
+
+    # planning view at fixed HBM (the 2x capacity claim, exact rates).
+    # Tiny test geometry (hd=16) pays ~25% scale overhead, so the 8B
+    # production geometry (hd=128, ~3% overhead) is planned beside it —
+    # that row is where "~2x at fixed HBM" is an honest claim.
+    plan_b = pool_sizing([member], n_devices=1)
+    plan_q = pool_sizing([member], n_devices=1, quantize_kv=True,
+                         quantize_weights=True)
+    plan8_b = pool_sizing(["xla:llama-3-8b"], n_devices=4)
+    plan8_q = pool_sizing(["xla:llama-3-8b"], n_devices=4,
+                          quantize_kv=True, quantize_weights=True)
+    return {
+        "n_prompts": n_prompts,
+        "kv_bytes_per_token_bf16": base["kv_bytes_per_token"],
+        "kv_bytes_per_token_int8": quant["kv_bytes_per_token"],
+        "kv_bytes_ratio": round(quant["kv_bytes_per_token"]
+                                / base["kv_bytes_per_token"], 3),
+        "resident_kv_tokens_plan_bf16":
+            plan_b["members"][0]["resident_kv_tokens"],
+        "resident_kv_tokens_plan_int8":
+            plan_q["members"][0]["resident_kv_tokens"],
+        "resident_kv_tokens_8b_bf16":
+            plan8_b["members"][0]["resident_kv_tokens"],
+        "resident_kv_tokens_8b_int8":
+            plan8_q["members"][0]["resident_kv_tokens"],
+        "resident_kv_tokens_8b_ratio": round(
+            plan8_q["members"][0]["resident_kv_tokens"]
+            / max(1, plan8_b["members"][0]["resident_kv_tokens"]), 3),
+        "handoff_bytes_bf16": base["env_bytes"],
+        "handoff_bytes_int8": quant["env_bytes"],
+        "handoff_bytes_ratio": round(
+            quant["env_bytes"] / max(1, base["env_bytes"]), 3),
+        "spill_bytes_bf16": base["spill_bytes"],
+        "spill_bytes_int8": quant["spill_bytes"],
+        "spill_bytes_ratio": round(
+            quant["spill_bytes"] / max(1, base["spill_bytes"]), 3),
+        "tokens_per_s_bf16": base["tok_s"],
+        "tokens_per_s_int8": quant["tok_s"],
+        "scorecard_deltas": scorecard,
+        "self_consistent": self_consistent,
+    }
+
+
 def measure_quality_overhead(backend, pool,
                              n_decides: int = N_CYCLES) -> dict:
     """Config 12: consensus-quality instrumentation overhead (ISSUE 5).
@@ -1888,6 +2040,22 @@ def base_payload() -> dict:
         "config18_prefix_hit_frac_without": None,
         "config18_router_rows_per_s": None,
         "config18_temp0_equal": None,
+        # config 19 — quantized serving (ISSUE 13): int8 weights + int8
+        # KV pages vs the bf16 baseline — exact per-token byte rates,
+        # planned resident tokens at fixed HBM, MEASURED handoff/spill
+        # byte ratios, tokens/sec both modes, per-member scorecard-style
+        # agreement deltas, and a self-consistency ASSERT (two quantized
+        # builds bit-identical). Detail in the QUANT sidecar
+        # (QUORACLE_BENCH_QUANT).
+        "config19_kv_bytes_ratio": None,
+        "config19_resident_kv_tokens_plan_bf16": None,
+        "config19_resident_kv_tokens_plan_int8": None,
+        "config19_handoff_bytes_ratio": None,
+        "config19_spill_bytes_ratio": None,
+        "config19_tokens_per_s_bf16": None,
+        "config19_tokens_per_s_int8": None,
+        "config19_agreement_frac": None,
+        "config19_self_consistent": None,
         "cycles": None,
         "rounds_per_cycle": None,
         "max_new_tokens": None,
@@ -2382,6 +2550,21 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             except OSError as e:
                 log(f"config18 sidecar write failed: {e}")
 
+    # config 19 builds its own backends (quantized vs not must not share
+    # engines — the whole point is two independent numeric regimes)
+    cfg19 = guard("config19", lambda: measure_quant(pool))
+    if cfg19:
+        log(f"config19: {cfg19}")
+        sidecar = os.environ.get("QUORACLE_BENCH_QUANT")
+        if sidecar:
+            try:
+                with open(sidecar, "w") as f:
+                    json.dump({"metric": "quant",
+                               "config19": cfg19}, f, indent=1)
+                log(f"config19 quant detail written to {sidecar}")
+            except OSError as e:
+                log(f"config19 sidecar write failed: {e}")
+
     def vision_config():
         # config 5: vision pool — free the trio's HBM first (weights + KV
         # page pools), then serve llama + the VLM checkpoint with an
@@ -2653,6 +2836,24 @@ def _run(args, payload: dict, deadline_at: float) -> None:
                 cfg18["prefix_hit_frac_without"],
             "config18_router_rows_per_s": cfg18["router_rows_per_s"],
             "config18_temp0_equal": cfg18["temp0_equal"],
+        })
+    if cfg19:
+        member19 = next(iter(cfg19["scorecard_deltas"]))
+        payload.update({
+            "config19_kv_bytes_ratio": cfg19["kv_bytes_ratio"],
+            "config19_resident_kv_tokens_plan_bf16":
+                cfg19["resident_kv_tokens_plan_bf16"],
+            "config19_resident_kv_tokens_plan_int8":
+                cfg19["resident_kv_tokens_plan_int8"],
+            "config19_handoff_bytes_ratio":
+                cfg19["handoff_bytes_ratio"],
+            "config19_spill_bytes_ratio": cfg19["spill_bytes_ratio"],
+            "config19_tokens_per_s_bf16": cfg19["tokens_per_s_bf16"],
+            "config19_tokens_per_s_int8": cfg19["tokens_per_s_int8"],
+            "config19_agreement_frac":
+                cfg19["scorecard_deltas"][member19][
+                    "token_agreement_frac"],
+            "config19_self_consistent": cfg19["self_consistent"],
         })
     if cfg10:
         payload.update({

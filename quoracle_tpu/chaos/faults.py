@@ -37,6 +37,10 @@ kvtier.restore          TierManager.restore_session            fail, delay
 kvtier.disk_load        DiskPrefixStore.load (corrupts the     corrupt
                         FILE bytes so the crc32 boundary is
                         exercised end-to-end)
+kvtier.scale_corrupt    DiskPrefixStore.load (flips a byte in  corrupt
+                        an int8 entry's appended per-page
+                        scale arrays — same crc boundary,
+                        ISSUE 13)
 compile.key             CompileRegistry.record (salts the      poison
                         shape key → ledger-level recompile
                         storm)
@@ -93,6 +97,11 @@ INJECTION_POINTS: dict = {
                       "ladder (degrades to re-prefill)",
     "kvtier.disk_load": "on-disk prefix entry corrupted before load — "
                         "the crc32 boundary must catch it",
+    "kvtier.scale_corrupt": "int8 entry's per-page scale bytes flipped "
+                            "on the restore path (ISSUE 13) — the same "
+                            "crc boundary must reject it; a wrong "
+                            "scale would silently rescale every token "
+                            "of the page",
     "compile.key": "compile-cache key poisoning — every dispatch "
                    "ledgers as a fresh miss (recompile storm)",
     "admission.signals": "admission signal refresh dropped/delayed — "

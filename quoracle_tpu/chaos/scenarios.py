@@ -506,23 +506,28 @@ class DriftStorm(Scenario):
 
 
 class HbmPressureChurn(Scenario):
-    """Sessioned continuous-batching traffic while chaos forces the
-    eviction ladder to hibernate everything demotable every other tick,
-    fails a quarter of the restores (degrade-to-re-prefill), and
-    poisons compile-cache keys into a ledger-level recompile storm.
-    Outputs must not move a bit; the storm gauge must trip and
-    recover."""
+    """Sessioned continuous-batching traffic on an INT8-quantized member
+    (ISSUE 13) while chaos forces the eviction ladder to hibernate
+    everything demotable every other tick, fails a quarter of the
+    restores (degrade-to-re-prefill), poisons compile-cache keys into a
+    ledger-level recompile storm, and flips per-page SCALE bytes in
+    disk entries on the restore path. Outputs must not move a bit; the
+    storm gauge must trip and recover; every scale corruption must be
+    crc-rejected (skip, unlink, re-prefill) — silently-wrong KV is the
+    one outcome this scenario exists to rule out."""
 
     name = "hbm_pressure_churn"
     description = ("forced demote churn + restore failures + compile-"
-                   "key poisoning under sessioned continuous traffic")
+                   "key poisoning + per-page scale corruption under "
+                   "sessioned continuous traffic on a quantized member")
 
     N_SESSIONS = 3
 
     def build(self, ctx: dict) -> None:
         from quoracle_tpu.models.runtime import TPUBackend
         b = TPUBackend([MEMBER], continuous=True, continuous_chunk=8,
-                       host_kv_mb=32)
+                       host_kv_mb=32, disk_kv_dir=ctx["tmpdir"],
+                       disk_kv_gb=1.0, quantize_kv=True)
         ctx["backend"] = b
         ctx["backends"] = [b]
 
@@ -531,12 +536,15 @@ class HbmPressureChurn(Scenario):
             FaultRule("sched.tick", "demote", every=2),
             FaultRule("kvtier.restore", "fail", prob=0.25),
             FaultRule("compile.key", "poison", max_fires=8),
+            FaultRule("kvtier.scale_corrupt", "corrupt", prob=0.5),
         ]
 
     def traffic(self, ctx: dict, phase: str) -> dict:
         b = ctx["backend"]
         results = []
-        prompts = [f"churn session {i}: keep a running tally. " * 2
+        # > 1 page (128 tokens, byte tokenizer) so wave 1's store-backs
+        # write-through full prefix blocks to the disk store
+        prompts = [f"churn session {i}: keep a running tally. " * 4
                    for i in range(self.N_SESSIONS)]
         # wave 1 establishes sessions; churn demotes them between
         # ticks; wave 2 resumes them (restore or re-prefill, same bits)
@@ -545,15 +553,43 @@ class HbmPressureChurn(Scenario):
                 results += b.query([_req(
                     _msgs(p + f" wave {wave}."), max_tokens=10,
                     sid=f"{phase}-churn{i}")])
+        # wave 3: FRESH sessions over the same shared prompts, with the
+        # radix tree stripped and the host prefix copies evicted — the
+        # prefix ladder's DISK rung must serve, i.e. every restore runs
+        # through the crc boundary the scale_corrupt point flips
+        # (reject → unlink → re-prefill, bits unchanged).
+        eng = b.engines[MEMBER]
+        tier = eng.sessions.tier
+        tier.flush_spills()
+        with eng._paged_lock:
+            with eng.sessions.lock:
+                got = eng.sessions.alloc(eng.sessions.n_pages - 1)
+                if got is not None:
+                    eng.sessions._release(got)
+        with eng.sessions.lock:
+            for key in list(tier.host.prefixes):
+                e = tier.host.prefixes.pop(key)
+                tier.host.bytes -= e.nbytes
+            tier.host.sessions.clear()
+            tier.host.bytes = 0
+        for i, p in enumerate(prompts):
+            results += b.query([_req(
+                _msgs(p + " wave 0."), max_tokens=10,
+                sid=f"{phase}-fresh{i}")])
         for i in range(self.N_SESSIONS):
             b.drop_session(f"{phase}-churn{i}")
+            b.drop_session(f"{phase}-fresh{i}")
         eng = b.engines[MEMBER]
         tier = eng.sessions.tier
         return {
-            "submitted": 2 * self.N_SESSIONS,
+            "submitted": 3 * self.N_SESSIONS,
             "results": results, "eq": results,
             "tier": tier.stats() if tier is not None else {},
             "storms_total": eng.compiles.storms_total,
+            # a storm already active at phase end never RE-trips inside
+            # the 120 s window — the detection check must not demand a
+            # second transition
+            "storm_active": eng.compiles.storm,
         }
 
     def check(self, ctx, clean, storm, plan, flight_slice) -> list:
@@ -565,6 +601,12 @@ class HbmPressureChurn(Scenario):
                   - clean.get("storms_total", 0))
         poisoned = [t for t in plan.schedule() if t[3] == "poison"]
         churned = [t for t in plan.schedule() if t[3] == "demote"]
+        scale_hits = [t for t in plan.schedule()
+                      if t[0] == "kvtier.scale_corrupt"]
+        disk = (tier_storm.get("disk") or {})
+        corrupt_detected = (disk.get("corrupt_skipped", 0)
+                            - ((tier_clean.get("disk") or {})
+                               .get("corrupt_skipped", 0)))
         out = [
             inv.no_silent_loss(storm["submitted"], storm["results"],
                                backends=[ctx["backend"]]),
@@ -578,11 +620,27 @@ class HbmPressureChurn(Scenario):
                 f"demote_faults={len(churned)} sessions_demoted={demoted}"),
             inv.InvariantResult(
                 "storm_detected",
-                storms >= 1 if len(poisoned) >= 5 else True,
-                f"poisoned_keys={len(poisoned)} storms_tripped={storms}"),
+                (storms >= 1 or bool(clean.get("storm_active"))
+                 or bool(storm.get("storm_active")))
+                if len(poisoned) >= 5 else True,
+                f"poisoned_keys={len(poisoned)} storms_tripped={storms} "
+                f"active={bool(storm.get('storm_active'))}"),
+            # ISSUE 13 satellite: every flipped per-page scale byte must
+            # be DETECTED — crc reject → skip + unlink + re-prefill. The
+            # temp-0 equality check above is the "never silently wrong"
+            # half; this is the "the boundary actually fired" half.
+            inv.InvariantResult(
+                "scale_corruption_detected",
+                corrupt_detected >= 1 if scale_hits else True,
+                f"scale_corrupt_faults={len(scale_hits)} "
+                f"crc_rejects={corrupt_detected}"),
         ]
         storm["evidence"] = {"tier": tier_storm, "storms": storms,
-                             "poisoned": len(poisoned)}
+                             "storm_active": bool(
+                                 storm.get("storm_active")),
+                             "poisoned": len(poisoned),
+                             "scale_corrupt": len(scale_hits),
+                             "crc_rejects": corrupt_detected}
         return out
 
 

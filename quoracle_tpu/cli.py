@@ -85,7 +85,9 @@ async def cmd_run(args: argparse.Namespace) -> int:
                                fabric_peers=(args.fabric_peers.split(",")
                                              if args.fabric_peers else None),
                                prefixd=args.prefixd,
-                               chaos_plan=args.chaos_plan))
+                               chaos_plan=args.chaos_plan,
+                               quantize_weights=args.quantize_weights,
+                               quantize_kv=args.quantize_kv))
     _attach_printer(rt)
     if pool is None and args.profile is None:
         pool = rt.default_pool()
@@ -124,7 +126,9 @@ async def cmd_resume(args: argparse.Namespace) -> int:
                                fabric_peers=(args.fabric_peers.split(",")
                                              if args.fabric_peers else None),
                                prefixd=args.prefixd,
-                               chaos_plan=args.chaos_plan))
+                               chaos_plan=args.chaos_plan,
+                               quantize_weights=args.quantize_weights,
+                               quantize_kv=args.quantize_kv))
     _attach_printer(rt)
     result = await rt.boot()
     print(json.dumps(result), flush=True)
@@ -157,7 +161,9 @@ async def cmd_serve(args: argparse.Namespace) -> int:
         fabric_peers=(args.fabric_peers.split(",")
                       if args.fabric_peers else None),
         prefixd=args.prefixd,
-        chaos_plan=args.chaos_plan))
+        chaos_plan=args.chaos_plan,
+        quantize_weights=args.quantize_weights,
+        quantize_kv=args.quantize_kv))
     # Validate host/token BEFORE boot so a refused bind exits with a clean
     # message instead of a traceback over a half-started runtime.
     try:
@@ -251,6 +257,21 @@ def build_parser() -> argparse.ArgumentParser:
                              "pool member (GiB): oldest-LRU entries "
                              "prune when a write overflows it; 0 = "
                              "unbounded")
+        sp.add_argument("--quantize-weights", dest="quantize_weights",
+                        action="store_true",
+                        help="quantized serving (models/quant.py): "
+                             "per-channel symmetric int8 weights with "
+                             "on-the-fly dequant in the matmuls — ~2x "
+                             "more/larger pool members at fixed HBM")
+        sp.add_argument("--quantize-kv", dest="quantize_kv",
+                        action="store_true",
+                        help="quantized serving: int8 KV pages with "
+                             "per-(token, kv-head) scales beside them "
+                             "— resident_kv_tokens ~doubles and every "
+                             "demote/spill/handoff ships ~half the "
+                             "bytes; the quant format is part of "
+                             "kv_signature (mixed-precision peers "
+                             "reject handoff and re-prefill)")
         sp.add_argument("--replicas", type=int, default=1,
                         help="disaggregated serving plane "
                              "(serving/cluster.py): run N full replicas "

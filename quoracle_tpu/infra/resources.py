@@ -96,11 +96,11 @@ def headroom_fraction(devices: Optional[list[dict]] = None) -> Optional[float]:
 
 
 def _kv_page_bytes(engine) -> int:
-    import jax
-    cfg = engine.cfg
-    return (2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
-            * jax.numpy.dtype(engine.cache_dtype).itemsize
-            * engine.sessions.page)
+    # per-token pool byte rate is the engine's own (int8 payload +
+    # scales for quantized members, plain cache bytes otherwise —
+    # ISSUE 13), so demotable/headroom math matches what demote
+    # actually moves
+    return engine.kv_token_pool_bytes() * engine.sessions.page
 
 
 def reclaimable_kv_bytes(backend) -> int:
@@ -198,11 +198,13 @@ def hbm_attribution(backend) -> dict:
                 for p in jax.tree.leaves(e.params))
             st = e.sessions
             cfg = e.cfg
-            page_b = (2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
-                      * jax.numpy.dtype(e.cache_dtype).itemsize * st.page)
+            page_b = _kv_page_bytes(e)
             pool_b = 0
             if st.k is not None:
                 pool_b = int(st.k.nbytes) + int(st.v.nbytes)
+                if st.k_scale is not None:
+                    pool_b += (int(st.k_scale.nbytes)
+                               + int(st.v_scale.nbytes))
             with st.lock:
                 free = len(st._free)
                 n_sessions = len(st._sessions)

@@ -739,6 +739,28 @@ KV_ALLOC_DRIFT_TOTAL = METRICS.counter(
     "SessionStore.alloc accounting-drift refusals (the formerly silent "
     "defensive branch), per model — any nonzero value is a bug report")
 
+# -- quantized serving (ISSUE 13) --------------------------------------------
+# Int8 weights + int8 KV pages (models/quant.py): the byte-economy
+# instruments — bytes each tier move avoided shipping, the per-token KV
+# rate capacity planning actually gets, and the dequant-path program
+# identity — so a quantized member's 2x capacity claim is auditable
+# from /metrics.
+QUANT_BYTES_SAVED_TOTAL = METRICS.counter(
+    "quoracle_quant_bytes_saved_total",
+    "bytes NOT held or shipped because a member serves int8, by tier "
+    "(weights | demote | disk_spill | handoff), per model — each event "
+    "counts the bf16-equivalent minus the actual int8+scales bytes")
+QUANT_KV_BYTES_PER_TOKEN = METRICS.gauge(
+    "quoracle_quant_kv_bytes_per_token",
+    "pool bytes per resident KV token (int8 payload + per-(token, "
+    "kv-head) fp32 scales) for quantized members — compare against "
+    "2·L·KV·hd·2 for the bf16 rate the member would otherwise pay")
+QUANT_DEQUANT_COMPILES_TOTAL = METRICS.counter(
+    "quoracle_quant_dequant_compiles_total",
+    "compile-ledger misses booked by quantized-KV engines, per model — "
+    "the dequant path's program identities; a storm here is the same "
+    "capacity incident as quoracle_compile_cache_misses_total")
+
 # -- disaggregated serving plane (ISSUE 10) ----------------------------------
 # Cluster/router/handoff instruments (serving/cluster.py, router.py,
 # handoff.py): replica topology, placement flow, and the prefill→decode

@@ -334,6 +334,8 @@ def decode_ragged(
     json_state: Optional[jax.Array] = None,
     shard: Optional[tuple] = None,
     interpret: Optional[bool] = None,
+    k_scale: Optional[jax.Array] = None,   # [L, n_pages, KV, page] f32 —
+    v_scale: Optional[jax.Array] = None,   # int8 pools (ISSUE 13)
 ) -> tuple:
     """Autoregressive decode through the UNIFIED ragged kernel (ISSUE 8):
     same sampling/grammar semantics as decode()/decode_paged(), but each
@@ -346,11 +348,15 @@ def decode_ragged(
 
     Returns (tokens [R, max_new], n_emitted [R], lens [R], k_pool,
     v_pool, jstate) where lens counts the row's valid pool tokens
-    (prompt + chunk + emitted-and-forwarded)."""
+    (prompt + chunk + emitted-and-forwarded). With ``k_scale``/
+    ``v_scale`` (int8 pools, ISSUE 13) each step's token quantizes on
+    write inside the forward and the return grows (…, k_scale, v_scale,
+    jstate)."""
     R = first_logits.shape[0]
     L, n_pages, page, KV, HD = k_pool.shape
     n_tok = n_pages * page
     maxp = tables.shape[1]
+    quant = k_scale is not None
     fns = _sampling_fns(json_table, eos_id, stop_ids)
     is_stop, mask_logits, advance, _ = fns
     tok0, n0, done0, jstate0, out0, rng = _first_token(
@@ -363,7 +369,8 @@ def decode_ragged(
         return (i < max_new) & ~jnp.all(done)
 
     def body(carry):
-        (i, done, cur, out, n_emitted, lens, kp, vp, rng, jstate) = carry
+        (i, done, cur, out, n_emitted, lens, kp, vp, ks, vs, rng,
+         jstate) = carry
         live = (~done).astype(jnp.int32)
         # this step's token writes at buffer slot lens; done rows (and
         # any row at its page-table edge) drop via the OOB sentinel
@@ -378,9 +385,15 @@ def decode_ragged(
             live,                     # nq
         ], axis=1)
         positions = lens + kv_off.astype(jnp.int32)
-        hidden, kp, vp = forward_hidden_ragged(
-            params, cfg, cur[None], positions[None], kp, vp, tables, meta,
-            flat, tq=1, interpret=interpret, shard=shard)
+        if quant:
+            hidden, kp, vp, ks, vs = forward_hidden_ragged(
+                params, cfg, cur[None], positions[None], kp, vp, tables,
+                meta, flat, tq=1, interpret=interpret, shard=shard,
+                k_scale=ks, v_scale=vs)
+        else:
+            hidden, kp, vp = forward_hidden_ragged(
+                params, cfg, cur[None], positions[None], kp, vp, tables,
+                meta, flat, tq=1, interpret=interpret, shard=shard)
         logits = project_logits(params, cfg, hidden)[0]      # [R, V]
         rng, k = jax.random.split(rng)
         nxt = sample_tokens(mask_logits(logits, jstate), k, temperature,
@@ -392,13 +405,18 @@ def decode_ragged(
         lens = lens + jnp.where(done, 0, 1)
         jstate = advance(jstate, nxt, done)
         done = done | is_stop(nxt) | (n_emitted >= row_limit)
-        return (i + 1, done, nxt, out, n_emitted, lens, kp, vp, rng,
-                jstate)
+        return (i + 1, done, nxt, out, n_emitted, lens, kp, vp, ks, vs,
+                rng, jstate)
 
+    # unquantized loops carry scale placeholders as empty pytrees (None
+    # is a valid while_loop carry leaf-less node)
     init = (jnp.asarray(1, jnp.int32), done0, tok0, out0, n0, lens0,
-            k_pool, v_pool, rng, jstate0)
-    (_, done, _, out, n_emitted, lens, k_pool, v_pool, _, jstate) = \
-        jax.lax.while_loop(cond, body, init)
+            k_pool, v_pool, k_scale, v_scale, rng, jstate0)
+    (_, done, _, out, n_emitted, lens, k_pool, v_pool, k_scale, v_scale,
+     _, jstate) = jax.lax.while_loop(cond, body, init)
+    if quant:
+        return (out, n_emitted, lens, k_pool, v_pool, k_scale, v_scale,
+                jstate)
     return out, n_emitted, lens, k_pool, v_pool, jstate
 
 
@@ -507,9 +525,13 @@ class SessionStore:
         from quoracle_tpu.models.prefix_cache import RadixPrefixCache
         self.prefix_cache = RadixPrefixCache(self)
         # device pool arrays live on the engine (self.k/self.v set there);
-        # the store only manages ids.
+        # the store only manages ids. Quantized-KV engines (ISSUE 13)
+        # additionally hold the per-(token, kv-head) fp32 scale pools
+        # ([L, n_pages, KV, page]) beside the int8 payload pools.
         self.k: Optional[jax.Array] = None
         self.v: Optional[jax.Array] = None
+        self.k_scale: Optional[jax.Array] = None
+        self.v_scale: Optional[jax.Array] = None
         # Tiered KV (ISSUE 7, serving/kvtier.py): when attached, alloc's
         # eviction ladder DEMOTES victims to the host tier instead of
         # destroying them, and the engine's session lookup restores
@@ -940,13 +962,38 @@ class GenerateEngine:
                  max_seq: Optional[int] = None, seed: int = 0,
                  prompt_buckets: Sequence[int] = (128, 256, 512, 1024, 2048, 4096, 8192),
                  mesh=None, session_max_bytes: int = 2 << 30,
-                 sp_window: Optional[int] = None):
+                 sp_window: Optional[int] = None,
+                 quantize_weights: bool = False,
+                 quantize_kv: bool = False):
         import threading
 
         from quoracle_tpu.analysis.lockdep import named_lock
         self.cfg = cfg
         self.mesh = mesh
         self.last_prefill_tokens = 0   # diagnostics: suffix actually computed
+        # Int8 quantized serving (ISSUE 13, models/quant.py): weights
+        # quantize per-channel at build; the KV pool stores int8 pages
+        # with per-(token, kv-head) scales beside them. Single-device
+        # engines only for now — shard_params has no placement rule for
+        # {q8, scale} leaves, and the flat ragged layout is the
+        # quantized serving path (it can't ride a dp axis anyway).
+        self.quantize_weights = bool(quantize_weights)
+        self.quantize_kv = bool(quantize_kv)
+        if (self.quantize_weights or self.quantize_kv) \
+                and mesh is not None:
+            raise ValueError(
+                f"engine {cfg.name}: int8 quantized serving "
+                f"(--quantize-weights/--quantize-kv) serves on "
+                f"single-device engines; drop the mesh or the flags")
+        # Params dtype drives the dense working-cache dtype; capture it
+        # BEFORE weight quantization turns leaves int8.
+        self._raw_param_dtype = jax.tree.leaves(params)[0].dtype
+        self._raw_param_bytes = sum(
+            int(x.size) * jnp.dtype(x.dtype).itemsize
+            for x in jax.tree.leaves(params))
+        if self.quantize_weights:
+            from quoracle_tpu.models.quant import quantize_params
+            params = quantize_params(params, cfg)
         if mesh is not None:
             from quoracle_tpu.parallel.mesh import shard_params
             params = shard_params(params, mesh, cfg)
@@ -965,16 +1012,23 @@ class GenerateEngine:
         self._rng = jax.random.PRNGKey(seed)
         self._rng_lock = named_lock("engine.rng")
         # KV cache dtype follows the params (bf16 serving, fp32 parity tests)
-        # — mixing dtypes would fail the in-place cache scatter.
-        self.cache_dtype = jax.tree.leaves(params)[0].dtype
+        # — mixing dtypes would fail the in-place cache scatter. With
+        # quantized KV the POOL dtype is int8 (scales beside the pages);
+        # dense working caches stay at the params dtype.
+        self.cache_dtype = self._raw_param_dtype
+        self.pool_dtype = jnp.int8 if self.quantize_kv else self.cache_dtype
         # Session budget in BYTES, converted to tokens for the store: per
         # cached token K+V cost 2 · L · n_kv · hd · itemsize — at 8B scale
         # that's ~128 KiB/token, so a token-denominated default would permit
         # tens of GiB of HBM before "bounding" anything. Also capped at 32
         # full context windows so tiny-KV test models don't allocate a
-        # giant pool from the byte budget alone.
-        token_bytes = (2 * cfg.n_layers * cfg.n_kv_heads * cfg.head_dim
-                       * jnp.dtype(self.cache_dtype).itemsize)
+        # giant pool from the byte budget alone. Int8 pools count their
+        # per-(token, head) scales, so resident_kv_tokens lands at ~2x
+        # the bf16 figure at the same byte budget (ISSUE 13).
+        from quoracle_tpu.models.quant import kv_token_bytes
+        token_bytes = kv_token_bytes(
+            cfg.n_layers, cfg.n_kv_heads, cfg.head_dim,
+            jnp.dtype(self.pool_dtype).itemsize, self.quantize_kv)
         self.sessions = SessionStore(
             max_tokens=max(PAGE, min(session_max_bytes // token_bytes,
                                      32 * self.max_seq)))
@@ -1025,6 +1079,27 @@ class GenerateEngine:
         # (0 on TPU, off elsewhere — CPU serving sticks with the fused
         # gather programs; tests force the unified path explicitly).
         self.unified_min_tokens = resolve_unified_gate(gates)
+        if self.quantize_kv:
+            # Quantized KV serves through the unified ragged path (the
+            # kernel dequantizes in its streaming loop; the gather refs
+            # are the CPU twin) — force it on regardless of platform
+            # calibration; the gather programs stay the structural
+            # fallback (pool exhaustion, partial boundary swaps) with
+            # dequant-on-gather / requant-on-scatter.
+            self.unified_min_tokens = 0
+            from quoracle_tpu.infra.telemetry import (
+                QUANT_KV_BYTES_PER_TOKEN,
+            )
+            QUANT_KV_BYTES_PER_TOKEN.set(float(token_bytes),
+                                         model=cfg.name)
+        if self.quantize_weights:
+            from quoracle_tpu.models.quant import params_nbytes
+            from quoracle_tpu.infra.telemetry import (
+                QUANT_BYTES_SAVED_TOTAL,
+            )
+            QUANT_BYTES_SAVED_TOTAL.inc(
+                max(0, self._raw_param_bytes - params_nbytes(self.params)),
+                model=cfg.name, tier="weights")
         # Padding-waste accounting (ISSUE 8 satellite): per generate call
         # (one continuous-batcher tick), how many chunk-token slots the
         # device actually processed vs the tick's real tokens. Ragged
@@ -1156,6 +1231,49 @@ class GenerateEngine:
 
         KV, HD, L = cfg.n_kv_heads, cfg.head_dim, cfg.n_layers
         page = self.sessions.page
+        # Int8 KV pools (ISSUE 13): the gather programs dequantize page
+        # reads into the dense working cache and requantize on scatter;
+        # the unified ragged path writes int8+scale directly inside its
+        # forward. ``quant`` is a trace-time constant, so the two modes
+        # compile disjoint programs off one code path.
+        quant = self.quantize_kv
+        work_dtype = self.cache_dtype
+
+        def _gather_work(k_pool, v_pool, k_scale, v_scale, src_pages):
+            """Resident pages → dense working cache [L, B, maxp·page,
+            KV, HD] (int8 pools dequantize per (token, kv-head) on the
+            gather)."""
+            B, maxp = src_pages.shape
+            kw = k_pool[:, src_pages].reshape(L, B, maxp * page, KV, HD)
+            vw = v_pool[:, src_pages].reshape(L, B, maxp * page, KV, HD)
+            if not quant:
+                return kw, vw
+            ks = k_scale[:, src_pages].transpose(0, 1, 2, 4, 3) \
+                .reshape(L, B, maxp * page, KV)
+            vs = v_scale[:, src_pages].transpose(0, 1, 2, 4, 3) \
+                .reshape(L, B, maxp * page, KV)
+            kw = (kw.astype(jnp.float32) * ks[..., None]).astype(work_dtype)
+            vw = (vw.astype(jnp.float32) * vs[..., None]).astype(work_dtype)
+            return kw, vw
+
+        def _quant_scatter(k_pool, v_pool, k_scale, v_scale, k_work,
+                           v_work, dst_pages):
+            """Working cache → dst pages, requantizing per (token,
+            kv-head) with the shared write rule (models/quant.kv_quant);
+            scales land page-structured beside the pages."""
+            from quoracle_tpu.models.quant import kv_quant
+            B, maxp = dst_pages.shape
+            kp = k_work.reshape(L, B, maxp, page, KV, HD)
+            vp = v_work.reshape(L, B, maxp, page, KV, HD)
+            kq, ks = kv_quant(kp)          # ks: [L, B, maxp, page, KV]
+            vq, vs = kv_quant(vp)
+            k_pool = k_pool.at[:, dst_pages].set(kq, mode="drop")
+            v_pool = v_pool.at[:, dst_pages].set(vq, mode="drop")
+            k_scale = k_scale.at[:, dst_pages].set(
+                ks.transpose(0, 1, 2, 4, 3), mode="drop")
+            v_scale = v_scale.at[:, dst_pages].set(
+                vs.transpose(0, 1, 2, 4, 3), mode="drop")
+            return k_pool, v_pool, k_scale, v_scale
         # tp-sharded ragged kernels: each tp shard runs the single-device
         # kernel on its local heads under shard_map (heads independent, no
         # collective) — mesh engines keep the direct paths instead of
@@ -1182,16 +1300,18 @@ class GenerateEngine:
         self._ragged_ok = mesh is None or ragged_shard is not None
 
         @functools.partial(jax.jit, static_argnames=())
-        def step_paged_prefill(params, k_pool, v_pool, src_pages, tokens,
-                               prefix_lens, chunk_lens, kv_off):
+        def step_paged_prefill(params, k_pool, v_pool, k_scale, v_scale,
+                               src_pages, tokens, prefix_lens,
+                               chunk_lens, kv_off):
             # Resume from the page pool: ONE in-device gather materializes
             # each row's resident prefix into the working cache (HBM→HBM at
             # full bandwidth; zero host-side data movement — the host only
             # uploaded the [B, maxp] int32 page table), then only the
-            # suffix chunk runs through the stack.
+            # suffix chunk runs through the stack. Int8 pools dequantize
+            # on the gather (scales are None otherwise).
             B, maxp = src_pages.shape
-            kw = k_pool[:, src_pages].reshape(L, B, maxp * page, KV, HD)
-            vw = v_pool[:, src_pages].reshape(L, B, maxp * page, KV, HD)
+            kw, vw = _gather_work(k_pool, v_pool, k_scale, v_scale,
+                                  src_pages)
             cache = _constrain(KVCache(k=kw, v=vw,
                                        lens=jnp.zeros((B,), jnp.int32)))
             return prefill_chunk(params, cfg, tokens, prefix_lens,
@@ -1199,7 +1319,8 @@ class GenerateEngine:
 
         if cfg.vision is not None:
             @functools.partial(jax.jit, static_argnames=())
-            def step_paged_prefill_vlm(params, k_pool, v_pool, src_pages,
+            def step_paged_prefill_vlm(params, k_pool, v_pool, k_scale,
+                                       v_scale, src_pages,
                                        tokens, prefix_lens, chunk_lens,
                                        kv_off, pixels):
                 # VLM chunk through the PAGED machinery (image-keyed
@@ -1212,8 +1333,8 @@ class GenerateEngine:
                     splice_image_embeds, vision_encode,
                 )
                 B, maxp = src_pages.shape
-                kw = k_pool[:, src_pages].reshape(L, B, maxp * page, KV, HD)
-                vw = v_pool[:, src_pages].reshape(L, B, maxp * page, KV, HD)
+                kw, vw = _gather_work(k_pool, v_pool, k_scale, v_scale,
+                                      src_pages)
                 cache = _constrain(KVCache(k=kw, v=vw,
                                            lens=jnp.zeros((B,), jnp.int32)))
                 img = vision_encode(params["vision"], cfg.vision, pixels)
@@ -1231,8 +1352,9 @@ class GenerateEngine:
             self._step_paged_prefill_vlm = None
 
         @functools.partial(jax.jit, static_argnames=("max_new",),
-                           donate_argnums=(1, 2, 3, 4))
-        def step_paged_decode(params, k_pool, v_pool, k_work, v_work, lens,
+                           donate_argnums=(1, 2, 5, 6))
+        def step_paged_decode(params, k_pool, v_pool, k_scale, v_scale,
+                              k_work, v_work, lens,
                               dst_pages, kv_off, last_logits, rng,
                               temperature, top_p, active, row_limit,
                               json_table, json_state, max_new: int):
@@ -1245,20 +1367,27 @@ class GenerateEngine:
                 json_state=json_state, kv_off=kv_off)
             # Scatter prompt + response KV back into the pool pages in
             # place (pool donated → aliased update). Rows without a session
-            # point every dst slot at scratch page 0.
+            # point every dst slot at scratch page 0. Int8 pools
+            # requantize on the scatter (scales beside the pages).
             B, maxp = dst_pages.shape
-            kp = cache.k.reshape(L, B, maxp, page, KV, HD)
-            vp = cache.v.reshape(L, B, maxp, page, KV, HD)
-            k_pool = k_pool.at[:, dst_pages].set(kp, mode="drop")
-            v_pool = v_pool.at[:, dst_pages].set(vp, mode="drop")
+            if quant:
+                k_pool, v_pool, k_scale, v_scale = _quant_scatter(
+                    k_pool, v_pool, k_scale, v_scale, cache.k, cache.v,
+                    dst_pages)
+            else:
+                kp = cache.k.reshape(L, B, maxp, page, KV, HD)
+                vp = cache.v.reshape(L, B, maxp, page, KV, HD)
+                k_pool = k_pool.at[:, dst_pages].set(kp, mode="drop")
+                v_pool = v_pool.at[:, dst_pages].set(vp, mode="drop")
             # cache.k/v returned (and discarded by the host) so the donated
             # work buffers alias an output — the decode loop then runs
             # truly in place instead of copying the working cache.
-            return out, n_emitted, cache.lens, k_pool, v_pool, cache.k, \
-                cache.v, jstate
+            return out, n_emitted, cache.lens, k_pool, v_pool, k_scale, \
+                v_scale, cache.k, cache.v, jstate
 
         @functools.partial(jax.jit, static_argnames=("kmax", "need_probs"))
-        def step_paged_verify(params, k_pool, v_pool, src_pages, tokens,
+        def step_paged_verify(params, k_pool, v_pool, k_scale, v_scale,
+                              src_pages, tokens,
                               prefix_lens, chunk_lens, kv_off, k_arr,
                               temperature, json_table, json_state,
                               kmax: int, need_probs: bool):
@@ -1273,8 +1402,8 @@ class GenerateEngine:
             # dead weight the next chunk's prefill overwrites (the LCP
             # session resume IS the rollback).
             B, maxp = src_pages.shape
-            kw = k_pool[:, src_pages].reshape(L, B, maxp * page, KV, HD)
-            vw = v_pool[:, src_pages].reshape(L, B, maxp * page, KV, HD)
+            kw, vw = _gather_work(k_pool, v_pool, k_scale, v_scale,
+                                  src_pages)
             cache = _constrain(KVCache(k=kw, v=vw,
                                        lens=jnp.zeros((B,), jnp.int32)))
             T = tokens.shape[1]
@@ -1355,20 +1484,25 @@ class GenerateEngine:
             last = project_logits(params, cfg, last_h)[:, 0, :]
             return last, k_pool, v_pool
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
-        def step_scatter_prompt(k_pool, v_pool, k_work, v_work, dst_pages):
+        @functools.partial(jax.jit, donate_argnums=(0, 1, 4, 5))
+        def step_scatter_prompt(k_pool, v_pool, k_scale, v_scale, k_work,
+                                v_work, dst_pages):
             # Working cache (prefix gather + suffix prefill) → dst pages,
             # BEFORE decode: the direct-decode path then reads pages only.
             # k_work/v_work are donated so the working cache's HBM frees
             # here (the memory win of the direct path) — XLA warns the
             # donation isn't aliasable into an output; that's the point,
-            # it's a free, not an alias.
+            # it's a free, not an alias. Int8 pools requantize on the
+            # scatter (scales beside the pages).
+            if quant:
+                return _quant_scatter(k_pool, v_pool, k_scale, v_scale,
+                                      k_work, v_work, dst_pages)
             B, maxp = dst_pages.shape
             kp = k_work.reshape(L, B, maxp, page, KV, HD)
             vp = v_work.reshape(L, B, maxp, page, KV, HD)
             k_pool = k_pool.at[:, dst_pages].set(kp, mode="drop")
             v_pool = v_pool.at[:, dst_pages].set(vp, mode="drop")
-            return k_pool, v_pool
+            return k_pool, v_pool, k_scale, v_scale
 
         @functools.partial(jax.jit, static_argnames=("max_new",))
         def step_paged_decode_direct(params, k_pool, v_pool, tables,
@@ -1397,9 +1531,28 @@ class GenerateEngine:
             vf = vf.at[:, flat_idx].set(tail_v, mode="drop")
             return (kf.reshape(k_pool.shape), vf.reshape(v_pool.shape))
 
+        def _fwd_ragged(params, k_pool, v_pool, k_scale, v_scale,
+                        tokens_flat, positions_flat, block_tables,
+                        block_meta, flat_dst, tq):
+            """The one ragged forward call both unified steps share:
+            int8 pools thread their scale pools through (quantize-on-
+            write inside the forward, in-kernel dequant on read)."""
+            if quant:
+                return forward_hidden_ragged(
+                    params, cfg, tokens_flat[None], positions_flat[None],
+                    k_pool, v_pool, block_tables, block_meta, flat_dst,
+                    tq=tq, shard=ragged_shard,
+                    k_scale=k_scale, v_scale=v_scale)
+            hidden, k_pool, v_pool = forward_hidden_ragged(
+                params, cfg, tokens_flat[None], positions_flat[None],
+                k_pool, v_pool, block_tables, block_meta, flat_dst,
+                tq=tq, shard=ragged_shard)
+            return hidden, k_pool, v_pool, k_scale, v_scale
+
         @functools.partial(jax.jit, donate_argnums=(1, 2),
                            static_argnames=("tq",))
-        def step_paged_ragged(params, k_pool, v_pool, tokens_flat,
+        def step_paged_ragged(params, k_pool, v_pool, k_scale, v_scale,
+                              tokens_flat,
                               positions_flat, block_tables, block_meta,
                               flat_dst, last_idx, tq: int):
             # UNIFIED mixed chunk forward (ISSUE 8): one ragged launch
@@ -1408,17 +1561,17 @@ class GenerateEngine:
             # chunk KV scattered to the rows' pages inside the forward.
             # Shapes key on (flat token budget, page-table width) only:
             # the batch-bucket × prompt-bucket program matrix collapses.
-            hidden, k_pool, v_pool = forward_hidden_ragged(
-                params, cfg, tokens_flat[None], positions_flat[None],
-                k_pool, v_pool, block_tables, block_meta, flat_dst,
-                tq=tq, shard=ragged_shard)
+            hidden, k_pool, v_pool, k_scale, v_scale = _fwd_ragged(
+                params, k_pool, v_pool, k_scale, v_scale, tokens_flat,
+                positions_flat, block_tables, block_meta, flat_dst, tq)
             last_h = hidden[0][last_idx]                  # [R, D]
             last = project_logits(params, cfg, last_h[:, None])[:, 0, :]
-            return last, k_pool, v_pool
+            return last, k_pool, v_pool, k_scale, v_scale
 
         @functools.partial(jax.jit, donate_argnums=(1, 2),
                            static_argnames=("tq", "kmax", "need_probs"))
-        def step_paged_ragged_verify(params, k_pool, v_pool, tokens_flat,
+        def step_paged_ragged_verify(params, k_pool, v_pool, k_scale,
+                                     v_scale, tokens_flat,
                                      positions_flat, block_tables,
                                      block_meta, flat_dst, widx,
                                      temperature, json_table, json_state,
@@ -1428,10 +1581,9 @@ class GenerateEngine:
             # to pages — committed prefixes resident for the next round,
             # LCP resume is still the rollback) and verdict logits
             # project at the flat indices of each row's last K positions.
-            hidden, k_pool, v_pool = forward_hidden_ragged(
-                params, cfg, tokens_flat[None], positions_flat[None],
-                k_pool, v_pool, block_tables, block_meta, flat_dst,
-                tq=tq, shard=ragged_shard)
+            hidden, k_pool, v_pool, k_scale, v_scale = _fwd_ragged(
+                params, k_pool, v_pool, k_scale, v_scale, tokens_flat,
+                positions_flat, block_tables, block_meta, flat_dst, tq)
             wh = hidden[0][widx]                          # [R, kmax, D]
             logits = project_logits(params, cfg, wh).astype(jnp.float32)
             R = widx.shape[0]
@@ -1464,25 +1616,32 @@ class GenerateEngine:
                     jax.nn.one_hot(ids, logits.shape[-1]), probs)
             else:
                 probs = jnp.zeros((1, 1, 1), jnp.float32)
-            return ids, probs, k_pool, v_pool
+            return ids, probs, k_pool, v_pool, k_scale, v_scale
 
         @functools.partial(jax.jit, donate_argnums=(1, 2),
                            static_argnames=("max_new",))
-        def step_paged_decode_ragged(params, k_pool, v_pool, tables,
+        def step_paged_decode_ragged(params, k_pool, v_pool, k_scale,
+                                     v_scale, tables,
                                      pool_lens, kv_off, last_logits, rng,
                                      temperature, top_p, active,
                                      row_limit, json_table, json_state,
                                      max_new: int):
             # Decode continuation of the unified tick: KV written straight
             # to pages inside the loop (no tail buffer, no tail scatter);
-            # attention is the same ragged kernel at tq=1.
-            return decode_ragged(
+            # attention is the same ragged kernel at tq=1 (int8 pools
+            # quantize each step's token on write).
+            res = decode_ragged(
                 params, cfg, k_pool, v_pool, tables, pool_lens, kv_off,
                 last_logits, rng, temperature, top_p, max_new,
                 cfg.eos_token_id, active=active, row_limit=row_limit,
                 pad_id=self.tokenizer.pad_id, stop_ids=cfg.stop_token_ids,
                 json_table=json_table, json_state=json_state,
-                shard=ragged_shard)
+                shard=ragged_shard, k_scale=k_scale, v_scale=v_scale)
+            if quant:
+                return res
+            out, n_emitted, lens, k_pool, v_pool, jstate = res
+            return (out, n_emitted, lens, k_pool, v_pool, k_scale,
+                    v_scale, jstate)
 
         self._step_paged_ragged = step_paged_ragged
         self._step_paged_ragged_verify = step_paged_ragged_verify
@@ -1709,9 +1868,15 @@ class GenerateEngine:
         compatibility check (serving/handoff.py) — two engines may only
         exchange KV bytes when their signatures match exactly."""
         cfg = self.cfg
+        # Quantized KV is part of the signature (ISSUE 13): a
+        # quantized↔unquantized peer pair must reject handoff BEFORE any
+        # bytes move (and never share a disk-store directory) — the
+        # degrade is a cold re-prefill, exactly the version-skew path.
+        # Unquantized engines keep the historic signature unchanged.
         return (f"{cfg.name.replace('/', '_')}-L{cfg.n_layers}"
                 f"x{cfg.n_kv_heads}x{cfg.head_dim}-p{self.sessions.page}"
-                f"-{jnp.dtype(self.cache_dtype).name}")
+                f"-{jnp.dtype(self.pool_dtype).name}"
+                + ("-q8kv" if self.quantize_kv else ""))
 
     def attach_tier(self, host_mb: int = 256,
                     disk_dir: Optional[str] = None,
@@ -2179,6 +2344,13 @@ class GenerateEngine:
             shape = (B, T, cache_len, max_new, paged)
         if self.compiles.record(shape, latency * 1000):
             JIT_COMPILES.inc(model=name)
+            if self.quantize_kv:
+                # the dequant path's program identity (ISSUE 13): a
+                # storm here is the quantized twin of a compile storm
+                from quoracle_tpu.infra.telemetry import (
+                    QUANT_DEQUANT_COMPILES_TOTAL,
+                )
+                QUANT_DEQUANT_COMPILES_TOTAL.inc(model=name)
             TRACER.emit(
                 "generate.first_shape_compile", latency * 1000,
                 model=name, phase="compile",
@@ -2217,16 +2389,50 @@ class GenerateEngine:
                             if padded else None),
         }
 
+    def kv_token_pool_bytes(self) -> int:
+        """Pool bytes per resident KV token (int8 payload + scales when
+        quantized; plain cache bytes otherwise) — the shared byte rate
+        for resources attribution, /api/kv compression and planning."""
+        from quoracle_tpu.models.quant import kv_token_bytes
+        return kv_token_bytes(
+            self.cfg.n_layers, self.cfg.n_kv_heads, self.cfg.head_dim,
+            jnp.dtype(self.pool_dtype).itemsize, self.quantize_kv)
+
+    def quant_stats(self) -> dict:
+        """The member's quantization posture for /api/kv and bench
+        config 19: mode flags, the per-token KV byte rate vs the bf16
+        rate, and the resulting compression ratio."""
+        bf16_rate = (2 * self.cfg.n_layers * self.cfg.n_kv_heads
+                     * self.cfg.head_dim
+                     * jnp.dtype(self.cache_dtype).itemsize)
+        rate = self.kv_token_pool_bytes()
+        return {
+            "quantize_weights": self.quantize_weights,
+            "quantize_kv": self.quantize_kv,
+            "kv_bytes_per_token": rate,
+            "kv_bytes_per_token_bf16": bf16_rate,
+            "kv_compression": round(bf16_rate / rate, 3) if rate else None,
+            "resident_kv_tokens": self.sessions.max_tokens,
+        }
+
     def _ensure_pool(self) -> None:
         """Allocate the device page pool on first sessioned call (engines
-        that never see sessions never pay for it)."""
+        that never see sessions never pay for it). Quantized-KV engines
+        allocate int8 pools plus the page-structured fp32 scale pools
+        ([L, n_pages, KV, page] — a page's scales are one contiguous
+        block that tier moves carry beside the page)."""
         st = self.sessions
         if st.k is not None:
             return
         shape = (self.cfg.n_layers, st.n_pages, st.page,
                  self.cfg.n_kv_heads, self.cfg.head_dim)
-        k = jnp.zeros(shape, self.cache_dtype)
-        v = jnp.zeros(shape, self.cache_dtype)
+        k = jnp.zeros(shape, self.pool_dtype)
+        v = jnp.zeros(shape, self.pool_dtype)
+        if self.quantize_kv:
+            sshape = (self.cfg.n_layers, st.n_pages,
+                      self.cfg.n_kv_heads, st.page)
+            st.k_scale = jnp.ones(sshape, jnp.float32)
+            st.v_scale = jnp.ones(sshape, jnp.float32)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             tp = int(self.mesh.shape.get("tp", 1))
@@ -2277,6 +2483,10 @@ class GenerateEngine:
                       and verify is None      # verify is a chunk forward,
                                               # not a decode loop
                       and not getattr(self, "_force_gather_decode", False)
+                      # quantized KV serves through the UNIFIED kernel
+                      # (in-kernel dequant); the split direct kernels
+                      # have no scale stream
+                      and not self.quantize_kv
                       and max(len(p) for p in prompts)
                       >= self.direct_decode_min_tokens)
         # UNIFIED ragged kernel (ISSUE 8) — the default serving path on
@@ -2464,14 +2674,16 @@ class GenerateEngine:
             # dead weight the next LCP resume overwrites.
             k_arr, kmax, need_probs = verify
             vids, vprobs, cache = self._step_paged_verify(
-                self.params, st.k, st.v, put(src, mat), put(tokens, mat),
+                self.params, st.k, st.v, st.k_scale, st.v_scale,
+                put(src, mat), put(tokens, mat),
                 put(pre_arr, row), put(chunk_arr, row), put(off_arr, row),
                 put(k_arr, row), samp[0], json_args[0], json_args[1],
                 kmax=kmax, need_probs=need_probs)
             jax.block_until_ready(vids)   # phase fence: chunk forward done
             t_prefill = time.monotonic()
-            st.k, st.v = self._step_scatter_prompt(
-                st.k, st.v, cache.k, cache.v, put(dst, mat))
+            st.k, st.v, st.k_scale, st.v_scale = self._step_scatter_prompt(
+                st.k, st.v, st.k_scale, st.v_scale, cache.k, cache.v,
+                put(dst, mat))
             cache = None   # k/v donated to the scatter; HBM freed
             vout = (np.asarray(vids),
                     np.asarray(vprobs) if need_probs else None)
@@ -2499,7 +2711,8 @@ class GenerateEngine:
             t_prefill = time.monotonic()
         else:
             last_logits, cache = self._step_paged_prefill(
-                self.params, st.k, st.v, put(src, mat), put(tokens, mat),
+                self.params, st.k, st.v, st.k_scale, st.v_scale,
+                put(src, mat), put(tokens, mat),
                 put(pre_arr, row), put(chunk_arr, row), put(off_arr, row))
             jax.block_until_ready(last_logits)  # phase fence: prefill done
             t_prefill = time.monotonic()
@@ -2513,8 +2726,10 @@ class GenerateEngine:
             # generated tail back.
             if not use_direct_pre:
                 pool_lens_dev = cache.lens
-                st.k, st.v = self._step_scatter_prompt(
-                    st.k, st.v, cache.k, cache.v, put(dst, mat))
+                st.k, st.v, st.k_scale, st.v_scale = \
+                    self._step_scatter_prompt(
+                        st.k, st.v, st.k_scale, st.v_scale, cache.k,
+                        cache.v, put(dst, mat))
                 cache = None  # drop host refs: k/v donated above, HBM freed
             out, n_emitted, final_lens, tail_k, tail_v, jstate_f = \
                 self._step_paged_decode_direct(
@@ -2543,9 +2758,11 @@ class GenerateEngine:
             jax.block_until_ready(st.k)
             now = time.monotonic()
         else:
-            out, n_emitted, final_lens, st.k, st.v, _, _, jstate_f = \
+            (out, n_emitted, final_lens, st.k, st.v, st.k_scale,
+             st.v_scale, _, _, jstate_f) = \
                 self._step_paged_decode(
-                    self.params, st.k, st.v, cache.k, cache.v, cache.lens,
+                    self.params, st.k, st.v, st.k_scale, st.v_scale,
+                    cache.k, cache.v, cache.lens,
                     put(dst, mat), put(off_arr, row), last_logits, rng_key,
                     *samp, *json_args, max_new=max_new)
             out = np.asarray(out)
@@ -2691,8 +2908,10 @@ class GenerateEngine:
 
         if verify is not None:
             self._pending.shape_key = ("ragged_verify", TB, maxp_p2, kmax)
-            vids, vprobs, st.k, st.v = self._step_paged_ragged_verify(
-                self.params, st.k, st.v, jnp.asarray(flat_tok),
+            (vids, vprobs, st.k, st.v, st.k_scale,
+             st.v_scale) = self._step_paged_ragged_verify(
+                self.params, st.k, st.v, st.k_scale, st.v_scale,
+                jnp.asarray(flat_tok),
                 jnp.asarray(flat_pos), jnp.asarray(btab),
                 jnp.asarray(bmeta), jnp.asarray(flat_dst),
                 jnp.asarray(widx), jnp.asarray(r_temp), json_table,
@@ -2710,15 +2929,20 @@ class GenerateEngine:
                     t_prefill, now)
 
         self._pending.shape_key = ("ragged", TB, maxp_p2, max_new)
-        last_logits, st.k, st.v = self._step_paged_ragged(
-            self.params, st.k, st.v, jnp.asarray(flat_tok),
-            jnp.asarray(flat_pos), jnp.asarray(btab), jnp.asarray(bmeta),
-            jnp.asarray(flat_dst), jnp.asarray(last_idx), tq=TQ)
+        last_logits, st.k, st.v, st.k_scale, st.v_scale = \
+            self._step_paged_ragged(
+                self.params, st.k, st.v, st.k_scale, st.v_scale,
+                jnp.asarray(flat_tok),
+                jnp.asarray(flat_pos), jnp.asarray(btab),
+                jnp.asarray(bmeta),
+                jnp.asarray(flat_dst), jnp.asarray(last_idx), tq=TQ)
         jax.block_until_ready(last_logits)  # phase fence: prefill done
         t_prefill = time.monotonic()
-        out, n_emitted, final_lens, st.k, st.v, jstate_f = \
+        (out, n_emitted, final_lens, st.k, st.v, st.k_scale, st.v_scale,
+         jstate_f) = \
             self._step_paged_decode_ragged(
-                self.params, st.k, st.v, jnp.asarray(r_tables),
+                self.params, st.k, st.v, st.k_scale, st.v_scale,
+                jnp.asarray(r_tables),
                 jnp.asarray(r_pool_lens), jnp.asarray(r_off), last_logits,
                 rng_key, jnp.asarray(r_temp), jnp.asarray(r_top),
                 jnp.asarray(r_active), jnp.asarray(r_limits), json_table,

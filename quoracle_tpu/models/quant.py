@@ -1,0 +1,170 @@
+"""Int8 quantization for the serving plane (ISSUE 13 tentpole).
+
+Two independent byte economies, both opt-in per pool member
+(``RuntimeConfig.quantize_weights`` / ``quantize_kv``):
+
+**Weights** — per-channel symmetric int8 applied at engine build
+(:func:`quantize_params`): every projection matrix keeps an ``int8``
+payload plus one fp32 scale per OUTPUT channel (the contraction axis is
+reduced away by the matmul, so a per-output scale commutes with it);
+matmuls dequantize on the fly (``dequant_weight`` inside the forward —
+XLA fuses the convert-multiply into the matmul prologue). Norm vectors
+and QKV biases stay bf16: they are O(dim) and numerically load-bearing.
+
+**KV pages** — the session page pool stores int8 K/V with one fp32
+scale per (token, kv-head), laid out PAGE-STRUCTURED as
+``[L, n_pages, KV, page]`` so a page's scales are a contiguous block
+that travels WITH the page through every tier move (demote, disk
+spill, prefix write-through, handoff envelope, prefixd fetch). The
+``[KV, page]`` orientation is deliberate: inside the ragged Pallas
+kernel a page's scale block broadcasts against score rows as
+``[1, page]`` — K's scale multiplies the scores (``q·(k·s) = (q·k)·s``
+per key token) and V's scale multiplies the probabilities
+(``(p·s)·v = p·(v·s)``), so in-kernel dequant never needs a lane
+transpose (ops/paged_attention.py).
+
+Quantization rule (shared by every write site so requantization of an
+unchanged page is deterministic): ``scale = amax(|x|, hd) / 127``
+(1.0 for an all-zero vector), ``q = clip(round(x / scale), -127, 127)``
+— symmetric, zero-point-free, the max element lands exactly on ±127.
+
+The scale overhead is 2·KV·4 bytes per token per layer against
+2·KV·hd int8 payload bytes — ~3% at hd=128 — so pool capacity, tier
+budgets, spill files, and handoff envelopes all land within a few
+percent of exactly half their bf16 size.
+
+No reference counterpart (the reference runs no model math locally,
+SURVEY.md §2.8); the format follows standard weight-only / KV-cache
+int8 serving practice (PAPERS.md Gemma-on-TPU sizing playbook).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# fp32 scale per (token, kv-head), one for K and one for V
+KV_SCALE_BYTES_PER_TOKEN_PER_HEAD = 8
+
+# Weight leaves quantized per-channel (everything the matmuls contract
+# over); norms/biases stay bf16.
+_LAYER_WEIGHT_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def is_quantized(w) -> bool:
+    """True for a quantized-weight leaf ({"q8" + "scale"/"scale_r"})."""
+    return isinstance(w, dict) and "q8" in w
+
+
+def _quantize_channels(w: np.ndarray | jax.Array, axis: int) -> dict:
+    """Symmetric int8 over ``axis`` (the contraction axis); the scale
+    keeps the remaining (per-output-channel) shape. The scale's KEY
+    names its orientation — ``scale`` reduces axis -2 (stacked layer
+    weights / lm_head), ``scale_r`` reduces axis -1 (embed rows) — so
+    dequant dispatch is structural, never a shape guess (square
+    matrices would make shapes ambiguous), and stays correct after
+    ``lax.scan`` strips the leading layer axis."""
+    assert axis in (-1, -2)
+    x = jnp.asarray(w, jnp.float32)
+    amax = jnp.max(jnp.abs(x), axis=axis)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x / jnp.expand_dims(scale, axis)),
+                 -127, 127).astype(jnp.int8)
+    key = "scale_r" if axis == -1 else "scale"
+    return {"q8": q, key: scale.astype(jnp.float32)}
+
+
+def quantize_params(params: dict, cfg) -> dict:
+    """Per-channel symmetric int8 for the text decoder's projection
+    matrices (embed / layer projections / lm_head). Vision towers stay
+    bf16 (the ViT is a fraction of decoder bytes and its GELU stack is
+    less quantization-tolerant). Returns a NEW pytree; unquantized
+    leaves are shared, not copied."""
+    out = dict(params)
+    # embed [V, D]: per-vocab-row scale serves both the gather (row v
+    # dequantizes as q[v]·s[v]) and the tied head (logits_v =
+    # (h·q[:,v])·s[v] — the row scale IS the head's output-channel
+    # scale).
+    out["embed"] = _quantize_channels(params["embed"], axis=-1)
+    layers = dict(params["layers"])
+    for key in _LAYER_WEIGHT_KEYS:
+        # stacked [L, in, out]: contraction over ``in`` → scale [L, out]
+        layers[key] = _quantize_channels(params["layers"][key], axis=-2)
+    out["layers"] = layers
+    if "lm_head" in params:
+        out["lm_head"] = _quantize_channels(params["lm_head"], axis=-2)
+    return out
+
+
+def dequant_weight(w, dtype=jnp.bfloat16):
+    """One weight leaf back to a dense array for the matmul. Quantized
+    leaves expand as q8·scale (f32 multiply, cast to ``dtype`` so the
+    matmul runs at the same precision as the unquantized path); plain
+    arrays pass through untouched — every forward call site routes
+    through here, so the two modes share one code path."""
+    if not is_quantized(w):
+        return w
+    q = w["q8"].astype(jnp.float32)
+    if "scale_r" in w:          # per-row (embed): scale over axis -1
+        return (q * w["scale_r"][..., None]).astype(dtype)
+    # per-output-channel (layer projections / lm_head): scale over the
+    # contraction axis -2
+    return (q * jnp.expand_dims(w["scale"], -2)).astype(dtype)
+
+
+def params_nbytes(params: dict) -> int:
+    """Device bytes of a (possibly quantized) params pytree."""
+    return sum(int(x.size) * jnp.dtype(x.dtype).itemsize
+               for x in jax.tree.leaves(params))
+
+
+# ---------------------------------------------------------------------------
+# KV page quantization
+# ---------------------------------------------------------------------------
+
+
+def kv_quant(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Quantize KV entries per (…, kv-head): ``x [..., KV, hd]`` →
+    (int8 same shape, fp32 scale ``[..., KV]``). The shared write rule:
+    deterministic, zero-safe, max element lands on ±127."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def kv_dequant(q: jax.Array, scale: jax.Array,
+               dtype=jnp.float32) -> jax.Array:
+    """``q [..., KV, hd]`` int8 + ``scale [..., KV]`` → dense KV."""
+    return (q.astype(jnp.float32) * scale[..., None].astype(jnp.float32)
+            ).astype(dtype)
+
+
+def gather_scales(scales: jax.Array, tables: jax.Array) -> jax.Array:
+    """Per-layer scale pool ``[n_pages, KV, page]`` gathered by a page
+    table ``[B, maxp]`` → token-major ``[B, maxp·page, KV]`` aligned
+    with the gathered KV ``[B, maxp·page, KV, hd]``."""
+    B, maxp = tables.shape
+    _, KV, page = scales.shape
+    s = scales[tables]                         # [B, maxp, KV, page]
+    return s.transpose(0, 1, 3, 2).reshape(B, maxp * page, KV)
+
+
+def kv_token_bytes(n_layers: int, n_kv: int, head_dim: int,
+                   pool_itemsize: int, quantized: bool) -> int:
+    """Per-token K+V pool bytes (scales included when quantized) — the
+    one formula the session budget, pool_sizing, /api/kv compression
+    and the resources attribution all share."""
+    payload = 2 * n_layers * n_kv * head_dim * pool_itemsize
+    if quantized:
+        payload += n_layers * n_kv * KV_SCALE_BYTES_PER_TOKEN_PER_HEAD
+    return payload
+
+
+def entry_nbytes(*arrays: Optional[np.ndarray]) -> int:
+    """Total bytes of a tier entry's payload arrays (None-tolerant)."""
+    return sum(int(a.nbytes) for a in arrays if a is not None)

@@ -381,7 +381,9 @@ class TPUBackend(ModelBackend):
                  draft_map: Optional[dict] = None, draft_k: int = 6,
                  qos=None, host_kv_mb: int = 0,
                  disk_kv_dir: Optional[str] = None,
-                 disk_kv_gb: float = 8.0):
+                 disk_kv_gb: float = 8.0,
+                 quantize_weights: bool = False,
+                 quantize_kv: bool = False):
         """``submeshes``: one jax Mesh per pool member (parallel.mesh.
         pool_submeshes) — each member's engine serves tp-sharded on its own
         chips, and ``overlap`` runs members concurrently from host threads
@@ -413,6 +415,13 @@ class TPUBackend(ModelBackend):
         self.overlap = overlap
         self._bus = None          # attach_bus: serving-telemetry broadcasts
         init_fn = init_params_fn or init_params
+        # Int8 quantized serving (ISSUE 13, models/quant.py): applied
+        # uniformly to every engine this backend builds — pool members
+        # AND their draft engines — so a member's whole decode stack
+        # (draft, verify, vanilla) shares one numeric regime and the
+        # quantized self-consistency gates hold across modes.
+        self.quantize_weights = bool(quantize_weights)
+        self.quantize_kv = bool(quantize_kv)
 
         def build_engine(spec: str, i: int, mesh=None) -> GenerateEngine:
             cfg = get_model_config(spec)
@@ -430,7 +439,9 @@ class TPUBackend(ModelBackend):
             else:
                 params = init_fn(cfg, jax.random.PRNGKey(seed + i))
             return GenerateEngine(cfg, params, get_tokenizer(spec),
-                                  seed=seed + i, mesh=mesh)
+                                  seed=seed + i, mesh=mesh,
+                                  quantize_weights=self.quantize_weights,
+                                  quantize_kv=self.quantize_kv)
 
         for i, spec in enumerate(self.pool):
             if spec in self.engines:
@@ -650,6 +661,10 @@ class TPUBackend(ModelBackend):
                     "sessions": n_sessions,
                     "prefix_cache": occ,
                 },
+                # compression posture (ISSUE 13): /api/kv's compression
+                # column — int8 members report their per-token byte
+                # rate vs the bf16 rate they would otherwise pay
+                "quant": e.quant_stats(),
                 **tier.stats(),
             }
         return {"enabled": True, "members": members}

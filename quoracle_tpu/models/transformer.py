@@ -22,6 +22,9 @@ import jax
 import jax.numpy as jnp
 
 from quoracle_tpu.models.config import ModelConfig
+from quoracle_tpu.models.quant import (
+    dequant_weight, is_quantized, kv_quant,
+)
 from quoracle_tpu.ops.attention import attend
 
 
@@ -151,6 +154,55 @@ def _activation(x: jax.Array, kind: str) -> jax.Array:
     raise ValueError(f"unknown activation {kind!r}")
 
 
+def _embed_lookup(params: dict, tokens: jax.Array) -> jax.Array:
+    """Embedding gather, int8-aware: a quantized embed gathers the int8
+    rows plus their per-row scales and dequantizes only the looked-up
+    rows (never the whole [V, D] table)."""
+    e = params["embed"]
+    if is_quantized(e):
+        q = e["q8"][tokens].astype(jnp.float32)
+        s = e["scale_r"][tokens]
+        # activations run at the UNQUANTIZED leaves' dtype (norms stay
+        # dense) — bf16 serving, fp32 parity tests
+        return (q * s[..., None]).astype(params["final_norm"].dtype)
+    return e[tokens]
+
+
+def _mlp(x: jax.Array, p: dict, cfg: ModelConfig) -> jax.Array:
+    """The shared MLP block: rmsnorm → gate·up → down, weights
+    dequantized on the fly when quantized (models/quant.py). One
+    implementation so the four forward variants can never drift."""
+    h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
+    gate = _activation(
+        jnp.einsum("btd,df->btf", h, dequant_weight(p["w_gate"], h.dtype)),
+        cfg.activation)
+    up = jnp.einsum("btd,df->btf", h, dequant_weight(p["w_up"], h.dtype))
+    return x + jnp.einsum("btf,fd->btd", gate * up,
+                          dequant_weight(p["w_down"], h.dtype))
+
+
+def _qkv(x: jax.Array, p: dict, cfg: ModelConfig, B: int,
+         T: int) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The shared attention-input block: rmsnorm → q/k/v projections
+    (+ optional bias) reshaped to head layout, weights dequantized on
+    the fly when quantized."""
+    h = rmsnorm(x, p["attn_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
+    q = jnp.einsum("btd,dh->bth", h, dequant_weight(p["wq"], h.dtype))
+    k = jnp.einsum("btd,dh->bth", h, dequant_weight(p["wk"], h.dtype))
+    v = jnp.einsum("btd,dh->bth", h, dequant_weight(p["wv"], h.dtype))
+    if cfg.attn_bias:               # Qwen2-style QKV biases
+        q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
+    q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    return q, k, v
+
+
+def _wo(p: dict, cfg: ModelConfig, dtype) -> jax.Array:
+    return dequant_weight(p["wo"], dtype).reshape(
+        cfg.n_heads, cfg.head_dim, cfg.dim)
+
+
 def forward_hidden(
     params: dict,
     cfg: ModelConfig,
@@ -187,7 +239,7 @@ def forward_hidden(
     if input_embeds is not None:
         x = input_embeds                # prepared by the caller (VLM)
     else:
-        x = params["embed"][tokens]     # gather: [B, T, D]
+        x = _embed_lookup(params, tokens)   # gather: [B, T, D]
         if cfg.scale_embeddings:
             x = (x.astype(jnp.float32) * (cfg.dim ** 0.5)).astype(x.dtype)
 
@@ -199,15 +251,7 @@ def forward_hidden(
 
     def layer_body(x, scanned):
         p, k_buf, v_buf = scanned  # p: one layer's params; bufs: [B, S, kv, hd]
-        h = rmsnorm(x, p["attn_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
-        q = jnp.einsum("btd,dh->bth", h, p["wq"])
-        k = jnp.einsum("btd,dh->bth", h, p["wk"])
-        v = jnp.einsum("btd,dh->bth", h, p["wv"])
-        if cfg.attn_bias:               # Qwen2-style QKV biases
-            q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
-        q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
-        k = k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-        v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        q, k, v = _qkv(x, p, cfg, B, T)
         q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
 
@@ -233,13 +277,8 @@ def forward_hidden(
                                kv_len=kv_lens,
                                sliding_window=cfg.sliding_window,
                                kv_pos_offset=kv_pos_offset)
-        x = x + jnp.einsum("bthd,hdD->btD", attn,
-                           p["wo"].reshape(cfg.n_heads, cfg.head_dim, cfg.dim))
-
-        h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
-        gate = _activation(jnp.einsum("btd,df->btf", h, p["w_gate"]), cfg.activation)
-        up = jnp.einsum("btd,df->btf", h, p["w_up"])
-        x = x + jnp.einsum("btf,fd->btd", gate * up, p["w_down"])
+        x = x + jnp.einsum("bthd,hdD->btD", attn, _wo(p, cfg, x.dtype))
+        x = _mlp(x, p, cfg)
         return x, (k_buf, v_buf)
 
     x, (new_k, new_v) = jax.lax.scan(layer_body, x, (params["layers"], cache.k, cache.v))
@@ -271,21 +310,13 @@ def forward_hidden_paged(
     new tail_k, new tail_v)."""
     from quoracle_tpu.ops.paged_attention import paged_decode_attend
     B, T = tokens.shape
-    x = params["embed"][tokens]
+    x = _embed_lookup(params, tokens)
     if cfg.scale_embeddings:
         x = (x.astype(jnp.float32) * (cfg.dim ** 0.5)).astype(x.dtype)
 
     def layer_body(x, scanned):
         p, kp, vp, tk, tv = scanned
-        h = rmsnorm(x, p["attn_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
-        q = jnp.einsum("btd,dh->bth", h, p["wq"])
-        k = jnp.einsum("btd,dh->bth", h, p["wk"])
-        v = jnp.einsum("btd,dh->bth", h, p["wv"])
-        if cfg.attn_bias:
-            q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
-        q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
-        k = k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-        v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        q, k, v = _qkv(x, p, cfg, B, T)
         q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         # all rows write the same tail slot (done rows deposit junk there;
@@ -296,14 +327,8 @@ def forward_hidden_paged(
             q, kp, vp, tables, pool_lens, kv_off, tk, tv,
             tail_len=step + 1, q_pos=positions[:, 0],
             sliding_window=cfg.sliding_window, shard=shard)
-        x = x + jnp.einsum("bthd,hdD->btD", attn,
-                           p["wo"].reshape(cfg.n_heads, cfg.head_dim,
-                                           cfg.dim))
-        h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
-        gate = _activation(jnp.einsum("btd,df->btf", h, p["w_gate"]),
-                           cfg.activation)
-        up = jnp.einsum("btd,df->btf", h, p["w_up"])
-        x = x + jnp.einsum("btf,fd->btd", gate * up, p["w_down"])
+        x = x + jnp.einsum("bthd,hdD->btD", attn, _wo(p, cfg, x.dtype))
+        x = _mlp(x, p, cfg)
         return x, (tk, tv)
 
     x, (new_tk, new_tv) = jax.lax.scan(
@@ -339,21 +364,13 @@ def forward_hidden_paged_prefill(
     from quoracle_tpu.ops.paged_attention import paged_prefill_merge
     B, T = tokens.shape
     n_tok = k_pool.shape[1] * k_pool.shape[2]
-    x = params["embed"][tokens]
+    x = _embed_lookup(params, tokens)
     if cfg.scale_embeddings:
         x = (x.astype(jnp.float32) * (cfg.dim ** 0.5)).astype(x.dtype)
 
     def layer_body(x, scanned):
         p, kp, vp = scanned          # kp/vp: [n_pages, page, kv, hd]
-        h = rmsnorm(x, p["attn_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
-        q = jnp.einsum("btd,dh->bth", h, p["wq"])
-        k = jnp.einsum("btd,dh->bth", h, p["wk"])
-        v = jnp.einsum("btd,dh->bth", h, p["wv"])
-        if cfg.attn_bias:
-            q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
-        q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
-        k = k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-        v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+        q, k, v = _qkv(x, p, cfg, B, T)
         q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         attn = paged_prefill_merge(
@@ -369,13 +386,8 @@ def forward_hidden_paged_prefill(
         kf = kf.at[flat_dst].set(k.astype(kp.dtype), mode="drop")
         vf = vf.at[flat_dst].set(v.astype(vp.dtype), mode="drop")
         x = x + jnp.einsum("bthd,hdD->btD", attn.astype(x.dtype),
-                           p["wo"].reshape(cfg.n_heads, cfg.head_dim,
-                                           cfg.dim))
-        h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
-        gate = _activation(jnp.einsum("btd,df->btf", h, p["w_gate"]),
-                           cfg.activation)
-        up = jnp.einsum("btd,df->btf", h, p["w_up"])
-        x = x + jnp.einsum("btf,fd->btd", gate * up, p["w_down"])
+                           _wo(p, cfg, x.dtype))
+        x = _mlp(x, p, cfg)
         return x, (kf.reshape(kp.shape), vf.reshape(vp.shape))
 
     x, (new_k, new_v) = jax.lax.scan(
@@ -399,7 +411,9 @@ def forward_hidden_ragged(
     tq: int,
     interpret: Optional[bool] = None,
     shard: Optional[tuple] = None,   # (mesh, tp_axis)
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+    k_scale: Optional[jax.Array] = None,   # [L, n_pages, KV, page] f32
+    v_scale: Optional[jax.Array] = None,   # per-(token, kv-head) scales
+) -> tuple:
     """UNIFIED ragged forward (ISSUE 8): one launch per layer over a
     token-major flattened batch of rows with arbitrary query lengths —
     T=1 decode rows, T=chunk continuations, T=suffix prefills and T=K
@@ -408,25 +422,31 @@ def forward_hidden_ragged(
     block's real pages (ops/paged_attention.ragged_attend_auto) — the
     [B, maxp·page] working cache, the dense intra-chunk piece, and the
     decode tail buffer all cease to exist. Returns
-    (hidden [1, Tp, D], k_pool, v_pool) with the chunk KV written."""
+    (hidden [1, Tp, D], k_pool, v_pool) with the chunk KV written.
+
+    With ``k_scale``/``v_scale`` (ISSUE 13) the pools are INT8: each
+    layer quantizes the chunk's fresh KV per (token, kv-head)
+    (models/quant.kv_quant), scatters int8 payloads into the pages and
+    fp32 scales into the page-structured scale pools, and the attention
+    dequantizes inside the kernel's streaming loop — returns a 5-tuple
+    (hidden, k_pool, v_pool, k_scale, v_scale)."""
     from quoracle_tpu.ops.paged_attention import ragged_attend_auto
     B, Tp = tokens.shape       # B == 1: the flat layout is the batch
-    n_tok = k_pool.shape[1] * k_pool.shape[2]
-    x = params["embed"][tokens]
+    n_pages, page = k_pool.shape[1], k_pool.shape[2]
+    n_tok = n_pages * page
+    KV = cfg.n_kv_heads
+    quant = k_scale is not None
+    x = _embed_lookup(params, tokens)
     if cfg.scale_embeddings:
         x = (x.astype(jnp.float32) * (cfg.dim ** 0.5)).astype(x.dtype)
 
     def layer_body(x, scanned):
-        p, kp, vp = scanned          # kp/vp: [n_pages, page, kv, hd]
-        h = rmsnorm(x, p["attn_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
-        q = jnp.einsum("btd,dh->bth", h, p["wq"])
-        k = jnp.einsum("btd,dh->bth", h, p["wk"])
-        v = jnp.einsum("btd,dh->bth", h, p["wv"])
-        if cfg.attn_bias:
-            q, k, v = q + p["bq"], k + p["bk"], v + p["bv"]
-        q = q.reshape(B, Tp, cfg.n_heads, cfg.head_dim)
-        k = k.reshape(B, Tp, cfg.n_kv_heads, cfg.head_dim)
-        v = v.reshape(B, Tp, cfg.n_kv_heads, cfg.head_dim)
+        if quant:
+            p, kp, vp, ks, vs = scanned  # ks/vs: [n_pages, KV, page]
+        else:
+            p, kp, vp = scanned          # kp/vp: [n_pages, page, kv, hd]
+            ks = vs = None
+        q, k, v = _qkv(x, p, cfg, B, Tp)
         q = rope(q, positions, cfg.rope_theta, cfg.rope_scaling)
         k = rope(k, positions, cfg.rope_theta, cfg.rope_scaling)
         # KV → pages BEFORE attention (padding/overflow slots carry the
@@ -434,24 +454,43 @@ def forward_hidden_ragged(
         # causal masking inside the one kernel — no dense second piece.
         kf = kp.reshape(n_tok, *kp.shape[2:])
         vf = vp.reshape(n_tok, *vp.shape[2:])
-        kf = kf.at[flat_dst].set(k[0].astype(kp.dtype), mode="drop")
-        vf = vf.at[flat_dst].set(v[0].astype(vp.dtype), mode="drop")
+        if quant:
+            kq, ks_new = kv_quant(k[0])          # [Tp, KV, hd] / [Tp, KV]
+            vq, vs_new = kv_quant(v[0])
+            kf = kf.at[flat_dst].set(kq, mode="drop")
+            vf = vf.at[flat_dst].set(vq, mode="drop")
+            # scale slot for token t, head j in the [n_pages, KV, page]
+            # pool: ((pid·KV)+j)·page + off — OOB flat_dst (pid =
+            # n_pages) stays OOB and drops
+            pid, off = flat_dst // page, flat_dst % page
+            sidx = ((pid[:, None] * KV
+                     + jnp.arange(KV, dtype=jnp.int32)[None, :]) * page
+                    + off[:, None])              # [Tp, KV]
+            ks = ks.reshape(-1).at[sidx].set(
+                ks_new, mode="drop").reshape(ks.shape)
+            vs = vs.reshape(-1).at[sidx].set(
+                vs_new, mode="drop").reshape(vs.shape)
+        else:
+            kf = kf.at[flat_dst].set(k[0].astype(kp.dtype), mode="drop")
+            vf = vf.at[flat_dst].set(v[0].astype(vp.dtype), mode="drop")
         kp2 = kf.reshape(kp.shape)
         vp2 = vf.reshape(vp.shape)
         attn = ragged_attend_auto(
             q[0], kp2, vp2, block_tables, block_meta, tq=tq,
             sliding_window=cfg.sliding_window, interpret=interpret,
-            shard=shard)[None]                           # [1, Tp, H, hd]
+            shard=shard, k_scale=ks, v_scale=vs)[None]   # [1, Tp, H, hd]
         x = x + jnp.einsum("bthd,hdD->btD", attn.astype(x.dtype),
-                           p["wo"].reshape(cfg.n_heads, cfg.head_dim,
-                                           cfg.dim))
-        h = rmsnorm(x, p["mlp_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
-        gate = _activation(jnp.einsum("btd,df->btf", h, p["w_gate"]),
-                           cfg.activation)
-        up = jnp.einsum("btd,df->btf", h, p["w_up"])
-        x = x + jnp.einsum("btf,fd->btd", gate * up, p["w_down"])
-        return x, (kp2, vp2)
+                           _wo(p, cfg, x.dtype))
+        x = _mlp(x, p, cfg)
+        return x, ((kp2, vp2, ks, vs) if quant else (kp2, vp2))
 
+    if quant:
+        x, (new_k, new_v, new_ks, new_vs) = jax.lax.scan(
+            layer_body, x,
+            (params["layers"], k_pool, v_pool, k_scale, v_scale))
+        x = rmsnorm(x, params["final_norm"], cfg.norm_eps,
+                    cfg.rmsnorm_plus_one)
+        return x, new_k, new_v, new_ks, new_vs
     x, (new_k, new_v) = jax.lax.scan(
         layer_body, x, (params["layers"], k_pool, v_pool))
     x = rmsnorm(x, params["final_norm"], cfg.norm_eps, cfg.rmsnorm_plus_one)
@@ -465,7 +504,10 @@ def project_logits(params: dict, cfg: ModelConfig, hidden: jax.Array) -> jax.Arr
     projecting — at llama-3-8b scale a full [B, 8192, 128256] fp32 logits
     tensor is ~4 GB/row and would blow HBM for a value that's 99.99% discarded.
     """
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    if cfg.tie_embeddings:
+        head = dequant_weight(params["embed"], jnp.float32).T
+    else:
+        head = dequant_weight(params["lm_head"], jnp.float32)
     logits = jnp.einsum("btd,dv->btv", hidden.astype(jnp.float32),
                         head.astype(jnp.float32))
     if cfg.final_logit_softcap is not None:

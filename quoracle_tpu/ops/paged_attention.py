@@ -595,10 +595,15 @@ def ragged_attend_ref(
     block_meta: jax.Array,    # [NB, 3] int32: kv_len, qpos0, nq
     tq: int,
     sliding_window: Optional[int] = None,
+    k_scale: Optional[jax.Array] = None,   # [n_pages, KV, page] f32
+    v_scale: Optional[jax.Array] = None,   # (int8 pools, ISSUE 13)
 ) -> jax.Array:
     """XLA gather reference for the unified ragged kernel (CPU serving
     path + the kernel's numerical oracle). Same contract: normalized
-    output [NB·tq, H, hd] f32."""
+    output [NB·tq, H, hd] f32. With ``k_scale``/``v_scale`` the pools
+    are int8 and the gathered pages dequantize per (token, kv-head)
+    before the scores — the dequantize-then-attend twin of the
+    kernel's in-loop dequant."""
     NB, maxp = block_tables.shape
     _, H, hd = q.shape
     n_pages, page, KV, _ = k_pages.shape
@@ -606,6 +611,12 @@ def ragged_attend_ref(
     qb = (q.astype(jnp.float32) * hd ** -0.5).reshape(NB, tq, KV, G, hd)
     k = k_pages[block_tables].reshape(NB, maxp * page, KV, hd)
     v = v_pages[block_tables].reshape(NB, maxp * page, KV, hd)
+    if k_scale is not None:
+        from quoracle_tpu.models.quant import gather_scales
+        k = k.astype(jnp.float32) \
+            * gather_scales(k_scale, block_tables)[..., None]
+        v = v.astype(jnp.float32) \
+            * gather_scales(v_scale, block_tables)[..., None]
     scores = jnp.einsum("btkgd,bskd->bkgts", qb, k.astype(jnp.float32))
     kv_len = block_meta[:, 0][:, None, None]       # [NB,1,1]
     qpos0 = block_meta[:, 1][:, None, None]
@@ -725,6 +736,113 @@ def _ragged_kernel(tables_ref, meta_ref, q_ref, k_hbm, v_hbm,
         out_ref[0, :, kv * G:(kv + 1) * G] = norm.reshape(tq, G, hd)
 
 
+def _ragged_kernel_q8(tables_ref, meta_ref, q_ref, k_hbm, v_hbm,
+                      ks_hbm, vs_hbm, out_ref, k_scr, v_scr, ks_scr,
+                      vs_scr, sems, *, page: int, n_kv: int, hd: int,
+                      tq: int, scale: float, window: int):
+    """Int8 variant of :func:`_ragged_kernel` (ISSUE 13): the pools hold
+    int8 payloads and each page's fp32 scale block ``[KV, page]`` rides
+    the SAME double-buffered DMA stream. Dequant happens inside the
+    streaming loop with zero lane transposes: K's per-token scale
+    multiplies the score columns (``q·(k·s) = (q·k)·s``) and V's
+    multiplies the probability columns (``(p·s)·v = p·(v·s)``), both as
+    a ``[1, page]`` lane broadcast."""
+    i = pl.program_id(0)
+    kv_len = meta_ref[i, 0]
+    qpos0 = meta_ref[i, 1]
+    nq = meta_ref[i, 2]
+    kv_hi = jnp.minimum(kv_len, qpos0 + nq)
+    if window >= 0:
+        p_lo = jnp.maximum(qpos0 + 1 - window, 0) // page
+    else:
+        p_lo = jnp.int32(0)
+    n = jnp.maximum((kv_hi + page - 1) // page - p_lo, 0)
+
+    q = q_ref[0].astype(jnp.float32) * scale             # [tq, H, hd]
+    H = q.shape[1]
+    G = H // n_kv
+
+    def start_dma(j, slot):
+        pid = tables_ref[i, p_lo + j]
+        pltpu.make_async_copy(k_hbm.at[pid], k_scr.at[slot],
+                              sems.at[slot, 0]).start()
+        pltpu.make_async_copy(v_hbm.at[pid], v_scr.at[slot],
+                              sems.at[slot, 1]).start()
+        pltpu.make_async_copy(ks_hbm.at[pid], ks_scr.at[slot],
+                              sems.at[slot, 2]).start()
+        pltpu.make_async_copy(vs_hbm.at[pid], vs_scr.at[slot],
+                              sems.at[slot, 3]).start()
+
+    def wait_dma(j, slot):
+        pid = tables_ref[i, p_lo + j]
+        pltpu.make_async_copy(k_hbm.at[pid], k_scr.at[slot],
+                              sems.at[slot, 0]).wait()
+        pltpu.make_async_copy(v_hbm.at[pid], v_scr.at[slot],
+                              sems.at[slot, 1]).wait()
+        pltpu.make_async_copy(ks_hbm.at[pid], ks_scr.at[slot],
+                              sems.at[slot, 2]).wait()
+        pltpu.make_async_copy(vs_hbm.at[pid], vs_scr.at[slot],
+                              sems.at[slot, 3]).wait()
+
+    @pl.when(n > 0)
+    def _():
+        start_dma(0, 0)
+
+    t_of_row = jax.lax.broadcasted_iota(
+        jnp.int32, (tq, G), 0).reshape(tq * G, 1)
+    qpos = qpos0 + t_of_row                              # [tq·G, 1]
+    q_ok = t_of_row < nq
+
+    def body(j, carry):
+        slot = jax.lax.rem(j, 2)
+
+        @pl.when(j + 1 < n)
+        def _():
+            start_dma(j + 1, jax.lax.rem(j + 1, 2))
+
+        wait_dma(j, slot)
+        k_blk = k_scr[slot].astype(jnp.float32)          # [page, KV·hd]
+        v_blk = v_scr[slot].astype(jnp.float32)
+        ks_blk = ks_scr[slot]                            # [KV, page] f32
+        vs_blk = vs_scr[slot]
+        s_idx = (p_lo + j) * page + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page), 1)                     # [1, page]
+        valid = (s_idx < kv_len) & (s_idx <= qpos) & q_ok
+        if window >= 0:
+            valid = valid & (qpos - s_idx < window)
+        out = []
+        for kv in range(n_kv):
+            m, l, acc = carry[kv]
+            scores = jax.lax.dot_general(                # [tq·G, page]
+                q[:, kv * G:(kv + 1) * G].reshape(tq * G, hd),
+                k_blk[:, kv * hd:(kv + 1) * hd],
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            scores = scores * ks_blk[kv:kv + 1, :]       # dequant K
+            scores = jnp.where(valid, scores, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(scores, axis=1, keepdims=True))
+            p = jnp.where(valid, jnp.exp(scores - m_new), 0.0)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + jnp.sum(p, axis=1, keepdims=True)
+            pv = jax.lax.dot_general(                    # [tq·G, hd]
+                p * vs_blk[kv:kv + 1, :],                # dequant V
+                v_blk[:, kv * hd:(kv + 1) * hd],
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            out.append((m_new, l_new, acc * corr + pv))
+        return tuple(out)
+
+    init = tuple((jnp.full((tq * G, 1), NEG_INF, jnp.float32),
+                  jnp.zeros((tq * G, 1), jnp.float32),
+                  jnp.zeros((tq * G, hd), jnp.float32))
+                 for _ in range(n_kv))
+    final = jax.lax.fori_loop(0, n, body, init)
+    for kv in range(n_kv):
+        _, l, acc = final[kv]
+        norm = acc / jnp.where(l > 0, l, 1.0)
+        out_ref[0, :, kv * G:(kv + 1) * G] = norm.reshape(tq, G, hd)
+
+
 @functools.partial(jax.jit, static_argnames=("tq", "sliding_window",
                                              "interpret"))
 def ragged_attend(
@@ -736,10 +854,14 @@ def ragged_attend(
     tq: int,
     sliding_window: Optional[int] = None,
     interpret: bool = False,
+    k_scale: Optional[jax.Array] = None,   # [n_pages, KV, page] f32
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Pallas unified ragged attention (same contract as ragged_attend_ref;
     tests/test_ragged_attention.py asserts numerical agreement). Grid is
-    (NB,) — sized by the tick's real tokens / tq, never by batch × max."""
+    (NB,) — sized by the tick's real tokens / tq, never by batch × max.
+    With ``k_scale``/``v_scale`` the int8 kernel variant streams each
+    page's scale block alongside its payload and dequantizes in-loop."""
     Tp, H, hd = q.shape
     NB = block_tables.shape[0]
     n_pages, page, KV, _ = k_pages.shape
@@ -753,9 +875,28 @@ def ragged_attend(
     vf = v_pages.reshape(n_pages, page, KV * hd_p)
     qb = q.reshape(NB, tq, H, hd_p)
     scale = hd ** -0.5
-    kernel = functools.partial(
-        _ragged_kernel, page=page, n_kv=KV, hd=hd_p, tq=tq, scale=scale,
-        window=-1 if sliding_window is None else int(sliding_window))
+    quant = k_scale is not None
+    if quant:
+        kernel = functools.partial(
+            _ragged_kernel_q8, page=page, n_kv=KV, hd=hd_p, tq=tq,
+            scale=scale,
+            window=-1 if sliding_window is None else int(sliding_window))
+        extra_in = [pl.BlockSpec(memory_space=pltpu.ANY),   # k scales
+                    pl.BlockSpec(memory_space=pltpu.ANY)]   # v scales
+        extra_scratch = [pltpu.VMEM((2, KV, page), jnp.float32),
+                         pltpu.VMEM((2, KV, page), jnp.float32)]
+        sems = pltpu.SemaphoreType.DMA((2, 4))
+        args = (qb, kf, vf, k_scale.astype(jnp.float32),
+                v_scale.astype(jnp.float32))
+    else:
+        kernel = functools.partial(
+            _ragged_kernel, page=page, n_kv=KV, hd=hd_p, tq=tq,
+            scale=scale,
+            window=-1 if sliding_window is None else int(sliding_window))
+        extra_in = []
+        extra_scratch = []
+        sems = pltpu.SemaphoreType.DMA((2, 2))
+        args = (qb, kf, vf)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -765,6 +906,7 @@ def ragged_attend(
                 pl.BlockSpec((1, tq, H, hd_p), lambda i, *_: (i, 0, 0, 0)),
                 pl.BlockSpec(memory_space=pltpu.ANY),     # k pool in HBM
                 pl.BlockSpec(memory_space=pltpu.ANY),     # v pool in HBM
+                *extra_in,
             ],
             out_specs=[
                 pl.BlockSpec((1, tq, H, hd_p), lambda i, *_: (i, 0, 0, 0)),
@@ -772,22 +914,24 @@ def ragged_attend(
             scratch_shapes=[
                 pltpu.VMEM((2, page, KV * hd_p), k_pages.dtype),
                 pltpu.VMEM((2, page, KV * hd_p), v_pages.dtype),
-                pltpu.SemaphoreType.DMA((2, 2)),
+                *extra_scratch,
+                sems,
             ],
         ),
         out_shape=[
             jax.ShapeDtypeStruct((NB, tq, H, hd_p), jnp.float32),
         ],
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), block_meta.astype(jnp.int32), qb,
-      kf, vf)[0]
+    )(block_tables.astype(jnp.int32), block_meta.astype(jnp.int32),
+      *args)[0]
     return out.reshape(NB * tq, H, hd_p)[..., :hd]
 
 
-def _ragged_tp_shard(inner, shard):
+def _ragged_tp_shard(inner, shard, quant: bool):
     """shard_map wrapper for the unified ragged kernel on tp meshes: every
     head attends independently (whole GQA groups per shard — callers gate
-    on divisibility), block tables/metadata replicate, no collective."""
+    on divisibility), block tables/metadata replicate, no collective.
+    Int8 scale pools shard on their KV axis beside the payload pools."""
     try:
         from jax import shard_map
     except ImportError:                      # older jax
@@ -796,8 +940,10 @@ def _ragged_tp_shard(inner, shard):
     mesh, tp_ax = shard
     head = P(None, tp_ax, None)              # [Tp, H, hd]
     kv = P(None, None, tp_ax, None)          # [n_pages, page, KV, hd]
-    specs = dict(in_specs=(head, kv, kv, P(None, None), P(None, None)),
-                 out_specs=head)
+    ins = [head, kv, kv, P(None, None), P(None, None)]
+    if quant:
+        ins += [P(None, tp_ax, None)] * 2    # [n_pages, KV, page]
+    specs = dict(in_specs=tuple(ins), out_specs=head)
     try:
         return shard_map(inner, mesh=mesh, check_rep=False, **specs)
     except TypeError:
@@ -814,24 +960,36 @@ def ragged_attend_auto(
     sliding_window: Optional[int] = None,
     interpret: Optional[bool] = None,
     shard: Optional[tuple] = None,   # (mesh, tp_axis)
+    k_scale: Optional[jax.Array] = None,   # [n_pages, KV, page] f32 —
+    v_scale: Optional[jax.Array] = None,   # int8 pools (ISSUE 13)
 ) -> jax.Array:
     """Unified ragged attention dispatcher: Pallas kernel on TPU (or under
     ``interpret``), XLA gather reference elsewhere (CPU tier-1 — same
     numerics, no paging win). With ``shard``, runs per-tp-shard under
-    shard_map (heads independent)."""
+    shard_map (heads independent). ``k_scale``/``v_scale`` mark int8
+    pools and route to the in-kernel-dequant variant / dequantizing
+    reference."""
     if shard is not None:
         inner = functools.partial(ragged_attend_auto, tq=tq,
                                   sliding_window=sliding_window,
                                   interpret=interpret, shard=None)
-        return _ragged_tp_shard(inner, shard)(
+        if k_scale is not None:
+            def inner_q(qq, kp, vp, bt, bm, ks, vs):
+                return inner(qq, kp, vp, bt, bm, k_scale=ks, v_scale=vs)
+            return _ragged_tp_shard(inner_q, shard, quant=True)(
+                q, k_pages, v_pages, block_tables, block_meta,
+                k_scale, v_scale)
+        return _ragged_tp_shard(inner, shard, quant=False)(
             q, k_pages, v_pages, block_tables, block_meta)
     on_tpu = jax.devices()[0].platform == "tpu"
     if on_tpu or interpret:
         return ragged_attend(q, k_pages, v_pages, block_tables, block_meta,
                              tq=tq, sliding_window=sliding_window,
-                             interpret=bool(interpret))
+                             interpret=bool(interpret),
+                             k_scale=k_scale, v_scale=v_scale)
     return ragged_attend_ref(q, k_pages, v_pages, block_tables, block_meta,
-                             tq=tq, sliding_window=sliding_window)
+                             tq=tq, sliding_window=sliding_window,
+                             k_scale=k_scale, v_scale=v_scale)
 
 
 def _tp_shard_map(inner, shard, q_rank4: bool):

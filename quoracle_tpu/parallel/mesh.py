@@ -188,7 +188,9 @@ def pool_sizing(pool: Sequence[str], n_devices: int = 8,
                 page: int = 128,
                 replicas: int = 1,
                 disaggregate: bool = False,
-                hosts: int = 1) -> dict:
+                hosts: int = 1,
+                quantize_weights: bool = False,
+                quantize_kv: bool = False) -> dict:
     """Explicit HBM budget for a model pool on a v5e sub-mesh partition
     (VERDICT r4 item 4): per member — chips (= recommended_tp), bf16
     weight bytes per chip, the page-pool bytes left after the tail
@@ -233,20 +235,34 @@ def pool_sizing(pool: Sequence[str], n_devices: int = 8,
     """
     from quoracle_tpu.models.config import get_model_config
     members, used, fits = [], 0, True
+    # Quantized serving (ISSUE 13): plan at the byte rates the ladder
+    # actually pays — int8 weights are 1 byte/param; int8 KV is 1
+    # byte/elem plus 8 bytes per (token, kv-head) of fp32 K+V scales
+    # (models/quant.py). Host/disk tier token rates quantize too: the
+    # scales travel WITH the pages through every tier.
+    w_byte = 1 if quantize_weights else dtype_bytes
     for spec in pool:
         cfg = get_model_config(spec)
         tp = _largest_tp_divisor(cfg.n_kv_heads,
                                  max(1, cfg.recommended_tp))
-        weights = cfg.n_params * dtype_bytes
+        weights = cfg.n_params * w_byte
         w_per_chip = weights / tp
         page_pool = hbm_per_chip - w_per_chip - POOL_TAIL_RESERVE
-        kv_tok = cfg.kv_bytes_per_token(tp, dtype_bytes)
+        if quantize_kv:
+            kv_tok = (cfg.kv_bytes_per_token(tp, 1)
+                      + cfg.n_layers * max(1, cfg.n_kv_heads // tp) * 8)
+        else:
+            kv_tok = cfg.kv_bytes_per_token(tp, dtype_bytes)
         resident = int(page_pool // kv_tok) if page_pool > 0 else 0
         m_fits = page_pool > 0
         fits = fits and m_fits
         used += tp
         # host/disk tiers hold full (unsharded) KV bytes per token
-        kv_tok_host = cfg.kv_bytes_per_token(1, dtype_bytes)
+        if quantize_kv:
+            kv_tok_host = (cfg.kv_bytes_per_token(1, 1)
+                           + cfg.n_layers * cfg.n_kv_heads * 8)
+        else:
+            kv_tok_host = cfg.kv_bytes_per_token(1, dtype_bytes)
         host_tokens = int(host_kv_mb * (1 << 20) // kv_tok_host) \
             if host_kv_mb else 0
         disk_tokens = int(disk_kv_gb * (1 << 30) // kv_tok_host) \
@@ -258,6 +274,8 @@ def pool_sizing(pool: Sequence[str], n_devices: int = 8,
             "page_pool_gb_per_chip": round(max(0.0, page_pool) / 1024 ** 3,
                                            2),
             "kv_bytes_per_token_per_chip": kv_tok,
+            "weights_dtype": "int8" if quantize_weights else "bf16",
+            "kv_dtype": "int8+scales" if quantize_kv else "bf16",
             "resident_kv_tokens": resident,
             "tiers": {
                 "hbm_pages": resident // page,
@@ -301,13 +319,15 @@ def pool_sizing(pool: Sequence[str], n_devices: int = 8,
     if replicas > 1:
         out["replica_tiers"] = _replica_tiers(
             list(pool), members, used, total_devices, replicas,
-            disaggregate, hbm_per_chip, host_kv_mb)
+            disaggregate, hbm_per_chip, host_kv_mb,
+            quantize_kv=quantize_kv)
     return out
 
 
 def _replica_tiers(pool: list, members: list, chips_per_replica: int,
                    n_devices: int, replicas: int, disaggregate: bool,
-                   hbm_per_chip: int, host_kv_mb: int) -> dict:
+                   hbm_per_chip: int, host_kv_mb: int,
+                   quantize_kv: bool = False) -> dict:
     """The per-role capacity block of a multi-replica --plan (ISSUE 10
     satellite). Session capacity is denominated in resident sessions of
     ONE full context window per member (the conservative agent-serving
@@ -325,7 +345,10 @@ def _replica_tiers(pool: list, members: list, chips_per_replica: int,
             window = max(1, cfg.context_window)
             sessions += m["resident_kv_tokens"] // window
             if host_kv_mb:
-                kv_tok_host = cfg.kv_bytes_per_token(1, 2)
+                kv_tok_host = (
+                    cfg.kv_bytes_per_token(1, 1)
+                    + cfg.n_layers * cfg.n_kv_heads * 8
+                    if quantize_kv else cfg.kv_bytes_per_token(1, 2))
                 host_sessions += int(host_kv_mb * (1 << 20)
                                      // kv_tok_host) // window
         return {
@@ -449,6 +472,13 @@ def _main(argv=None) -> int:
                          "over N hosts x --devices chips each; "
                          "replicas stay host-local, the wire is the "
                          "only cross-host coupling")
+    ap.add_argument("--quantize-weights", dest="quantize_weights",
+                    action="store_true",
+                    help="plan at the int8 weight byte rate (ISSUE 13)")
+    ap.add_argument("--quantize-kv", dest="quantize_kv",
+                    action="store_true",
+                    help="plan at the int8+scales KV byte rate — "
+                         "resident/host/disk token figures ~double")
     args = ap.parse_args(argv)
     if args.pool:
         pool = args.pool.split(",")
@@ -459,7 +489,9 @@ def _main(argv=None) -> int:
                        disk_kv_gb=args.disk_kv_gb,
                        replicas=args.replicas,
                        disaggregate=args.disaggregate,
-                       hosts=args.hosts)
+                       hosts=args.hosts,
+                       quantize_weights=args.quantize_weights,
+                       quantize_kv=args.quantize_kv)
     print(json.dumps(plan, indent=2))
     return 0 if plan["fits"] else 1
 

@@ -283,6 +283,18 @@ class RuntimeConfig:
     fabric_peers: Optional[list[str]] = None
     fabric_listen: Optional[str] = None
     prefixd: Optional[str] = None
+    # Quantized serving (ISSUE 13, models/quant.py): per-member opt-in
+    # int8. ``quantize_weights`` quantizes every engine's projection
+    # matrices per-channel at load (~2x more members fit at fixed HBM);
+    # ``quantize_kv`` stores int8 KV pages with per-(token, kv-head)
+    # scales beside them (resident_kv_tokens ~doubles; every demote,
+    # spill, prefix write-through and handoff envelope ships ~half the
+    # bytes). The KV quant format is part of kv_signature, so a
+    # quantized↔unquantized peer pair rejects handoff before bytes move
+    # and degrades to a cold re-prefill. Off by default: the
+    # unquantized path keeps its temp-0 bit-equality gates untouched.
+    quantize_weights: bool = False
+    quantize_kv: bool = False
 
 
 class Runtime:
@@ -389,15 +401,17 @@ class Runtime:
                     or config.process_id is not None
                     or config.replicas > 1 or config.disaggregate
                     or config.fabric_peers or config.fabric_listen
-                    or config.prefixd):
+                    or config.prefixd or config.quantize_weights
+                    or config.quantize_kv):
                 # Silent fallback to mock would make the user believe their
-                # checkpoint (or cluster, or fabric peer) is serving
-                # while scripted responses come back.
+                # checkpoint (or cluster, or fabric peer, or quantized
+                # member) is serving while scripted responses come back.
                 raise ValueError(
                     "--checkpoint/--tp/--draft/--coordinator/"
                     "--num-processes/--process-id/--replicas/"
                     "--disaggregate/--fabric-listen/--fabric-peers/"
-                    "--prefixd require --backend tpu "
+                    "--prefixd/--quantize-weights/--quantize-kv "
+                    "require --backend tpu "
                     f"(backend is {config.backend!r})")
             return MockBackend()
         if config.fabric_peers:
@@ -498,7 +512,9 @@ class Runtime:
                 host_kv_mb=config.host_kv_mb,
                 disk_kv_dir=config.disk_kv_dir,
                 disk_kv_gb=config.disk_kv_gb,
-                embed_model=config.embed_model)
+                embed_model=config.embed_model,
+                quantize_weights=config.quantize_weights,
+                quantize_kv=config.quantize_kv)
         else:
             built = TPUBackend(
                 pool, seed=config.seed, draft_k=config.draft_k,
@@ -508,7 +524,9 @@ class Runtime:
                 continuous=config.continuous,
                 qos=qos, host_kv_mb=config.host_kv_mb,
                 disk_kv_dir=config.disk_kv_dir,
-                disk_kv_gb=config.disk_kv_gb)
+                disk_kv_gb=config.disk_kv_gb,
+                quantize_weights=config.quantize_weights,
+                quantize_kv=config.quantize_kv)
         if config.prefixd:
             self._attach_prefixd(built, config.prefixd)
         if config.fabric_listen:

@@ -281,7 +281,8 @@ class ClusterPlane(ModelBackend):
               continuous_chunk: int = 32, continuous_slots: int = 8,
               host_kv_mb: int = 0, disk_kv_dir: Optional[str] = None,
               disk_kv_gb: float = 8.0, embed_model: Optional[str] = None,
-              ) -> "ClusterPlane":
+              quantize_weights: bool = False,
+              quantize_kv: bool = False) -> "ClusterPlane":
         """Build N replicas over one model pool. With ``disaggregate``,
         the first ``max(1, replicas // 2)`` replicas become the prefill
         tier and the rest the decode tier (decode-heavy by default —
@@ -320,7 +321,13 @@ class ClusterPlane(ModelBackend):
                 draft_map=None if prefill else draft_map,
                 draft_k=draft_k, qos=qos,
                 host_kv_mb=host_kv_mb, disk_kv_dir=disk_kv_dir,
-                disk_kv_gb=disk_kv_gb)
+                disk_kv_gb=disk_kv_gb,
+                # quantization is uniform across the cluster: a
+                # mixed-precision replica pair would reject every
+                # handoff at the signature gate (by design — see
+                # kv_signature), so the plane builds one regime
+                quantize_weights=quantize_weights,
+                quantize_kv=quantize_kv)
             if embedder is None:
                 embedder = backend.embedder
             if prefill:

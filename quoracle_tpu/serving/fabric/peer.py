@@ -73,7 +73,9 @@ class FabricPeer:
               continuous_chunk: int = 32, continuous_slots: int = 8,
               host_kv_mb: int = 0, disk_kv_dir: Optional[str] = None,
               disk_kv_gb: float = 8.0,
-              embed_model: Optional[str] = None) -> "FabricPeer":
+              embed_model: Optional[str] = None,
+              quantize_weights: bool = False,
+              quantize_kv: bool = False) -> "FabricPeer":
         """One role-tagged replica backend, mirroring ClusterPlane.build
         exactly: prefill peers run no batcher and no drafts (one ragged
         prefill per placement is their whole job) and every peer gets a
@@ -89,7 +91,8 @@ class FabricPeer:
             continuous_slots=continuous_slots,
             draft_map=None if prefill else draft_map,
             draft_k=draft_k, qos=qos, host_kv_mb=host_kv_mb,
-            disk_kv_dir=disk_kv_dir, disk_kv_gb=disk_kv_gb)
+            disk_kv_dir=disk_kv_dir, disk_kv_gb=disk_kv_gb,
+            quantize_weights=quantize_weights, quantize_kv=quantize_kv)
         if role in ("prefill", "decode"):
             for spec in pool:
                 backend.engines[spec].role = role

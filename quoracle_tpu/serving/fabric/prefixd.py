@@ -88,14 +88,21 @@ class PrefixService:
                 req["key"], req["tokens"])
             if loaded is None:
                 return MSG_PREFIX_MISS, wire.encode_json({})
-            k, v = loaded
-            k = np.ascontiguousarray(k)
-            v = np.ascontiguousarray(v)
-            return MSG_PREFIX_HIT, wire.pack_blob(
-                {"dtype": str(k.dtype), "k_shape": list(k.shape),
-                 "v_shape": list(v.shape)},
-                k.view(np.uint8).reshape(-1).tobytes(),
-                v.view(np.uint8).reshape(-1).tobytes())
+            k, v = np.ascontiguousarray(loaded[0]), \
+                np.ascontiguousarray(loaded[1])
+            header = {"dtype": str(k.dtype), "k_shape": list(k.shape),
+                      "v_shape": list(v.shape)}
+            chunks = [k.view(np.uint8).reshape(-1).tobytes(),
+                      v.view(np.uint8).reshape(-1).tobytes()]
+            if len(loaded) == 4:
+                # int8 entry (ISSUE 13): scale sections follow payload
+                ks = np.ascontiguousarray(loaded[2], np.float32)
+                vs = np.ascontiguousarray(loaded[3], np.float32)
+                header["quant"] = "q8kv"
+                header["scale_shape"] = list(ks.shape)
+                chunks += [ks.view(np.uint8).reshape(-1).tobytes(),
+                           vs.view(np.uint8).reshape(-1).tobytes()]
+            return MSG_PREFIX_HIT, wire.pack_blob(header, *chunks)
         if msg_type == MSG_PREFIX_PUT:
             header, body = wire.unpack_blob(payload)
             dt = wire._np_dtype(header["dtype"])
@@ -103,8 +110,16 @@ class PrefixService:
                                  tuple(header["k_shape"]))
             v = wire._array_from(body[k.nbytes:], dt,
                                  tuple(header["v_shape"]))
+            ks = vs = None
+            if header.get("quant") == "q8kv":
+                sshape = tuple(header.get("scale_shape") or ())
+                f32 = np.dtype(np.float32)
+                off = k.nbytes + v.nbytes
+                ks = wire._array_from(body[off:], f32, sshape)
+                vs = wire._array_from(body[off + ks.nbytes:], f32,
+                                      sshape)
             stored = self._store(header["signature"]).save(
-                header["key"], header["tokens"], k, v)
+                header["key"], header["tokens"], k, v, ks, vs)
             return MSG_OK, wire.encode_json({"stored": bool(stored)})
         if msg_type == MSG_PREFIX_STATS:
             with self._lock:
@@ -174,15 +189,26 @@ class PrefixdClient:
             k = wire._array_from(body, dt, tuple(header["k_shape"]))
             v = wire._array_from(body[k.nbytes:], dt,
                                  tuple(header["v_shape"]))
+            ks = vs = None
+            if header.get("quant") == "q8kv":
+                sshape = tuple(header.get("scale_shape") or ())
+                f32 = np.dtype(np.float32)
+                off = k.nbytes + v.nbytes
+                ks = wire._array_from(body[off:], f32, sshape)
+                vs = wire._array_from(body[off + ks.nbytes:], f32,
+                                      sshape)
         except WireError as e:
             self._note_degraded("get", f"undecodable hit: {e}")
             return None
         self.hits += 1
         FABRIC_PREFIXD_TOTAL.inc(op="get", status="hit")
+        if ks is not None:
+            return np.copy(k), np.copy(v), np.copy(ks), np.copy(vs)
         return np.copy(k), np.copy(v)
 
     def publish(self, key: str, tokens: Sequence[int], k: np.ndarray,
-                v: np.ndarray) -> bool:
+                v: np.ndarray, k_scale: Optional[np.ndarray] = None,
+                v_scale: Optional[np.ndarray] = None) -> bool:
         """Push one block to the fleet (spill-writer thread only — this
         does wire I/O and must never run under serving locks)."""
         from quoracle_tpu.infra.telemetry import FABRIC_PREFIXD_TOTAL
@@ -191,13 +217,20 @@ class PrefixdClient:
             return False
         k = np.ascontiguousarray(k)
         v = np.ascontiguousarray(v)
-        blob = wire.pack_blob(
-            {"signature": self.signature, "key": key,
-             "tokens": [int(t) for t in tokens],
-             "dtype": str(k.dtype), "k_shape": list(k.shape),
-             "v_shape": list(v.shape)},
-            k.view(np.uint8).reshape(-1).tobytes(),
-            v.view(np.uint8).reshape(-1).tobytes())
+        header = {"signature": self.signature, "key": key,
+                  "tokens": [int(t) for t in tokens],
+                  "dtype": str(k.dtype), "k_shape": list(k.shape),
+                  "v_shape": list(v.shape)}
+        chunks = [k.view(np.uint8).reshape(-1).tobytes(),
+                  v.view(np.uint8).reshape(-1).tobytes()]
+        if k_scale is not None:
+            ks = np.ascontiguousarray(k_scale, np.float32)
+            vs = np.ascontiguousarray(v_scale, np.float32)
+            header["quant"] = "q8kv"
+            header["scale_shape"] = list(ks.shape)
+            chunks += [ks.view(np.uint8).reshape(-1).tobytes(),
+                       vs.view(np.uint8).reshape(-1).tobytes()]
+        blob = wire.pack_blob(header, *chunks)
         try:
             _, payload = self.transport.request(MSG_PREFIX_PUT, blob)
         except (TransportError, WireError) as e:

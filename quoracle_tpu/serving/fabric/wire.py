@@ -303,10 +303,16 @@ def encode_envelope(env) -> bytes:
     """Serialize a serving/handoff.HandoffEnvelope (entry = the kvtier
     host-side ``_HostSession``). K and V ship as raw bytes + dtype name
     + shape (npz-style round-trip of extension dtypes, see
-    DiskPrefixStore.save)."""
+    DiskPrefixStore.save). Int8 entries (ISSUE 13) append their per-page
+    fp32 scale arrays as two more byte sections and stamp the quant
+    format in the HEADER — the signature gate rejects a quantized↔
+    unquantized pair before any section is parsed, and the envelope
+    ships ~half the bytes of its bf16 twin."""
     e = env.entry
     k = np.ascontiguousarray(e.k)
     v = np.ascontiguousarray(e.v)
+    k_scale = getattr(e, "k_scale", None)
+    v_scale = getattr(e, "v_scale", None)
     header = {
         "session_id": env.session_id,
         "model_spec": env.model_spec,
@@ -319,8 +325,16 @@ def encode_envelope(env) -> bytes:
         "k_shape": list(k.shape),
         "v_shape": list(v.shape),
     }
-    return pack_blob(header, k.view(np.uint8).reshape(-1).tobytes(),
-                     v.view(np.uint8).reshape(-1).tobytes())
+    chunks = [k.view(np.uint8).reshape(-1).tobytes(),
+              v.view(np.uint8).reshape(-1).tobytes()]
+    if k_scale is not None:
+        ks = np.ascontiguousarray(k_scale, np.float32)
+        vs = np.ascontiguousarray(v_scale, np.float32)
+        header["quant"] = "q8kv"
+        header["scale_shape"] = list(ks.shape)
+        chunks += [ks.view(np.uint8).reshape(-1).tobytes(),
+                   vs.view(np.uint8).reshape(-1).tobytes()]
+    return pack_blob(header, *chunks)
 
 
 def peek_envelope(payload: bytes) -> dict:
@@ -356,15 +370,32 @@ def decode_envelope(payload: bytes, expect_signature: Optional[str] = None):
     k = _array_from(body, dt, k_shape)
     k_bytes = k.nbytes
     v = _array_from(body[k_bytes:], dt, v_shape)
-    if len(body) != k_bytes + v.nbytes:
+    off = k_bytes + v.nbytes
+    ks = vs = None
+    if header.get("quant") == "q8kv":
+        # int8 entry (ISSUE 13): two fp32 scale sections follow the
+        # payload — truncated/short scale bytes are a structured reject
+        # like any other section
+        sshape = tuple(int(s) for s in header.get("scale_shape") or ())
+        if not sshape:
+            raise WireError("quantized envelope missing scale_shape",
+                            reason="decode")
+        f32 = np.dtype(np.float32)
+        ks = _array_from(body[off:], f32, sshape)
+        off += ks.nbytes
+        vs = _array_from(body[off:], f32, sshape)
+        off += vs.nbytes
+    if len(body) != off:
         raise WireError(
-            f"envelope body {len(body)} bytes != declared "
-            f"{k_bytes + v.nbytes}", reason="truncated")
+            f"envelope body {len(body)} bytes != declared {off}",
+            reason="truncated")
     from quoracle_tpu.serving.handoff import HandoffEnvelope
     from quoracle_tpu.serving.kvtier import _HostSession
     entry = _HostSession(list(header["tokens"]),
                          int(header["start_pos"]),
-                         np.copy(k), np.copy(v))
+                         np.copy(k), np.copy(v),
+                         None if ks is None else np.copy(ks),
+                         None if vs is None else np.copy(vs))
     return HandoffEnvelope(
         session_id=header["session_id"],
         model_spec=header["model_spec"],

@@ -137,6 +137,16 @@ class KVHandoff:
             signature=engine.kv_signature(), entry=entry,
             json_state=json_state, src_replica=src_replica,
             ts=time.monotonic())
+        if getattr(entry, "k_scale", None) is not None:
+            # int8 entry (ISSUE 13): this envelope ships ~half the
+            # bytes its bf16 twin would — count the savings per tier
+            from quoracle_tpu.infra.telemetry import (
+                QUANT_BYTES_SAVED_TOTAL,
+            )
+            payload = int(entry.k.nbytes) + int(entry.v.nbytes)
+            QUANT_BYTES_SAVED_TOTAL.inc(
+                max(0, 2 * payload - entry.nbytes),
+                model=model_spec, tier="handoff")
         with self._lock:
             self._inflight[self._key(model_spec, session_id)] = env
             self.exports += 1

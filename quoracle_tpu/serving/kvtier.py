@@ -93,26 +93,50 @@ def _scatter_pages(k_pool, v_pool, k_host, v_host, pages):
     return k_pool, v_pool
 
 
-class _HostSession:
-    __slots__ = ("tokens", "start_pos", "k", "v", "nbytes", "ts")
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+def _scatter_pages_q(k_pool, v_pool, ks_pool, vs_pool, k_host, v_host,
+                     ks_host, vs_host, pages):
+    """Quantized page-in (ISSUE 13): int8 payload pages AND their fp32
+    scale blocks land together — a restored page is byte-identical to
+    the demoted one, scales included."""
+    k_pool = k_pool.at[:, pages].set(k_host.astype(k_pool.dtype))
+    v_pool = v_pool.at[:, pages].set(v_host.astype(v_pool.dtype))
+    ks_pool = ks_pool.at[:, pages].set(ks_host.astype(ks_pool.dtype))
+    vs_pool = vs_pool.at[:, pages].set(vs_host.astype(vs_pool.dtype))
+    return k_pool, v_pool, ks_pool, vs_pool
 
-    def __init__(self, tokens, start_pos, k, v):
+
+class _HostSession:
+    __slots__ = ("tokens", "start_pos", "k", "v", "k_scale", "v_scale",
+                 "nbytes", "ts")
+
+    def __init__(self, tokens, start_pos, k, v, k_scale=None,
+                 v_scale=None):
         self.tokens = tokens
         self.start_pos = start_pos
         self.k = k                      # np [L, n_pages, page, KV, HD]
         self.v = v
-        self.nbytes = int(k.nbytes) + int(v.nbytes)
+        # int8 entries (ISSUE 13): fp32 [L, n_pages, KV, page] — the
+        # scales travel WITH the pages through every tier move
+        self.k_scale = k_scale
+        self.v_scale = v_scale
+        from quoracle_tpu.models.quant import entry_nbytes
+        self.nbytes = entry_nbytes(k, v, k_scale, v_scale)
         self.ts = time.monotonic()
 
 
 class _HostBlock:
-    __slots__ = ("tokens", "k", "v", "nbytes", "ts")
+    __slots__ = ("tokens", "k", "v", "k_scale", "v_scale", "nbytes",
+                 "ts")
 
-    def __init__(self, tokens, k, v):
+    def __init__(self, tokens, k, v, k_scale=None, v_scale=None):
         self.tokens = tokens            # full token prefix (page-aligned)
         self.k = k                      # np [L, page, KV, HD]
         self.v = v
-        self.nbytes = int(k.nbytes) + int(v.nbytes)
+        self.k_scale = k_scale          # np [L, KV, page] (int8 entries)
+        self.v_scale = v_scale
+        from quoracle_tpu.models.quant import entry_nbytes
+        self.nbytes = entry_nbytes(k, v, k_scale, v_scale)
         self.ts = time.monotonic()
 
 
@@ -305,14 +329,24 @@ class DiskPrefixStore:
         return os.path.exists(self._path(key))
 
     @staticmethod
-    def _crc(tokens: np.ndarray, k: np.ndarray, v: np.ndarray) -> int:
+    def _crc(tokens: np.ndarray, k: np.ndarray, v: np.ndarray,
+             k_scale: Optional[np.ndarray] = None,
+             v_scale: Optional[np.ndarray] = None) -> int:
         c = zlib.crc32(tokens.tobytes())
         c = zlib.crc32(k.tobytes(), c)
         c = zlib.crc32(v.tobytes(), c)
+        if k_scale is not None:
+            # int8 entries (ISSUE 13): the per-page scale blocks live
+            # under the SAME crc as the payload — a flipped scale byte
+            # is indistinguishable from a flipped payload byte at this
+            # boundary (reject, unlink, degrade to re-prefill)
+            c = zlib.crc32(np.ascontiguousarray(k_scale).tobytes(), c)
+            c = zlib.crc32(np.ascontiguousarray(v_scale).tobytes(), c)
         return c & 0xFFFFFFFF
 
     def save(self, key: str, tokens: Sequence[int], k: np.ndarray,
-             v: np.ndarray) -> bool:
+             v: np.ndarray, k_scale: Optional[np.ndarray] = None,
+             v_scale: Optional[np.ndarray] = None) -> bool:
         """Write one block. The npz serialization and the tmp-file write
         run OUTSIDE ``_lock`` (qlint lock-blocking: the spill writer
         holding the lock through megabytes of compression would stall
@@ -333,7 +367,17 @@ class DiskPrefixStore:
                 # npz round-trips extension dtypes (ml_dtypes
                 # bfloat16 — the serving cache dtype) as an opaque
                 # void dtype, which would silently strip the dtype a
-                # restore needs
+                # restore needs. Int8 entries (ISSUE 13) append their
+                # per-page scale arrays under the same crc.
+                extra = {}
+                if k_scale is not None:
+                    extra = {
+                        "k_scale": np.ascontiguousarray(
+                            k_scale, np.float32),
+                        "v_scale": np.ascontiguousarray(
+                            v_scale, np.float32),
+                        "scale_shape": np.asarray(k_scale.shape),
+                    }
                 np.savez(
                     f, tokens=toks,
                     k=np.ascontiguousarray(k).view(np.uint8)
@@ -341,7 +385,9 @@ class DiskPrefixStore:
                     v=np.ascontiguousarray(v).view(np.uint8)
                     .reshape(-1),
                     dtype=str(k.dtype), shape=np.asarray(k.shape),
-                    crc=np.uint32(self._crc(toks, k, v)))
+                    crc=np.uint32(self._crc(toks, k, v, k_scale,
+                                            v_scale)),
+                    **extra)
             with self._lock:
                 if os.path.exists(path):
                     # a concurrent writer published the same content
@@ -385,6 +431,15 @@ class DiskPrefixStore:
         d = CHAOS.fire("kvtier.disk_load", model=self.model)
         if d is not None and d.kind == "corrupt":
             self._chaos_corrupt(path)
+        # Chaos seam (ISSUE 13): "kvtier.scale_corrupt" flips a byte in
+        # the TAIL of the entry file — where npz appends the int8
+        # entry's per-page scale arrays — on the restore path. The crc
+        # covers scales exactly like payload, so the SAME boundary must
+        # reject it: a silently-wrong scale would dequantize every
+        # token of the page to wrong values at temp 0.
+        d = CHAOS.fire("kvtier.scale_corrupt", model=self.model)
+        if d is not None and d.kind == "corrupt":
+            self._chaos_corrupt(path, where=0.95)
         try:
             # Restore path by design (ARCHITECTURE §9): extend_prefix
             # calls this under the store lock so match→alloc→scatter→
@@ -400,7 +455,14 @@ class DiskPrefixStore:
                 shape = tuple(int(s) for s in z["shape"])
                 k = z["k"].view(dt).reshape(shape)
                 v = z["v"].view(dt).reshape(shape)
-            if (self._crc(toks, k, v) != crc
+                ks = vs = None
+                if "k_scale" in z.files:
+                    sshape = tuple(int(s) for s in z["scale_shape"])
+                    ks = np.asarray(z["k_scale"],
+                                    np.float32).reshape(sshape)
+                    vs = np.asarray(z["v_scale"],
+                                    np.float32).reshape(sshape)
+            if (self._crc(toks, k, v, ks, vs) != crc
                     or toks.tolist() != [int(t) for t in tokens]):
                 raise ValueError("checksum/token mismatch")
             self.loads += 1
@@ -411,7 +473,7 @@ class DiskPrefixStore:
                 pass
             from quoracle_tpu.infra.telemetry import KV_DISK_LOADS_TOTAL
             KV_DISK_LOADS_TOTAL.inc(model=self.model, status="ok")
-            return k, v
+            return (k, v) if ks is None else (k, v, ks, vs)
         except Exception:                 # noqa: BLE001 — corrupt entry
             self.corrupt += 1
             logger.warning("corrupt disk prefix entry skipped: %s", path)
@@ -427,9 +489,12 @@ class DiskPrefixStore:
             return None
 
     @staticmethod
-    def _chaos_corrupt(path: str) -> None:
-        """Flip a byte mid-payload in place (chaos "corrupt" directive).
-        Best-effort: a vanished file is already the degraded case."""
+    def _chaos_corrupt(path: str, where: float = 0.5) -> None:
+        """Flip a byte in place at fraction ``where`` of the file
+        (chaos "corrupt" directives: 0.5 lands mid-payload;
+        kvtier.scale_corrupt uses 0.95 to land in the appended scale
+        arrays of an int8 entry). Best-effort: a vanished file is
+        already the degraded case."""
         try:
             # qlint: allow[lock-blocking] chaos-only byte flip; armed plans never run on the production hot path
             with open(path, "r+b") as f:
@@ -437,9 +502,10 @@ class DiskPrefixStore:
                 size = f.tell()
                 if size < 1:
                     return
-                f.seek(size // 2)
+                pos = min(size - 1, int(size * where))
+                f.seek(pos)
                 b = f.read(1)
-                f.seek(size // 2)
+                f.seek(pos)
                 f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
         except OSError:
             pass
@@ -516,9 +582,9 @@ class TierManager:
 
     # -- device <-> host plumbing ---------------------------------------
 
-    def _gather_host(self, pages: list[int]) -> tuple[np.ndarray,
-                                                      np.ndarray]:
-        """One device_get per victim: the pages' KV as host numpy.
+    def _gather_host(self, pages: list[int]) -> tuple:
+        """One device_get per victim: the pages' KV as host numpy —
+        (k, v, k_scale, v_scale), scales None on unquantized pools.
 
         Deliberately under the store lock (ARCHITECTURE §9 demote
         invariant): eviction-as-demotion must copy the victim's pages
@@ -533,13 +599,21 @@ class TierManager:
         k = np.asarray(jax.device_get(st.k[:, idx]))
         # qlint: allow[hot-path-sync, lock-blocking] second half of the same bounded victim copy
         v = np.asarray(jax.device_get(st.v[:, idx]))
-        return k, v
+        if st.k_scale is None:
+            return k, v, None, None
+        # qlint: allow[hot-path-sync, lock-blocking] scale blocks ride the same bounded victim copy
+        ks = np.asarray(jax.device_get(st.k_scale[:, idx]))
+        # qlint: allow[hot-path-sync, lock-blocking] scale blocks ride the same bounded victim copy
+        vs = np.asarray(jax.device_get(st.v_scale[:, idx]))
+        return k, v, ks, vs
 
     def _scatter_device(self, pages: list[int], k: np.ndarray,
-                        v: np.ndarray) -> None:
+                        v: np.ndarray, k_scale=None,
+                        v_scale=None) -> None:
         """Page-in via the pool scatter (shape-bucketed to bound
         compiles: the page-count axis pads to a power of two, padded
-        slots target scratch page 0)."""
+        slots target scratch page 0). Int8 pools scatter the scale
+        blocks beside the payload pages."""
         import jax.numpy as jnp
         st = self.store
         n = len(pages)
@@ -548,8 +622,25 @@ class TierManager:
             pad = ((0, 0), (0, cap - n), (0, 0), (0, 0), (0, 0))
             k = np.pad(k, pad)
             v = np.pad(v, pad)
+            if k_scale is not None:
+                spad = ((0, 0), (0, cap - n), (0, 0), (0, 0))
+                k_scale = np.pad(k_scale, spad)
+                v_scale = np.pad(v_scale, spad)
         idx = np.zeros((cap,), np.int32)
         idx[:n] = pages
+        if st.k_scale is not None:
+            if k_scale is None:
+                # entry predates quantization (or scales were lost):
+                # never scatter int8 payloads with stale scales — the
+                # caller degrades to re-prefill
+                raise ValueError(
+                    "quantized pool restore without scale blocks")
+            (st.k, st.v, st.k_scale,
+             st.v_scale) = _scatter_pages_q(
+                st.k, st.v, st.k_scale, st.v_scale, jnp.asarray(k),
+                jnp.asarray(v), jnp.asarray(k_scale),
+                jnp.asarray(v_scale), jnp.asarray(idx))
+            return
         st.k, st.v = _scatter_pages(st.k, st.v, jnp.asarray(k),
                                     jnp.asarray(v), jnp.asarray(idx))
 
@@ -567,17 +658,19 @@ class TierManager:
             return False
         t0 = time.monotonic()
         try:
-            k, v = self._gather_host(pages)
+            k, v, ks, vs = self._gather_host(pages)
         except Exception:                 # noqa: BLE001 — demote is best-
             logger.exception("kv demote failed for %s", key)   # effort
             return False
-        self.host.put_session(
-            key, _HostSession(list(sess.tokens), sess.start_pos, k, v),
-            spill_fn=self._spill_prefix_entry)
+        entry = _HostSession(list(sess.tokens), sess.start_pos, k, v,
+                             ks, vs)
+        self.host.put_session(key, entry,
+                              spill_fn=self._spill_prefix_entry)
         self.demoted_sessions += 1
         from quoracle_tpu.infra.flightrec import FLIGHT
         from quoracle_tpu.infra.telemetry import KV_DEMOTES_TOTAL
         KV_DEMOTES_TOTAL.inc(model=self.model, kind="session")
+        self._note_bytes_saved("demote", entry)
         FLIGHT.record("kv_demote", model=self.model, what="session",
                       session=key, pages=len(pages),
                       ms=round((time.monotonic() - t0) * 1000, 2))
@@ -657,7 +750,16 @@ class TierManager:
                 st._release(pages)
                 return None
             t0 = time.monotonic()
-            self._scatter_device(pages, e.k, e.v)
+            try:
+                self._scatter_device(pages, e.k, e.v, e.k_scale,
+                                     e.v_scale)
+            except ValueError:
+                # dtype/scale skew (a non-quantized entry adopted into a
+                # quantized pool): degrade to re-prefill, never scatter
+                # wrong bytes
+                st._release(pages)
+                self.restore_failures += 1
+                return None
             sess = st.register_restored(key, list(e.tokens), pages,
                                         e.start_pos)
             self.restored_sessions += 1
@@ -688,20 +790,35 @@ class TierManager:
             finally:
                 self._spill_q.task_done()
 
+    def _note_bytes_saved(self, tier: str, entry) -> None:
+        """Quantized byte-economy accounting (ISSUE 13): each tier move
+        of an int8 entry counts the bf16-equivalent bytes it avoided
+        holding/shipping (2·payload − (payload + scales)). No-op for
+        unquantized entries."""
+        if np.dtype(entry.k.dtype) != np.int8:
+            return
+        from quoracle_tpu.infra.telemetry import QUANT_BYTES_SAVED_TOTAL
+        payload = int(entry.k.nbytes) + int(entry.v.nbytes)
+        QUANT_BYTES_SAVED_TOTAL.inc(max(0, 2 * payload - entry.nbytes),
+                                    model=self.model, tier=tier)
+
     def _write_block(self, key: str, entry: _HostBlock) -> None:
         """Writer-thread side of a spill: the actual (atomic, content-
         addressed) disk write — and, with a fleet prefix service
         attached, the publish to it — never under the store/paged
         locks."""
         if self.disk is not None \
-                and self.disk.save(key, entry.tokens, entry.k, entry.v):
+                and self.disk.save(key, entry.tokens, entry.k, entry.v,
+                                   entry.k_scale, entry.v_scale):
             from quoracle_tpu.infra.flightrec import FLIGHT
             from quoracle_tpu.infra.telemetry import KV_DISK_SPILLS_TOTAL
             KV_DISK_SPILLS_TOTAL.inc(model=self.model)
             FLIGHT.record("kv_disk_spill", model=self.model,
                           tokens=len(entry.tokens))
+            self._note_bytes_saved("disk_spill", entry)
         if self.prefixd is not None:
-            self.prefixd.publish(key, entry.tokens, entry.k, entry.v)
+            self.prefixd.publish(key, entry.tokens, entry.k, entry.v,
+                                 entry.k_scale, entry.v_scale)
 
     def _enqueue_spill(self, key: str, entry: _HostBlock) -> None:
         if self._spill_q is None:
@@ -738,12 +855,14 @@ class TierManager:
         if self.disk is not None and self.disk.has(key):
             return        # already durable; skip the device_get
         try:
-            k, v = self._gather_host([page])
+            k, v, ks, vs = self._gather_host([page])
         except Exception:                 # noqa: BLE001 — best-effort
             logger.exception("prefix leaf capture failed")
             return
         self.host.put_prefix(
-            key, _HostBlock(list(tokens), k[:, 0], v[:, 0]),
+            key, _HostBlock(list(tokens), k[:, 0], v[:, 0],
+                            None if ks is None else ks[:, 0],
+                            None if vs is None else vs[:, 0]),
             spill_fn=self._spill_prefix_entry)
         self.demoted_prefix_pages += 1
         from quoracle_tpu.infra.flightrec import FLIGHT
@@ -770,11 +889,13 @@ class TierManager:
         if st.k is None:
             return
         try:
-            k, v = self._gather_host([page])
+            k, v, ks, vs = self._gather_host([page])
         except Exception:                 # noqa: BLE001 — best-effort
             return
         self._enqueue_spill(
-            key, _HostBlock([int(t) for t in tokens], k[:, 0], v[:, 0]))
+            key, _HostBlock([int(t) for t in tokens], k[:, 0], v[:, 0],
+                            None if ks is None else ks[:, 0],
+                            None if vs is None else vs[:, 0]))
 
     def extend_prefix(self, tokens: Sequence[int], cap: int) -> int:
         """Lazily page tiered prefix blocks back into the radix tree:
@@ -839,7 +960,17 @@ class TierManager:
                     break
                 continue
             t0 = time.monotonic()
-            self._scatter_device(pages, blk.k[:, None], blk.v[:, None])
+            try:
+                self._scatter_device(
+                    pages, blk.k[:, None], blk.v[:, None],
+                    None if blk.k_scale is None else blk.k_scale[:, None],
+                    None if blk.v_scale is None else blk.v_scale[:, None])
+            except ValueError:
+                # scale-less block against a quantized pool (signature
+                # dirs make this near-impossible; stay paranoid anyway)
+                st._release(pages)
+                self.restore_failures += 1
+                break
             added = st.prefix_cache.insert(
                 prefix, [nd.page for nd in path] + pages)
             if not added:

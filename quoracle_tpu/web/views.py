@@ -476,6 +476,12 @@ def kv_panel(kv: dict) -> str:
         hbm = m.get("hbm") or {}
         host = m.get("host") or {}
         disk = m.get("disk") or {}
+        quant = m.get("quant") or {}
+        # compression column (ISSUE 13): int8 members show their
+        # bf16-vs-actual byte ratio; unquantized members show 1.0x
+        comp = quant.get("kv_compression")
+        comp_s = (f"{comp}x int8" if quant.get("quantize_kv")
+                  else "1.0x bf16")
         rows.append(
             f"<tr class=\"kv-row\" data-model=\"{_e(model)}\">"
             f"<td>{_e(model)}</td>"
@@ -490,12 +496,14 @@ def kv_panel(kv: dict) -> str:
             f"<td>{_e(m.get('demoted_sessions'))}/"
             f"{_e(m.get('restored_sessions'))}</td>"
             f"<td>{_e(disk.get('corrupt_skipped') if disk else '—')}"
-            f"</td></tr>")
+            f"</td>"
+            f"<td class=\"kv-comp\">{_e(comp_s)}</td></tr>")
     parts.append(
         "<table id=\"kvtier\"><tr><th>model</th><th>hbm pages</th>"
         "<th>sessions</th><th>host MB</th><th>host sess+pfx</th>"
         "<th>disk entries</th><th>demote/restore</th>"
-        "<th>corrupt</th></tr>" + "".join(rows) + "</table>")
+        "<th>corrupt</th><th>compression</th></tr>"
+        + "".join(rows) + "</table>")
     return "".join(parts)
 
 

@@ -6,7 +6,7 @@
 # mid-calibration the round lost its primary bench record entirely; the
 # header claimed "commit immediately" but the script never committed.)
 cd /root/repo
-LOG=RELAY_POLL_r14.log
+LOG=RELAY_POLL_r15.log
 echo "$(date -u +%FT%TZ) direct run: device confirmed live (probe ok)" >> "$LOG"
 
 # Primary record first. If a previous run left calibration gates behind,
@@ -32,45 +32,50 @@ echo "$(date -u +%FT%TZ) direct run: device confirmed live (probe ok)" >> "$LOG"
 # against a 3-replica prefill/decode cluster at the same offered load
 # chaos on vs off — goodput delta, interactive p95 during recovery,
 # and the machine-checked invariant verdicts (zero silent loss,
-# structured failures only, temp-0 survivor equality). NEW in r14 the
-# ISSUE 12 fabric row: config 18 runs the same disaggregated traffic
+# structured failures only, temp-0 survivor equality). In r14 the
+# ISSUE 12 fabric row landed: config 18 runs the same disaggregated traffic
 # through an in-process ClusterPlane vs a prefill+decode FabricPlane
 # over the loopback wire (handoff p95 + per-row serialization
 # overhead, temp-0 equality ASSERT), measures the fleet prefix hit
 # rate cold-start with vs without prefixd, and front-door throughput
-# at N loopback peers; detail in FABRIC_r14_live.json
-# (QUORACLE_BENCH_FABRIC). Config 15's
-# detail lands in the RAGGED_r14_live.json sidecar, config 16's in
-# CLUSTER_r14_live.json, config 17's in CHAOS_r14_live.json,
+# at N loopback peers; detail in FABRIC_r15_live.json
+# (QUORACLE_BENCH_FABRIC). NEW in r15: config 19 — quantized
+# serving (int8 weights + int8 KV pages): byte-rate/handoff/spill
+# ratios, tokens/sec and scorecard deltas quantized vs not, with a
+# self-consistency assert; detail in QUANT_r15_live.json
+# (QUORACLE_BENCH_QUANT). Config 15's
+# detail lands in the RAGGED_r15_live.json sidecar, config 16's in
+# CLUSTER_r15_live.json, config 17's in CHAOS_r15_live.json,
 # committed with the bench record alongside the
 # RESOURCES/QUALITY/SPEC/KVTIER sidecars.
 [ -f /root/repo/calib_v5e.json ] && export QUORACLE_PAGED_CALIB=/root/repo/calib_v5e.json
-export QUORACLE_BENCH_RESOURCES=/root/repo/RESOURCES_r14_live.json
-export QUORACLE_BENCH_QUALITY=/root/repo/QUALITY_r14_live.json
-export QUORACLE_BENCH_SPEC=/root/repo/SPEC_r14_live.json
-export QUORACLE_BENCH_KV=/root/repo/KVTIER_r14_live.json
-export QUORACLE_BENCH_RAGGED=/root/repo/RAGGED_r14_live.json
-export QUORACLE_BENCH_CLUSTER=/root/repo/CLUSTER_r14_live.json
-export QUORACLE_BENCH_CHAOS=/root/repo/CHAOS_r14_live.json
-export QUORACLE_BENCH_FABRIC=/root/repo/FABRIC_r14_live.json
-timeout 5400 python bench.py > /root/repo/BENCH_r14_live.json 2>> "$LOG"
+export QUORACLE_BENCH_RESOURCES=/root/repo/RESOURCES_r15_live.json
+export QUORACLE_BENCH_QUALITY=/root/repo/QUALITY_r15_live.json
+export QUORACLE_BENCH_SPEC=/root/repo/SPEC_r15_live.json
+export QUORACLE_BENCH_KV=/root/repo/KVTIER_r15_live.json
+export QUORACLE_BENCH_RAGGED=/root/repo/RAGGED_r15_live.json
+export QUORACLE_BENCH_CLUSTER=/root/repo/CLUSTER_r15_live.json
+export QUORACLE_BENCH_CHAOS=/root/repo/CHAOS_r15_live.json
+export QUORACLE_BENCH_FABRIC=/root/repo/FABRIC_r15_live.json
+export QUORACLE_BENCH_QUANT=/root/repo/QUANT_r15_live.json
+timeout 5400 python bench.py > /root/repo/BENCH_r15_live.json 2>> "$LOG"
 rc=$?
-echo "$(date -u +%FT%TZ) bench rc=$rc artifact=BENCH_r14_live.json" >> "$LOG"
+echo "$(date -u +%FT%TZ) bench rc=$rc artifact=BENCH_r15_live.json" >> "$LOG"
 if [ "$rc" -eq 0 ] && python - <<'EOF'
 import json
-d = json.load(open("/root/repo/BENCH_r14_live.json"))
+d = json.load(open("/root/repo/BENCH_r15_live.json"))
 ok = (not d.get("device_unavailable")) and d.get("value")
 raise SystemExit(0 if ok else 1)
 EOF
 then
     echo "$(date -u +%FT%TZ) BENCH SUCCESS — committing the record" >> "$LOG"
-    git add BENCH_r14_live.json RESOURCES_r14_live.json \
-        QUALITY_r14_live.json SPEC_r14_live.json \
-        KVTIER_r14_live.json RAGGED_r14_live.json \
-        CLUSTER_r14_live.json CHAOS_r14_live.json \
-        FABRIC_r14_live.json "$LOG" 2>/dev/null
+    git add BENCH_r15_live.json RESOURCES_r15_live.json \
+        QUALITY_r15_live.json SPEC_r15_live.json \
+        KVTIER_r15_live.json RAGGED_r15_live.json \
+        CLUSTER_r15_live.json CHAOS_r15_live.json \
+        FABRIC_r15_live.json QUANT_r15_live.json "$LOG" 2>/dev/null
     git -c user.name=distsys-graft -c user.email=graft@localhost \
-        commit -m "Chip-verified BENCH_r14_live artifact (direct run)" >> "$LOG" 2>&1 \
+        commit -m "Chip-verified BENCH_r15_live artifact (direct run)" >> "$LOG" 2>&1 \
         || echo "$(date -u +%FT%TZ) commit failed (artifact still on disk)" >> "$LOG"
 else
     echo "$(date -u +%FT%TZ) bench artifact not clean; bonus captures may still run" >> "$LOG"
@@ -83,7 +88,7 @@ fi
 # realized row depends on.
 timeout 900 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m quoracle_tpu.tools.train_draft --check \
-    > /root/repo/SPEC_CHECK_r14.json 2>> "$LOG" \
+    > /root/repo/SPEC_CHECK_r15.json 2>> "$LOG" \
     && echo "$(date -u +%FT%TZ) draft check passed" >> "$LOG" \
     || echo "$(date -u +%FT%TZ) draft check FAILED (bench record already safe)" >> "$LOG"
 timeout 2400 python -m quoracle_tpu.tools.calibrate_paged \
@@ -92,9 +97,9 @@ timeout 2400 python -m quoracle_tpu.tools.calibrate_paged \
     || echo "$(date -u +%FT%TZ) calibration FAILED (bench record already safe)" >> "$LOG"
 timeout 1800 python -m quoracle_tpu.tools.bench_longctx \
     --resident 16384 --rounds 3 \
-    > /root/repo/LONGCTX_r14.json 2>> "$LOG" \
+    > /root/repo/LONGCTX_r15.json 2>> "$LOG" \
     || echo "$(date -u +%FT%TZ) longctx FAILED (bench record already safe)" >> "$LOG"
-git add calib_v5e.json LONGCTX_r14.json SPEC_CHECK_r14.json "$LOG" 2>/dev/null
+git add calib_v5e.json LONGCTX_r15.json SPEC_CHECK_r15.json "$LOG" 2>/dev/null
 git -c user.name=distsys-graft -c user.email=graft@localhost \
     commit -m "Post-bench chip captures: draft check + paged-gate calibration + long-context sweep" >> "$LOG" 2>&1 \
     || true

@@ -156,7 +156,14 @@ def test_scenario_drift_storm():
 def test_scenario_hbm_pressure_churn():
     report = _assert_scenario("hbm_pressure_churn", seed=11)
     assert report.evidence["tier"]["demoted_sessions"] >= 1
-    assert report.evidence["storms"] >= 1
+    # the poisoned keys put the ledger in storm: either they tripped it
+    # here, or the quantized member's real compiles already had (the
+    # gauge stays up through the 120 s window either way)
+    assert report.evidence["storms"] >= 1 or report.evidence["storm_active"]
+    # ISSUE 13 satellite: when the scale_corrupt point fired, the crc
+    # boundary rejected (skip + unlink + re-prefill) every flip
+    if report.evidence["scale_corrupt"]:
+        assert report.evidence["crc_rejects"] >= 1
 
 
 def test_scenario_restart_warm_start():
