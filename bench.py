@@ -1755,6 +1755,189 @@ def measure_quant(pool, n_prompts: int = 6) -> dict:
     }
 
 
+def measure_fleet(pool, n_interactive: int = 6, n_sessions: int = 3,
+                  seed: int = 2026) -> dict:
+    """Config 20: the elastic fleet controller on real engines
+    (ISSUE 14) — the SAME mixed traffic (``n_interactive`` short
+    INTERACTIVE rows timed individually + ``n_sessions`` constrained
+    sessioned AGENT rows, two rounds each) through a 3-replica
+    prefill/decode QoS cluster twice: a STATIC phase with the boot
+    topology frozen, then an ELASTIC phase with scale events forced
+    mid-traffic — a policy-driven scale-up (burn ticks through the
+    FleetController), a forced drain that live-migrates every resident
+    session (the round-2 resumes ride the MIGRATED pages), a re-tier
+    flip + flip-back, and a scale-down retirement.
+
+    Reported: goodput (ok completion tokens/s) per phase and the delta
+    the scale events cost, sessions migrated/sec through the handoff
+    path, the max INTERACTIVE SLO burn observed during the drain/
+    re-tier window vs the static phase, drain wall times, and the
+    temp-0 equality ASSERT (elastic texts == static texts, bit-for-bit
+    — elasticity must be invisible in the output). Detail lands in the
+    FLEET sidecar (QUORACLE_BENCH_FLEET)."""
+    import jax
+
+    from quoracle_tpu.models.runtime import QueryRequest
+    from quoracle_tpu.serving.cluster import ClusterPlane
+    from quoracle_tpu.serving.fleet import (
+        FleetConfig, FleetController, FleetSignals, ReplicaSignal,
+    )
+    from quoracle_tpu.serving.qos import Priority
+
+    member = pool[0]
+    inter_msgs = [[{"role": "user",
+                    "content": f"[user {i}] {TASKS[i % len(TASKS)][:48]}"}]
+                  for i in range(n_interactive)]
+    sess_msgs = [[{"role": "user",
+                   "content": f"[agent {i}] working state: "
+                              + " ".join(TASKS)[:384]}]
+                 for i in range(n_sessions)]
+
+    def burn_signals(cluster):
+        return FleetSignals(replicas=tuple(
+            ReplicaSignal(r.replica_id, r.role,
+                          30.0 if r.role == "decode" else 0.0)
+            for r in cluster.replicas), slo_burn=2.0)
+
+    def max_burn(cluster) -> float:
+        burn = 0.0
+        for rep in cluster.replicas:
+            slo = getattr(rep.backend, "slo", None)
+            if slo is not None:
+                burn = max(burn, slo.burn(Priority.INTERACTIVE))
+        return burn
+
+    def run_phase(cluster, tag: str, fleet=None) -> dict:
+        # warmup pays both paths' compiles so the static phase isn't
+        # billed for them
+        cluster.query([QueryRequest(member, inter_msgs[0],
+                                    temperature=0.0, max_tokens=4)])
+        cluster.query([QueryRequest(member, sess_msgs[0],
+                                    temperature=0.0, max_tokens=4,
+                                    session_id=f"fleet-{tag}-warm",
+                                    constrain_json=True)])
+        cluster.drop_session(f"fleet-{tag}-warm")
+        lat, results, drains = [], [], []
+        burn_during_events = 0.0
+        t0 = time.monotonic()
+        # round 1: establish the sessions, interleaved with
+        # interactive rows
+        for j, m in enumerate(sess_msgs):
+            results += cluster.query([QueryRequest(
+                member, m, temperature=0.0, max_tokens=24,
+                session_id=f"fleet-{tag}-{j}", constrain_json=True,
+                priority=1)])
+        for m in inter_msgs[:n_interactive // 2]:
+            r0 = time.monotonic()
+            results += cluster.query([QueryRequest(
+                member, m, temperature=0.0, max_tokens=16, priority=0)])
+            lat.append((time.monotonic() - r0) * 1000)
+        if fleet is not None:
+            # the scale events, mid-traffic: policy scale-up, forced
+            # drain (live migration), re-tier round trip, scale-down
+            fleet.tick(burn_signals(cluster))
+            act = fleet.tick(burn_signals(cluster))
+            assert act is not None and act.action == "scale_up", act
+            victim = sorted(r.replica_id for r in cluster.replicas
+                            if r.role == "decode")[0]
+            drains.append(fleet.drain(victim, retire=True,
+                                      reason="bench-scale-down"))
+            burn_during_events = max(burn_during_events,
+                                     max_burn(cluster))
+            pre = sorted(r.replica_id for r in cluster.replicas
+                         if r.role == "prefill")[-1]
+            drains.append(fleet.drain(pre, new_role="decode",
+                                      reason="bench-retier"))
+            drains.append(fleet.drain(pre, new_role="prefill",
+                                      reason="bench-retier-back"))
+            burn_during_events = max(burn_during_events,
+                                     max_burn(cluster))
+        # round 2: resume every session (on its MIGRATED pages in the
+        # elastic phase) + the remaining interactive rows
+        for j, m in enumerate(sess_msgs):
+            results += cluster.query([QueryRequest(
+                member, m + [{"role": "assistant", "content": "ok"},
+                             {"role": "user", "content": "continue."}],
+                temperature=0.0, max_tokens=24,
+                session_id=f"fleet-{tag}-{j}", constrain_json=True,
+                priority=1)])
+        for m in inter_msgs[n_interactive // 2:]:
+            r0 = time.monotonic()
+            results += cluster.query([QueryRequest(
+                member, m, temperature=0.0, max_tokens=16, priority=0)])
+            lat.append((time.monotonic() - r0) * 1000)
+        wall = time.monotonic() - t0
+        for j in range(n_sessions):
+            cluster.drop_session(f"fleet-{tag}-{j}")
+        ok_tokens = sum(r.usage.completion_tokens for r in results
+                        if r.ok)
+        lat.sort()
+        return {
+            "results": results,
+            "texts": [r.text if r.ok else None for r in results],
+            "wall_s": round(wall, 3),
+            "ok_rows": sum(1 for r in results if r.ok),
+            "goodput_tok_s": round(ok_tokens / max(1e-9, wall), 1),
+            "interactive_p95_ms": round(
+                lat[min(len(lat) - 1, int(0.95 * len(lat)))], 1),
+            "slo_burn_peak": round(max_burn(cluster), 3),
+            "burn_during_events": round(burn_during_events, 3),
+            "drains": drains,
+        }
+
+    cluster = ClusterPlane.build([member], replicas=3, disaggregate=True,
+                                 continuous=True, continuous_chunk=16,
+                                 continuous_slots=8, qos=True)
+    fleet = FleetController(cluster, FleetConfig(
+        min_replicas=1, max_replicas=4, hysteresis_ticks=2,
+        cooldown_ticks=0, seed=seed))
+    try:
+        static = run_phase(cluster, "static")
+        elastic = run_phase(cluster, "elastic", fleet=fleet)
+        handoff = cluster.handoff.stats()
+    finally:
+        cluster.close()
+
+    migrated = sum(d["migrated"] for d in elastic["drains"])
+    failed = sum(d["failed"] for d in elastic["drains"])
+    drain_ms = [d["ms"] for d in elastic["drains"]]
+    migrate_wall_s = sum(drain_ms) / 1000.0
+    n_chips = max(1, len(jax.devices()))
+    temp0_equal = elastic["texts"] == static["texts"]
+    result = {
+        "n_interactive": n_interactive,
+        "n_sessions": n_sessions,
+        "seed": seed,
+        "goodput_tok_s_static": static["goodput_tok_s"],
+        "goodput_tok_s_elastic": elastic["goodput_tok_s"],
+        "goodput_delta_frac": round(
+            1.0 - elastic["goodput_tok_s"]
+            / max(1e-9, static["goodput_tok_s"]), 3),
+        "goodput_tok_s_chip_static": round(
+            static["goodput_tok_s"] / n_chips, 1),
+        "goodput_tok_s_chip_elastic": round(
+            elastic["goodput_tok_s"] / n_chips, 1),
+        "interactive_p95_ms_static": static["interactive_p95_ms"],
+        "interactive_p95_ms_elastic": elastic["interactive_p95_ms"],
+        "slo_burn_static": static["slo_burn_peak"],
+        "slo_burn_during_events": elastic["burn_during_events"],
+        "sessions_migrated": migrated,
+        "sessions_migrate_failed": failed,
+        "sessions_migrated_per_s": round(
+            migrated / max(1e-9, migrate_wall_s), 2),
+        "drain_ms": drain_ms,
+        "drain_ms_max": max(drain_ms) if drain_ms else 0.0,
+        "fleet_ledger": fleet.ledger(),
+        "handoff": handoff,
+        "envelope_leaks": handoff["inflight"],
+        "temp0_equal": temp0_equal,
+    }
+    assert temp0_equal, "config20: elastic texts diverged from static"
+    assert handoff["inflight"] == 0, \
+        f"config20: leaked handoff envelopes: {handoff}"
+    return result
+
+
 def measure_quality_overhead(backend, pool,
                              n_decides: int = N_CYCLES) -> dict:
     """Config 12: consensus-quality instrumentation overhead (ISSUE 5).
@@ -2056,6 +2239,25 @@ def base_payload() -> dict:
         "config19_tokens_per_s_int8": None,
         "config19_agreement_frac": None,
         "config19_self_consistent": None,
+        # config 20 — elastic fleet controller (ISSUE 14): the same
+        # mixed traffic through a 3-replica prefill/decode QoS cluster
+        # with a static topology vs scale events forced mid-traffic
+        # (policy scale-up, forced drain with live session migration,
+        # re-tier round trip, scale-down retirement) — goodput during
+        # scale events vs static, sessions migrated/sec through the
+        # handoff path, SLO burn during the drain/re-tier window, and
+        # the temp-0 equality ASSERT (elasticity invisible in the
+        # output). Detail in the FLEET sidecar (QUORACLE_BENCH_FLEET).
+        "config20_goodput_tok_s_static": None,
+        "config20_goodput_tok_s_elastic": None,
+        "config20_goodput_delta_frac": None,
+        "config20_slo_burn_static": None,
+        "config20_slo_burn_during_events": None,
+        "config20_sessions_migrated": None,
+        "config20_sessions_migrated_per_s": None,
+        "config20_drain_ms_max": None,
+        "config20_envelope_leaks": None,
+        "config20_temp0_equal": None,
         "cycles": None,
         "rounds_per_cycle": None,
         "max_new_tokens": None,
@@ -2550,6 +2752,22 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             except OSError as e:
                 log(f"config18 sidecar write failed: {e}")
 
+    # config 20 builds its own 3-replica cluster (the fleet must be
+    # free to retire/re-tier replicas without touching backend's
+    # engines) — before the vision config frees the checkpoints
+    cfg20 = guard("config20", lambda: measure_fleet(pool))
+    if cfg20:
+        log(f"config20: {cfg20}")
+        sidecar = os.environ.get("QUORACLE_BENCH_FLEET")
+        if sidecar:
+            try:
+                with open(sidecar, "w") as f:
+                    json.dump({"metric": "fleet",
+                               "config20": cfg20}, f, indent=1)
+                log(f"config20 fleet detail written to {sidecar}")
+            except OSError as e:
+                log(f"config20 sidecar write failed: {e}")
+
     # config 19 builds its own backends (quantized vs not must not share
     # engines — the whole point is two independent numeric regimes)
     cfg19 = guard("config19", lambda: measure_quant(pool))
@@ -2854,6 +3072,24 @@ def _run(args, payload: dict, deadline_at: float) -> None:
                 cfg19["scorecard_deltas"][member19][
                     "token_agreement_frac"],
             "config19_self_consistent": cfg19["self_consistent"],
+        })
+    if cfg20:
+        payload.update({
+            "config20_goodput_tok_s_static":
+                cfg20["goodput_tok_s_static"],
+            "config20_goodput_tok_s_elastic":
+                cfg20["goodput_tok_s_elastic"],
+            "config20_goodput_delta_frac":
+                cfg20["goodput_delta_frac"],
+            "config20_slo_burn_static": cfg20["slo_burn_static"],
+            "config20_slo_burn_during_events":
+                cfg20["slo_burn_during_events"],
+            "config20_sessions_migrated": cfg20["sessions_migrated"],
+            "config20_sessions_migrated_per_s":
+                cfg20["sessions_migrated_per_s"],
+            "config20_drain_ms_max": cfg20["drain_ms_max"],
+            "config20_envelope_leaks": cfg20["envelope_leaks"],
+            "config20_temp0_equal": cfg20["temp0_equal"],
         })
     if cfg10:
         payload.update({

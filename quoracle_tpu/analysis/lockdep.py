@@ -56,6 +56,13 @@ HIERARCHY: tuple = (
     #    replica's batcher, so its locks must release before any
     #    replica-internal lock is taken) -------------------------------
     ("cluster.plane",   4, False),  # ClusterPlane replica table / seq
+    ("fleet",           5, False),  # FleetController ledger + policy
+                                    # state (ISSUE 14): decisions read
+                                    # router/replica signals (6+) and
+                                    # drains reach engine locks (25),
+                                    # so it sits above both — pure
+                                    # bookkeeping, no device work under
+                                    # it
     ("router",          6, False),  # ClusterRouter affinity + liveness
     ("fabric.plane",    7, False),  # FabricPlane peer table + retained
                                     # envelope-bytes ledger (below the

@@ -60,6 +60,11 @@ fabric.prefixd          fabric/prefixd.PrefixdClient           unavailable,
                         (fetch/publish degrade to local-       slow
                         only — warm-start becomes prefill,
                         never an error)
+fleet.migrate           serving/fleet.FleetController._drain   crash, fail
+                        (per session migration — a crash is
+                        the draining replica dying with
+                        sessions still aboard; a fail degrades
+                        one session to re-prefill)
 ======================  =====================================  ==========
 
 ``crash`` kinds raise :class:`InjectedFault` out of ``fire()`` — a
@@ -118,6 +123,12 @@ INJECTION_POINTS: dict = {
     "fabric.prefixd": "fleet prefix service unavailable / slow — the "
                       "read-through client degrades to local tiers "
                       "and cold prefill",
+    "fleet.migrate": "replica death mid-drain (ISSUE 14) — fires per "
+                     "session migration on the fleet controller's "
+                     "drain path; a crash means the draining replica "
+                     "died with sessions still aboard, which must "
+                     "degrade to mark-failed + re-prefill, never "
+                     "silent loss",
 }
 
 

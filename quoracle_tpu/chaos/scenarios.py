@@ -32,6 +32,10 @@ engines. The registry:
                       fabric (ISSUE 12) — drops and corrupt frames;
                       bounded retry absorbs the flap or the row
                       degrades/re-places structurally
+  scale_storm         the elastic fleet (ISSUE 14) scales, re-tiers,
+                      and drains mid-traffic while a replica is killed
+                      during its own drain and a migration degrades —
+                      survivors bit-equal, envelope ledger empty
 """
 
 from __future__ import annotations
@@ -745,8 +749,164 @@ class FabricPartition(Scenario):
         return out
 
 
+# ---------------------------------------------------------------------------
+# 7. Scale storm (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+class ScaleStorm(Scenario):
+    """The elastic fleet under fire: a 4-replica prefill/decode cluster
+    runs sessioned traffic while the FleetController scales up (policy
+    ticks over synthetic burn signals), retires a decode replica
+    through a live drain, re-tiers a prefill replica and flips it back,
+    and force-drains the replica holding a live session — with chaos
+    KILLING the first draining replica mid-drain (sessions still
+    aboard) and degrading one later migration. Every row must survive
+    bit-equal to the fault-free pass (cold re-prefills allowed, wrong
+    bits never), failures must be structured, and the handoff envelope
+    ledger must end empty — a leaked envelope is a stranded failover
+    source. Both phases run the SAME self-restoring script, so the
+    shared cluster enters the storm with the clean phase's topology
+    shape (2 prefill + 2 decode)."""
+
+    name = "scale_storm"
+    description = ("forced drain + re-tier + scale-down mid-traffic "
+                   "with a replica killed during its own drain")
+
+    N_SESSIONS = 3
+
+    def build(self, ctx: dict) -> None:
+        from quoracle_tpu.serving.cluster import ClusterPlane
+        from quoracle_tpu.serving.fleet import FleetConfig, FleetController
+        cl = ClusterPlane.build([MEMBER], replicas=4, disaggregate=True,
+                                continuous=True, continuous_chunk=8)
+        ctx["cluster"] = cl
+        ctx["fleet"] = FleetController(cl, FleetConfig(
+            min_replicas=1, max_replicas=4, hysteresis_ticks=2,
+            cooldown_ticks=0, seed=5))
+        ctx["backends"] = [cl]
+
+    def rules(self, ctx: dict) -> list:
+        return [
+            # the first draining replica dies with sessions aboard —
+            # mark-failed + re-prefill, never silent loss
+            FaultRule("fleet.migrate", "crash", max_fires=1),
+            # one later migration degrades a single session to
+            # re-prefill (affinity dropped, bits unchanged)
+            FaultRule("fleet.migrate", "fail", max_fires=1),
+        ]
+
+    @staticmethod
+    def _burn_signals(cl):
+        from quoracle_tpu.serving.fleet import FleetSignals, ReplicaSignal
+        return FleetSignals(replicas=tuple(
+            ReplicaSignal(r.replica_id, r.role,
+                          12.0 if r.role == "decode" else 0.0)
+            for r in cl.replicas), slo_burn=2.0)
+
+    def traffic(self, ctx: dict, phase: str) -> dict:
+        cl, fc = ctx["cluster"], ctx["fleet"]
+        results, drains = [], []
+        sids = [f"{phase}-elastic{i}" for i in range(self.N_SESSIONS)]
+        # wave 1: establish sessions on the decode tier
+        for i, sid in enumerate(sids):
+            results += cl.query([_req(
+                _msgs(f"elastic session {i}: plan the next scale "
+                      f"event step by step"), sid=sid, max_tokens=10)])
+        # policy scale-up: two burn ticks clear the hysteresis bound
+        fc.tick(self._burn_signals(cl))
+        up = fc.tick(self._burn_signals(cl))
+        assert up is not None and up.action == "scale_up"
+        results += cl.query([_req(_msgs("mid-traffic row A"),
+                                  max_tokens=8)])
+        # scale-down: retire the first decode replica through a live
+        # drain — the storm kills it mid-drain (fleet.migrate crash)
+        first_dec = sorted(r.replica_id for r in cl.replicas
+                           if r.role == "decode")[0]
+        drains.append(fc.drain(first_dec, retire=True,
+                               reason=f"{phase}-scale-down"))
+        # re-tier a prefill replica into the decode tier and back —
+        # the drain-flip-drain-flip round trip must strand nothing
+        pre = sorted(r.replica_id for r in cl.replicas
+                     if r.role == "prefill")[-1]
+        drains.append(fc.drain(pre, new_role="decode",
+                               reason=f"{phase}-retier"))
+        results += cl.query([_req(_msgs("mid-traffic row B"),
+                                  max_tokens=8)])
+        drains.append(fc.drain(pre, new_role="prefill",
+                               reason=f"{phase}-retier-back"))
+        # wave 2: resume every session (migrated, or re-prefilled where
+        # the kill took its replica down)
+        for i, sid in enumerate(sids):
+            results += cl.query([_req(
+                _msgs(f"elastic session {i}: continue the plan"),
+                sid=sid, max_tokens=10)])
+        # forced drain of the replica HOLDING session 0 (the hot-swap
+        # primitive): its migration degrades in the storm (fail)
+        holder = cl.router.affinity_of(sids[0])
+        if holder is not None:
+            drains.append(fc.drain(holder.replica_id, retire=False,
+                                   reason=f"{phase}-hot-swap"))
+        # wave 3: every session serves again, wherever it landed
+        for i, sid in enumerate(sids):
+            results += cl.query([_req(
+                _msgs(f"elastic session {i}: summarize"),
+                sid=sid, max_tokens=10)])
+        for sid in sids:
+            cl.drop_session(sid)
+        return {
+            "submitted": 3 * self.N_SESSIONS + 2,
+            "results": results, "eq": results,
+            "drains": drains,
+            "handoff": cl.handoff.stats(),
+        }
+
+    def check(self, ctx, clean, storm, plan, flight_slice) -> list:
+        cl = ctx["cluster"]
+        crash_fired = [t for t in plan.schedule() if t[3] == "crash"]
+        fail_fired = [t for t in plan.schedule() if t[3] == "fail"]
+        clean_first, storm_first = clean["drains"][0], storm["drains"][0]
+        out = [
+            inv.no_silent_loss(storm["submitted"], storm["results"],
+                               backends=[cl]),
+            inv.structured_failures(storm["results"]),
+            inv.temp0_equality(clean["eq"], storm["eq"]),
+            inv.lockdep_clean(),
+            inv.fault_schedule(plan, flight_slice),
+            inv.InvariantResult(
+                "clean_drain_migrated",
+                clean_first["migrated"] >= 1
+                and not clean_first["died"],
+                f"clean scale-down drain: {clean_first}"),
+            inv.InvariantResult(
+                "kill_mid_drain_contained",
+                (not crash_fired)
+                or (storm_first["died"]
+                    and storm_first["replica"] == crash_fired[0][1]),
+                f"crash={crash_fired} storm drain: {storm_first}"),
+            inv.InvariantResult(
+                "migration_degraded_structurally",
+                (not fail_fired)
+                or any(d["failed"] >= 1 for d in storm["drains"]),
+                f"fail={fail_fired} drains={storm['drains']}"),
+            inv.InvariantResult(
+                "no_envelope_leaks",
+                storm["handoff"]["inflight"] == 0,
+                f"handoff={storm['handoff']}"),
+        ]
+        storm["evidence"] = {
+            "drains": storm["drains"],
+            "ledger": ctx["fleet"].ledger(),
+            "dead_replicas": [r.replica_id for r in cl.replicas
+                              if not r.alive],
+            "handoff": storm["handoff"],
+        }
+        return out
+
+
 SCENARIOS: dict = {
     sc.name: sc for sc in (TrafficStorm, KillMidHandoff,
                            RestartWarmStart, DriftStorm,
-                           HbmPressureChurn, FabricPartition)
+                           HbmPressureChurn, FabricPartition,
+                           ScaleStorm)
 }

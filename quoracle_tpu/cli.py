@@ -87,7 +87,10 @@ async def cmd_run(args: argparse.Namespace) -> int:
                                prefixd=args.prefixd,
                                chaos_plan=args.chaos_plan,
                                quantize_weights=args.quantize_weights,
-                               quantize_kv=args.quantize_kv))
+                               quantize_kv=args.quantize_kv,
+                               fleet_min=args.fleet_min,
+                               fleet_max=args.fleet_max,
+                               fleet_tick_s=args.fleet_tick_s))
     _attach_printer(rt)
     if pool is None and args.profile is None:
         pool = rt.default_pool()
@@ -128,7 +131,10 @@ async def cmd_resume(args: argparse.Namespace) -> int:
                                prefixd=args.prefixd,
                                chaos_plan=args.chaos_plan,
                                quantize_weights=args.quantize_weights,
-                               quantize_kv=args.quantize_kv))
+                               quantize_kv=args.quantize_kv,
+                               fleet_min=args.fleet_min,
+                               fleet_max=args.fleet_max,
+                               fleet_tick_s=args.fleet_tick_s))
     _attach_printer(rt)
     result = await rt.boot()
     print(json.dumps(result), flush=True)
@@ -163,7 +169,9 @@ async def cmd_serve(args: argparse.Namespace) -> int:
         prefixd=args.prefixd,
         chaos_plan=args.chaos_plan,
         quantize_weights=args.quantize_weights,
-        quantize_kv=args.quantize_kv))
+        quantize_kv=args.quantize_kv,
+        fleet_min=args.fleet_min, fleet_max=args.fleet_max,
+        fleet_tick_s=args.fleet_tick_s))
     # Validate host/token BEFORE boot so a refused bind exits with a clean
     # message instead of a traceback over a half-started runtime.
     try:
@@ -285,6 +293,27 @@ def build_parser() -> argparse.ArgumentParser:
                              "speculation) tiers with KV handoff "
                              "between them; implies --replicas 2 when "
                              "unset")
+        sp.add_argument("--fleet-min", dest="fleet_min", type=int,
+                        default=1,
+                        help="elastic fleet (serving/fleet.py): "
+                             "serving-tier replica lower bound for the "
+                             "autoscaler")
+        sp.add_argument("--fleet-max", dest="fleet_max", type=int,
+                        default=0,
+                        help="elastic fleet: arm the FleetController "
+                             "over the cluster — scale the serving "
+                             "tier within [--fleet-min, this], re-tier "
+                             "prefill/decode when the traffic mix "
+                             "shifts, and drain replicas by live "
+                             "session migration; 0 (default) keeps the "
+                             "static boot topology; requires "
+                             "--replicas/--disaggregate")
+        sp.add_argument("--fleet-tick-s", dest="fleet_tick_s",
+                        type=float, default=5.0,
+                        help="elastic fleet: seconds between policy "
+                             "ticks (paces the ticker thread only — "
+                             "decisions consume signals, never the "
+                             "clock)")
         sp.add_argument("--fabric-listen", dest="fabric_listen",
                         default=None, metavar="[ROLE@]HOST:PORT",
                         help="cluster fabric (serving/fabric/): serve "

@@ -138,6 +138,13 @@ TOPIC_CLUSTER = "cluster:events"
 # SSE stream tails them live so an open dashboard sees a partition the
 # moment the transport gives up on it.
 TOPIC_FABRIC = "fabric:events"
+# Elastic fleet controller (ISSUE 14): policy-action and drain events —
+# a replica scaled up/down, re-tiered, or drained with its sessions
+# live-migrated — broadcast by serving/fleet.py and ring-buffered by
+# EventHistory (the /api/history "fleet" key); the SSE stream tails
+# them live so an open dashboard sees a scale event the moment the
+# controller commits it.
+TOPIC_FLEET = "fleet:events"
 
 
 def topic_agent_state(agent_id: str) -> str:

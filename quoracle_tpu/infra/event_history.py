@@ -14,7 +14,7 @@ from typing import Optional
 from quoracle_tpu.analysis.lockdep import named_lock
 from quoracle_tpu.infra.bus import (
     EventBus, Subscription, TOPIC_ACTIONS, TOPIC_CLUSTER, TOPIC_CONSENSUS,
-    TOPIC_FABRIC,
+    TOPIC_FABRIC, TOPIC_FLEET,
     TOPIC_LIFECYCLE, TOPIC_RESOURCES, TOPIC_SERVING, TOPIC_TRACE,
 )
 
@@ -52,6 +52,7 @@ class EventHistory:
         self._consensus: deque = deque(maxlen=MAX_CONSENSUS_RECORDS)
         self._cluster: deque = deque(maxlen=max_logs)
         self._fabric: deque = deque(maxlen=max_logs)
+        self._fleet: deque = deque(maxlen=max_logs)
         self._tasks: set[str] = set()
         self._lock = named_lock("history")
         self._closed = False
@@ -64,6 +65,7 @@ class EventHistory:
             bus.subscribe(TOPIC_CONSENSUS, self._on_consensus),
             bus.subscribe(TOPIC_CLUSTER, self._on_cluster),
             bus.subscribe(TOPIC_FABRIC, self._on_fabric),
+            bus.subscribe(TOPIC_FLEET, self._on_fleet),
         ]
 
     # Agent log/message topics are per-agent; the runtime calls track_agent
@@ -144,6 +146,10 @@ class EventHistory:
         with self._lock:
             self._fabric.append(event)
 
+    def _on_fleet(self, topic: str, event: dict) -> None:
+        with self._lock:
+            self._fleet.append(event)
+
     def _on_task_message(self, topic: str, event: dict) -> None:
         # topic is "tasks:<id>:messages". Ring under the TASK key always
         # (the mailbox replay), and ALSO under the SENDER when the message
@@ -212,6 +218,13 @@ class EventHistory:
         /api/history "fabric" key."""
         with self._lock:
             return list(self._fabric)
+
+    def replay_fleet(self) -> list[dict]:
+        """Recent fleet-controller events (scale / re-tier / drain
+        actions, per-drain migration totals — TOPIC_FLEET,
+        serving/fleet.py). Backs the /api/history "fleet" key."""
+        with self._lock:
+            return list(self._fleet)
 
     def replay_traces(self, trace_id: Optional[str] = None) -> list[dict]:
         """Recent finished spans (infra/telemetry.py), optionally filtered

@@ -299,6 +299,20 @@ FLIGHT_EVENTS: dict = {
     "fabric_prefixd_degraded": "the fleet prefix-service client "
                                "degraded a fetch/publish to local-only "
                                "after a transport failure",
+    "fabric_peer_rejoin": "a peer previously marked failed re-announced "
+                          "via a hello and was restored to the front "
+                          "door's placement set (ISSUE 14 satellite)",
+    # elastic fleet controller (ISSUE 14, serving/fleet.py)
+    "fleet_action": "the fleet controller executed one policy action "
+                    "(scale_up / scale_down / retier / drain) — the "
+                    "tick, target, and deterministic reason string "
+                    "form the replayable action ledger",
+    "fleet_drain": "a replica drain finished: every resident session "
+                   "live-migrated through the handoff path (or counted "
+                   "failed), with per-drain totals and wall time",
+    "fleet_migrate_failed": "one session's live migration degraded — "
+                            "the session re-prefills on its next touch "
+                            "(affinity dropped), bits unchanged",
     # consensus quality
     "model_health_drift": "EWMA drift detector tripped for a member",
     # chaos plane (ISSUE 11, chaos/faults.py + chaos/scenarios.py)

@@ -856,6 +856,36 @@ CHAOS_INVARIANT_FAILURES = METRICS.counter(
     "and invariant name — any nonzero value is a recovery-path bug "
     "report, alert like a crash")
 
+# -- elastic fleet controller (ISSUE 14) -------------------------------------
+# Signal-driven autoscaling + role re-tiering + live session migration
+# (serving/fleet.py): every policy action, every migrated session, and
+# the drain latency are first-class series — a scale event must be as
+# attributable from /metrics as a shed or a handoff.
+FLEET_ACTIONS_TOTAL = METRICS.counter(
+    "quoracle_fleet_actions_total",
+    "fleet-controller policy actions executed, by action (scale_up | "
+    "scale_down | retier | drain) and target role — the action ledger's "
+    "counter twin; a flapping rate here means the hysteresis bounds are "
+    "too tight for the traffic")
+FLEET_TICKS_TOTAL = METRICS.counter(
+    "quoracle_fleet_ticks_total",
+    "fleet-controller policy ticks evaluated, by outcome (action | "
+    "hold | cooldown) — the denominator that turns the action counter "
+    "into a flap rate")
+FLEET_SESSIONS_MIGRATED_TOTAL = METRICS.counter(
+    "quoracle_fleet_sessions_migrated_total",
+    "sessions live-migrated off a draining replica through the handoff "
+    "path, by model and status (ok | failed) — failed means the session "
+    "degraded to a re-prefill on its next touch, never wrong bits")
+FLEET_DRAIN_MS = METRICS.histogram(
+    "quoracle_fleet_drain_ms",
+    "wall time (ms) of one replica drain: settle-wait through the last "
+    "session's migration — the zero-downtime retirement budget")
+FLEET_DRAINING = METRICS.gauge(
+    "quoracle_fleet_draining",
+    "replicas currently draining (new placements excluded, affinities "
+    "still serving until each session's migration lands)")
+
 # -- consensus quality (ISSUE 5) ---------------------------------------------
 # Decision-quality instruments (consensus/quality.py): per-decide
 # contestedness and the per-member scorecard counters. Registered at

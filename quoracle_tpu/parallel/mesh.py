@@ -190,7 +190,9 @@ def pool_sizing(pool: Sequence[str], n_devices: int = 8,
                 disaggregate: bool = False,
                 hosts: int = 1,
                 quantize_weights: bool = False,
-                quantize_kv: bool = False) -> dict:
+                quantize_kv: bool = False,
+                fleet_min: int = 1,
+                fleet_max: int = 0) -> dict:
     """Explicit HBM budget for a model pool on a v5e sub-mesh partition
     (VERDICT r4 item 4): per member — chips (= recommended_tp), bf16
     weight bytes per chip, the page-pool bytes left after the tail
@@ -321,6 +323,33 @@ def pool_sizing(pool: Sequence[str], n_devices: int = 8,
             list(pool), members, used, total_devices, replicas,
             disaggregate, hbm_per_chip, host_kv_mb,
             quantize_kv=quantize_kv)
+        if fleet_max:
+            # Elastic fleet (ISSUE 14, serving/fleet.py): the capacity
+            # ENVELOPE the autoscaler moves within — serving-tier
+            # resident sessions at the min and max bounds, and whether
+            # the slice can even hold the max (a fleet that cannot
+            # reach --fleet-max is a misconfiguration the plan should
+            # say out loud). New replicas share the default device set
+            # until the next reboot repartitions, so devices_at_max is
+            # the honest post-reboot figure.
+            rt = out["replica_tiers"]
+            serving = rt.get("decode") or rt.get("unified")
+            n_reps = max(1, serving["replicas"])
+            per_sessions = serving["resident_sessions"] // n_reps
+            per_host_s = serving["host_tier_sessions"] // n_reps
+            n_prefill = rt.get("prefill", {}).get("replicas", 0)
+            devices_at_max = (n_prefill + fleet_max) * used
+            out["fleet"] = {
+                "min_replicas": fleet_min,
+                "max_replicas": fleet_max,
+                "serving_role": serving["role"],
+                "resident_sessions_min": per_sessions * fleet_min,
+                "resident_sessions_max": per_sessions * fleet_max,
+                "host_tier_sessions_min": per_host_s * fleet_min,
+                "host_tier_sessions_max": per_host_s * fleet_max,
+                "devices_at_max": devices_at_max,
+                "fits_at_max": devices_at_max <= total_devices,
+            }
     return out
 
 
@@ -472,6 +501,14 @@ def _main(argv=None) -> int:
                          "over N hosts x --devices chips each; "
                          "replicas stay host-local, the wire is the "
                          "only cross-host coupling")
+    ap.add_argument("--fleet-min", dest="fleet_min", type=int,
+                    default=1,
+                    help="elastic fleet (ISSUE 14): autoscaler lower "
+                         "bound for the serving tier")
+    ap.add_argument("--fleet-max", dest="fleet_max", type=int,
+                    default=0,
+                    help="elastic fleet: plan the capacity envelope "
+                         "the autoscaler moves within (0 = static)")
     ap.add_argument("--quantize-weights", dest="quantize_weights",
                     action="store_true",
                     help="plan at the int8 weight byte rate (ISSUE 13)")
@@ -491,7 +528,9 @@ def _main(argv=None) -> int:
                        disaggregate=args.disaggregate,
                        hosts=args.hosts,
                        quantize_weights=args.quantize_weights,
-                       quantize_kv=args.quantize_kv)
+                       quantize_kv=args.quantize_kv,
+                       fleet_min=args.fleet_min,
+                       fleet_max=args.fleet_max)
     print(json.dumps(plan, indent=2))
     return 0 if plan["fits"] else 1
 

@@ -259,6 +259,17 @@ class RuntimeConfig:
     # from here on means raising --replicas, not re-architecting.
     replicas: int = 1
     disaggregate: bool = False
+    # Elastic fleet controller (ISSUE 14, serving/fleet.py):
+    # ``fleet_max`` > 0 arms a FleetController over the ClusterPlane —
+    # a ticker thread evaluates the policy every ``fleet_tick_s``,
+    # scaling the serving tier within [fleet_min, fleet_max], re-tiering
+    # roles when the traffic mix shifts, and draining replicas by live
+    # session migration. Requires --replicas/--disaggregate (there is
+    # no fleet without a cluster). 0 (the default) keeps the static
+    # boot topology.
+    fleet_min: int = 1
+    fleet_max: int = 0
+    fleet_tick_s: float = 5.0
     # Chaos plane (ISSUE 11, quoracle_tpu/chaos/): path to a JSON fault
     # plan ({"seed": N, "faults": [{"point", "kind", ...}]}) armed on
     # the process-wide CHAOS plane at boot — game-day runs against a
@@ -317,6 +328,11 @@ class Runtime:
         # Fabric peer server (ISSUE 12, --fabric-listen): set by
         # _build_backend when this node serves its backend over the wire
         self._fabric_peer = None
+        # Elastic fleet controller (ISSUE 14, --fleet-max): set by
+        # _build_backend over the ClusterPlane; ticked below
+        self._fleet = None
+        self._fleet_stop = threading.Event()
+        self._fleet_thread: Optional[threading.Thread] = None
         self.backend = backend or self._build_backend(config)
         # serving telemetry (prefix-cache counters, phase timings) rides
         # the bus into EventHistory's ring + the dashboard SSE tail
@@ -357,6 +373,11 @@ class Runtime:
         for name, fn in self.backend.watchdog_sources():
             self.watchdog.add_source(name, fn)
         self.watchdog.start()
+        if self._fleet is not None:
+            self._fleet_thread = threading.Thread(
+                target=self._fleet_loop, name="fleet-ticker",
+                daemon=True)
+            self._fleet_thread.start()
         self.token_manager = TokenManager(
             self.backend.count_tokens,
             context_limit_fn=self.backend.context_window)
@@ -402,7 +423,7 @@ class Runtime:
                     or config.replicas > 1 or config.disaggregate
                     or config.fabric_peers or config.fabric_listen
                     or config.prefixd or config.quantize_weights
-                    or config.quantize_kv):
+                    or config.quantize_kv or config.fleet_max):
                 # Silent fallback to mock would make the user believe their
                 # checkpoint (or cluster, or fabric peer, or quantized
                 # member) is serving while scripted responses come back.
@@ -410,8 +431,8 @@ class Runtime:
                     "--checkpoint/--tp/--draft/--coordinator/"
                     "--num-processes/--process-id/--replicas/"
                     "--disaggregate/--fabric-listen/--fabric-peers/"
-                    "--prefixd/--quantize-weights/--quantize-kv "
-                    "require --backend tpu "
+                    "--prefixd/--quantize-weights/--quantize-kv/"
+                    "--fleet-max require --backend tpu "
                     f"(backend is {config.backend!r})")
             return MockBackend()
         if config.fabric_peers:
@@ -419,11 +440,13 @@ class Runtime:
             # engines, no device runtime — placement, aggregate
             # admission, and the wire handoff flow over remote peers.
             if (config.replicas > 1 or config.disaggregate
-                    or config.fabric_listen):
+                    or config.fabric_listen or config.fleet_max):
                 raise ValueError(
                     "--fabric-peers is the front-door role: it excludes "
-                    "--replicas/--disaggregate/--fabric-listen (peers "
-                    "carry the engines)")
+                    "--replicas/--disaggregate/--fabric-listen/"
+                    "--fleet-max (peers carry the engines; the door "
+                    "grows/shrinks its peer set via add_peer/"
+                    "remove_peer + the re-join sweep)")
             from quoracle_tpu.serving.fabric.frontdoor import FabricPlane
             return FabricPlane.connect(list(config.fabric_peers))
         from quoracle_tpu.utils.compile_cache import (
@@ -515,7 +538,25 @@ class Runtime:
                 embed_model=config.embed_model,
                 quantize_weights=config.quantize_weights,
                 quantize_kv=config.quantize_kv)
+            if config.fleet_max:
+                # Elastic fleet (ISSUE 14): the controller scales the
+                # serving tier within [fleet_min, fleet_max] on a
+                # deterministic policy tick, re-tiers roles, and drains
+                # by live session migration; this thread is the only
+                # production ticker.
+                from quoracle_tpu.serving.fleet import (
+                    FleetConfig, FleetController,
+                )
+                self._fleet = FleetController(
+                    built, FleetConfig(
+                        min_replicas=config.fleet_min,
+                        max_replicas=config.fleet_max,
+                        seed=config.seed))
         else:
+            if config.fleet_max:
+                raise ValueError(
+                    "--fleet-max elasticizes a CLUSTER: it requires "
+                    "--replicas > 1 or --disaggregate")
             built = TPUBackend(
                 pool, seed=config.seed, draft_k=config.draft_k,
                 embed_model=config.embed_model,
@@ -585,6 +626,16 @@ class Runtime:
                     peer._server.addr)
         return peer
 
+    def _fleet_loop(self) -> None:
+        """The fleet ticker: wall-clock paces the ticks, never the
+        decisions (the policy consumes only the gathered signals — the
+        determinism contract lives in serving/fleet.py)."""
+        while not self._fleet_stop.wait(self.config.fleet_tick_s):
+            try:
+                self._fleet.tick()
+            except Exception:             # noqa: BLE001 — keep ticking
+                logger.exception("fleet tick failed")
+
     async def boot(self) -> dict:
         """Boot-time revival of persisted running tasks (reference
         application.ex:71-74 → AgentRevival)."""
@@ -597,6 +648,10 @@ class Runtime:
         self.close()
 
     def close(self) -> None:
+        self._fleet_stop.set()
+        if self._fleet_thread is not None:
+            self._fleet_thread.join(timeout=5)
+            self._fleet_thread = None
         if self._fabric_peer is not None and \
                 self._fabric_peer._server is not None:
             self._fabric_peer._server.close()

@@ -33,6 +33,11 @@ module allowed to import models:
   (ISSUE 12): wire codec + transports, the FabricPeer/FabricPlane
   process roles, and the fleet prefix service — replicas as network
   peers with the same temp-0 bit-equality gate.
+* :mod:`quoracle_tpu.serving.fleet` — the elastic fleet controller
+  (ISSUE 14): signal-driven autoscaling, prefill/decode role
+  re-tiering, and zero-downtime drains that live-migrate every
+  resident session through the handoff path on a deterministic
+  policy tick.
 
 The cluster trio (and the fabric package) is imported lazily (see
 bottom) — importing serving.qos from the scheduler must not drag
@@ -71,4 +76,8 @@ def __getattr__(name: str):
     if name in ("FabricPlane", "FabricPeer"):
         from quoracle_tpu.serving import fabric
         return getattr(fabric, name)
+    if name in ("FleetController", "FleetConfig", "FleetSignals",
+                "ReplicaSignal", "FleetAction"):
+        from quoracle_tpu.serving import fleet
+        return getattr(fleet, name)
     raise AttributeError(name)

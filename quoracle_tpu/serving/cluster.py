@@ -268,6 +268,16 @@ class ClusterPlane(ModelBackend):
         self._bus = None
         self._lock = named_lock("cluster.plane")
         self._seq = 0
+        # Elastic fleet (ISSUE 14): ``build`` saves its backend kwargs
+        # here so ``add_replica`` can construct new replicas in either
+        # role; a directly-constructed plane can set it explicitly (the
+        # fleet tests inject tiny-engine factories).
+        self._replica_args: Optional[dict] = None
+        self._embedder = None
+        # monotonic replica-id counter: ids must never be reused after
+        # a retirement — a stale affinity or flight event naming a
+        # retired id must stay unambiguous forever
+        self._rep_seq = len(self.replicas)
         self._refresh_replica_gauges()
 
     # -- construction ----------------------------------------------------
@@ -338,7 +348,22 @@ class ClusterPlane(ModelBackend):
                     backend.engines[spec].role = "decode"
             reps.append(Replica(replica_id=f"{role}-{i}", role=role,
                                 backend=backend))
-        return cls(reps)
+        plane = cls(reps)
+        # the fleet controller's scale-up factory: same pool, same QoS,
+        # same quantization regime — new replicas land on the default
+        # device set (per-replica submesh partitions are a boot-time
+        # layout; an elastically added replica shares devices until the
+        # next reboot repartitions)
+        plane._replica_args = dict(
+            pool=list(pool), seed=seed, embed_model=embed_model,
+            qos=qos, draft_map=draft_map,
+            draft_k=draft_k, continuous=continuous,
+            continuous_chunk=continuous_chunk,
+            continuous_slots=continuous_slots, host_kv_mb=host_kv_mb,
+            disk_kv_dir=disk_kv_dir, disk_kv_gb=disk_kv_gb,
+            quantize_weights=quantize_weights, quantize_kv=quantize_kv)
+        plane._embedder = embedder
+        return plane
 
     def close(self) -> None:
         for rep in self.replicas:
@@ -379,6 +404,75 @@ class ClusterPlane(ModelBackend):
         self._broadcast({"event": "replica_failed",
                          "replica": rep.replica_id, "role": rep.role,
                          "error": error[:200]})
+
+    # -- elastic topology (ISSUE 14, serving/fleet.py) --------------------
+
+    def _recompute_modes(self) -> None:
+        self.disaggregated = any(r.role == "prefill"
+                                 for r in self.replicas)
+
+    def add_replica(self, role: str = "decode") -> Replica:
+        """Spin up one replica in ``role`` and register it with the
+        router — the fleet controller's scale-up primitive. Requires
+        the factory args ``build`` saved (or a test-injected
+        ``_replica_args``)."""
+        if self._replica_args is None:
+            raise RuntimeError(
+                "this plane has no replica factory — build it via "
+                "ClusterPlane.build (or set _replica_args) before "
+                "scaling")
+        a = dict(self._replica_args)
+        prefill = role == "prefill"
+        backend = TPUBackend(
+            a["pool"], seed=a["seed"], embed_model=a.get("embed_model"),
+            embedder=self._embedder,
+            continuous=a["continuous"] and not prefill,
+            continuous_chunk=a["continuous_chunk"],
+            continuous_slots=a["continuous_slots"],
+            draft_map=None if prefill else a["draft_map"],
+            draft_k=a["draft_k"], qos=a["qos"],
+            host_kv_mb=a["host_kv_mb"] or 256,
+            disk_kv_dir=a["disk_kv_dir"], disk_kv_gb=a["disk_kv_gb"],
+            quantize_weights=a["quantize_weights"],
+            quantize_kv=a["quantize_kv"])
+        if self._embedder is None:
+            self._embedder = backend.embedder
+        if role in ("prefill", "decode"):
+            for spec in a["pool"]:
+                backend.engines[spec].role = role
+        with self._lock:
+            rid = f"{role}-{self._rep_seq}"
+            self._rep_seq += 1
+        rep = Replica(replica_id=rid, role=role, backend=backend)
+        self.replicas.append(rep)
+        self.router.register(rep)
+        self._recompute_modes()
+        self._refresh_replica_gauges()
+        self._broadcast({"event": "replica_added", "replica": rid,
+                         "role": role})
+        return rep
+
+    def remove_replica(self, replica_id: str) -> bool:
+        """Retire a replica: deregister from the router and close its
+        backend. The fleet controller drains it FIRST (live-migrating
+        every resident session); calling this on an undrained replica
+        loses its sessions to re-prefill — correct, just cold."""
+        rep = next((r for r in self.replicas
+                    if r.replica_id == replica_id), None)
+        if rep is None:
+            return False
+        self.replicas.remove(rep)
+        self.router.deregister(replica_id)
+        self._recompute_modes()
+        self._refresh_replica_gauges()
+        try:
+            rep.close()
+        except Exception:                 # noqa: BLE001 — best-effort
+            logger.exception("retired replica %s close failed",
+                             replica_id)
+        self._broadcast({"event": "replica_removed",
+                         "replica": replica_id, "role": rep.role})
+        return True
 
     # -- ModelBackend -----------------------------------------------------
 

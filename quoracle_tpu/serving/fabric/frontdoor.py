@@ -176,6 +176,92 @@ class FabricPlane(ModelBackend):
             except Exception:             # noqa: BLE001 — best-effort
                 logger.exception("peer %s close failed", p.replica_id)
 
+    # -- elastic peer set (ISSUE 14) --------------------------------------
+
+    def add_peer(self, addr: str, *, connect_timeout: float = 2.0,
+                 io_timeout: float = 60.0, retries: int = 2):
+        """Register one more ``[role@]host:port`` peer at a running
+        front door — the fleet's scale-up registration surface: the
+        operator spins the peer process, the door attaches it without a
+        restart."""
+        from quoracle_tpu.serving.cluster import RemoteReplica
+        from quoracle_tpu.serving.fabric.transport import (
+            TcpTransport, parse_addr,
+        )
+        role, host, port = parse_addr(addr)
+        t = TcpTransport(host, port, connect_timeout=connect_timeout,
+                         io_timeout=io_timeout, retries=retries)
+        peer = RemoteReplica(t, role=role)
+        self.peers.append(peer)
+        self.router.register(peer)
+        self.disaggregated = any(p.role == "prefill"
+                                 for p in self.peers)
+        self._refresh_peer_gauges()
+        self._broadcast({"event": "peer_added",
+                         "peer": peer.replica_id, "role": peer.role})
+        return peer
+
+    def remove_peer(self, replica_id: str) -> bool:
+        """Deregister a peer (scale-down retirement at the door; the
+        operator drains/retires the peer process itself)."""
+        peer = next((p for p in self.peers
+                     if p.replica_id == replica_id), None)
+        if peer is None:
+            return False
+        self.peers.remove(peer)
+        self.router.deregister(replica_id)
+        self._refresh_peer_gauges()
+        try:
+            peer.close()
+        except Exception:                 # noqa: BLE001 — best-effort
+            logger.exception("removed peer %s close failed", replica_id)
+        self._broadcast({"event": "peer_removed", "peer": replica_id,
+                         "role": peer.role})
+        return True
+
+    def rejoin_peer(self, replica_id: str) -> bool:
+        """Restore a peer previously marked failed (ISSUE 14
+        satellite): re-issue the hello on its transport; a matching
+        answer (same replica_id and role — a DIFFERENT process at the
+        same address must not inherit the old identity's role) restores
+        it to the placement set with a clean silent-poll streak. Before
+        this, a restarted peer required restarting the whole front
+        door. Its old affinities stayed purged by mark_failed — the
+        sessions died with the process; new traffic lands normally."""
+        peer = next((p for p in self.peers
+                     if p.replica_id == replica_id), None)
+        if peer is None or peer.alive:
+            return False
+        try:
+            _, payload = peer.transport.request(
+                wire.MSG_HELLO, wire.encode_json({}))
+            hello = wire.decode_json(payload)
+        except WireError:
+            return False                  # still down; try again later
+        if (hello.get("replica_id") != peer.replica_id
+                or hello.get("role") != peer.role):
+            logger.warning(
+                "peer at %s answered hello as %s/%s, expected %s/%s — "
+                "not rejoining a different identity", replica_id,
+                hello.get("replica_id"), hello.get("role"),
+                peer.replica_id, peer.role)
+            return False
+        peer.alive = True
+        self.router.revive(replica_id)
+        self._refresh_peer_gauges()
+        FLIGHT.record("fabric_peer_rejoin", peer=replica_id,
+                      role=peer.role)
+        self._broadcast({"event": "peer_rejoined", "peer": replica_id,
+                         "role": peer.role})
+        return True
+
+    def try_rejoin_dead_peers(self) -> int:
+        """One re-join sweep over every dead peer — called by the
+        stats path and the fleet ticker, so a restarted peer is
+        restored within a poll interval instead of never."""
+        return sum(1 for p in list(self.peers)
+                   if not p.alive and self.rejoin_peer(p.replica_id))
+
     # -- bookkeeping ------------------------------------------------------
 
     def _refresh_peer_gauges(self) -> None:
@@ -438,7 +524,11 @@ class FabricPlane(ModelBackend):
 
     def fabric_stats(self) -> dict:
         """GET /api/fabric payload: peer topology + router + wire
-        counters in one read."""
+        counters in one read. Doubles as the re-join sweep (ISSUE 14):
+        a dead peer that answers its hello again is restored here, so
+        an operator watching the panel sees the restart land without
+        bouncing the door."""
+        self.try_rejoin_dead_peers()
         self._refresh_peer_gauges()
         with self._lock:
             counters = {"wire_handoffs": self.wire_handoffs,

@@ -75,6 +75,11 @@ class ClusterRouter:
         self._lock = named_lock("router")
         self._replicas: dict[str, Any] = {}      # id -> Replica
         self._affinity: dict[str, str] = {}      # session_id -> replica id
+        # graceful drain (ISSUE 14): ids here are excluded from NEW
+        # placements but keep serving their affinity sessions until
+        # each one's migration lands — distinct from mark_failed, which
+        # purges affinities (the sessions are gone)
+        self._draining: set[str] = set()
         self.max_signal_age_s = float(max_signal_age_s)
         self.placements = 0
         self.shed = 0
@@ -98,18 +103,38 @@ class ClusterRouter:
             self._replicas[replica.replica_id] = replica
 
     def replicas(self, role: Optional[str] = None,
-                 alive_only: bool = True) -> list:
+                 alive_only: bool = True,
+                 include_draining: bool = False) -> list:
         """Replicas eligible for ``role`` ("prefill" / "decode" / None =
         all): exact-role matches first, then "unified" (which serves
-        both), dead replicas excluded."""
+        both), dead replicas excluded. Draining replicas (ISSUE 14) are
+        excluded from eligibility unless ``include_draining`` — the
+        fleet controller's topology reads want them, new placements
+        must not."""
         with self._lock:
             reps = list(self._replicas.values())
+            draining = set(self._draining)
         out = [r for r in reps
                if (not alive_only or r.alive)
+               and (include_draining or r.replica_id not in draining)
                and (role is None or r.role == role
                     or r.role == "unified")]
         out.sort(key=lambda r: (r.role == "unified", r.replica_id))
         return out
+
+    def deregister(self, replica_id: str) -> None:
+        """Remove a replica from the router entirely (ISSUE 14 scale-
+        down retirement): its affinities must already have been
+        migrated (drain) or be acceptable losses (the caller purged
+        them via mark_failed). Remaining affinities are dropped — a
+        pointer at an unregistered replica could never serve."""
+        with self._lock:
+            self._replicas.pop(replica_id, None)
+            self._draining.discard(replica_id)
+            self._silent.pop(replica_id, None)
+            for sid in [s for s, rid in self._affinity.items()
+                        if rid == replica_id]:
+                del self._affinity[sid]
 
     def mark_failed(self, replica_id: str, error: str = "") -> None:
         """A serving call against this replica raised: drop it from
@@ -120,6 +145,7 @@ class ClusterRouter:
             if rep is None or not rep.alive:
                 return
             rep.alive = False
+            self._draining.discard(replica_id)
             # purge affinities pointing at the corpse: their sessions
             # are gone; the next round re-places (handoff envelopes
             # cover rows mid-flight)
@@ -129,6 +155,41 @@ class ClusterRouter:
                 del self._affinity[sid]
         FLIGHT.record("cluster_replica_dead", replica=replica_id,
                       error=error[:200], dropped_affinities=len(stale))
+
+    def mark_draining(self, replica_id: str) -> None:
+        """Graceful drain (ISSUE 14 satellite) — DISTINCT from
+        ``mark_failed``: the replica leaves the placement set but its
+        affinity entries survive, so resident sessions keep serving on
+        their pages (no spurious cold re-prefills) until the fleet
+        controller migrates each one and rewrites its affinity."""
+        with self._lock:
+            if replica_id in self._replicas:
+                self._draining.add(replica_id)
+
+    def clear_draining(self, replica_id: str) -> None:
+        """Drain finished without retirement (a re-tier flip): the
+        replica re-enters the placement set under its current role."""
+        with self._lock:
+            self._draining.discard(replica_id)
+
+    def is_draining(self, replica_id: str) -> bool:
+        with self._lock:
+            return replica_id in self._draining
+
+    def revive(self, replica_id: str) -> bool:
+        """A failed replica came back (fabric peer re-join, ISSUE 14
+        satellite): restore it to the placement set with a clean
+        silent-poll streak. Its old affinities stayed purged by
+        mark_failed — the sessions died with the process; new traffic
+        lands normally. Returns False for an unknown id."""
+        with self._lock:
+            rep = self._replicas.get(replica_id)
+            if rep is None:
+                return False
+            rep.alive = True
+            self._silent.pop(replica_id, None)
+            self._draining.discard(replica_id)
+        return True
 
     def alive_count(self, role: Optional[str] = None) -> int:
         return len(self.replicas(role))
@@ -305,6 +366,7 @@ class ClusterRouter:
             affinity = len(self._affinity)
             placements, shed = self.placements, self.shed
             streak, last_retry = self._shed_streak, self._last_retry_ms
+            draining = set(self._draining)
         out = {
             "replicas": {},
             "affinity_sessions": affinity,
@@ -327,6 +389,8 @@ class ClusterRouter:
             out["replicas"][rep.replica_id] = {
                 "role": rep.role,
                 "alive": rep.alive,
+                "draining": rep.replica_id in draining,
                 "signals": sig,
             }
+        out["draining"] = sorted(draining)
         return out

@@ -145,6 +145,18 @@ class SLOTracker:
         with self._lock:
             return self._demoted
 
+    def burn(self, priority=Priority.INTERACTIVE) -> float:
+        """Tail-over-target ratio for a class (ISSUE 14): 0.0 with no
+        observations, 1.0 exactly at target, >1.0 while the SLO burns.
+        The fleet controller reads this as its scale-up pressure signal
+        — the same number the demotion loop compares against 1.0."""
+        cls = coerce_priority(priority)
+        with self._lock:
+            if not self._count.get(cls):
+                return 0.0
+            return self._tail_locked(cls) / max(1e-9,
+                                                self.targets_ms[cls])
+
     def stats(self) -> dict:
         with self._lock:
             return {

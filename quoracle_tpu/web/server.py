@@ -35,6 +35,10 @@ Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
                             placement/affinity/shed state with the
                             per-replica admission signals, KV-handoff
                             counters (serving/cluster.py)
+  GET  /api/fleet           elastic fleet controller (ISSUE 14): policy
+                            config, tick/cooldown state, the action
+                            ledger, drain/migration counters
+                            (serving/fleet.py)
   GET  /api/models          consensus-quality scorecards (ISSUE 5): rolling
                             per-member agreement/dissent/failure-by-kind/
                             recovery rates, proposal latency, drift state
@@ -212,6 +216,9 @@ class DashboardServer:
             # fabric incidents (ISSUE 12): peer death, frame rejects,
             # prefixd degrades — TOPIC_FABRIC ring
             "fabric": h.replay_fabric(),
+            # fleet-controller events (ISSUE 14): scale / re-tier /
+            # drain actions + migration totals — TOPIC_FLEET ring
+            "fleet": h.replay_fleet(),
         }
         if agent_id:
             payload["logs"] = h.replay_logs(agent_id)
@@ -502,6 +509,26 @@ class DashboardServer:
         }
         return payload
 
+    def fleet_payload(self) -> dict:
+        """GET /api/fleet: the elastic-fleet panel (ISSUE 14) — policy
+        config, tick/cooldown state, the recent action ledger, and the
+        action/migration counter series. ``enabled`` False on runtimes
+        without a FleetController."""
+        from quoracle_tpu.infra.telemetry import (
+            FLEET_ACTIONS_TOTAL, FLEET_DRAIN_MS,
+            FLEET_SESSIONS_MIGRATED_TOTAL,
+        )
+        fleet = getattr(self.runtime, "_fleet", None)
+        payload = fleet.stats() if fleet is not None \
+            else {"enabled": False}
+        payload["counters"] = {
+            "actions": FLEET_ACTIONS_TOTAL._snapshot(),
+            "sessions_migrated":
+                FLEET_SESSIONS_MIGRATED_TOTAL._snapshot(),
+            "drain_ms": FLEET_DRAIN_MS._snapshot(),
+        }
+        return payload
+
     def qos_payload(self) -> dict:
         """GET /api/qos: the serving-QoS panel (ISSUE 4) — admission
         controller state (signals, thresholds, tenant buckets), the
@@ -654,7 +681,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_html(views.telemetry_page(
                     d.metrics_payload(), d.resources_payload(),
                     d.qos_payload(), d.models_payload(),
-                    d.kv_payload(), d.chaos_payload()))
+                    d.kv_payload(), d.chaos_payload(),
+                    d.fleet_payload()))
             elif parsed.path == "/settings":
                 from quoracle_tpu.web import views
                 self._send_html(views.settings_page(
@@ -695,6 +723,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.fabric_payload())
             elif parsed.path == "/api/chaos":
                 self._send_json(d.chaos_payload())
+            elif parsed.path == "/api/fleet":
+                self._send_json(d.fleet_payload())
             elif parsed.path == "/api/models":
                 self._send_json(d.models_payload())
             elif parsed.path == "/api/consensus":

@@ -543,11 +543,49 @@ def chaos_panel(chaos: dict) -> str:
     return "".join(parts)
 
 
+def fleet_panel(fleet: dict) -> str:
+    """Elastic-fleet panel (ISSUE 14): policy config + tick state, the
+    recent action ledger, and the drain/migration totals — the
+    /api/fleet payload as tables. Renders nothing on runtimes without
+    a FleetController."""
+    fleet = fleet or {}
+    if not fleet.get("enabled"):
+        return ""
+    cfg = fleet.get("config") or {}
+    parts = [
+        "<h2 class=\"meta\">elastic fleet</h2>",
+        f"<p class=\"meta\" id=\"fleet-state\">"
+        f"ticks {_e(fleet.get('ticks'))}"
+        f" · cooldown {_e(fleet.get('cooldown'))}"
+        f" · drains {_e(fleet.get('drains'))}"
+        f" · migrated {_e(fleet.get('sessions_migrated'))}"
+        f" (failed {_e(fleet.get('sessions_failed'))})"
+        f" · bounds [{_e(cfg.get('min_replicas'))}, "
+        f"{_e(cfg.get('max_replicas'))}]"
+        f" · seed {_e(cfg.get('seed'))}</p>",
+    ]
+    ledger = fleet.get("ledger") or []
+    if ledger:
+        rows = "".join(
+            f"<tr class=\"fleet-action\"><td>{_e(a.get('tick'))}</td>"
+            f"<td>{_e(a.get('action'))}</td>"
+            f"<td>{_e(a.get('target'))}</td>"
+            f"<td>{_e(a.get('role'))}</td>"
+            f"<td>{_e((a.get('reason') or '')[:100])}</td></tr>"
+            for a in ledger[-16:])
+        parts.append(
+            "<table id=\"fleet-ledger\"><tr><th>tick</th>"
+            "<th>action</th><th>target</th><th>role</th>"
+            "<th>reason</th></tr>" + rows + "</table>")
+    return "".join(parts)
+
+
 def telemetry_page(metrics: dict, resources: Optional[dict] = None,
                    qos: Optional[dict] = None,
                    quality: Optional[dict] = None,
                    kv: Optional[dict] = None,
-                   chaos: Optional[dict] = None) -> str:
+                   chaos: Optional[dict] = None,
+                   fleet: Optional[dict] = None) -> str:
     """Dev telemetry view (reference LiveDashboard at /dev/dashboard):
     the /api/metrics snapshot as readable tables, led by the latency
     histogram panel, the live resources panel, the QoS panel, the
@@ -570,6 +608,7 @@ def telemetry_page(metrics: dict, resources: Optional[dict] = None,
             + qos_panel(qos or {})
             + kv_panel(kv or {})
             + chaos_panel(chaos or {})
+            + fleet_panel(fleet or {})
             + quality_panel(quality or {})
             + spec_panel((quality or {}).get("speculative") or {})
             + (table("runtime", flat) if flat else "")

@@ -126,10 +126,12 @@ def test_flight_events_and_instruments_registered():
     # every scenario's injection points exist in the catalog
     assert set(SCENARIOS) == {"traffic_storm", "kill_mid_handoff",
                               "restart_warm_start", "drift_storm",
-                              "hbm_pressure_churn", "fabric_partition"}
+                              "hbm_pressure_churn", "fabric_partition",
+                              "scale_storm"}
     assert "pool.member" in INJECTION_POINTS
     assert "fabric.send" in INJECTION_POINTS
     assert "fabric.prefixd" in INJECTION_POINTS
+    assert "fleet.migrate" in INJECTION_POINTS
 
 
 # ---------------------------------------------------------------------------
@@ -190,6 +192,22 @@ def test_scenario_fabric_partition():
     assert (ev["retried"] >= 1 or ev["replaced"] >= 1
             or ev["cold_failovers"] >= 1)
     assert ev["survivors"] >= 1
+
+
+def test_scenario_scale_storm():
+    """ISSUE 14 satellite: the elastic fleet scales, re-tiers, and
+    drains mid-traffic while chaos kills the first draining replica
+    with sessions aboard and degrades a later migration — survivors
+    bit-equal, failures structured, envelope ledger empty."""
+    report = _assert_scenario("scale_storm", seed=5)
+    kinds = {t[3] for t in report.schedule}
+    assert "crash" in kinds               # a replica died mid-drain
+    ev = report.evidence
+    assert any(d["died"] for d in ev["drains"])
+    assert ev["handoff"]["inflight"] == 0
+    # the policy path executed a real scale-up (the ledger's counter
+    # twin also ticks quoracle_fleet_actions_total)
+    assert any(a["action"] == "scale_up" for a in ev["ledger"])
 
 
 def test_scenario_traffic_storm():

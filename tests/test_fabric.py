@@ -348,6 +348,89 @@ def test_silent_signals_worst_rank_then_mark_failed():
     assert plane.router.place("decode").replica_id == "decode-b"
 
 
+def test_peer_rejoin_after_mark_failed():
+    """ISSUE 14 satellite: a peer marked failed (silent signals) that
+    answers its hello again is RESTORED to the placement set via
+    ``rejoin_peer`` — no front-door restart — with a fabric_peer_rejoin
+    flight event; while it stays down, the sweep is a no-op, and a
+    DIFFERENT identity at the same address is refused."""
+    from quoracle_tpu.infra.flightrec import FLIGHT
+    from quoracle_tpu.serving.router import SILENT_SIGNALS_LIMIT
+
+    silent = {"on": False}
+    down = {"on": False}
+    base = _fake_peer_handler("decode-a", "decode", silent=silent)
+
+    def handler(msg_type, payload):
+        if down["on"]:
+            raise TransportError("decode-a fully partitioned")
+        return base(msg_type, payload)
+
+    a = RemoteReplica(LoopbackTransport(handler, "decode-a",
+                                        retries=0))
+    b = RemoteReplica(LoopbackTransport(
+        _fake_peer_handler("decode-b", "decode"), "decode-b"))
+    plane = FabricPlane([a, b])
+    silent["on"] = True
+    for _ in range(SILENT_SIGNALS_LIMIT):
+        b.backend.qos_controller._cached = None
+        plane.router.place("decode")
+    assert a.alive is False
+    # still fully partitioned (hellos fail too): the sweep restores
+    # nothing
+    down["on"] = True
+    assert plane.try_rejoin_dead_peers() == 0
+    assert a.alive is False
+    # link back: the hello answers and the peer rejoins
+    down["on"] = False
+    silent["on"] = False
+    assert plane.try_rejoin_dead_peers() == 1
+    assert a.alive is True
+    st = plane.router.stats()
+    assert st["replicas"]["decode-a"]["alive"] is True
+    assert st["silent"].get("decode-a") is None
+    assert any(e.get("kind") == "fabric_peer_rejoin"
+               and e.get("peer") == "decode-a"
+               for e in FLIGHT.snapshot())
+    # the restored peer is placeable again
+    a.backend.qos_controller._cached = None
+    b.backend.qos_controller._cached = None
+    assert plane.router.place("decode").replica_id in ("decode-a",
+                                                       "decode-b")
+    # an imposter (same address, different identity) must NOT inherit
+    # the slot: re-fail the peer, then swap the handler's identity
+    imposter = RemoteReplica(LoopbackTransport(
+        _fake_peer_handler("decode-c", "decode"), "decode-c"))
+    plane.peers.append(imposter)
+    plane.router.register(imposter)
+    imposter.alive = False
+    plane.router.mark_failed("decode-c", "test")
+    imposter.replica_id = "decode-c"      # hello will answer decode-c
+    imposter.role = "prefill"             # ...but the ROLE changed
+    assert plane.rejoin_peer("decode-c") is False
+    assert imposter.alive is False
+
+
+def test_frontdoor_add_and_remove_peer_loopback():
+    """The fleet's door-side registration surface: a peer attached at a
+    RUNNING front door joins placement; removing it deregisters and
+    drops its affinities."""
+    a = RemoteReplica(LoopbackTransport(
+        _fake_peer_handler("decode-a", "decode"), "decode-a"))
+    plane = FabricPlane([a])
+    b = RemoteReplica(LoopbackTransport(
+        _fake_peer_handler("decode-b", "decode"), "decode-b"))
+    plane.peers.append(b)
+    plane.router.register(b)
+    assert len(plane.router.replicas("decode")) == 2
+    plane.router.set_affinity("s1", "decode-b")
+    assert plane.remove_peer("decode-b")
+    assert [r.replica_id for r in plane.router.replicas("decode")] \
+        == ["decode-a"]
+    assert plane.router.affinity_of("s1") is None
+    assert plane.fabric_stats()["peers"][0]["replica_id"] == "decode-a"
+
+
 def test_all_decode_peers_shed_propagates_max_retry_after():
     """The 429 contract at the fabric front door: every decode peer
     sheds OVER THE WIRE → OverloadedError with the escalated MAX
