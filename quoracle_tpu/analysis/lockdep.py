@@ -116,6 +116,14 @@ HIERARCHY: tuple = (
                                     # track_* subscribes under it)
     ("bus",            53, False),  # EventBus subscriber table
     ("tracer.sinks",   55, False),  # Tracer sink list
+    ("fleetobs.spans", 56, False),  # fleetobs span ring (ISSUE 15):
+                                    # appended from tracer sinks under
+                                    # arbitrary serving locks, reads
+                                    # nothing below it
+    ("fleetobs.incidents", 57, False),  # incident ledger counters/ids
+                                    # (ISSUE 15): pure bookkeeping —
+                                    # flight dumps and file I/O happen
+                                    # strictly OUTSIDE it
     ("flight",         58, False),  # flight-recorder ring
     ("metrics.registry", 59, False),  # MetricsRegistry name table
     ("metrics",        60, False),  # per-metric cells (innermost)

@@ -166,6 +166,13 @@ def run_scenario(name: str, seed: int = 0,
             if not r.ok:
                 CHAOS_INVARIANT_FAILURES.inc(scenario=name,
                                              invariant=r.name)
+                # correlated incident capture (ISSUE 15): a failed
+                # recovery invariant is a bug report — bundle every
+                # reachable flight ring under one deterministic id
+                from quoracle_tpu.infra.fleetobs import INCIDENTS
+                INCIDENTS.capture("chaos_invariant",
+                                  f"{name}:{r.name}",
+                                  reason=r.detail[:200])
         FLIGHT.record("chaos_scenario_end", scenario=name, seed=seed,
                       passed=passed,
                       failed=[r.name for r in results if not r.ok],

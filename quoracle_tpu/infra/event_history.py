@@ -22,7 +22,11 @@ MAX_LOGS_PER_AGENT = 100      # reference ui/event_history.ex:17-20
 MAX_MESSAGES_PER_AGENT = 50
 # Trace-span ring: one consensus round emits ~10 spans (tick, decide,
 # rounds, members, phases, action), so 512 covers dozens of recent rounds
-# across tasks; /api/trace filters by trace_id.
+# across tasks; /api/trace filters by trace_id. Configurable via
+# QUORACLE_TRACE_RING (ISSUE 15 satellite — serving-plane spans share
+# this ring with consensus traces, so fleets under heavy decode traffic
+# size it up; overflow is COUNTED in quoracle_trace_dropped_total
+# either way, never silent).
 MAX_TRACE_SPANS = 512
 # Consensus-audit ring (ISSUE 5): one record per decide (plus occasional
 # drift alerts), so 256 covers hours of recent decisions across tasks;
@@ -38,16 +42,25 @@ class EventHistory:
 
     def __init__(self, bus: EventBus,
                  max_logs: int = MAX_LOGS_PER_AGENT,
-                 max_messages: int = MAX_MESSAGES_PER_AGENT):
+                 max_messages: int = MAX_MESSAGES_PER_AGENT,
+                 max_trace_spans: Optional[int] = None):
+        import os
         self.bus = bus
         self.max_logs = max_logs
         self.max_messages = max_messages
+        if max_trace_spans is None:
+            try:
+                max_trace_spans = max(16, int(os.environ.get(
+                    "QUORACLE_TRACE_RING", MAX_TRACE_SPANS)))
+            except ValueError:
+                max_trace_spans = MAX_TRACE_SPANS
+        self.max_trace_spans = max_trace_spans
         self._logs: dict[str, deque] = {}
         self._messages: dict[str, deque] = {}
         self._lifecycle: deque = deque(maxlen=max_logs)
         self._actions: deque = deque(maxlen=max_logs)
         self._serving: deque = deque(maxlen=max_logs)
-        self._traces: deque = deque(maxlen=MAX_TRACE_SPANS)
+        self._traces: deque = deque(maxlen=max_trace_spans)
         self._resources: deque = deque(maxlen=max_logs)
         self._consensus: deque = deque(maxlen=MAX_CONSENSUS_RECORDS)
         self._cluster: deque = deque(maxlen=max_logs)
@@ -128,6 +141,14 @@ class EventHistory:
 
     def _on_trace(self, topic: str, event: dict) -> None:
         with self._lock:
+            if len(self._traces) == self.max_trace_spans:
+                # overflow is overwrite-oldest either way, but COUNTED
+                # (ISSUE 15 satellite): a sustained drop rate means
+                # serving spans are starving consensus traces
+                from quoracle_tpu.infra.telemetry import (
+                    TRACE_DROPPED_TOTAL,
+                )
+                TRACE_DROPPED_TOTAL.inc(ring="history")
             self._traces.append(event)
 
     def _on_resource(self, topic: str, event: dict) -> None:

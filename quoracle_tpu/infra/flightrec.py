@@ -313,6 +313,16 @@ FLIGHT_EVENTS: dict = {
     "fleet_migrate_failed": "one session's live migration degraded — "
                             "the session re-prefills on its next touch "
                             "(affinity dropped), bits unchanged",
+    # fleet observability (ISSUE 15, infra/fleetobs.py)
+    "incident_open": "a correlated incident was opened (deterministic "
+                     "incident id stamped): the local flight ring dumps "
+                     "into the incident bundle and the id is broadcast "
+                     "over the fabric so every peer's dump lands in the "
+                     "same bundle",
+    "incident_dump": "this process dumped its flight ring into an "
+                     "incident bundle on a fabric broadcast (MSG_OBS "
+                     "incident op) — the peer-side half of correlated "
+                     "capture",
     # consensus quality
     "model_health_drift": "EWMA drift detector tripped for a member",
     # chaos plane (ISSUE 11, chaos/faults.py + chaos/scenarios.py)

@@ -232,6 +232,45 @@ class Histogram(_Metric):
         agg, _, _ = self.counts(**labels)
         return {p: quantile(self.buckets, agg, p) for p in ps}
 
+    # -- federation (ISSUE 15) -------------------------------------------
+
+    def merge(self, other: "Histogram") -> None:
+        """LOSSLESS merge of another histogram's cells into this one:
+        identical bucket boundaries → per-bucket summed counts, so every
+        quantile of the merged histogram equals the quantile of one
+        histogram that observed both streams (the fleet-rollup
+        guarantee; mismatched boundaries refuse loudly — a lossy
+        re-bucketing would silently corrupt the federated tails)."""
+        if tuple(other.buckets) != self.buckets:
+            raise ValueError(
+                f"histogram {self.name!r}: cannot merge mismatched "
+                f"bucket boundaries ({len(other.buckets)} vs "
+                f"{len(self.buckets)})")
+        with other._lock:
+            cells = {k: (list(c.counts), c.sum, c.count)
+                     for k, c in other._cells.items()}
+        for key, (counts, s, n) in cells.items():
+            self.merge_cell(key, counts, s, n)
+
+    def merge_cell(self, key: tuple, counts: Sequence[int],
+                   s: float, n: int) -> None:
+        """Merge one exported cell (bucket counts + sum + count) under
+        ``key`` — the primitive both :meth:`merge` and the wire-state
+        federation (infra/fleetobs.py) build on."""
+        if len(counts) != len(self.buckets) + 1:
+            raise ValueError(
+                f"histogram {self.name!r}: cell has {len(counts)} "
+                f"buckets, expected {len(self.buckets) + 1}")
+        key = tuple(sorted((str(k), str(v)) for k, v in key))
+        with self._lock:
+            cell = self._cells.get(key)
+            if cell is None:
+                cell = self._cells[key] = _HistCell(len(self.buckets))
+            for i, c in enumerate(counts):
+                cell.counts[i] += int(c)
+            cell.sum += float(s)
+            cell.count += int(n)
+
     def _snapshot(self) -> dict:
         def q(agg):
             return {f"p{int(p * 100)}": quantile(self.buckets, agg, p)
@@ -365,6 +404,37 @@ class MetricsRegistry:
         with self._lock:
             self._metrics.clear()
 
+    # -- portable state (ISSUE 15 federation) -----------------------------
+
+    def export_state(self) -> dict:
+        """The registry's full state as a JSON-able dict — the wire
+        payload a fleet front door scrapes from each peer (fleetobs's
+        MSG_OBS "metrics" op). Unlike the Prometheus text exposition
+        this is LOSSLESS for histograms (raw bucket counts travel, not
+        quantiles), so the front door's merged rollup interpolates
+        quantiles over summed counts exactly as one process would.
+        Collectors run first, like every other scrape."""
+        self.collect()
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out: dict = {}
+        for m in metrics:
+            entry: dict = {"kind": m.kind, "help": m.help}
+            with m._lock:
+                cells = dict(m._cells)
+            if isinstance(m, Histogram):
+                entry["buckets"] = list(m.buckets)
+                entry["series"] = [
+                    [list(map(list, k)),
+                     {"counts": list(c.counts), "sum": c.sum,
+                      "count": c.count}]
+                    for k, c in cells.items()]
+            else:
+                entry["series"] = [[list(map(list, k)), v]
+                                   for k, v in cells.items()]
+            out[m.name] = entry
+        return out
+
 
 # ---------------------------------------------------------------------------
 # Tracing
@@ -472,6 +542,14 @@ class Tracer:
             if fn in self._sinks:
                 self._sinks.remove(fn)
 
+    def active(self) -> bool:
+        """True when at least one sink would receive finished spans —
+        the hot-path guard (scheduler decode ticks, tier restores) that
+        keeps span construction off the serving path entirely while
+        nothing is listening. Racy by design: a stale read costs one
+        span either way, never correctness."""
+        return bool(self._sinks)
+
     def _emit(self, span: Span) -> None:
         with self._sink_lock:
             sinks = list(self._sinks)
@@ -521,12 +599,16 @@ class Tracer:
 
     def emit(self, name: str, duration_ms: float,
              trace_id: Optional[str] = None, parent: Optional[Span] = None,
-             **attrs: Any) -> None:
+             ts: Optional[float] = None, **attrs: Any) -> None:
         """Retroactive span: a phase whose duration was measured elsewhere
         (e.g. the engine's device-fenced prefill/decode seconds) enters
-        the trace after the fact."""
+        the trace after the fact. ``ts`` backdates the span's start so
+        timeline assembly (infra/fleetobs.py) orders it where the work
+        actually began, not where it was reported."""
         span = self.start(name, trace_id, parent, **attrs)
         span.duration_ms = float(duration_ms)
+        if ts is not None:
+            span.ts = float(ts)
         self._emit(span)
 
 
@@ -885,6 +967,44 @@ FLEET_DRAINING = METRICS.gauge(
     "quoracle_fleet_draining",
     "replicas currently draining (new placements excluded, affinities "
     "still serving until each session's migration lands)")
+
+# -- fleet observability (ISSUE 15) ------------------------------------------
+# Cross-process tracing + metrics federation + correlated incident
+# capture (infra/fleetobs.py): span-ring health, the front door's
+# peer-scrape loop, and the incident ledger — the observability OF the
+# observability layer, so a starved trace ring or a stale federation
+# window is itself alertable.
+TRACE_DROPPED_TOTAL = METRICS.counter(
+    "quoracle_trace_dropped_total",
+    "finished spans dropped on span-ring overflow, per ring "
+    "(fleetobs | history) — the ring overwrites oldest-first; a "
+    "sustained rate means serving traffic is starving consensus traces "
+    "and the ring size / decode-tick sample knobs need retuning")
+FLEETOBS_SCRAPE_MS = METRICS.histogram(
+    "quoracle_fleetobs_scrape_ms",
+    "wall time (ms) of one fleet metrics-federation sweep: every "
+    "peer's MSG_OBS metrics state pulled + merged at the front door")
+FLEETOBS_PEERS = METRICS.gauge(
+    "quoracle_fleetobs_peers",
+    "peers in the last federation sweep, by status (ok | failed) — a "
+    "failed peer's series go stale in the rollup until it answers")
+FLEETOBS_STALENESS_S = METRICS.gauge(
+    "quoracle_fleetobs_staleness_s",
+    "age of the last successful federation sweep at scrape time — the "
+    "federation-staleness alert input (DEPLOY §16)")
+FLEETOBS_SLO_BURN = METRICS.gauge(
+    "quoracle_fleetobs_slo_burn",
+    "max INTERACTIVE SLO-burn ratio reported by any peer in the last "
+    "federation sweep — the fleet-wide worst-tail gauge")
+FLEETOBS_GOODPUT = METRICS.gauge(
+    "quoracle_fleetobs_goodput_tokens_per_s",
+    "fleet-wide goodput (real chunk tokens/s summed over peers) "
+    "computed from consecutive federation sweeps' counter deltas")
+INCIDENTS_TOTAL = METRICS.counter(
+    "quoracle_incidents_total",
+    "correlated incidents opened, by kind (watchdog | replica_dead | "
+    "chaos_invariant | manual) — each one is a retention-pruned bundle "
+    "of every reachable peer's flight-ring dump under one incident id")
 
 # -- consensus quality (ISSUE 5) ---------------------------------------------
 # Decision-quality instruments (consensus/quality.py): per-decide

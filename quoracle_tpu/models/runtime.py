@@ -70,6 +70,14 @@ class QueryRequest:
     tenant: str = "default"
     priority: Optional[int] = None
     deadline_ms: Optional[float] = None
+    # -- fleet observability (ISSUE 15) -------------------------------
+    # Compact trace context ({"trace_id", "span_id"}, infra/fleetobs.
+    # TraceContext.to_dict) stamped by the sender so a peer process can
+    # rebind TRACER and its spans land in the SAME trace. None = root
+    # locally (the un-traced behavior). Observability only: never read
+    # by generate/sampling paths, so temp-0 bits are identical with or
+    # without it.
+    trace: Optional[dict] = None
 
 
 @dataclasses.dataclass

@@ -61,13 +61,14 @@ import logging
 import threading
 import time
 from concurrent.futures import Future
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 from quoracle_tpu.analysis.lockdep import named_lock
+from quoracle_tpu.infra import fleetobs
 from quoracle_tpu.infra.flightrec import FLIGHT
 from quoracle_tpu.infra.telemetry import (
     QOS_ADMIT_WAIT_MS, SCHED_ADMIT_WAIT_MS, SCHED_QUEUE_DEPTH,
-    SCHED_ROWS_TOTAL, SCHED_SLOTS_BUSY,
+    SCHED_ROWS_TOTAL, SCHED_SLOTS_BUSY, TRACER,
 )
 from quoracle_tpu.models.generate import GenResult
 from quoracle_tpu.serving.admission import (
@@ -108,6 +109,13 @@ class _Row:
     spec_rounds: int = 0
     spec_drafted: int = 0
     spec_accepted: int = 0
+    # Fleet observability (ISSUE 15): the submitter's trace context —
+    # queue-wait and decode spans emitted from the worker thread parent
+    # onto it, so a row's lifecycle lands in the SAME trace that placed
+    # it (possibly opened on another host). t_admit anchors the decode
+    # span so queue wait is never double-counted in the decomposition.
+    trace: Optional[Any] = None
+    t_admit: float = 0.0
 
 
 class ContinuousBatcher:
@@ -179,7 +187,11 @@ class ContinuousBatcher:
                    future=Future(), t_submit=time.monotonic(),
                    priority=int(coerce_priority(priority)),
                    tenant=tenant, deadline_s=deadline_s,
-                   json_state=initial_json_state)
+                   json_state=initial_json_state,
+                   # trace capture only while something listens — the
+                   # un-traced fast path stays allocation-identical
+                   trace=(fleetobs.TraceContext.current()
+                          if TRACER.active() else None))
         row.owns_session = session_id is None
         # Per-row admission check: an over-window prompt must fail ONLY
         # its own future — inside a shared chunk the engine's
@@ -336,6 +348,15 @@ class ContinuousBatcher:
             SCHED_ADMIT_WAIT_MS.observe(wait_ms, model=self._model)
             QOS_ADMIT_WAIT_MS.observe(wait_ms,
                                       cls=class_name(row.priority))
+            row.t_admit = now
+            if TRACER.active():
+                # retroactive queue-wait span, parented on the
+                # submitter's (possibly remote) trace context
+                TRACER.emit("sched.queue_wait", wait_ms,
+                            parent=row.trace,
+                            ts=time.time() - wait_ms / 1000.0,
+                            session=row.session_id, model=self._model,
+                            cls=class_name(row.priority))
             self._live.append(row)
             admitted += 1
         if admitted:
@@ -351,10 +372,24 @@ class ContinuousBatcher:
                 self._wake.wait(timeout=0.2)
                 self._wake.clear()
                 continue
+            # Sampled decode-tick span (ISSUE 15 satellite): 1-in-N
+            # ticks (QUORACLE_TRACE_DECODE_SAMPLE, keyed on the
+            # monotonic step counter — deterministic, no RNG) so
+            # serving decode traffic cannot starve consensus traces
+            # out of the bounded span rings.
+            t_tick = (time.monotonic()
+                      if TRACER.active() and fleetobs.sample_tick(
+                          self.steps) else None)
+            n_rows = len(self._live)
             try:
                 self._live = self._step(self._live)
             except Exception:             # noqa: BLE001 — isolate, don't
                 self._live = self._isolate_failure(self._live)  # nuke all
+            if t_tick is not None:
+                TRACER.emit("sched.decode_tick",
+                            (time.monotonic() - t_tick) * 1000,
+                            model=self._model, rows=n_rows,
+                            step=self.steps)
             self.steps += 1               # watchdog progress signal
             self._chaos_tick()
         # worker exit (close()): the worker owns _live, so it fails any
@@ -448,6 +483,16 @@ class ContinuousBatcher:
         self._drop_row_sessions(row)
         self.retired += 1
         SCHED_ROWS_TOTAL.inc(model=self._model, status="retired")
+        if TRACER.active():
+            # one decode span per row lifetime, anchored at admission
+            # so queue wait is never double-counted in the TTFT
+            # decomposition (fleetobs.assemble_timeline)
+            dur_ms = (time.monotonic()
+                      - (row.t_admit or row.t_submit)) * 1000
+            TRACER.emit("sched.decode", dur_ms, parent=row.trace,
+                        ts=time.time() - dur_ms / 1000.0,
+                        session=row.session_id, model=self._model,
+                        tokens=len(row.emitted), finish=finish_reason)
         if self.slo is not None:
             # per-class tail tracking (serving/slo.py): feeds the
             # INTERACTIVE-burn → BATCH-demotion control loop

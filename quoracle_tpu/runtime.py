@@ -154,6 +154,12 @@ class StallWatchdog:
             dump_path = FLIGHT.dump(reason=f"watchdog-{name}")
         except Exception:                 # noqa: BLE001 — keep serving
             logger.exception("flight-recorder dump failed on stall")
+        # correlated incident capture (ISSUE 15): the trip also opens a
+        # deterministic incident — on a fabric front door the id fans
+        # out so every peer's flight ring joins the bundle
+        from quoracle_tpu.infra.fleetobs import INCIDENTS
+        INCIDENTS.capture("watchdog", name,
+                          reason=f"no progress for {stalled_s:.1f}s")
         logger.error("stall watchdog tripped: %s made no progress for "
                      "%.1fs (flight recorder: %s)", name, stalled_s,
                      dump_path)
@@ -345,6 +351,11 @@ class Runtime:
         self._trace_sink = (
             lambda event: self.bus.broadcast(TOPIC_TRACE, event))
         TRACER.add_sink(self._trace_sink)
+        # fleet observability (ISSUE 15): the pull-able span ring — any
+        # runtime (front door, peer host, monolith) can answer
+        # /api/timeline and the MSG_OBS spans op from it
+        from quoracle_tpu.infra import fleetobs
+        fleetobs.ensure_ring()
         # Consensus quality (ISSUE 5): audit records + model-health drift
         # alerts (consensus/quality.py QUALITY, process-wide like TRACER)
         # re-broadcast on THIS runtime's bus — EventHistory rings them for

@@ -60,6 +60,7 @@ import numpy as np
 
 from quoracle_tpu.analysis.lockdep import named_lock
 from quoracle_tpu.chaos.faults import CHAOS
+from quoracle_tpu.infra import fleetobs
 from quoracle_tpu.infra.telemetry import (
     CLUSTER_REPLICAS, CLUSTER_REQUESTS_TOTAL, TRACER,
 )
@@ -133,11 +134,21 @@ class RemoteReplica:
 
     # -- wire ops ---------------------------------------------------------
 
+    @staticmethod
+    def _trace_dict() -> Optional[dict]:
+        """The calling thread's trace context as a wire-able dict —
+        stamped onto every peer-bound payload so the peer's spans land
+        in the caller's trace (ISSUE 15)."""
+        ctx = fleetobs.TraceContext.current()
+        return ctx.to_dict() if ctx is not None else None
+
     def serve(self, request):
         from quoracle_tpu.serving.fabric import wire
+        d = wire.request_to_dict(request)
+        if d.get("trace") is None:
+            d["trace"] = self._trace_dict()
         _, payload = self.transport.request(
-            wire.MSG_SERVE,
-            wire.encode_json(wire.request_to_dict(request)))
+            wire.MSG_SERVE, wire.encode_json(d))
         return wire.result_from_dict(wire.decode_json(payload))
 
     def prefill(self, request, handoff_id: str) -> tuple[dict, bytes]:
@@ -145,10 +156,13 @@ class RemoteReplica:
         bytes) — or (meta-with-"result", b"") for rows that never
         dispatched (overflow / deadline)."""
         from quoracle_tpu.serving.fabric import wire
+        d = wire.request_to_dict(request)
+        if d.get("trace") is None:
+            d["trace"] = self._trace_dict()
         _, payload = self.transport.request(
             wire.MSG_PREFILL,
             wire.encode_json({
-                "request": wire.request_to_dict(request),
+                "request": d,
                 "handoff_id": handoff_id}))
         meta, body = wire.unpack_blob(payload)
         return meta, bytes(body)
@@ -163,9 +177,40 @@ class RemoteReplica:
         header = {"handoff_id": meta["handoff_id"],
                   "model_spec": meta["model_spec"],
                   "prompt": meta["prompt"], "row": meta["row"],
-                  "g1": meta["g1"], "owns": owns}
+                  "g1": meta["g1"], "owns": owns,
+                  "trace": self._trace_dict()}
         _, payload = self.transport.request(
             wire.MSG_DECODE, wire.pack_blob(header, env_bytes))
+        return wire.decode_json(payload)
+
+    def pull_spans(self, session_id: Optional[str] = None,
+                   trace_id: Optional[str] = None) -> list[dict]:
+        """This peer's span-ring slice for one session/trace — the new
+        wire op the front door's timeline assembly pulls (ISSUE 15)."""
+        from quoracle_tpu.serving.fabric import wire
+        _, payload = self.transport.request(
+            wire.MSG_OBS, wire.encode_json({
+                "op": "spans", "session_id": session_id,
+                "trace_id": trace_id}))
+        out = wire.decode_json(payload)
+        return list(out.get("spans") or ())
+
+    def obs_metrics(self) -> dict:
+        """This peer's lossless metrics state (MetricsRegistry.
+        export_state + rollup scalars) — the federation scrape input."""
+        from quoracle_tpu.serving.fabric import wire
+        _, payload = self.transport.request(
+            wire.MSG_OBS, wire.encode_json({"op": "metrics"}))
+        return wire.decode_json(payload)
+
+    def obs_incident(self, incident_id: str, reason: str = "") -> dict:
+        """Ask this peer to dump its flight ring into the named
+        incident bundle — the correlated-capture broadcast leg."""
+        from quoracle_tpu.serving.fabric import wire
+        _, payload = self.transport.request(
+            wire.MSG_OBS, wire.encode_json({
+                "op": "incident", "incident_id": incident_id,
+                "reason": reason}))
         return wire.decode_json(payload)
 
     def session_resident(self, request) -> bool:
@@ -278,6 +323,9 @@ class ClusterPlane(ModelBackend):
         # a retirement — a stale affinity or flight event naming a
         # retired id must stay unambiguous forever
         self._rep_seq = len(self.replicas)
+        # fleet observability (ISSUE 15): any serving plane can answer
+        # a timeline pull, so the span ring captures from build time
+        fleetobs.ensure_ring()
         self._refresh_replica_gauges()
 
     # -- construction ----------------------------------------------------
@@ -404,6 +452,18 @@ class ClusterPlane(ModelBackend):
         self._broadcast({"event": "replica_failed",
                          "replica": rep.replica_id, "role": rep.role,
                          "error": error[:200]})
+        # incident capture rides router.mark_failed (ISSUE 15) — the
+        # single chokepoint both planes and the silent-signal path hit
+
+    def pull_timeline(self, session_id: Optional[str] = None,
+                      trace_id: Optional[str] = None) -> dict:
+        """One session's ordered lifecycle across every replica
+        (ISSUE 15): in-process replicas share the process-wide span
+        ring, so the pull is local — the wire twin lives on
+        FabricPlane.pull_timeline."""
+        return fleetobs.assemble_timeline(
+            fleetobs.SPANS.spans(), session_id=session_id,
+            trace_id=trace_id)
 
     # -- elastic topology (ISSUE 14, serving/fleet.py) --------------------
 
@@ -497,7 +557,10 @@ class ClusterPlane(ModelBackend):
                    parent=None) -> None:
         with TRACER.use(parent):
             try:
-                results[i] = self._route(r)
+                with fleetobs.request_span("cluster.request",
+                                           r.session_id,
+                                           model=r.model_spec):
+                    results[i] = self._route(r)
             except AdmissionError as e:
                 results[i] = QueryResult(
                     model_spec=r.model_spec,
@@ -592,8 +655,10 @@ class ClusterPlane(ModelBackend):
         row = rows[0]
         hid = r.session_id or self._own_session_id()
         owns = r.session_id is None
+        fleetobs.tag_current_span(hid)
         pe = pre.backend.engines[spec]
         CLUSTER_REQUESTS_TOTAL.inc(replica=pre.replica_id, path="disagg")
+        t_pre = time.monotonic()
         try:
             g1 = pe.generate(
                 [row["prompt"]], temperature=row["temperature"],
@@ -620,6 +685,11 @@ class ClusterPlane(ModelBackend):
                            e)
             rep = self.router.place("decode", session_id=r.session_id)
             return self._delegate(rep, r, path="failover")
+        if TRACER.active():
+            pre_ms = (time.monotonic() - t_pre) * 1000
+            TRACER.emit("cluster.prefill", pre_ms,
+                        ts=time.time() - pre_ms / 1000.0, session=hid,
+                        model=spec, replica=pre.replica_id)
         try:
             return self._decode_phase(r, row, g1, env, hid, owns, t0)
         finally:
@@ -630,6 +700,7 @@ class ClusterPlane(ModelBackend):
                       exclude: tuple = ()) -> QueryResult:
         spec = r.model_spec
         dec = self.router.place("decode", exclude=exclude)
+        t_dec = time.monotonic()
         try:
             self.handoff.adopt(dec.backend.engines[spec], env,
                                dst_replica=dec.replica_id)
@@ -686,6 +757,11 @@ class ClusterPlane(ModelBackend):
                 f"decode replica {dec.replica_id} died mid-stream and "
                 f"no surviving decode replica could adopt the row: {e}",
                 replica_id=dec.replica_id, phase="decode")
+        if TRACER.active():
+            dec_ms = (time.monotonic() - t_dec) * 1000
+            TRACER.emit("cluster.decode", dec_ms,
+                        ts=time.time() - dec_ms / 1000.0, session=hid,
+                        model=spec, replica=dec.replica_id)
         de = dec.backend.engines[spec]
         if owns:
             de.drop_session(hid)

@@ -40,9 +40,12 @@ from typing import Optional, Sequence
 import numpy as np
 
 from quoracle_tpu.analysis.lockdep import named_lock
+from quoracle_tpu.infra import fleetobs
 from quoracle_tpu.infra.flightrec import FLIGHT
 from quoracle_tpu.infra.telemetry import (
-    CLUSTER_REQUESTS_TOTAL, FABRIC_PEERS, TRACER,
+    CLUSTER_REQUESTS_TOTAL, FABRIC_PEERS, FLEETOBS_GOODPUT,
+    FLEETOBS_PEERS, FLEETOBS_SCRAPE_MS, FLEETOBS_SLO_BURN,
+    FLEETOBS_STALENESS_S, TRACER,
 )
 from quoracle_tpu.models.runtime import (
     ModelBackend, QueryRequest, QueryResult,
@@ -109,13 +112,15 @@ class RemoteSignalsProxy:
         left = None
         if deadline_s is not None:
             left = max(0.0, (deadline_s - time.monotonic()) * 1000)
+        ctx = fleetobs.TraceContext.current()
         try:
             _, payload = self.transport.request(
                 MSG_ADMIT, wire.encode_json({
                     "tenant": tenant,
                     "priority": (int(priority) if priority is not None
                                  else None),
-                    "deadline_ms_left": left}))
+                    "deadline_ms_left": left,
+                    "trace": ctx.to_dict() if ctx else None}))
         except TransportError as e:
             raise OverloadedError(
                 f"peer unreachable at admission: {e}",
@@ -149,6 +154,15 @@ class FabricPlane(ModelBackend):
         self.wire_handoffs = 0
         self.replaced = 0
         self.cold_failovers = 0
+        # fleet observability (ISSUE 15): span ring for timeline pulls,
+        # federation sweep cache, and the incident broadcast hook that
+        # makes every peer's flight ring land in one bundle
+        fleetobs.ensure_ring()
+        self._fed: Optional[fleetobs.FederatedMetrics] = None
+        self._fed_at = 0.0
+        self._fed_tokens: Optional[float] = None
+        self._incident_notifier = self._broadcast_incident
+        fleetobs.INCIDENTS.add_notifier(self._incident_notifier)
         self._refresh_peer_gauges()
 
     @classmethod
@@ -170,6 +184,7 @@ class FabricPlane(ModelBackend):
         return cls(peers)
 
     def close(self) -> None:
+        fleetobs.INCIDENTS.remove_notifier(self._incident_notifier)
         for p in self.peers:
             try:
                 p.close()
@@ -298,6 +313,94 @@ class FabricPlane(ModelBackend):
         self._broadcast({"event": "peer_failed",
                          "peer": peer.replica_id, "role": peer.role,
                          "phase": phase, "error": error[:200]})
+        # incident capture rides router.mark_failed (ISSUE 15): the
+        # door's registered notifier then broadcasts the deterministic
+        # incident id to every surviving peer, so their flight-ring
+        # dumps land in the same retention-pruned bundle
+
+    def _broadcast_incident(self, incident_id: str, kind: str,
+                            key: str, reason: str) -> None:
+        """INCIDENTS notifier: fan the incident id out over the fabric
+        so every reachable peer's flight-ring dump joins the bundle.
+        Best-effort per peer — a dead peer is often the incident."""
+        for p in list(self.peers):
+            if not p.alive or not hasattr(p, "obs_incident"):
+                continue
+            try:
+                p.obs_incident(incident_id, reason=reason)
+            except WireError:
+                pass
+
+    # -- fleet observability (ISSUE 15) -----------------------------------
+
+    def pull_timeline(self, session_id: Optional[str] = None,
+                      trace_id: Optional[str] = None) -> dict:
+        """GET /api/timeline: the session's spans pulled from EVERY
+        reachable peer over the new wire op, merged with the door's own
+        ring, deduped and ordered into one lifecycle with per-stage
+        TTFT attribution (fleetobs.assemble_timeline)."""
+        spans = fleetobs.SPANS.spans()
+        for p in list(self.peers):
+            if not p.alive or not hasattr(p, "pull_spans"):
+                continue
+            try:
+                spans.extend(p.pull_spans(session_id=session_id,
+                                          trace_id=trace_id))
+            except WireError:
+                continue                  # a silent peer's slice is lost
+        return fleetobs.assemble_timeline(spans, session_id=session_id,
+                                          trace_id=trace_id)
+
+    def federated_metrics(self,
+                          max_age_s: float = 2.0) -> fleetobs.FederatedMetrics:
+        """The fleet-wide metrics rollup: every peer's lossless registry
+        state scraped over the wire and merged (summed-count histogram
+        cells — quantiles equal the per-peer oracle), cached
+        ``max_age_s`` so scrape storms cost one sweep. Sets the
+        fleet SLO-burn / goodput / staleness gauges as a side effect."""
+        now = time.monotonic()
+        with self._lock:
+            fed, at = self._fed, self._fed_at
+        if fed is not None and now - at < max_age_s:
+            FLEETOBS_STALENESS_S.set(round(now - at, 3))
+            return fed
+        t0 = time.monotonic()
+        # the door itself is a peer of the rollup: its router/fabric
+        # series ride under peer="door" so the exposition declares each
+        # metric name exactly once, all series peer-labeled
+        states: dict = {"door": fleetobs.local_obs_state()["state"]}
+        ok = failed = 0
+        slo_burn = 0.0
+        tokens = 0.0
+        for p in list(self.peers):
+            if not p.alive or not hasattr(p, "obs_metrics"):
+                failed += 1
+                continue
+            try:
+                out = p.obs_metrics()
+            except WireError:
+                failed += 1
+                continue
+            ok += 1
+            states[p.replica_id] = out.get("state") or {}
+            slo_burn = max(slo_burn, float(out.get("slo_burn") or 0.0))
+            tokens += float(out.get("tokens_total") or 0.0)
+        fed = fleetobs.federate(states)
+        now = time.monotonic()
+        with self._lock:
+            last_at, last_tokens = self._fed_at, self._fed_tokens
+            self._fed, self._fed_at = fed, now
+            self._fed_tokens = tokens
+        FLEETOBS_SCRAPE_MS.observe((now - t0) * 1000)
+        FLEETOBS_PEERS.set(ok, status="ok")
+        FLEETOBS_PEERS.set(failed, status="failed")
+        FLEETOBS_STALENESS_S.set(0.0)
+        FLEETOBS_SLO_BURN.set(round(slo_burn, 4))
+        if last_tokens is not None and now > last_at:
+            FLEETOBS_GOODPUT.set(
+                round(max(0.0, tokens - last_tokens)
+                      / (now - last_at), 2))
+        return fed
 
     # -- ModelBackend -----------------------------------------------------
 
@@ -322,7 +425,9 @@ class FabricPlane(ModelBackend):
                    parent=None) -> None:
         with TRACER.use(parent):
             try:
-                results[i] = self._route(r)
+                with fleetobs.request_span("door.request", r.session_id,
+                                           model=r.model_spec):
+                    results[i] = self._route(r)
             except AdmissionError as e:
                 results[i] = QueryResult(
                     model_spec=r.model_spec,
@@ -377,6 +482,7 @@ class FabricPlane(ModelBackend):
         pre = self.router.place("prefill")
         hid = r.session_id or self._own_session_id()
         owns = r.session_id is None
+        fleetobs.tag_current_span(hid)
         CLUSTER_REQUESTS_TOTAL.inc(replica=pre.replica_id, path="disagg")
         try:
             meta, env_bytes = pre.prefill(r, hid)
@@ -401,9 +507,18 @@ class FabricPlane(ModelBackend):
             return wire.result_from_dict(meta["result"])
         with self._lock:
             self.wire_handoffs += 1
+        leg_ms = (time.monotonic() - t0) * 1000
         FLIGHT.record("fabric_handoff_wire", model=spec, session=hid,
                       src=pre.replica_id, bytes=len(env_bytes),
-                      ms=round((time.monotonic() - t0) * 1000, 2))
+                      ms=round(leg_ms, 2))
+        if TRACER.active():
+            # the whole prefill RPC leg: peer-side prefill rides inside
+            # it, so (door.prefill_rpc − peer.prefill) is the wire +
+            # serialization cost the timeline attributes to "wire"
+            TRACER.emit("door.prefill_rpc", leg_ms,
+                        ts=time.time() - leg_ms / 1000.0, session=hid,
+                        model=spec, replica=pre.replica_id,
+                        bytes=len(env_bytes))
         return self._decode_phase(r, meta, env_bytes, hid, owns, t0)
 
     def _decode_phase(self, r: QueryRequest, meta: dict,
@@ -411,6 +526,7 @@ class FabricPlane(ModelBackend):
                       exclude: tuple = ()) -> QueryResult:
         spec = r.model_spec
         dec = self.router.place("decode", exclude=exclude)
+        t_leg = time.monotonic()
         try:
             d = dec.adopt_decode(meta, env_bytes, owns=owns)
         except AdmissionError:
@@ -457,6 +573,11 @@ class FabricPlane(ModelBackend):
                 f"surviving decode peer could adopt the row: {e}",
                 replica_id=dec.replica_id, phase="decode")
         CLUSTER_REQUESTS_TOTAL.inc(replica=dec.replica_id, path="disagg")
+        if TRACER.active():
+            dec_ms = (time.monotonic() - t_leg) * 1000
+            TRACER.emit("door.decode_rpc", dec_ms,
+                        ts=time.time() - dec_ms / 1000.0, session=hid,
+                        model=spec, replica=dec.replica_id)
         if not owns and r.session_id:
             self.router.set_affinity(r.session_id, dec.replica_id)
         res = wire.result_from_dict(d)
@@ -545,6 +666,13 @@ class FabricPlane(ModelBackend):
                 "transport": p.transport.stats(),
             } for p in self.peers],
             "router": self.router.stats(),
+            "obs": {
+                "span_ring": fleetobs.SPANS.stats(),
+                "incidents": fleetobs.INCIDENTS.status(),
+                "federation_age_s": round(
+                    max(0.0, time.monotonic() - self._fed_at), 3)
+                if self._fed is not None else None,
+            },
             **counters,
         }
 

@@ -39,12 +39,14 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from quoracle_tpu.infra import fleetobs
+from quoracle_tpu.infra.telemetry import TRACER
 from quoracle_tpu.serving.fabric import wire
 from quoracle_tpu.serving.fabric.wire import (
     MSG_ADMIT, MSG_ADMITTED, MSG_DECODE, MSG_DECODED, MSG_DROP_SESSION,
-    MSG_EMBED, MSG_EMBEDDED, MSG_ERROR, MSG_HELLO, MSG_META, MSG_OK,
-    MSG_PREFILL, MSG_PREFILLED, MSG_RESULT, MSG_SERVE, MSG_SIGNALS,
-    MSG_SIGNALS_POLL, MSG_STATS, WireError,
+    MSG_EMBED, MSG_EMBEDDED, MSG_ERROR, MSG_HELLO, MSG_META, MSG_OBS,
+    MSG_OBS_RESULT, MSG_OK, MSG_PREFILL, MSG_PREFILLED, MSG_RESULT,
+    MSG_SERVE, MSG_SIGNALS, MSG_SIGNALS_POLL, MSG_STATS, WireError,
 )
 
 logger = logging.getLogger(__name__)
@@ -62,6 +64,9 @@ class FabricPeer:
         self.role = role
         self.handoff = KVHandoff()
         self._server = None
+        # fleet observability (ISSUE 15): every peer keeps a span ring
+        # so the front door can pull its slice of a session's timeline
+        fleetobs.ensure_ring()
 
     # -- construction -----------------------------------------------------
 
@@ -150,9 +155,46 @@ class FabricPeer:
             return self._h_embed(payload)
         if msg_type == MSG_META:
             return self._h_meta(payload)
+        if msg_type == MSG_OBS:
+            return self._h_obs(payload)
         return MSG_ERROR, wire.error_payload(
             f"peer {self.replica_id!r} does not serve op {msg_type}",
             reason="decode")
+
+    def _h_obs(self, payload: bytes) -> tuple[int, bytes]:
+        """Fleet observability ops (ISSUE 15): ``spans`` serves this
+        peer's span-ring slice for a session/trace (the front door's
+        timeline pull), ``metrics`` serves the lossless registry state
+        (the federation scrape), ``incident`` dumps the flight ring
+        into the named incident bundle (correlated capture)."""
+        d = wire.decode_json(payload)
+        op = d.get("op")
+        if op == "spans":
+            spans = fleetobs.SPANS.spans(
+                session_id=d.get("session_id"),
+                trace_id=d.get("trace_id"))
+            return MSG_OBS_RESULT, wire.encode_json(
+                {"replica_id": self.replica_id, "spans": spans,
+                 "ring": fleetobs.SPANS.stats()})
+        if op == "metrics":
+            out = fleetobs.local_obs_state()
+            out["replica_id"] = self.replica_id
+            slo = getattr(self.backend, "slo", None)
+            if slo is not None:
+                from quoracle_tpu.serving.qos import Priority
+                try:
+                    out["slo_burn"] = slo.burn(Priority.INTERACTIVE)
+                except Exception:         # noqa: BLE001 — best-effort
+                    pass
+            return MSG_OBS_RESULT, wire.encode_json(out)
+        if op == "incident":
+            path = fleetobs.INCIDENTS.peer_dump(
+                str(d.get("incident_id") or "unknown"),
+                self.replica_id)
+            return MSG_OBS_RESULT, wire.encode_json(
+                {"replica_id": self.replica_id, "dumped": bool(path),
+                 "path": path})
+        raise WireError(f"unknown obs op {op!r}", reason="decode")
 
     def _hello(self) -> dict:
         return {
@@ -170,8 +212,16 @@ class FabricPeer:
 
     def _h_serve(self, payload: bytes) -> tuple[int, bytes]:
         from quoracle_tpu.models.runtime import QueryResult
-        r = wire.request_from_dict(wire.decode_json(payload))
-        out = self.backend.query([r])
+        d = wire.decode_json(payload)
+        r = wire.request_from_dict(d)
+        # rebind the caller's trace (ISSUE 15): this peer's spans —
+        # admit, queue-wait, decode — land in the front door's trace
+        ctx = fleetobs.TraceContext.from_dict(d.get("trace"))
+        with fleetobs.bind_remote(ctx):
+            with fleetobs.request_span("peer.serve", r.session_id,
+                                       model=r.model_spec,
+                                       replica=self.replica_id):
+                out = self.backend.query([r])
         res = out[0] if out else QueryResult(
             model_spec=r.model_spec, error="peer returned no result")
         return MSG_RESULT, wire.encode_json(wire.result_to_dict(res))
@@ -194,29 +244,35 @@ class FabricPeer:
             return MSG_ERROR, wire.error_payload(
                 f"unknown model {spec!r} on peer {self.replica_id!r}",
                 reason="decode")
-        t0 = time.monotonic()
-        tmp: list = [None]
-        rows, live = b._build_rows(spec, [0], [r], tmp, t0)
-        if not live:
-            # overflow / pre-dispatch deadline: the structured result
-            # rides back as-is — nothing prefilled, nothing to hand off
-            return MSG_PREFILLED, wire.pack_blob(
-                {"result": wire.result_to_dict(tmp[0])})
-        row = rows[0]
-        pe = b.engines[spec]
-        g1 = pe.generate(
-            [row["prompt"]], temperature=row["temperature"],
-            top_p=row["top_p"], max_new_tokens=1, session_ids=[hid],
-            constrain_json=[row["constrain_json"]],
-            action_enums=[row["action_enum"]])[0]
-        js = g1.json_state if row["constrain_json"] else None
-        try:
-            env = self.handoff.export(pe, hid, spec,
-                                      src_replica=self.replica_id,
-                                      json_state=js)
-        except HandoffError as e:
-            return MSG_ERROR, wire.error_payload(
-                str(e), reason=e.reason, error_type="handoff")
+        ctx = fleetobs.TraceContext.from_dict(
+            (d["request"] or {}).get("trace"))
+        with fleetobs.bind_remote(ctx), \
+                fleetobs.request_span("peer.prefill", hid, model=spec,
+                                      replica=self.replica_id):
+            t0 = time.monotonic()
+            tmp: list = [None]
+            rows, live = b._build_rows(spec, [0], [r], tmp, t0)
+            if not live:
+                # overflow / pre-dispatch deadline: the structured
+                # result rides back as-is — nothing prefilled, nothing
+                # to hand off
+                return MSG_PREFILLED, wire.pack_blob(
+                    {"result": wire.result_to_dict(tmp[0])})
+            row = rows[0]
+            pe = b.engines[spec]
+            g1 = pe.generate(
+                [row["prompt"]], temperature=row["temperature"],
+                top_p=row["top_p"], max_new_tokens=1, session_ids=[hid],
+                constrain_json=[row["constrain_json"]],
+                action_enums=[row["action_enum"]])[0]
+            js = g1.json_state if row["constrain_json"] else None
+            try:
+                env = self.handoff.export(pe, hid, spec,
+                                          src_replica=self.replica_id,
+                                          json_state=js)
+            except HandoffError as e:
+                return MSG_ERROR, wire.error_payload(
+                    str(e), reason=e.reason, error_type="handoff")
         # the front door's retained BYTES are the failover source now
         self.handoff.forget(spec, hid)
         env_bytes = wire.encode_envelope(env)
@@ -270,25 +326,34 @@ class FabricPeer:
         # re-anchor so quoracle_cluster_handoff_ms measures the adopt
         # leg (wire transit rides quoracle_fabric_rtt_ms instead)
         env.ts = time.monotonic()
-        self.handoff.adopt(de, env, dst_replica=self.replica_id)
-        row, g1 = header["row"], header["g1"]
-        budget = row["budget"]
-        g1_ids = [int(t) for t in g1["token_ids"]]
-        done = g1["finish_reason"] == "stop" or budget <= 1
-        g2 = None
-        try:
-            if done:
-                g_ids = list(g1_ids)
-            else:
-                g2 = self._continue(de, spec, header, row, g1, hid)
-                g_ids = g1_ids + [int(t) for t in g2.token_ids]
-        except BaseException:
-            # a failed continuation must not strand the adopted pages on
-            # THIS peer: the front door re-places through its retained
-            # envelope bytes (a fresh adopt elsewhere), so the local
-            # copy is dead weight either way
-            de.drop_session(hid)
-            raise
+        # rebind the trace that crossed the wire (request header first,
+        # the envelope's own stamp as fallback) so adopt/queue/decode
+        # spans land in the front door's trace (ISSUE 15)
+        ctx = (fleetobs.TraceContext.from_dict(header.get("trace"))
+               or fleetobs.TraceContext.from_dict(env.trace))
+        with fleetobs.bind_remote(ctx), \
+                fleetobs.request_span("peer.decode", hid, model=spec,
+                                      replica=self.replica_id):
+            self.handoff.adopt(de, env, dst_replica=self.replica_id)
+            row, g1 = header["row"], header["g1"]
+            budget = row["budget"]
+            g1_ids = [int(t) for t in g1["token_ids"]]
+            done = g1["finish_reason"] == "stop" or budget <= 1
+            g2 = None
+            try:
+                if done:
+                    g_ids = list(g1_ids)
+                else:
+                    g2 = self._continue(de, spec, header, row, g1, hid)
+                    g_ids = g1_ids + [int(t) for t in g2.token_ids]
+            except BaseException:
+                # a failed continuation must not strand the adopted
+                # pages on THIS peer: the front door re-places through
+                # its retained envelope bytes (a fresh adopt
+                # elsewhere), so the local copy is dead weight either
+                # way
+                de.drop_session(hid)
+                raise
         if header.get("owns"):
             de.drop_session(hid)
         cfg = de.cfg
@@ -372,9 +437,16 @@ class FabricPeer:
             cls = coerce_priority(d.get("priority"))
             return MSG_ADMITTED, wire.encode_json(
                 {"priority": int(cls), "qos": False})
+        t0 = time.monotonic()
         cls = ctrl.admit(tenant=d.get("tenant", "default"),
                          priority=d.get("priority"),
                          deadline_s=deadline_s)
+        if TRACER.active():
+            ctx = fleetobs.TraceContext.from_dict(d.get("trace"))
+            TRACER.emit("peer.admit",
+                        (time.monotonic() - t0) * 1000, parent=ctx,
+                        replica=self.replica_id,
+                        tenant=d.get("tenant", "default"))
         return MSG_ADMITTED, wire.encode_json(
             {"priority": int(cls), "qos": True})
 

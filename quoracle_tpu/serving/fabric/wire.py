@@ -80,6 +80,8 @@ MSG_PREFIX_HIT = 31      # blob: {dtype, shape} + K bytes + V bytes
 MSG_PREFIX_MISS = 32     # JSON {}
 MSG_PREFIX_PUT = 33      # blob: {signature, key, tokens, dtype, shape}+K+V
 MSG_PREFIX_STATS = 34    # JSON {} -> JSON per-signature store stats
+MSG_OBS = 40             # JSON {"op": metrics|spans|incident, ...}
+MSG_OBS_RESULT = 41      # JSON op-specific (ISSUE 15 fleet observability)
 
 # metric label per opcode (quoracle_fabric_requests_total / _rtt_ms)
 OP_NAMES: dict = {
@@ -94,6 +96,7 @@ OP_NAMES: dict = {
     MSG_PREFIX_GET: "prefix_get", MSG_PREFIX_HIT: "prefix_get",
     MSG_PREFIX_MISS: "prefix_get", MSG_PREFIX_PUT: "prefix_put",
     MSG_PREFIX_STATS: "prefix_stats",
+    MSG_OBS: "obs", MSG_OBS_RESULT: "obs",
 }
 
 
@@ -325,6 +328,12 @@ def encode_envelope(env) -> bytes:
         "k_shape": list(k.shape),
         "v_shape": list(v.shape),
     }
+    # Trace context (ISSUE 15) rides the JSON header: un-upgraded peers
+    # skip unknown header KEYS by construction (decode_envelope reads
+    # only the fields it knows), so a trace-carrying envelope interops
+    # with a peer that has never heard of tracing.
+    if getattr(env, "trace", None):
+        header["trace"] = dict(env.trace)
     chunks = [k.view(np.uint8).reshape(-1).tobytes(),
               v.view(np.uint8).reshape(-1).tobytes()]
     if k_scale is not None:
@@ -385,6 +394,23 @@ def decode_envelope(payload: bytes, expect_signature: Optional[str] = None):
         off += ks.nbytes
         vs = _array_from(body[off:], f32, sshape)
         off += vs.nbytes
+    # Forward compatibility (ISSUE 15 satellite): optional byte
+    # sections a NEWER peer appended are declared in the header as
+    # ``"ext": [[name, nbytes], ...]`` and SKIPPED here — an unknown
+    # optional section must never be a WireError, or a mixed-version
+    # pair could not interop. Only an undeclared length mismatch (true
+    # truncation/corruption) still rejects.
+    for ext in header.get("ext") or ():
+        try:
+            _, nbytes = ext[0], int(ext[1])
+        except (TypeError, ValueError, IndexError):
+            raise WireError("malformed ext-section declaration",
+                            reason="decode") from None
+        if nbytes < 0 or len(body) - off < nbytes:
+            raise WireError(
+                f"ext section truncated: {len(body) - off} < {nbytes}",
+                reason="truncated")
+        off += nbytes
     if len(body) != off:
         raise WireError(
             f"envelope body {len(body)} bytes != declared {off}",
@@ -402,7 +428,9 @@ def decode_envelope(payload: bytes, expect_signature: Optional[str] = None):
         signature=header["signature"],
         entry=entry,
         json_state=header.get("json_state"),
-        src_replica=header.get("src_replica", ""))
+        src_replica=header.get("src_replica", ""),
+        trace=header.get("trace") if isinstance(header.get("trace"),
+                                                dict) else None)
 
 
 # ---------------------------------------------------------------------------
@@ -428,6 +456,9 @@ def request_to_dict(r) -> dict:
         # wire latency eats into the client's wait, not the row's
         # deadline accounting
         "deadline_ms": r.deadline_ms,
+        # trace context (ISSUE 15): an un-upgraded peer ignores unknown
+        # JSON keys, so a trace-carrying request interops either way
+        "trace": r.trace,
     }
 
 
@@ -442,7 +473,9 @@ def request_from_dict(d: dict):
         constrain_json=bool(d.get("constrain_json")),
         action_enum=tuple(ae) if ae else None,
         tenant=d.get("tenant", "default"), priority=d.get("priority"),
-        deadline_ms=d.get("deadline_ms"))
+        deadline_ms=d.get("deadline_ms"),
+        trace=d.get("trace") if isinstance(d.get("trace"), dict)
+        else None)
 
 
 def result_to_dict(res) -> dict:

@@ -431,6 +431,11 @@ class FleetController:
             FLEET_DRAINING.set(len(router.stats()["draining"]))
         ms = (self._clock() - t0) * 1000
         FLEET_DRAIN_MS.observe(ms)
+        from quoracle_tpu.infra.telemetry import TRACER
+        if TRACER.active():
+            TRACER.emit("fleet.drain", ms, replica=replica_id,
+                        reason=reason, migrated=migrated,
+                        failed=failed, retired=bool(retire))
         with self._lock:
             self.drains += 1
             self.sessions_migrated += migrated
@@ -507,6 +512,7 @@ class FleetController:
                      target_role: str) -> bool:
         router = self.plane.router
         handoff = self.plane.handoff
+        t_mig = time.monotonic()
         try:
             target = router.place(target_role,
                                   exclude=(rep.replica_id,))
@@ -531,6 +537,16 @@ class FleetController:
             handoff.forget(spec, sid)
         router.set_affinity(sid, target.replica_id)
         FLEET_SESSIONS_MIGRATED_TOTAL.inc(model=spec, status="ok")
+        from quoracle_tpu.infra.telemetry import TRACER
+        if TRACER.active():
+            # live migrations join the session's trace (ISSUE 15):
+            # observability only — the policy's no-wall-clock contract
+            # covers decisions, not span timestamps
+            mig_ms = (time.monotonic() - t_mig) * 1000
+            TRACER.emit("fleet.migrate", mig_ms,
+                        ts=time.time() - mig_ms / 1000.0, session=sid,
+                        model=spec, src=rep.replica_id,
+                        dst=target.replica_id)
         return True
 
     def _note_failed(self, rep, spec: str, sid: str, why: str) -> int:

@@ -47,9 +47,10 @@ import time
 from typing import Any, Optional
 
 from quoracle_tpu.analysis.lockdep import named_lock
+from quoracle_tpu.infra import fleetobs
 from quoracle_tpu.infra.flightrec import FLIGHT
 from quoracle_tpu.infra.telemetry import (
-    CLUSTER_HANDOFF_MS, CLUSTER_HANDOFFS_TOTAL,
+    CLUSTER_HANDOFF_MS, CLUSTER_HANDOFFS_TOTAL, TRACER,
 )
 
 
@@ -78,6 +79,11 @@ class HandoffEnvelope:
     json_state: Optional[int] = None
     src_replica: str = ""
     ts: float = 0.0
+    # Trace context (ISSUE 15): {"trace_id", "span_id"} stamped at
+    # export so the adopting peer's restore/decode spans land in the
+    # same trace. Rides the wire blob's JSON header; un-upgraded peers
+    # skip it (unknown header keys are ignored by construction).
+    trace: Optional[dict] = None
 
     @property
     def n_tokens(self) -> int:
@@ -132,11 +138,13 @@ class KVHandoff:
             raise HandoffError(
                 f"session {session_id!r} not exportable from "
                 f"{engine.cfg.name}", reason="export_failed")
+        ctx = fleetobs.TraceContext.current()
         env = HandoffEnvelope(
             session_id=session_id, model_spec=model_spec,
             signature=engine.kv_signature(), entry=entry,
             json_state=json_state, src_replica=src_replica,
-            ts=time.monotonic())
+            ts=time.monotonic(),
+            trace=ctx.to_dict() if ctx is not None else None)
         if getattr(entry, "k_scale", None) is not None:
             # int8 entry (ISSUE 13): this envelope ships ~half the
             # bytes its bf16 twin would — count the savings per tier
@@ -150,10 +158,15 @@ class KVHandoff:
         with self._lock:
             self._inflight[self._key(model_spec, session_id)] = env
             self.exports += 1
+        export_ms = (time.monotonic() - t0) * 1000
         FLIGHT.record("kv_handoff_export", model=model_spec,
                       session=session_id, replica=src_replica,
-                      tokens=env.n_tokens,
-                      ms=round((time.monotonic() - t0) * 1000, 2))
+                      tokens=env.n_tokens, ms=round(export_ms, 2))
+        if TRACER.active():
+            TRACER.emit("kv.export", export_ms,
+                        ts=time.time() - export_ms / 1000.0,
+                        session=session_id, model=model_spec,
+                        replica=src_replica, tokens=env.n_tokens)
         return env
 
     # -- adopt (decode side) --------------------------------------------
@@ -196,6 +209,17 @@ class KVHandoff:
         FLIGHT.record("kv_handoff_adopt", model=env.model_spec,
                       session=env.session_id, replica=dst_replica,
                       tokens=env.n_tokens, ms=round(ms, 2))
+        if TRACER.active():
+            # parent onto the exporting side's context when the local
+            # thread carries none (the envelope's trace crossed the
+            # wire with the pages)
+            TRACER.emit("kv.adopt", ms,
+                        parent=(TRACER.current()
+                                or fleetobs.TraceContext.from_dict(
+                                    env.trace)),
+                        ts=time.time() - ms / 1000.0,
+                        session=env.session_id, model=env.model_spec,
+                        replica=dst_replica, tokens=env.n_tokens)
 
     # -- ledger ----------------------------------------------------------
 

@@ -773,6 +773,14 @@ class TierManager:
         KV_RESTORE_MS.observe(ms, model=self.model, kind="session")
         FLIGHT.record("kv_restore", model=self.model, what="session",
                       session=key, pages=len(pages), ms=round(ms, 2))
+        from quoracle_tpu.infra.telemetry import TRACER
+        if TRACER.active():
+            # the restore leg of a hibernated/handed-off session enters
+            # the session's trace (ISSUE 15) — under the store lock's
+            # caller, so a retroactive emit, never a bound span
+            TRACER.emit("kv.restore", ms, ts=time.time() - ms / 1000.0,
+                        session=key, model=self.model,
+                        pages=len(pages))
         return sess
 
     # -- prefix-block tiering -------------------------------------------

@@ -155,6 +155,15 @@ class ClusterRouter:
                 del self._affinity[sid]
         FLIGHT.record("cluster_replica_dead", replica=replica_id,
                       error=error[:200], dropped_affinities=len(stale))
+        # correlated incident capture (ISSUE 15): every replica death —
+        # serving failure, silent signals, chaos kill — stamps a
+        # deterministic incident id, dumps the local flight ring into
+        # the bundle, and (via the front door's registered notifier)
+        # broadcasts the id so every reachable peer's dump joins it.
+        # This is the single chokepoint: both planes route deaths here.
+        from quoracle_tpu.infra.fleetobs import INCIDENTS
+        INCIDENTS.capture("replica_dead", replica_id,
+                          reason=error[:200])
 
     def mark_draining(self, replica_id: str) -> None:
         """Graceful drain (ISSUE 14 satellite) — DISTINCT from

@@ -50,6 +50,13 @@ Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
   POST /api/flightrec/dump  dump the flight-recorder ring to a JSON file
   GET  /api/trace?task_id   finished trace spans for one task (TOPIC_TRACE
                             ring in infra/event_history.py)
+  GET  /api/timeline?session_id  one session's cross-process lifecycle
+                            (ISSUE 15): spans pulled from every fabric
+                            peer, ordered, with per-stage TTFT
+                            attribution (infra/fleetobs.py)
+  GET  /api/incidents       correlated incident bundles (ISSUE 15):
+                            deterministic-id directories of every
+                            reachable peer's flight-ring dump
   GET  /api/tasks           tasks + live agent counts
   GET  /api/agents?task_id  agent tree with budget/cost/todo state
   GET  /api/logs?agent_id   durable logs (newest last)
@@ -564,7 +571,12 @@ class DashboardServer:
 
     def prometheus_text(self) -> str:
         """GET /metrics body: scrape-time gauge refresh + the registry's
-        text exposition (infra/telemetry.py)."""
+        text exposition (infra/telemetry.py). A fabric front door
+        (ISSUE 15) serves the FLEET rollup instead: every peer's
+        lossless registry state scraped over the wire and merged, all
+        series labeled by ``peer`` (the door's own under
+        ``peer="door"``), histogram aggregates under ``peer="fleet"``
+        whose quantiles equal the merged per-peer oracle."""
         from quoracle_tpu.infra.telemetry import (
             KV_FREE_PAGES, LIVE_AGENTS, METRICS,
         )
@@ -572,7 +584,38 @@ class DashboardServer:
         LIVE_AGENTS.set(len(rt.registry.all()))
         for spec, e in (getattr(rt.backend, "engines", None) or {}).items():
             KV_FREE_PAGES.set(e.sessions.free_pages(), model=spec)
+        fed_fn = getattr(rt.backend, "federated_metrics", None)
+        if fed_fn is not None:
+            return fed_fn().render_prometheus()
         return METRICS.render_prometheus()
+
+    def timeline_payload(self, session_id: Optional[str] = None,
+                         trace_id: Optional[str] = None) -> dict:
+        """GET /api/timeline?session_id=…: one session's ordered
+        lifecycle (ISSUE 15) — spans pulled from every fabric peer on a
+        front door (backend.pull_timeline), the process-wide span ring
+        otherwise. With no filter, the most recently traced session is
+        shown (the /telemetry panel's default)."""
+        from quoracle_tpu.infra import fleetobs
+        if session_id is None and trace_id is None:
+            for s in reversed(fleetobs.SPANS.spans()):
+                if s.get("session"):
+                    session_id = s["session"]
+                    break
+        fn = getattr(self.runtime.backend, "pull_timeline", None)
+        if fn is not None:
+            return fn(session_id=session_id, trace_id=trace_id)
+        return fleetobs.assemble_timeline(
+            fleetobs.SPANS.spans(), session_id=session_id,
+            trace_id=trace_id)
+
+    def incidents_payload(self) -> dict:
+        """GET /api/incidents: the correlated-incident bundles
+        (ISSUE 15) — each a deterministic-id directory holding every
+        reachable peer's flight-ring dump, retention-pruned."""
+        from quoracle_tpu.infra.fleetobs import INCIDENTS
+        return {"incidents": INCIDENTS.list(),
+                **INCIDENTS.status()}
 
     def settings_payload(self) -> dict:
         """The settings surface (reference SecretManagementLive): system
@@ -682,7 +725,7 @@ class _Handler(BaseHTTPRequestHandler):
                     d.metrics_payload(), d.resources_payload(),
                     d.qos_payload(), d.models_payload(),
                     d.kv_payload(), d.chaos_payload(),
-                    d.fleet_payload()))
+                    d.fleet_payload(), d.timeline_payload()))
             elif parsed.path == "/settings":
                 from quoracle_tpu.web import views
                 self._send_html(views.settings_page(
@@ -732,6 +775,11 @@ class _Handler(BaseHTTPRequestHandler):
             elif parsed.path == "/api/trace":
                 self._send_json(d.trace_payload(one("task_id")
                                                 or one("trace_id")))
+            elif parsed.path == "/api/timeline":
+                self._send_json(d.timeline_payload(
+                    one("session_id"), one("trace_id")))
+            elif parsed.path == "/api/incidents":
+                self._send_json(d.incidents_payload())
             elif parsed.path == "/metrics":
                 # Prometheus text exposition; gated by the same bearer
                 # token as the API above (scrapers pass it via the

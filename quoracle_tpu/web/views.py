@@ -580,12 +580,52 @@ def fleet_panel(fleet: dict) -> str:
     return "".join(parts)
 
 
+def timeline_panel(timeline: dict) -> str:
+    """Session-timeline panel (ISSUE 15): the most recent traced
+    session's cross-process lifecycle — per-stage TTFT attribution (the
+    stages sum to the observed end-to-end wall by construction) plus
+    the ordered span list, each span named with its replica. Renders
+    nothing while no traced session is in the ring."""
+    timeline = timeline or {}
+    spans = timeline.get("spans") or []
+    if not spans:
+        return ""
+    stages = timeline.get("stages") or {}
+    parts = [
+        "<h2 class=\"meta\">session timeline</h2>",
+        f"<p class=\"meta\" id=\"timeline-state\">"
+        f"session {_e(timeline.get('session_id'))}"
+        f" · trace {_e(','.join(timeline.get('trace_ids') or []))}"
+        f" · spans {_e(timeline.get('n_spans'))}"
+        f" · total {_fmt_ms(timeline.get('total_ms'))}"
+        f" (stages sum {_fmt_ms(timeline.get('stages_sum_ms'))})</p>",
+    ]
+    if stages:
+        rows = "".join(
+            f"<tr class=\"timeline-stage\"><td>{_e(k)}</td>"
+            f"<td>{_fmt_ms(v)}</td></tr>"
+            for k, v in stages.items())
+        parts.append("<table id=\"timeline-stages\"><tr><th>stage</th>"
+                     "<th>ms</th></tr>" + rows + "</table>")
+    rows = "".join(
+        f"<tr class=\"timeline-span\"><td>{_e(s.get('name'))}</td>"
+        f"<td>{_e(s.get('replica') or s.get('model') or '')}</td>"
+        f"<td>{_ts(s.get('ts'))}</td>"
+        f"<td>{_fmt_ms(s.get('duration_ms'))}</td></tr>"
+        for s in spans[:24])
+    parts.append("<table id=\"timeline-spans\"><tr><th>span</th>"
+                 "<th>where</th><th>start</th><th>ms</th></tr>"
+                 + rows + "</table>")
+    return "".join(parts)
+
+
 def telemetry_page(metrics: dict, resources: Optional[dict] = None,
                    qos: Optional[dict] = None,
                    quality: Optional[dict] = None,
                    kv: Optional[dict] = None,
                    chaos: Optional[dict] = None,
-                   fleet: Optional[dict] = None) -> str:
+                   fleet: Optional[dict] = None,
+                   timeline: Optional[dict] = None) -> str:
     """Dev telemetry view (reference LiveDashboard at /dev/dashboard):
     the /api/metrics snapshot as readable tables, led by the latency
     histogram panel, the live resources panel, the QoS panel, the
@@ -609,6 +649,7 @@ def telemetry_page(metrics: dict, resources: Optional[dict] = None,
             + kv_panel(kv or {})
             + chaos_panel(chaos or {})
             + fleet_panel(fleet or {})
+            + timeline_panel(timeline or {})
             + quality_panel(quality or {})
             + spec_panel((quality or {}).get("speculative") or {})
             + (table("runtime", flat) if flat else "")

@@ -143,6 +143,81 @@ def test_envelope_round_trip_bit_exact():
             out.entry.v.view(np.uint8), env.entry.v.view(np.uint8))
 
 
+def test_envelope_unknown_header_keys_and_ext_sections_skipped():
+    """Forward compatibility (ISSUE 15 satellite): a NEWER peer's
+    envelope may carry unknown JSON header keys (the trace context) and
+    extra byte sections declared under ``ext`` — an un-upgraded decoder
+    must SKIP them (bit-exact KV either way), never raise WireError.
+    Only an UNDECLARED length mismatch still rejects (true
+    corruption)."""
+    env = _envelope()
+    env.trace = {"trace_id": "tr-9", "span_id": "s42"}
+    blob = wire.encode_envelope(env)
+    header, body = wire.unpack_blob(blob)
+    assert header["trace"] == {"trace_id": "tr-9", "span_id": "s42"}
+    # a future peer appends two optional sections it declares
+    future = dict(header)
+    future["ext"] = [["qos_hints", 7], ["embedding", 16]]
+    future["totally_unknown_key"] = {"nested": [1, 2, 3]}
+    future_blob = wire.pack_blob(future, bytes(body), b"\x01" * 7,
+                                 b"\x02" * 16)
+    out = wire.decode_envelope(future_blob,
+                               expect_signature=env.signature)
+    np.testing.assert_array_equal(out.entry.k, env.entry.k)
+    np.testing.assert_array_equal(out.entry.v, env.entry.v)
+    assert out.trace == env.trace
+    # truncated ext section: declared 16 bytes, only 3 present
+    torn = wire.pack_blob(future, bytes(body), b"\x01" * 7, b"\x02" * 3)
+    with pytest.raises(WireError) as ei:
+        wire.decode_envelope(torn)
+    assert ei.value.reason == "truncated"
+    # malformed ext declaration is a structured decode reject
+    bad = dict(header)
+    bad["ext"] = [["oops"]]
+    with pytest.raises(WireError) as ei:
+        wire.decode_envelope(wire.pack_blob(bad, bytes(body)))
+    assert ei.value.reason == "decode"
+    # an UNDECLARED trailing section is still corruption
+    with pytest.raises(WireError) as ei:
+        wire.decode_envelope(wire.pack_blob(dict(header), bytes(body),
+                                            b"\x03" * 5))
+    assert ei.value.reason == "truncated"
+
+
+def test_mixed_version_loopback_pair_interops():
+    """Property test, mixed-version pair over the loopback codec: a
+    trace-carrying request (new sender) served by a handler that has
+    never heard of tracing (old peer reads only the fields it knows),
+    and an old-style request (no trace key at all) parsed by the NEW
+    request codec — both directions parse clean."""
+    got = {}
+
+    def old_peer(msg_type, payload):
+        d = wire.decode_json(payload)
+        got["keys"] = sorted(d)
+        # an "old" peer builds its request from known fields only
+        r = wire.request_from_dict({k: v for k, v in d.items()
+                                    if k != "trace"})
+        assert r.trace is None
+        return wire.MSG_OK, wire.encode_json({"ok": True})
+
+    t = LoopbackTransport(old_peer, "old-peer", retries=0)
+    new_req = {"model_spec": "xla:tiny",
+               "messages": [{"role": "user", "content": "hi"}],
+               "trace": {"trace_id": "tr-1", "span_id": "s1"},
+               "future_field": [1, 2, 3]}
+    rtype, _ = t.request(wire.MSG_SERVE, wire.encode_json(new_req))
+    assert rtype == wire.MSG_OK and "trace" in got["keys"]
+    # old request (no trace) through the NEW codec: trace stays None,
+    # and a malformed trace value is dropped, not raised
+    r = wire.request_from_dict({"model_spec": "xla:tiny",
+                                "messages": []})
+    assert r.trace is None
+    r = wire.request_from_dict({"model_spec": "xla:tiny",
+                                "messages": [], "trace": "garbage"})
+    assert r.trace is None
+
+
 def test_envelope_signature_checked_before_kv_bytes():
     """A mismatched signature must reject from the HEADER — even when
     the KV body is truncated garbage that could never parse."""

@@ -1,0 +1,446 @@
+"""Fleet-scope observability (infra/fleetobs.py, ISSUE 15).
+
+The tentpole's acceptance bar:
+
+  * a session served across two loopback wire peers yields ONE
+    contiguous timeline (single trace id) whose stage durations sum to
+    the door-observed end-to-end latency, with handoff wire time
+    attributed — and one real-TCP case;
+  * histogram ``merge()`` / the federation rollup's quantiles equal a
+    hand-computed oracle (one histogram fed every peer's stream);
+  * incident bundles are COMPLETE under a chaos ``fabric.send`` drop:
+    the door's dump plus every surviving peer's dump land under one
+    deterministic incident id;
+  * span-ring overflow is counted (``quoracle_trace_dropped_total``),
+    the ring size is configurable, decode-tick spans are sampled;
+  * temp-0 bits are identical with tracing on vs off.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from quoracle_tpu.infra import fleetobs
+from quoracle_tpu.infra.fleetobs import (
+    IncidentManager, SpanRing, TraceContext, assemble_timeline, federate,
+)
+from quoracle_tpu.infra.telemetry import (
+    TRACE_DROPPED_TOTAL, TRACER, Histogram, MetricsRegistry,
+)
+from quoracle_tpu.models.runtime import QueryRequest
+from quoracle_tpu.serving.fabric.frontdoor import FabricPlane
+from quoracle_tpu.serving.fabric.peer import FabricPeer
+from quoracle_tpu.serving.fabric.transport import LoopbackTransport
+
+pytestmark = pytest.mark.fabric
+
+MEMBER = "xla:tiny"
+MSGS = [{"role": "user", "content": "hello fleet observability, "
+                                    "please elaborate at length"}]
+
+
+def req(sid=None, max_tokens=16, content=None):
+    msgs = MSGS if content is None else [{"role": "user",
+                                          "content": content}]
+    return QueryRequest(MEMBER, msgs, temperature=0.0,
+                        max_tokens=max_tokens, session_id=sid)
+
+
+def _remote(peer, **kw):
+    from quoracle_tpu.serving.cluster import RemoteReplica
+    return RemoteReplica(LoopbackTransport(peer.handle,
+                                           peer.replica_id, **kw))
+
+
+# ---------------------------------------------------------------------------
+# Unit layer: context, ring, sampling, merge, federation, incidents
+# ---------------------------------------------------------------------------
+
+def test_trace_context_is_a_valid_parent_and_survives_garbage():
+    ctx = TraceContext(trace_id="tr-x", span_id="s-x")
+    assert TraceContext.from_dict(ctx.to_dict()) == ctx
+    span = TRACER.start("child", parent=ctx)
+    assert span.trace_id == "tr-x" and span.parent_id == "s-x"
+    for garbage in (None, "str", 7, {}, {"trace_id": ""},
+                    {"trace_id": "t"}, {"span_id": "s"},
+                    {"trace_id": 3, "span_id": "s"}):
+        assert TraceContext.from_dict(garbage) is None
+
+
+def test_span_ring_overflow_counted_not_silent():
+    ring = SpanRing(capacity=16, ring_label="fleetobs")
+    before = TRACE_DROPPED_TOTAL.value(ring="fleetobs")
+    for i in range(21):
+        ring.record({"span_id": f"s{i}", "name": "x", "ts": float(i)})
+    assert ring.stats()["n_spans"] == 16
+    assert ring.stats()["dropped"] == 5
+    assert TRACE_DROPPED_TOTAL.value(ring="fleetobs") == before + 5
+
+
+def test_ring_size_and_tick_sampling_knobs(monkeypatch):
+    monkeypatch.setenv("QUORACLE_TRACE_RING", "64")
+    assert fleetobs.ring_capacity() == 64
+    assert SpanRing().capacity == 64
+    from quoracle_tpu.infra.bus import EventBus
+    from quoracle_tpu.infra.event_history import EventHistory
+    h = EventHistory(EventBus())
+    assert h.max_trace_spans == 64
+    h.close()
+    monkeypatch.setenv("QUORACLE_TRACE_DECODE_SAMPLE", "4")
+    assert fleetobs.decode_tick_sample() == 4
+    assert [fleetobs.sample_tick(i) for i in range(8)] == [
+        True, False, False, False, True, False, False, False]
+    monkeypatch.setenv("QUORACLE_TRACE_DECODE_SAMPLE", "garbage")
+    assert fleetobs.decode_tick_sample() == \
+        fleetobs.DEFAULT_DECODE_TICK_SAMPLE
+
+
+def test_history_trace_ring_counts_drops():
+    from quoracle_tpu.infra.bus import TOPIC_TRACE, EventBus
+    from quoracle_tpu.infra.event_history import EventHistory
+    bus = EventBus()
+    h = EventHistory(bus, max_trace_spans=8)
+    before = TRACE_DROPPED_TOTAL.value(ring="history")
+    for i in range(11):
+        bus.broadcast(TOPIC_TRACE, {"span_id": f"s{i}", "ts": float(i)})
+    assert len(h.replay_traces()) == 8
+    assert TRACE_DROPPED_TOTAL.value(ring="history") == before + 3
+    h.close()
+
+
+def test_histogram_merge_matches_hand_computed_oracle():
+    rng = np.random.default_rng(5)
+    a = rng.uniform(0.2, 4000.0, 700)
+    b = rng.uniform(0.1, 9000.0, 400)
+    h1, h2 = Histogram("m1"), Histogram("m1")
+    oracle = Histogram("m1")
+    for v in a:
+        h1.observe(float(v), model="t")
+        oracle.observe(float(v), model="t")
+    for v in b:
+        h2.observe(float(v), model="t")
+        oracle.observe(float(v), model="t")
+    h1.merge(h2)
+    assert h1.percentiles() == oracle.percentiles()
+    counts, s, n = h1.counts()
+    ocounts, os_, on = oracle.counts()
+    assert counts == ocounts and n == on and abs(s - os_) < 1e-6
+    # mismatched boundaries refuse loudly — never a lossy re-bucket
+    skewed = Histogram("m1", buckets=(1.0, 10.0, 100.0))
+    with pytest.raises(ValueError):
+        h1.merge(skewed)
+
+
+def test_federation_rollup_quantiles_equal_merged_oracle():
+    rng = np.random.default_rng(9)
+    streams = {"peer-a": rng.uniform(0.5, 800.0, 300),
+               "peer-b": rng.uniform(0.5, 6000.0, 500),
+               "peer-c": rng.uniform(20.0, 90.0, 150)}
+    oracle = Histogram("quoracle_test_fed_ms")
+    states = {}
+    for peer, vals in streams.items():
+        reg = MetricsRegistry()
+        h = reg.histogram("quoracle_test_fed_ms")
+        c = reg.counter("quoracle_test_fed_total")
+        reg.gauge("quoracle_test_fed_gauge").set(2.5, dev="0")
+        for v in vals:
+            h.observe(float(v), model="t")
+            oracle.observe(float(v), model="t")
+        c.inc(len(vals), model="t")
+        states[peer] = reg.export_state()
+    fed = federate(states)
+    assert fed.quantiles("quoracle_test_fed_ms") == oracle.percentiles()
+    # per-label-set fleet cell equals the oracle cell too
+    assert fed.quantiles("quoracle_test_fed_ms", model="t") == \
+        oracle.percentiles(model="t")
+    snap = fed.snapshot()["quoracle_test_fed_total"]
+    assert snap["total"] == sum(len(v) for v in streams.values())
+    text = fed.render_prometheus()
+    assert 'peer="peer-a"' in text and 'peer="fleet"' in text
+    assert 'quoracle_test_fed_gauge{dev="0",peer="peer-b"} 2.5' in text
+    # round-trip: the state is JSON-able (it crosses the wire)
+    json.dumps(states)
+    # a malformed peer series is skipped and named, not fatal
+    states["peer-bad"] = {"quoracle_test_fed_ms": {
+        "kind": "histogram", "buckets": [1, 2], "series": [[[], {}]]}}
+    fed2 = federate(states)
+    assert any("peer-bad" in s for s in fed2.skipped)
+
+
+def test_incident_ids_deterministic_and_retention_pruned(tmp_path):
+    m1 = IncidentManager(directory=str(tmp_path / "a"), retention=3)
+    m2 = IncidentManager(directory=str(tmp_path / "b"), retention=3)
+    ids1 = [m1.capture("replica_dead", "decode-0", broadcast=False)
+            for _ in range(2)]
+    ids1.append(m1.capture("watchdog", "batcher", broadcast=False))
+    ids2 = [m2.capture("replica_dead", "decode-0", broadcast=False)
+            for _ in range(2)]
+    ids2.append(m2.capture("watchdog", "batcher", broadcast=False))
+    # same (kind, key, occurrence) sequence -> same ids, no wall clock
+    assert ids1 == ids2
+    assert len(set(ids1)) == 3            # occurrences disambiguate
+    listed = m1.list()
+    assert {b["incident_id"] for b in listed} == set(ids1)
+    for b in listed:
+        assert any(f.startswith("local-") for f in b["files"])
+    # a peer dump joins an existing bundle
+    assert m1.peer_dump(ids1[0], "decode-1") is not None
+    bundle = [b for b in m1.list() if b["incident_id"] == ids1[0]][0]
+    assert "peer-decode-1.json" in bundle["files"]
+    # retention: 3 newest kept
+    for i in range(5):
+        m1.capture("manual", f"k{i}", broadcast=False)
+    assert len(m1.list()) == 3
+
+
+def test_timeline_attribution_sums_to_total_exactly():
+    spans = [
+        {"span_id": "s1", "name": "door.request", "trace_id": "tr",
+         "ts": 100.0, "duration_ms": 100.0, "session": "sess"},
+        {"span_id": "s2", "name": "door.prefill_rpc", "trace_id": "tr",
+         "ts": 100.001, "duration_ms": 40.0, "session": "sess"},
+        {"span_id": "s3", "name": "peer.prefill", "trace_id": "tr",
+         "ts": 100.002, "duration_ms": 30.0, "session": "sess"},
+        {"span_id": "s4", "name": "kv.export", "trace_id": "tr",
+         "ts": 100.025, "duration_ms": 5.0, "session": "sess"},
+        {"span_id": "s5", "name": "peer.decode", "trace_id": "tr",
+         "ts": 100.045, "duration_ms": 50.0, "session": "sess"},
+        {"span_id": "s6", "name": "kv.adopt", "trace_id": "tr",
+         "ts": 100.046, "duration_ms": 6.0, "session": "sess"},
+        {"span_id": "s7", "name": "sched.queue_wait", "trace_id": "tr",
+         "ts": 100.052, "duration_ms": 4.0, "session": "sess"},
+        # duplicates (loopback peers share a ring) must dedup
+        {"span_id": "s7", "name": "sched.queue_wait", "trace_id": "tr",
+         "ts": 100.052, "duration_ms": 4.0, "session": "sess"},
+        # other sessions are filtered out
+        {"span_id": "s8", "name": "door.request", "trace_id": "tr2",
+         "ts": 100.0, "duration_ms": 999.0, "session": "other"},
+    ]
+    tl = assemble_timeline(spans, session_id="sess")
+    assert tl["contiguous"] and tl["trace_ids"] == ["tr"]
+    assert tl["n_spans"] == 7
+    assert tl["total_ms"] == 100.0
+    st = tl["stages"]
+    assert st["prefill"] == 25.0          # peer.prefill - kv.export
+    assert st["kv_export"] == 5.0
+    assert st["wire"] == 20.0             # total - both peer legs
+    assert st["kv_adopt"] == 6.0
+    assert st["queue_wait"] == 4.0
+    assert st["decode"] == 40.0           # peer.decode - adopt - queue
+    assert tl["stages_sum_ms"] == tl["total_ms"]
+
+
+# ---------------------------------------------------------------------------
+# The acceptance gate: one session across two loopback wire peers
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fabric():
+    peers = [FabricPeer.build([MEMBER], role="prefill",
+                              replica_id="prefill-0",
+                              continuous_chunk=8),
+             FabricPeer.build([MEMBER], role="decode",
+                              replica_id="decode-0",
+                              continuous_chunk=8)]
+    plane = FabricPlane([_remote(p) for p in peers])
+    yield plane, peers
+    plane.close()
+    for p in peers:
+        p.close()
+
+
+def test_session_over_two_wire_peers_is_one_contiguous_timeline(fabric):
+    plane, _ = fabric
+    fleetobs.SPANS.clear()
+    sid = "obs-sess-1"
+    t0 = time.monotonic()
+    out = plane.query([req(sid=sid)])
+    observed_ms = (time.monotonic() - t0) * 1000
+    assert out[0].ok, out[0].error
+    tl = plane.pull_timeline(session_id=sid)
+    # ONE trace across door + both peers — the propagation tentpole
+    assert tl["contiguous"], tl["trace_ids"]
+    names = {s["name"] for s in tl["spans"]}
+    assert {"door.request", "door.prefill_rpc", "door.decode_rpc",
+            "peer.prefill", "peer.decode", "kv.export",
+            "kv.adopt"} <= names
+    # every span agrees on the trace id and carries the session
+    tid = tl["trace_ids"][0]
+    assert all(s["trace_id"] == tid for s in tl["spans"])
+    # the decomposition covers the door-observed end-to-end wall: the
+    # stages sum to the door.request span by construction, and that
+    # span is the observed latency minus only the plane's thread-hop
+    assert tl["stages_sum_ms"] == tl["total_ms"] > 0
+    assert tl["total_ms"] <= observed_ms + 1.0
+    assert tl["total_ms"] >= 0.5 * observed_ms
+    st = tl["stages"]
+    # handoff wire time attributed: both RPC legs cost more than the
+    # peer-side work they carried
+    assert st["wire"] > 0
+    assert st["prefill"] > 0 and st["decode"] > 0
+    # ordered: spans sorted by start time
+    ts = [s["ts"] for s in tl["spans"]]
+    assert ts == sorted(ts)
+    plane.query([QueryRequest(MEMBER, MSGS, temperature=0.0,
+                              max_tokens=4)])  # sessionless also clean
+
+
+def test_temp0_bits_identical_tracing_on_vs_off(fabric):
+    plane, _ = fabric
+    # OFF: detach the span ring (the only sink this test controls)
+    TRACER.remove_sink(fleetobs.SPANS.record)
+    try:
+        off = plane.query([req(sid=None, content="trace equality probe")])
+    finally:
+        TRACER.add_sink(fleetobs.SPANS.record)
+    on = plane.query([req(sid=None, content="trace equality probe")])
+    assert off[0].ok and on[0].ok
+    assert off[0].text == on[0].text      # bit-identical at temp 0
+
+
+def test_obs_wire_ops_serve_spans_and_metrics(fabric):
+    plane, peers = fabric
+    fleetobs.SPANS.clear()
+    sid = "obs-sess-2"
+    assert plane.query([req(sid=sid)])[0].ok
+    # the raw wire op: every peer serves its slice by session
+    rep = plane.peers[1]
+    spans = rep.pull_spans(session_id=sid)
+    assert spans and all(s.get("session") == sid for s in spans)
+    # metrics op: lossless state + rollup scalars
+    out = rep.obs_metrics()
+    assert "quoracle_sched_rows_total" in out["state"]
+    assert out["tokens_total"] >= 0
+    # federation at the door: peer-labeled series + fleet aggregates,
+    # quantiles equal to re-merging the scraped states by hand
+    fed = plane.federated_metrics(max_age_s=0.0)
+    text = fed.render_prometheus()
+    assert 'peer="decode-0"' in text and 'peer="fleet"' in text
+    states = {p.replica_id: p.obs_metrics()["state"]
+              for p in plane.peers}
+    oracle = federate(states)
+    got = fed.quantiles("quoracle_sched_admit_wait_ms")
+    want = oracle.quantiles("quoracle_sched_admit_wait_ms")
+    # the door's own series ride the sweep too (peer="door" — in this
+    # one-process fabric the same registry again), so count totals
+    # differ by a constant factor: quantiles are scale-invariant up to
+    # interpolation ulps (the EXACT merge oracle is the synthetic-
+    # registry test above, where the state sets are identical)
+    import math
+    assert got.keys() == want.keys()
+    for p, v in got.items():
+        assert (v is None and want[p] is None) or \
+            math.isclose(v, want[p], rel_tol=1e-6), (p, v, want[p])
+    # the cached sweep is served inside max_age_s
+    assert plane.federated_metrics(max_age_s=60.0) is \
+        plane.federated_metrics(max_age_s=60.0)
+
+
+def test_timeline_over_real_tcp(fabric_unused=None):
+    peer = FabricPeer.build([MEMBER], role="unified",
+                            replica_id="tcp-peer-0",
+                            continuous_chunk=8)
+    server = peer.listen("127.0.0.1", 0)
+    plane = None
+    try:
+        plane = FabricPlane.connect([f"unified@{server.addr}"])
+        fleetobs.SPANS.clear()
+        sid = "obs-tcp-1"
+        assert plane.query([req(sid=sid)])[0].ok
+        tl = plane.pull_timeline(session_id=sid)
+        assert tl["contiguous"] and tl["n_spans"] >= 2
+        names = {s["name"] for s in tl["spans"]}
+        assert "door.request" in names and "peer.serve" in names
+        assert tl["stages"].get("serve", 0) > 0
+    finally:
+        if plane is not None:
+            plane.close()
+        peer.close()
+
+
+# ---------------------------------------------------------------------------
+# Correlated incident capture under chaos
+# ---------------------------------------------------------------------------
+
+def test_incident_bundle_complete_under_fabric_send_drop(
+        monkeypatch, tmp_path):
+    from quoracle_tpu.chaos.faults import CHAOS, FaultPlan, FaultRule
+    monkeypatch.setenv("QUORACLE_INCIDENT_DIR", str(tmp_path))
+    peers = [FabricPeer.build([MEMBER], role="prefill",
+                              replica_id="prefill-0",
+                              continuous_chunk=8),
+             FabricPeer.build([MEMBER], role="decode",
+                              replica_id="decode-0",
+                              continuous_chunk=8),
+             FabricPeer.build([MEMBER], role="decode",
+                              replica_id="decode-1",
+                              continuous_chunk=8)]
+    plane = FabricPlane([_remote(p, retries=1, backoff_ms=1.0)
+                         for p in peers])
+    try:
+        # decode-0's link drops EVERY attempt: the leg exhausts retries,
+        # the door marks it failed, re-places onto decode-1 — and the
+        # death opens a correlated incident
+        plan = FaultPlan(3, [FaultRule("fabric.send", "drop",
+                                       max_fires=1 << 30,
+                                       match={"replica": "decode-0"})])
+        with CHAOS.arming(plan):
+            # each placement scores decode-0's signals and finds the
+            # link silent; after SILENT_SIGNALS_LIMIT polls the router
+            # marks it FAILED — the death that opens the incident.
+            # Traffic keeps landing on the survivor throughout.
+            outs = [plane.query([req(sid=f"inc-sess-{i}")])[0]
+                    for i in range(4)]
+        assert all(o.ok for o in outs), [o.error for o in outs]
+        dead = [p for p in plane.peers if not p.alive]
+        assert [p.replica_id for p in dead] == ["decode-0"]
+        incidents = fleetobs.INCIDENTS.list()
+        mine = [b for b in incidents
+                if b.get("kind") == "replica_dead"
+                and b.get("key") == "decode-0"]
+        assert mine, incidents
+        bundle = mine[0]
+        # COMPLETE: the door's own dump plus every reachable peer's
+        # dump landed under the one deterministic incident id
+        assert any(f.startswith("local-") for f in bundle["files"])
+        assert "peer-prefill-0.json" in bundle["files"]
+        assert "peer-decode-1.json" in bundle["files"]
+        assert "peer-decode-0.json" not in bundle["files"]
+        # each dump is a real flight-ring artifact
+        with open(os.path.join(bundle["path"],
+                               "peer-decode-1.json")) as f:
+            dump = json.load(f)
+        assert dump["n_events"] >= 1
+        assert any(e.get("kind") == "incident_open"
+                   for e in dump["events"])
+    finally:
+        plane.close()
+        for p in peers:
+            p.close()
+
+
+def test_registries_and_surfaces():
+    """New instruments / flight events / wire op / lockdep ranks are
+    registered coherently (the qlint contract rides tier-1 separately;
+    this is the direct check)."""
+    from quoracle_tpu.analysis.lockdep import RANKS
+    from quoracle_tpu.infra.flightrec import FLIGHT_EVENTS
+    from quoracle_tpu.infra.telemetry import METRICS
+    from quoracle_tpu.serving.fabric import wire
+    for name in ("quoracle_trace_dropped_total",
+                 "quoracle_fleetobs_scrape_ms",
+                 "quoracle_fleetobs_peers",
+                 "quoracle_fleetobs_staleness_s",
+                 "quoracle_fleetobs_slo_burn",
+                 "quoracle_fleetobs_goodput_tokens_per_s",
+                 "quoracle_incidents_total"):
+        assert name in METRICS.snapshot(), name
+    assert "incident_open" in FLIGHT_EVENTS
+    assert "incident_dump" in FLIGHT_EVENTS
+    assert wire.op_name(wire.MSG_OBS) == "obs"
+    assert RANKS["fleetobs.spans"] < RANKS["flight"]
+    assert RANKS["fleetobs.incidents"] < RANKS["flight"]
+    assert RANKS["tracer.sinks"] < RANKS["fleetobs.spans"]
