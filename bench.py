@@ -615,15 +615,18 @@ def measure_qos_overload(backend, pool, overload_x: int = 4,
     )
     from quoracle_tpu.serving.qos import Priority, QoSConfig
 
+    from quoracle_tpu.sim.workload import bench_overload_mix
+
     member = pool[0]
     tok = get_tokenizer(member)
-    batch_prompt = tok.encode(
-        "background agent subtree task: " + max(TASKS, key=len),
-        add_bos=True)
-    inter_prompts = [
-        tok.encode(f"[user turn {i}] {TASKS[i % len(TASKS)]}",
-                   add_bos=True)
-        for i in range(n_interactive)]
+    # prompt mix sourced from the fleet simulator (ISSUE 16): the
+    # interactive/batch texts come off a seeded workload trace, so the
+    # overload phases replay the same mix every run and the sidecar
+    # records which trace drove them
+    mix = bench_overload_mix(TASKS, n_interactive)
+    batch_prompt = tok.encode(mix["batch_text"], add_bos=True)
+    inter_prompts = [tok.encode(t, add_bos=True)
+                     for t in mix["interactive_texts"]]
     slots = 8
 
     def build(qos_on: bool) -> TPUBackend:
@@ -754,6 +757,7 @@ def measure_qos_overload(backend, pool, overload_x: int = 4,
     total_on = on["batch_retired"] + on["batch_shed"] + on["batch_failed"]
     return {
         "overload_x": overload_x,
+        "sim_trace_digest": mix["trace"].digest(),
         "unloaded_interactive_p50_ms": round(unloaded_p50, 1),
         "qos_off": off,
         "qos_on": on,
@@ -1784,14 +1788,15 @@ def measure_fleet(pool, n_interactive: int = 6, n_sessions: int = 3,
     )
     from quoracle_tpu.serving.qos import Priority
 
+    from quoracle_tpu.sim.workload import bench_fleet_mix
+
     member = pool[0]
-    inter_msgs = [[{"role": "user",
-                    "content": f"[user {i}] {TASKS[i % len(TASKS)][:48]}"}]
-                  for i in range(n_interactive)]
-    sess_msgs = [[{"role": "user",
-                   "content": f"[agent {i}] working state: "
-                              + " ".join(TASKS)[:384]}]
-                 for i in range(n_sessions)]
+    # traffic sourced from the fleet simulator (ISSUE 16): the
+    # interactive/session message mixes come off seeded workload
+    # traces — same texts every run, trace digests in the result
+    mix = bench_fleet_mix(TASKS, n_interactive, n_sessions, seed=seed)
+    inter_msgs = mix["inter_msgs"]
+    sess_msgs = mix["sess_msgs"]
 
     def burn_signals(cluster):
         return FleetSignals(replicas=tuple(
@@ -1908,6 +1913,7 @@ def measure_fleet(pool, n_interactive: int = 6, n_sessions: int = 3,
         "n_interactive": n_interactive,
         "n_sessions": n_sessions,
         "seed": seed,
+        "sim_trace_digests": [t.digest() for t in mix["traces"]],
         "goodput_tok_s_static": static["goodput_tok_s"],
         "goodput_tok_s_elastic": elastic["goodput_tok_s"],
         "goodput_delta_frac": round(
@@ -2087,6 +2093,58 @@ def measure_fleetobs(pool, n_rows: int = 6) -> dict:
         except OSError as e:
             log(f"config21 sidecar write failed: {e}")
     return result
+
+
+def measure_sim(seed: int = 2026) -> dict:
+    """Config 22: the fleet simulator as a benchmark (ISSUE 16).
+
+    Phases source from the simulator's canonical workload catalog
+    (sim/workload.py) instead of hand-rolled loops: each canonical
+    trace (diurnal mix, burst storm, agent tree, long-tail ladder) is
+    generated from ``seed`` and replayed TWICE through the invariant
+    gate at compressed time — the engine-sampled scenarios spot-check a
+    sampled subset through a real mock-device ClusterPlane at
+    temperature 0. Reported: replay throughput (events per wall
+    second) and compression factor per trace, outcome mixes, the
+    long-tail tier census, ledger digests (the determinism witness —
+    compare across revisions on the same seed), and the gate verdicts,
+    which must all pass. Smoke runs scale the long-tail population to
+    10k sessions; live runs replay the full 100k. Detail lands in the
+    SIM sidecar (QUORACLE_BENCH_SIM)."""
+    from quoracle_tpu.sim.gate import SIM_SCENARIOS, run_sim_scenario
+
+    smoke = MAX_NEW <= 16
+    out: dict = {"seed": seed, "smoke": smoke, "scenarios": {}}
+    events_total = 0
+    wall_total = 0.0
+    for name in SIM_SCENARIOS:
+        scale = (0.1 if smoke and name == "longtail_ladder" else None)
+        rep = run_sim_scenario(name, seed=seed, scale=scale)
+        ev = rep.evidence
+        out["scenarios"][name] = {
+            "passed": rep.passed,
+            "events": ev["trace"]["events"],
+            "sessions": ev["trace"]["sessions"],
+            "ledger_digest": ev["ledger"],
+            "outcomes": ev["outcomes"],
+            "census": ev["census"],
+            "samples": ev["samples"],
+            "invariants": {r.name: r.ok for r in rep.invariants},
+            "wall_s": rep.wall_s,
+        }
+        # two replays per scenario: both count toward throughput
+        events_total += 2 * ev["trace"]["events"]
+        wall_total += rep.wall_s
+    out["events_total"] = events_total
+    out["events_per_s"] = round(events_total / max(1e-9, wall_total), 1)
+    out["wall_s"] = round(wall_total, 2)
+    out["longtail_sessions"] = \
+        out["scenarios"]["longtail_ladder"]["census"]["seen"]
+    out["all_passed"] = all(s["passed"]
+                            for s in out["scenarios"].values())
+    assert out["all_passed"], \
+        f"config22: sim gate failed: {out['scenarios']}"
+    return out
 
 
 def measure_quality_overhead(backend, pool,
@@ -2927,6 +2985,22 @@ def _run(args, payload: dict, deadline_at: float) -> None:
     if cfg21:
         log(f"config21: {cfg21}")
 
+    # config 22 is device-light by design (the fleet simulator replays
+    # its canonical traces on a tiny mock-device plane): it sources its
+    # phases from sim/workload.py instead of hand-rolled loops
+    cfg22 = guard("config22", lambda: measure_sim())
+    if cfg22:
+        log(f"config22: {cfg22}")
+        sidecar = os.environ.get("QUORACLE_BENCH_SIM")
+        if sidecar:
+            try:
+                with open(sidecar, "w") as f:
+                    json.dump({"metric": "sim",
+                               "config22": cfg22}, f, indent=1)
+                log(f"config22 sim detail written to {sidecar}")
+            except OSError as e:
+                log(f"config22 sidecar write failed: {e}")
+
     # config 19 builds its own backends (quantized vs not must not share
     # engines — the whole point is two independent numeric regimes)
     cfg19 = guard("config19", lambda: measure_quant(pool))
@@ -3265,6 +3339,16 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             "config21_federation_quantiles_equal_oracle":
                 cfg21["federation_quantiles_equal_oracle"],
             "config21_temp0_equal": cfg21["temp0_equal"],
+        })
+    if cfg22:
+        payload.update({
+            "config22_all_passed": cfg22["all_passed"],
+            "config22_events_total": cfg22["events_total"],
+            "config22_events_per_s": cfg22["events_per_s"],
+            "config22_longtail_sessions": cfg22["longtail_sessions"],
+            "config22_ledger_digests": {
+                name: s["ledger_digest"]
+                for name, s in cfg22["scenarios"].items()},
         })
     if cfg10:
         payload.update({

@@ -52,9 +52,14 @@ from typing import Any, Optional
 # ---------------------------------------------------------------------------
 
 HIERARCHY: tuple = (
-    # -- cluster plane (outermost — the router sits in FRONT of every
-    #    replica's batcher, so its locks must release before any
-    #    replica-internal lock is taken) -------------------------------
+    # -- fleet simulator (outermost of all — the replay driver's status
+    #    board is pure bookkeeping, but an engine-sampled replay calls
+    #    straight into ClusterPlane.query, so the sim lock must release
+    #    before any serving lock is taken) -----------------------------
+    ("sim.replay",      3, False),  # sim/replay.py SIM status board
+    # -- cluster plane (outermost serving lock — the router sits in
+    #    FRONT of every replica's batcher, so its locks must release
+    #    before any replica-internal lock is taken) ---------------------
     ("cluster.plane",   4, False),  # ClusterPlane replica table / seq
     ("fleet",           5, False),  # FleetController ledger + policy
                                     # state (ISSUE 14): decisions read

@@ -73,6 +73,19 @@ def _result(name: str, ok: bool, detail: str = "") -> InvariantResult:
     return InvariantResult(name=name, ok=bool(ok), detail=detail)
 
 
+def conservation(name: str, total: int, parts: dict) -> InvariantResult:
+    """Shared conservation law: ``total`` equals the sum of ``parts``
+    with nothing unaccounted. The chaos no-silent-loss check and the
+    sim gate's hibernation-tier census (sim/gate.py) are both
+    instances of this shape — a population must be fully partitioned
+    into named buckets."""
+    s = sum(parts.values())
+    return _result(
+        name, s == total,
+        f"total={total} sum={s} parts=" + ",".join(
+            f"{k}:{v}" for k, v in sorted(parts.items())))
+
+
 def classify(result) -> str:
     """ok | shed | failed for one QueryResult-shaped object."""
     if result is None:

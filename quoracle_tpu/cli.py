@@ -90,7 +90,9 @@ async def cmd_run(args: argparse.Namespace) -> int:
                                quantize_kv=args.quantize_kv,
                                fleet_min=args.fleet_min,
                                fleet_max=args.fleet_max,
-                               fleet_tick_s=args.fleet_tick_s))
+                               fleet_tick_s=args.fleet_tick_s,
+                               sim_trace=args.sim_trace,
+                               sim_seed=args.sim_seed))
     _attach_printer(rt)
     if pool is None and args.profile is None:
         pool = rt.default_pool()
@@ -134,7 +136,9 @@ async def cmd_resume(args: argparse.Namespace) -> int:
                                quantize_kv=args.quantize_kv,
                                fleet_min=args.fleet_min,
                                fleet_max=args.fleet_max,
-                               fleet_tick_s=args.fleet_tick_s))
+                               fleet_tick_s=args.fleet_tick_s,
+                               sim_trace=args.sim_trace,
+                               sim_seed=args.sim_seed))
     _attach_printer(rt)
     result = await rt.boot()
     print(json.dumps(result), flush=True)
@@ -171,7 +175,8 @@ async def cmd_serve(args: argparse.Namespace) -> int:
         quantize_weights=args.quantize_weights,
         quantize_kv=args.quantize_kv,
         fleet_min=args.fleet_min, fleet_max=args.fleet_max,
-        fleet_tick_s=args.fleet_tick_s))
+        fleet_tick_s=args.fleet_tick_s,
+        sim_trace=args.sim_trace, sim_seed=args.sim_seed))
     # Validate host/token BEFORE boot so a refused bind exits with a clean
     # message instead of a traceback over a half-started runtime.
     try:
@@ -343,6 +348,20 @@ def build_parser() -> argparse.ArgumentParser:
                              "[{'point', 'kind', ...}]}) at boot — "
                              "deterministic game-day fault injection "
                              "against a canary; see ARCHITECTURE.md §14")
+        sp.add_argument("--sim-trace", dest="sim_trace", default=None,
+                        metavar="TRACE.json",
+                        help="fleet simulator (quoracle_tpu/sim): "
+                             "replay this serialized workload trace at "
+                             "boot on a shadow thread — compressed "
+                             "virtual time, capacity sized from the "
+                             "live router, forecast priors to the "
+                             "fleet policy's dry-run seam; results on "
+                             "GET /api/sim; see ARCHITECTURE.md §19")
+        sp.add_argument("--sim-seed", dest="sim_seed", default=None,
+                        type=int, metavar="N",
+                        help="fleet simulator: with no --sim-trace, "
+                             "generate and replay the canonical "
+                             "diurnal-mix trace from this seed")
         sp.add_argument("--qos", action="store_true",
                         help="serving QoS (ISSUE 4): weighted-fair "
                              "admission + overload shedding + SLO "

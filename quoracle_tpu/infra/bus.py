@@ -145,6 +145,12 @@ TOPIC_FABRIC = "fabric:events"
 # them live so an open dashboard sees a scale event the moment the
 # controller commits it.
 TOPIC_FLEET = "fleet:events"
+# Fleet simulator (ISSUE 16): end-of-replay summaries (events, ledger
+# digest, outcome counts, tier census, virtual goodput) broadcast by
+# sim/replay.py when a bus is attached — a boot-armed --sim-trace
+# replay surfaces its result on the SSE stream and the EventHistory
+# ring exactly like a chaos report, without polling GET /api/sim.
+TOPIC_SIM = "sim:events"
 
 
 def topic_agent_state(agent_id: str) -> str:

@@ -339,4 +339,14 @@ FLIGHT_EVENTS: dict = {
     # lock discipline (analysis/lockdep.py)
     "lockdep_inversion": "runtime lock-order sanitizer saw an "
                          "acquisition against the declared hierarchy",
+    # fleet simulator (ISSUE 16, sim/replay.py + sim/gate.py)
+    "sim_replay_start": "a trace replay began (mode=compressed|paced, "
+                        "events, trace digest)",
+    "sim_replay_end": "a trace replay finished; carries the ledger "
+                      "digest, outcome counts, and wall seconds",
+    "sim_forecast": "the replay driver offered a next-window "
+                    "traffic-mix prior to the fleet policy "
+                    "(shadow-mode FleetSignals.forecast seam)",
+    "sim_gate": "a sim scenario's workload-invariant verdict "
+                "(name, seed, passed, invariants)",
 }

@@ -1006,6 +1006,40 @@ INCIDENTS_TOTAL = METRICS.counter(
     "chaos_invariant | manual) — each one is a retention-pruned bundle "
     "of every reachable peer's flight-ring dump under one incident id")
 
+# -- fleet simulator (ISSUE 16) ----------------------------------------------
+# Deterministic workload simulator (quoracle_tpu/sim/): per-replay
+# traffic/outcome counters and the modeled-fleet gauges the /telemetry
+# sim panel and GET /api/sim read. Instruments carry MODELED quantities
+# (virtual-clock TTFT, virtual goodput) — they share the registry so
+# one scrape shows real and simulated planes side by side, but nothing
+# here is a chip measurement.
+SIM_EVENTS_TOTAL = METRICS.counter(
+    "quoracle_sim_events_total",
+    "trace events replayed, by workload stream and modeled outcome "
+    "(ok | shed | deadline) — flushed once per replay, not per event")
+SIM_REPLAYS_TOTAL = METRICS.counter(
+    "quoracle_sim_replays_total",
+    "completed trace replays, by mode (compressed | paced) and result")
+SIM_TTFT_MS = METRICS.histogram(
+    "quoracle_sim_ttft_ms",
+    "modeled time-to-first-token (virtual ms: queue wait + tier "
+    "restore + prefill) for admitted events, by class — sampled every "
+    "16th event on large traces",
+    buckets=(1, 5, 20, 50, 100, 250, 500, 1_000, 1_500, 3_000, 6_000,
+             15_000))
+SIM_GOODPUT = METRICS.gauge(
+    "quoracle_sim_goodput_tokens_per_s",
+    "delivered tokens per VIRTUAL second over the last replayed trace")
+SIM_SESSIONS = METRICS.gauge(
+    "quoracle_sim_sessions",
+    "virtual sessions by final ladder tier (resident | host | disk | "
+    "prefixd | dropped) after the last replay — the conservation "
+    "census the sim gate checks")
+SIM_GATE_FAILURES = METRICS.counter(
+    "quoracle_sim_gate_failures_total",
+    "sim scenarios that failed at least one workload invariant, by "
+    "scenario — the acceptance gate's alarm counter")
+
 # -- consensus quality (ISSUE 5) ---------------------------------------------
 # Decision-quality instruments (consensus/quality.py): per-decide
 # contestedness and the per-member scorecard counters. Registered at

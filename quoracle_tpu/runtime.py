@@ -312,6 +312,16 @@ class RuntimeConfig:
     # unquantized path keeps its temp-0 bit-equality gates untouched.
     quantize_weights: bool = False
     quantize_kv: bool = False
+    # Fleet simulator (ISSUE 16, quoracle_tpu/sim/): ``sim_trace`` is a
+    # path to a serialized workload trace replayed at boot on a daemon
+    # thread — compressed virtual time, capacity model sized from the
+    # live router's capacity_hint(), forecast priors offered to the
+    # fleet controller's shadow seam, results on GET /api/sim and
+    # TOPIC_SIM. ``sim_seed`` (with no trace path) regenerates the
+    # canonical diurnal-mix trace from that seed instead. Both None
+    # (the default) = no simulator thread at all.
+    sim_trace: Optional[str] = None
+    sim_seed: Optional[int] = None
 
 
 class Runtime:
@@ -389,6 +399,16 @@ class Runtime:
                 target=self._fleet_loop, name="fleet-ticker",
                 daemon=True)
             self._fleet_thread.start()
+        # Fleet simulator (ISSUE 16): boot-armed shadow replay — a
+        # daemon thread replays the configured (or seeded canonical)
+        # trace at compressed time beside live traffic; model-only, so
+        # it never contends for device work.
+        self._sim_driver = None
+        self._sim_thread: Optional[threading.Thread] = None
+        if config.sim_trace or config.sim_seed is not None:
+            self._sim_thread = threading.Thread(
+                target=self._sim_loop, name="sim-replay", daemon=True)
+            self._sim_thread.start()
         self.token_manager = TokenManager(
             self.backend.count_tokens,
             context_limit_fn=self.backend.context_window)
@@ -647,6 +667,40 @@ class Runtime:
             except Exception:             # noqa: BLE001 — keep ticking
                 logger.exception("fleet tick failed")
 
+    def _sim_loop(self) -> None:
+        """Boot-armed trace replay (ISSUE 16): loads --sim-trace (or
+        generates the canonical diurnal-mix trace from --sim-seed),
+        sizes the capacity model from the live router when the backend
+        is a cluster, and replays at compressed time with forecast
+        priors offered to the fleet controller's shadow seam."""
+        try:
+            from quoracle_tpu.sim.replay import (
+                SIM, CapacityModel, ReplayDriver,
+            )
+            from quoracle_tpu.sim.workload import (
+                Trace, canonical_spec, generate,
+            )
+            if self.config.sim_trace:
+                trace = Trace.from_file(self.config.sim_trace)
+            else:
+                trace = generate(canonical_spec(
+                    "diurnal_mix", seed=self.config.sim_seed or 0))
+            SIM.note_trace(trace.stats())
+            capacity = None
+            router = getattr(self.backend, "router", None)
+            if router is not None:
+                hint = router.capacity_hint()
+                slots = max(2, hint["decode_slots"])
+                capacity = CapacityModel(
+                    decode_slots=slots,
+                    reserved_interactive=max(1, slots // 4))
+            self._sim_driver = ReplayDriver(
+                trace, capacity=capacity, fleet=self._fleet,
+                bus=self.bus)
+            self._sim_driver.run()
+        except Exception:                 # noqa: BLE001 — shadow only
+            logger.exception("sim replay failed")
+
     async def boot(self) -> dict:
         """Boot-time revival of persisted running tasks (reference
         application.ex:71-74 → AgentRevival)."""
@@ -659,6 +713,11 @@ class Runtime:
         self.close()
 
     def close(self) -> None:
+        if self._sim_driver is not None:
+            self._sim_driver.stop()
+        if self._sim_thread is not None:
+            self._sim_thread.join(timeout=5)
+            self._sim_thread = None
         self._fleet_stop.set()
         if self._fleet_thread is not None:
             self._fleet_thread.join(timeout=5)

@@ -104,10 +104,18 @@ class ReplicaSignal:
 class FleetSignals:
     """The complete per-tick policy input. ``slo_burn`` is the
     INTERACTIVE tail-over-target ratio (serving/slo.py ``burn()``):
-    1.0 = exactly at target, >1.0 = burning."""
+    1.0 = exactly at target, >1.0 = burning.
+
+    ``forecast`` is the SHADOW-MODE predictive seam (ISSUE 16): sorted
+    ``(class, events_per_s)`` pairs — a traffic-mix prior for the next
+    window, computed by the fleet simulator's replay driver from the
+    trace ahead of the clock. The policy records it (tick ledger,
+    ``stats()["forecast"]``) but ``_decide`` stays forecast-blind until
+    the predictive policy lands; nothing scales on a prediction yet."""
 
     replicas: tuple
     slo_burn: float = 0.0
+    forecast: Optional[tuple] = None
 
     def tier(self, roles: tuple, serving_only: bool = True) -> list:
         return [r for r in self.replicas
@@ -196,6 +204,8 @@ class FleetController:
         self._mix_streak = 0           # signed: +prefill-starved,
         self._mix_dir = 0              # -decode-starved
         self._spawned = 0              # dry-run scale_up naming
+        self._forecast_ticks = 0       # shadow seam: priors seen
+        self._last_forecast: Optional[tuple] = None
         self.sessions_migrated = 0
         self.sessions_failed = 0
         self.drains = 0
@@ -352,6 +362,10 @@ class FleetController:
         if signals is None:
             signals = self.gather()
         with self._lock:
+            if signals.forecast is not None:
+                # shadow seam: record the prior, decide without it
+                self._forecast_ticks += 1
+                self._last_forecast = signals.forecast
             planned = self._decide(signals)
             if planned is None:
                 FLEET_TICKS_TOTAL.inc(outcome="hold")
@@ -610,6 +624,13 @@ class FleetController:
                 "drains": self.drains,
                 "sessions_migrated": self.sessions_migrated,
                 "sessions_failed": self.sessions_failed,
+                "forecast": {
+                    "shadow": True,
+                    "ticks": self._forecast_ticks,
+                    "last": (dict(self._last_forecast)
+                             if self._last_forecast is not None
+                             else None),
+                },
                 "config": {
                     "min_replicas": cfg.min_replicas,
                     "max_replicas": cfg.max_replicas,

@@ -399,11 +399,20 @@ class DiskPrefixStore:
                 # payload write already happened outside.
                 # qlint: allow[lock-blocking] single rename, not payload I/O
                 os.replace(tmp, path)
+                self._scan_entries += 1
                 try:
                     self._scan_bytes += os.path.getsize(path)
-                    self._scan_entries += 1
                 except OSError:
-                    self._scan_ts = 0.0   # stale; rescan on next stats
+                    pass                  # bytes drift; TTL heal below
+                # TTL healing rescan moved OFF the scrape path (ISSUE
+                # 16): stats() is a pure O(1) snapshot now (a 100k-
+                # session replay scrapes /api/kv concurrently), so any
+                # accounting drift heals here on the spill writer —
+                # which is already doing disk I/O — at most once per
+                # TTL window.
+                if (time.monotonic() - self._scan_ts
+                        > self._SCAN_TTL_S):
+                    self._rescan_locked()
                 if (self.budget_bytes
                         and self._scan_bytes > self.budget_bytes):
                     self._prune_locked()
@@ -481,11 +490,19 @@ class DiskPrefixStore:
             from quoracle_tpu.infra.telemetry import KV_DISK_LOADS_TOTAL
             KV_DISK_LOADS_TOTAL.inc(model=self.model, status="corrupt")
             FLIGHT.record("kv_disk_corrupt", path=path, model=self.model)
+            # exact incremental accounting (ISSUE 16): decrement the
+            # ledger by the unlinked entry instead of invalidating the
+            # whole scan — stats() never pays a rescan for a corrupt
+            # eviction
             try:
+                sz = os.path.getsize(path)
                 os.unlink(path)
             except OSError:
-                pass
-            self._scan_ts = 0.0           # stale; rescan on next stats
+                sz = -1
+            if sz >= 0:
+                with self._lock:
+                    self._scan_entries = max(0, self._scan_entries - 1)
+                    self._scan_bytes = max(0, self._scan_bytes - sz)
             return None
 
     @staticmethod
@@ -511,9 +528,12 @@ class DiskPrefixStore:
             pass
 
     def stats(self) -> dict:
+        # O(1) by contract (ISSUE 16): the entry/byte ledger is
+        # maintained incrementally by save()/load()/prune, and the TTL
+        # healing rescan runs on the save path — a scrape NEVER walks
+        # the directory (tests/test_sim.py bounds this at 100k-entry
+        # scale)
         with self._lock:
-            if time.monotonic() - self._scan_ts > self._SCAN_TTL_S:
-                self._rescan_locked()
             return {"dir": self.dir, "entries": self._scan_entries,
                     "bytes": self._scan_bytes,
                     "budget_bytes": self.budget_bytes,

@@ -369,6 +369,32 @@ class ClusterRouter:
 
     # -- reads -----------------------------------------------------------
 
+    def capacity_hint(self) -> dict:
+        """Whole-fleet capacity in the units the fleet simulator's
+        ``CapacityModel`` speaks (ISSUE 16): alive serving-tier replica
+        count and their summed continuous-batcher decode rows. A
+        ``--sim-trace`` boot replay sizes its modeled fleet from this
+        instead of a hand-picked constant, so a game-day replay models
+        THE cluster it runs beside. Best-effort: an unreachable
+        backend contributes the scheduler default (8 rows)."""
+        decode = prefill = slots = 0
+        for rep in self.replicas():
+            if rep.role == "prefill":
+                prefill += 1
+                continue
+            decode += 1
+            n = 0
+            fn = getattr(rep.backend, "scheduler_stats", None)
+            if callable(fn):
+                try:
+                    for st in (fn() or {}).values():
+                        n += int(st.get("max_slots", 0) or 0)
+                except Exception:         # noqa: BLE001 — silent peer
+                    n = 0
+            slots += n or 8
+        return {"decode_replicas": decode, "prefill_replicas": prefill,
+                "decode_slots": max(1, slots)}
+
     def stats(self) -> dict:
         with self._lock:
             reps = list(self._replicas.values())

@@ -1,0 +1,33 @@
+"""Deterministic fleet-workload simulator (ISSUE 16).
+
+Three parts, one contract:
+
+* :mod:`quoracle_tpu.sim.workload` — a composable, seeded generator of
+  traffic traces (diurnal tenant mixes, burst storms, recursive
+  agent-tree fan-outs, a long-tail population of mostly-hibernated
+  sessions). A trace is a reproducible artifact: pure
+  ``sha256(seed:stream:n)`` draws, no wall clock, no ``random``,
+  serializable to JSON byte-for-byte.
+* :mod:`quoracle_tpu.sim.replay` — a compressed-time replay driver: a
+  virtual clock walks the trace event by event against a deterministic
+  capacity/tier-ladder model (optionally spot-checking a sampled subset
+  through a real ClusterPlane/FabricPlane), recording every outcome
+  into a ledger. Same trace, same ledger — bit-identical.
+* :mod:`quoracle_tpu.sim.gate` — the chaos invariant catalog extended
+  with workload-level postconditions (SLO attainment per class, goodput
+  floor, no-silent-loss over the full ledger, hibernation-tier
+  conservation, temp-0 spot equality), run as tier-1 scenarios.
+
+The simulator is the serving plane's acceptance gate: every later
+policy change (adaptive consensus gating, predictive autoscaling,
+fabric burn-in) replays the same traces and must keep the same
+invariants green.
+"""
+
+from quoracle_tpu.sim.workload import (  # noqa: F401
+    SimEvent, Trace, WorkloadSpec, generate,
+)
+from quoracle_tpu.sim.replay import ReplayDriver, SIM  # noqa: F401
+from quoracle_tpu.sim.gate import (  # noqa: F401
+    SIM_SCENARIOS, run_sim_scenario,
+)

@@ -39,6 +39,11 @@ Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
                             config, tick/cooldown state, the action
                             ledger, drain/migration counters
                             (serving/fleet.py)
+  GET  /api/sim             fleet simulator (ISSUE 16): loaded trace
+                            stats, last replay summary (ledger digest,
+                            outcomes, tier census, virtual goodput),
+                            last gate report, sim counter series
+                            (quoracle_tpu/sim/)
   GET  /api/models          consensus-quality scorecards (ISSUE 5): rolling
                             per-member agreement/dissent/failure-by-kind/
                             recovery rates, proposal latency, drift state
@@ -536,6 +541,24 @@ class DashboardServer:
         }
         return payload
 
+    def sim_payload(self) -> dict:
+        """GET /api/sim: the fleet simulator (ISSUE 16) — loaded trace
+        stats, the last replay's summary (ledger digest, outcome
+        counts, tier census, virtual goodput), the last gate report's
+        invariant verdicts, and the sim counter series. ``enabled``
+        False until a trace is loaded or replayed."""
+        from quoracle_tpu.infra.telemetry import (
+            SIM_EVENTS_TOTAL, SIM_GATE_FAILURES, SIM_REPLAYS_TOTAL,
+        )
+        from quoracle_tpu.sim.replay import SIM
+        payload = SIM.status()
+        payload["counters"] = {
+            "events": SIM_EVENTS_TOTAL._snapshot(),
+            "replays": SIM_REPLAYS_TOTAL._snapshot(),
+            "gate_failures": SIM_GATE_FAILURES._snapshot(),
+        }
+        return payload
+
     def qos_payload(self) -> dict:
         """GET /api/qos: the serving-QoS panel (ISSUE 4) — admission
         controller state (signals, thresholds, tenant buckets), the
@@ -725,7 +748,8 @@ class _Handler(BaseHTTPRequestHandler):
                     d.metrics_payload(), d.resources_payload(),
                     d.qos_payload(), d.models_payload(),
                     d.kv_payload(), d.chaos_payload(),
-                    d.fleet_payload(), d.timeline_payload()))
+                    d.fleet_payload(), d.timeline_payload(),
+                    d.sim_payload()))
             elif parsed.path == "/settings":
                 from quoracle_tpu.web import views
                 self._send_html(views.settings_page(
@@ -768,6 +792,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.chaos_payload())
             elif parsed.path == "/api/fleet":
                 self._send_json(d.fleet_payload())
+            elif parsed.path == "/api/sim":
+                self._send_json(d.sim_payload())
             elif parsed.path == "/api/models":
                 self._send_json(d.models_payload())
             elif parsed.path == "/api/consensus":

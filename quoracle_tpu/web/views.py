@@ -580,6 +580,59 @@ def fleet_panel(fleet: dict) -> str:
     return "".join(parts)
 
 
+def sim_panel(sim: dict) -> str:
+    """Fleet-simulator panel (ISSUE 16): the /api/sim payload as
+    tables — loaded trace stats, the last replay's outcome counts and
+    tier census, and the last gate report's invariant verdicts.
+    Renders nothing until a trace is loaded or replayed."""
+    sim = sim or {}
+    if not sim.get("enabled"):
+        return ""
+    parts = ["<h2 class=\"meta\">fleet simulator</h2>"]
+    trace = sim.get("trace") or {}
+    if trace:
+        parts.append(
+            f"<p class=\"meta\" id=\"sim-trace\">trace "
+            f"{_e(trace.get('digest'))} · events {_e(trace.get('events'))}"
+            f" · sessions {_e(trace.get('sessions'))}"
+            f" · horizon {_e(trace.get('horizon_ms'))}ms"
+            f" · seed {_e(trace.get('seed'))}</p>")
+    replay = sim.get("last_replay") or {}
+    if replay:
+        outcomes = replay.get("outcomes") or {}
+        census = replay.get("census") or {}
+        parts.append(
+            f"<p class=\"meta\" id=\"sim-replay\">replay "
+            f"{_e(replay.get('mode'))} · ledger {_e(replay.get('ledger'))}"
+            f" · ok {_e(outcomes.get('ok'))}"
+            f" · shed {_e(outcomes.get('shed'))}"
+            f" · deadline {_e(outcomes.get('deadline'))}"
+            f" · goodput {_e(replay.get('goodput_tok_s_virtual'))} tok/s"
+            f" · compression ×{_e(replay.get('compression_x'))}</p>")
+        if census:
+            rows = "".join(
+                f"<tr class=\"sim-tier\"><td>{_e(t)}</td>"
+                f"<td>{_e(census.get(t))}</td></tr>"
+                for t in ("resident", "host", "disk", "prefixd",
+                          "dropped", "seen") if t in census)
+            parts.append("<table id=\"sim-census\"><tr><th>tier</th>"
+                         "<th>sessions</th></tr>" + rows + "</table>")
+    report = sim.get("last_report") or {}
+    if report:
+        rows = "".join(
+            f"<tr class=\"sim-invariant\"><td>{_e(r.get('name'))}</td>"
+            f"<td>{'ok' if r.get('ok') else 'FAIL'}</td>"
+            f"<td>{_e((r.get('detail') or '')[:100])}</td></tr>"
+            for r in report.get("invariants") or [])
+        parts.append(
+            f"<p class=\"meta\" id=\"sim-gate\">gate "
+            f"{_e(report.get('name'))} · seed {_e(report.get('seed'))}"
+            f" · {'PASSED' if report.get('passed') else 'FAILED'}</p>"
+            "<table id=\"sim-invariants\"><tr><th>invariant</th>"
+            "<th>ok</th><th>detail</th></tr>" + rows + "</table>")
+    return "".join(parts)
+
+
 def timeline_panel(timeline: dict) -> str:
     """Session-timeline panel (ISSUE 15): the most recent traced
     session's cross-process lifecycle — per-stage TTFT attribution (the
@@ -625,7 +678,8 @@ def telemetry_page(metrics: dict, resources: Optional[dict] = None,
                    kv: Optional[dict] = None,
                    chaos: Optional[dict] = None,
                    fleet: Optional[dict] = None,
-                   timeline: Optional[dict] = None) -> str:
+                   timeline: Optional[dict] = None,
+                   sim: Optional[dict] = None) -> str:
     """Dev telemetry view (reference LiveDashboard at /dev/dashboard):
     the /api/metrics snapshot as readable tables, led by the latency
     histogram panel, the live resources panel, the QoS panel, the
@@ -649,6 +703,7 @@ def telemetry_page(metrics: dict, resources: Optional[dict] = None,
             + kv_panel(kv or {})
             + chaos_panel(chaos or {})
             + fleet_panel(fleet or {})
+            + sim_panel(sim or {})
             + timeline_panel(timeline or {})
             + quality_panel(quality or {})
             + spec_panel((quality or {}).get("speculative") or {})
