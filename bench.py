@@ -106,6 +106,36 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+class HistWindow:
+    """Histogram count-delta window — the shared idiom behind configs
+    9/10/13/14/21/23: snapshot a telemetry histogram's cumulative bucket
+    counts at construction, run the measured region, then read quantiles
+    over JUST the window's observations. The artifact reports exactly
+    what GET /metrics scrapes over the window — never a parallel
+    wall-clock estimate."""
+
+    def __init__(self, hist, **labels):
+        self.hist = hist
+        self.labels = labels
+        self._c0 = hist.counts(**labels)[0]
+
+    def delta(self) -> list:
+        c1 = self.hist.counts(**self.labels)[0]
+        return [a - b for a, b in zip(c1, self._c0)]
+
+    def n(self) -> int:
+        return sum(self.delta())
+
+    def quantile(self, p: float, ndigits: int = 1):
+        """Window quantile, or None while the window saw nothing."""
+        from quoracle_tpu.infra.telemetry import quantile
+        delta = self.delta()
+        if not sum(delta):
+            return None
+        v = quantile(self.hist.buckets, delta, p)
+        return round(v, ndigits) if v is not None else None
+
+
 # ---------------------------------------------------------------------------
 # Survivability: device probe + deadline (VERDICT r3 weak #1)
 # ---------------------------------------------------------------------------
@@ -438,12 +468,11 @@ def measure_consensus_telemetry(backend, pool,
     reports exactly what GET /metrics scrapes. Per-decide rows carry the
     prefill/decode decomposition (ConsensusOutcome.prefill_ms/decode_ms)."""
     from quoracle_tpu.consensus.engine import ConsensusConfig, ConsensusEngine
-    from quoracle_tpu.infra.telemetry import DECIDE_MS, ROUND_MS, quantile
+    from quoracle_tpu.infra.telemetry import DECIDE_MS, ROUND_MS
 
     eng = ConsensusEngine(backend, ConsensusConfig(
         model_pool=list(pool), session_key="bench-config9"))
-    rb, _, _ = ROUND_MS.counts()
-    db, _, _ = DECIDE_MS.counts()
+    rwin, dwin = HistWindow(ROUND_MS), HistWindow(DECIDE_MS)
     rows = []
     for i in range(n_decides):
         msgs = {m: [{"role": "system", "content": SYSTEM_PROMPT},
@@ -456,22 +485,14 @@ def measure_consensus_telemetry(backend, pool,
                      "decode_ms": round(out.decode_ms, 1),
                      "cached_tokens": out.cached_tokens})
         log(f"config9 decide {i}: {rows[-1]}")
-    ra, _, _ = ROUND_MS.counts()
-    da, _, _ = DECIDE_MS.counts()
-    rdelta = [a - b for a, b in zip(ra, rb)]
-    ddelta = [a - b for a, b in zip(da, db)]
-
-    def q(h, delta, p):
-        v = quantile(h.buckets, delta, p)
-        return round(v, 1) if v is not None else None
     return {
         "rows": rows,
         "n_decides": n_decides,
-        "n_rounds": sum(rdelta),
-        "round_p50_ms": q(ROUND_MS, rdelta, 0.50),
-        "round_p95_ms": q(ROUND_MS, rdelta, 0.95),
-        "decide_p50_ms": q(DECIDE_MS, ddelta, 0.50),
-        "decide_p95_ms": q(DECIDE_MS, ddelta, 0.95),
+        "n_rounds": rwin.n(),
+        "round_p50_ms": rwin.quantile(0.50),
+        "round_p95_ms": rwin.quantile(0.95),
+        "decide_p50_ms": dwin.quantile(0.50),
+        "decide_p95_ms": dwin.quantile(0.95),
         "prefill_ms_total": round(sum(r["prefill_ms"] for r in rows), 1),
         "decode_ms_total": round(sum(r["decode_ms"] for r in rows), 1),
     }
@@ -496,7 +517,7 @@ def measure_resource_observability(backend, pool,
     from quoracle_tpu.consensus.engine import ConsensusConfig, ConsensusEngine
     from quoracle_tpu.infra import resources as res
     from quoracle_tpu.infra.telemetry import (
-        SCHED_ADMIT_WAIT_MS, WATCHDOG_STALLS, quantile,
+        SCHED_ADMIT_WAIT_MS, WATCHDOG_STALLS,
     )
     from quoracle_tpu.models.runtime import TPUBackend
 
@@ -518,7 +539,7 @@ def measure_resource_observability(backend, pool,
             })
             stop.wait(0.25)
 
-    ab, _, _ = SCHED_ADMIT_WAIT_MS.counts()
+    awin = HistWindow(SCHED_ADMIT_WAIT_MS)
     th = threading.Thread(target=sampler, daemon=True)
     th.start()
     eng = ConsensusEngine(backend10, ConsensusConfig(
@@ -537,9 +558,7 @@ def measure_resource_observability(backend, pool,
         th.join(5)
         for cb in backend10._cbatchers.values():
             cb.close()
-    aa, _, _ = SCHED_ADMIT_WAIT_MS.counts()
-    wait_delta = [a - b for a, b in zip(aa, ab)]
-    admit_p95 = quantile(SCHED_ADMIT_WAIT_MS.buckets, wait_delta, 0.95)
+    admit_p95 = awin.quantile(0.95, ndigits=2)
 
     comp = {spec: backend.engines[spec].compiles.snapshot()
             for spec in pool}
@@ -563,8 +582,7 @@ def measure_resource_observability(backend, pool,
         "queue_depth_p95": (depths[min(len(depths) - 1,
                                        int(0.95 * len(depths)))]
                             if depths else None),
-        "admit_wait_p95_ms": (round(admit_p95, 2)
-                              if admit_p95 is not None else None),
+        "admit_wait_p95_ms": admit_p95,
         "watchdog_stalls": WATCHDOG_STALLS.total(),
         "scheduler": {spec: {k: s[k] for k in
                              ("steps", "retired", "failed")}
@@ -1253,8 +1271,7 @@ def measure_cluster_disagg(backend, pool, n_interactive: int = 6,
     for j in range(n_agent):           # free the monolithic sessions
         backend.engines[member].drop_session(f"agent{j}")
 
-    ho_counts0, ho_buckets = CLUSTER_HANDOFF_MS.counts()[0], \
-        CLUSTER_HANDOFF_MS.buckets
+    ho_win = HistWindow(CLUSTER_HANDOFF_MS)
     cluster = ClusterPlane.build([member], replicas=2, disaggregate=True,
                                  continuous=True, continuous_chunk=16,
                                  continuous_slots=8)
@@ -1263,10 +1280,7 @@ def measure_cluster_disagg(backend, pool, n_interactive: int = 6,
         handoff_stats = cluster.handoff.stats()
     finally:
         cluster.close()
-    ho_delta = [a - b for a, b in zip(CLUSTER_HANDOFF_MS.counts()[0],
-                                      ho_counts0)]
-    handoff_p95 = (quantile(ho_buckets, ho_delta, 0.95)
-                   if sum(ho_delta) else None)
+    handoff_p95 = ho_win.quantile(0.95, ndigits=4)
 
     equal = mono["texts"] == disagg["texts"]
     n_chips = max(1, len(jax.devices()))
@@ -1448,7 +1462,7 @@ def measure_fabric(pool, n_rows: int = 6, n_router_peers: int = 3,
     """
     import tempfile
 
-    from quoracle_tpu.infra.telemetry import CLUSTER_HANDOFF_MS, quantile
+    from quoracle_tpu.infra.telemetry import CLUSTER_HANDOFF_MS
     from quoracle_tpu.models.runtime import QueryRequest
     from quoracle_tpu.serving.cluster import ClusterPlane, RemoteReplica
     from quoracle_tpu.serving.fabric.frontdoor import FabricPlane
@@ -1467,15 +1481,11 @@ def measure_fabric(pool, n_rows: int = 6, n_router_peers: int = 3,
             for i in range(n_rows)]
 
     def handoff_window(fn):
-        c0, buckets = CLUSTER_HANDOFF_MS.counts()[0], \
-            CLUSTER_HANDOFF_MS.buckets
+        win = HistWindow(CLUSTER_HANDOFF_MS)
         t0 = time.monotonic()
         out = fn()
         wall = time.monotonic() - t0
-        delta = [a - b for a, b in zip(CLUSTER_HANDOFF_MS.counts()[0],
-                                       c0)]
-        p95 = quantile(buckets, delta, 0.95) if sum(delta) else None
-        return out, p95, wall
+        return out, win.quantile(0.95, ndigits=3), wall
 
     # -- 1. handoff p95: in-process vs loopback wire ---------------------
     cl = ClusterPlane.build([member], replicas=2, disaggregate=True,
@@ -2165,18 +2175,14 @@ def measure_quality_overhead(backend, pool,
     (run_live_bench.sh commits it)."""
     from quoracle_tpu.consensus.engine import ConsensusConfig, ConsensusEngine
     from quoracle_tpu.consensus.quality import QUALITY
-    from quoracle_tpu.infra.telemetry import DECIDE_MS, quantile
-
-    def q(delta, p):
-        v = quantile(DECIDE_MS.buckets, delta, p)
-        return round(v, 1) if v is not None else None
+    from quoracle_tpu.infra.telemetry import DECIDE_MS
 
     def run_phase(quality_on: bool) -> dict:
         eng = ConsensusEngine(backend, ConsensusConfig(
             model_pool=list(pool),
             session_key=f"bench-config12-{'on' if quality_on else 'off'}",
             quality=quality_on))
-        before, _, _ = DECIDE_MS.counts()
+        dwin = HistWindow(DECIDE_MS)
         records = []
         for i in range(n_decides):
             msgs = {m: [{"role": "system", "content": SYSTEM_PROMPT},
@@ -2188,10 +2194,8 @@ def measure_quality_overhead(backend, pool,
                 records.append(out.audit)
             log(f"config12 decide {i} (quality={'on' if quality_on else 'off'}): "
                 f"status={out.status} rounds={out.rounds_used}")
-        after, _, _ = DECIDE_MS.counts()
-        delta = [a - b for a, b in zip(after, before)]
-        return {"decide_p50_ms": q(delta, 0.50),
-                "decide_p95_ms": q(delta, 0.95),
+        return {"decide_p50_ms": dwin.quantile(0.50),
+                "decide_p95_ms": dwin.quantile(0.95),
                 "records": records}
 
     off = run_phase(False)
@@ -2229,6 +2233,188 @@ def measure_quality_overhead(backend, pool,
             json.dump({"summary": result, "records": on["records"],
                        "scorecards": cards}, f)
         log(f"config12 audit records written to {sidecar}")
+    return result
+
+
+def measure_cost(backend, pool, n_decides: int = N_CYCLES) -> dict:
+    """Config 23: the chip-economics plane (ISSUE 17) as a benchmark.
+
+    Phase OFF runs real ConsensusEngine decides with the plane disabled
+    (``QUORACLE_COST_ACCOUNTING=0`` equivalent), phase ON repeats them
+    with attribution + roofline live: the tokens/sec delta is the
+    measured price of the plane and the temp-0 decisions must be equal
+    (ASSERT — accounting is read-only by construction). The ON window
+    reports the per-stage chip-second decomposition (ledger deltas
+    around the window, the same numbers GET /api/costs serves),
+    chip-ms/decide + tokens/decide from the quoracle_cost_decide_*
+    histogram count deltas, the exact-sum invariant restated at bench
+    scale, and each compiled program's best observed MFU with its cliff
+    count. Last, the sim-calibration loop closes against the LIVE
+    profile: fit a CapacityModel from the busiest ledger
+    (sim/calibrate.py), record a measured profile by replaying a
+    canonical trace under the fit, re-fit from that profile, and gate
+    the calibrated replay's per-class TTFT quantiles against the
+    measured distribution — the max relative error is the headline
+    calibration number. Detail (full /api/costs payload + gate checks)
+    lands in the COST sidecar (QUORACLE_BENCH_COST)."""
+    from quoracle_tpu.consensus.engine import ConsensusConfig, ConsensusEngine
+    from quoracle_tpu.infra import costobs
+    from quoracle_tpu.infra.telemetry import (
+        COST_DECIDE_CHIP_MS, COST_DECIDE_TOKENS,
+    )
+    from quoracle_tpu.sim.calibrate import (
+        calibrate, fit_capacity, record_profile, ttft_gate,
+    )
+    from quoracle_tpu.sim.workload import canonical_spec, generate
+
+    def run_phase(tag: str) -> dict:
+        eng = ConsensusEngine(backend, ConsensusConfig(
+            model_pool=list(pool),
+            session_key=f"bench-config23-{tag}"))
+        t0 = time.monotonic()
+        decisions, tokens, chip_ms = [], 0, 0.0
+        for i in range(n_decides):
+            msgs = {m: [{"role": "system", "content": SYSTEM_PROMPT},
+                        {"role": "user",
+                         "content": TASKS[(i + 2) % len(TASKS)]}]
+                    for m in pool}
+            out = eng.decide(msgs)
+            d = out.decision
+            decisions.append((d.action, d.params) if d else None)
+            tokens += out.completion_tokens
+            chip_ms += out.chip_ms
+            log(f"config23 decide {i} ({tag}): status={out.status} "
+                f"chip_ms={out.chip_ms:.1f}")
+        wall = time.monotonic() - t0
+        return {"decisions": decisions, "tokens": tokens,
+                "chip_ms": round(chip_ms, 3), "wall_s": round(wall, 3),
+                "tokens_per_s": round(tokens / max(1e-9, wall), 1)}
+
+    def ledger_marks() -> dict:
+        out = {}
+        for name, led in costobs.ledgers().items():
+            overhead = sum(ns for k, ns in led.cells().items()
+                           if k[:4] == costobs.OVERHEAD_KEY)
+            out[name] = (led.busy_ns(), led.stage_ns(),
+                         led.stage_tokens(), overhead)
+        return out
+
+    # warmup pays the pool's compiles so they land in neither phase —
+    # the off/on delta must price the accounting plane, not XLA
+    ConsensusEngine(backend, ConsensusConfig(
+        model_pool=list(pool),
+        session_key="bench-config23-warmup")).decide(
+        {m: [{"role": "system", "content": SYSTEM_PROMPT},
+             {"role": "user", "content": TASKS[2]}] for m in pool})
+
+    # -- 1. accounting off vs on: price of the plane + temp-0 ASSERT ----
+    was_on = costobs.enabled()
+    costobs.disable()
+    try:
+        off = run_phase("off")
+    finally:
+        costobs.enable()
+    before = ledger_marks()
+    cwin = HistWindow(COST_DECIDE_CHIP_MS)
+    twin = HistWindow(COST_DECIDE_TOKENS)
+    on = run_phase("on")
+    after = ledger_marks()
+    if not was_on:
+        costobs.disable()
+
+    equal = off["decisions"] == on["decisions"]
+    assert equal, \
+        "config23: temp-0 decisions diverged accounting off vs on"
+    assert off["chip_ms"] == 0.0, "config23: charged while disabled"
+    assert on["chip_ms"] > 0.0, "config23: nothing charged while enabled"
+
+    # -- 2. per-stage chip-second decomposition of the ON window --------
+    stages: dict = {}
+    stage_tokens: dict = {}
+    busy_ms = overhead_ms = 0.0
+    for name, (busy1, st1, tok1, ov1) in after.items():
+        busy0, st0, tok0, ov0 = before.get(name, (0, {}, {}, 0))
+        busy_ms += (busy1 - busy0) / 1e6
+        overhead_ms += (ov1 - ov0) / 1e6
+        for s, ns in st1.items():
+            d = ns - st0.get(s, 0)
+            if d > 0:
+                stages[s] = round(stages.get(s, 0.0) + d / 1e6, 3)
+        for s, t in tok1.items():
+            d = t - tok0.get(s, 0)
+            if d > 0:
+                stage_tokens[s] = stage_tokens.get(s, 0) + d
+    # the exact-sum invariant restated over the full ledgers (tier-1
+    # proves it per charge; the artifact witnesses it at bench scale)
+    invariant_ok = all(
+        sum(led.cells().values()) == led.busy_ns()
+        == sum(led.stage_ns().values())
+        for led in costobs.ledgers().values())
+    assert invariant_ok, "config23: chip-second sum invariant violated"
+
+    # -- 3. MFU per compiled program: best ratio + cliff count ----------
+    mfu: dict = {}
+    for member in pool:
+        rf = getattr(backend.engines.get(member), "_costobs_roofline",
+                     None)
+        if rf is None:
+            continue
+        with rf._lock:
+            mfu[rf.model] = {
+                f"{stage}/b{bucket}": {"best_mfu": round(st.best, 5),
+                                       "cliff_trips": st.trips}
+                for (stage, bucket), st in sorted(rf._best.items())}
+
+    # -- 4. sim calibration fitted from the live profile ----------------
+    rep = calibrate()
+    gate = None
+    gate_err = None
+    if rep is not None:
+        smoke = MAX_NEW <= 16
+        trace = generate(canonical_spec(
+            "diurnal_mix", seed=2026, scale=0.25 if smoke else 1.0))
+        led, measured = record_profile(trace, rep.fitted)
+        refit = fit_capacity(led)
+        gate = ttft_gate(trace, measured, refit.fitted)
+        gate_err = max((c["rel_err"] for c in gate["checks"]),
+                       default=0.0)
+
+    result = {
+        "n_decides": n_decides,
+        "n_members": len(pool),
+        "tokens_per_s_accounting_off": off["tokens_per_s"],
+        "tokens_per_s_accounting_on": on["tokens_per_s"],
+        "accounting_overhead_frac": (
+            round(1.0 - on["tokens_per_s"] / off["tokens_per_s"], 4)
+            if off["tokens_per_s"] else None),
+        "temp0_equal": equal,
+        "chip_ms_total_on": on["chip_ms"],
+        "chip_ms_per_decide_p50": cwin.quantile(0.50),
+        "chip_ms_per_decide_p95": cwin.quantile(0.95),
+        "tokens_per_decide_p50": twin.quantile(0.50),
+        "by_stage_chip_ms": stages,
+        "by_stage_tokens": stage_tokens,
+        "window_busy_chip_ms": round(busy_ms, 3),
+        "window_overhead_chip_ms": round(overhead_ms, 3),
+        "overhead_frac": (round(overhead_ms / busy_ms, 4)
+                          if busy_ms else None),
+        "sum_invariant_exact": invariant_ok,
+        "mfu_best_by_program": mfu,
+        "calibration": rep.as_dict() if rep else None,
+        "calibration_gate_passed": gate["passed"] if gate else None,
+        "calibration_ttft_max_rel_err": gate_err,
+    }
+    sidecar = os.environ.get("QUORACLE_BENCH_COST")
+    if sidecar:
+        try:
+            with open(sidecar, "w") as f:
+                json.dump({"metric": "cost", "config23": result,
+                           "gate": gate,
+                           "api_costs": costobs.costs_payload()},
+                          f, indent=1, default=str)
+            log(f"config23 cost detail written to {sidecar}")
+        except OSError as e:
+            log(f"config23 sidecar write failed: {e}")
     return result
 
 
@@ -3001,6 +3187,16 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             except OSError as e:
                 log(f"config22 sidecar write failed: {e}")
 
+    # config 23 measures the chip-economics plane itself (ISSUE 17) on
+    # the shared backend: accounting off vs on over real decides (temp-0
+    # ASSERT), per-stage chip-second decomposition + MFU-per-program
+    # bests for the ON window, and the sim-calibration loop fitted from
+    # the live ledger profile; the sidecar (QUORACLE_BENCH_COST) carries
+    # the full /api/costs payload + the TTFT gate checks
+    cfg23 = guard("config23", lambda: measure_cost(backend, pool))
+    if cfg23:
+        log(f"config23: {cfg23}")
+
     # config 19 builds its own backends (quantized vs not must not share
     # engines — the whole point is two independent numeric regimes)
     cfg19 = guard("config19", lambda: measure_quant(pool))
@@ -3349,6 +3545,26 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             "config22_ledger_digests": {
                 name: s["ledger_digest"]
                 for name, s in cfg22["scenarios"].items()},
+        })
+    if cfg23:
+        payload.update({
+            "config23_tokens_per_s_accounting_off":
+                cfg23["tokens_per_s_accounting_off"],
+            "config23_tokens_per_s_accounting_on":
+                cfg23["tokens_per_s_accounting_on"],
+            "config23_accounting_overhead_frac":
+                cfg23["accounting_overhead_frac"],
+            "config23_chip_ms_per_decide_p50":
+                cfg23["chip_ms_per_decide_p50"],
+            "config23_by_stage_chip_ms": cfg23["by_stage_chip_ms"],
+            "config23_overhead_frac": cfg23["overhead_frac"],
+            "config23_sum_invariant_exact":
+                cfg23["sum_invariant_exact"],
+            "config23_calibration_gate_passed":
+                cfg23["calibration_gate_passed"],
+            "config23_calibration_ttft_max_rel_err":
+                cfg23["calibration_ttft_max_rel_err"],
+            "config23_temp0_equal": cfg23["temp0_equal"],
         })
     if cfg10:
         payload.update({

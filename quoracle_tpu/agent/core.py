@@ -503,6 +503,8 @@ class AgentCore:
                 amount=Decimal(str(outcome.cost)), cost_type="model",
                 input_tokens=outcome.prompt_tokens,
                 output_tokens=outcome.completion_tokens,
+                measured_chip_ms=round(
+                    getattr(outcome, "chip_ms", 0.0), 3),
                 description=f"consensus x{outcome.rounds_used} rounds"))
         for p in outcome.proposals:
             deps.events.raw_response_log(self.agent_id, p.model_spec,

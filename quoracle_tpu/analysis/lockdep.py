@@ -120,6 +120,11 @@ HIERARCHY: tuple = (
     ("history",        52, False),  # EventHistory rings (OUTER of bus:
                                     # track_* subscribes under it)
     ("bus",            53, False),  # EventBus subscriber table
+    ("costobs",        54, False),  # chip-economics ledger (ISSUE 17):
+                                    # pure bookkeeping — charge cells,
+                                    # roofline observations, budget
+                                    # windows; metric/flight calls
+                                    # happen strictly OUTSIDE it
     ("tracer.sinks",   55, False),  # Tracer sink list
     ("fleetobs.spans", 56, False),  # fleetobs span ring (ISSUE 15):
                                     # appended from tracer sinks under

@@ -33,6 +33,7 @@ from quoracle_tpu.consensus.result import (
 from quoracle_tpu.consensus.rules import EmbedAccumulator
 from quoracle_tpu.consensus.temperature import temperature_for_round
 from quoracle_tpu.infra.telemetry import (
+    COST_DECIDE_CHIP_MS, COST_DECIDE_TOKENS,
     DECIDE_MS, ROUND_MS, ROUNDS_TOTAL, TRACER,
 )
 from quoracle_tpu.models.runtime import ModelBackend, QueryRequest
@@ -126,6 +127,14 @@ class ConsensusOutcome:
     # the decision audit record, queryable at /api/consensus.
     spec_rounds: int = 0
     spec_accepted_tokens: int = 0
+    # Chip economics (ISSUE 17): measured device wall this decide
+    # consumed (ChipLedger row shares summed over all rounds/members),
+    # per member and total, and the decide id the ledger keyed rows by
+    # (drawn BEFORE the first round so rows and audit share one id).
+    chip_ms: float = 0.0
+    member_chip_ms: dict[str, float] = dataclasses.field(
+        default_factory=dict)
+    decide_id: Optional[str] = None
     cost: float = 0.0
     embed_texts: int = 0
     # Summed per-member proposal latency across all rounds (ms) — the
@@ -181,6 +190,15 @@ class ConsensusEngine:
                             spec_accepted_tokens=outcome.
                             spec_accepted_tokens)
         DECIDE_MS.observe((time.monotonic() - t0) * 1000)
+        from quoracle_tpu.infra import costobs
+        if costobs.enabled():
+            # Economics-per-decide (ISSUE 17): chip-ms and emitted tokens,
+            # so cost-per-answer trends are visible without joining audit
+            # records.  Zero chip-ms decides (attribution off / CPU stub
+            # engines that never ran a jitted step) are still observed —
+            # the histogram's zero bucket is the "unmetered" population.
+            COST_DECIDE_CHIP_MS.observe(outcome.chip_ms)
+            COST_DECIDE_TOKENS.observe(float(outcome.completion_tokens))
         if outcome.audit is not None:
             # Scorecards + entropy/margin instruments + drift detection +
             # audit-record fan-out (consensus/quality.py). After the
@@ -193,6 +211,9 @@ class ConsensusEngine:
         t0 = time.monotonic()
         cfg = self.config
         outcome = ConsensusOutcome(status="ok")
+        if cfg.quality:
+            from quoracle_tpu.consensus.quality import next_decide_id
+            outcome.decide_id = next_decide_id()
         pool = list(cfg.model_pool)
         # Working copy: refinement appends to these, not the caller's lists.
         histories = {m: list(msgs) for m, msgs in messages_per_model.items()}
@@ -311,7 +332,7 @@ class ConsensusEngine:
             task_id=task_id, agent_id=cfg.session_key, pool=pool,
             outcome=outcome, clusters=clusters, winner_index=winner_index,
             sim_margins=acc.margins, failure_counts=failure_kinds,
-            corrected=corrected)
+            corrected=corrected, decide_id=outcome.decide_id)
 
     # ------------------------------------------------------------------
 
@@ -355,6 +376,10 @@ class ConsensusEngine:
                 priority=cfg.priority,
                 tenant=cfg.tenant,
                 deadline_ms=cfg.deadline_ms,
+                # chip-economics keys (ISSUE 17): the ledger rolls this
+                # round's device wall up by (task, decide)
+                task_id=cfg.task_id,
+                decide=outcome.decide_id,
             )
             for m in pool
         ]
@@ -372,6 +397,11 @@ class ConsensusEngine:
             outcome.spec_rounds += getattr(res, "spec_rounds", 0)
             outcome.spec_accepted_tokens += getattr(
                 res, "spec_accepted_tokens", 0)
+            chip = getattr(res, "chip_ms", 0.0)
+            if chip:
+                outcome.chip_ms += chip
+                outcome.member_chip_ms[res.model_spec] = \
+                    outcome.member_chip_ms.get(res.model_spec, 0.0) + chip
             outcome.member_latency_ms[res.model_spec] = \
                 outcome.member_latency_ms.get(res.model_spec, 0.0) \
                 + getattr(res, "latency_ms", 0.0)

@@ -78,6 +78,13 @@ LATENCY_WINDOW = 256            # per-member rolling latency samples kept
 _decide_ids = itertools.count(1)
 
 
+def next_decide_id() -> str:
+    """Allocate a decide id (``c<n:x>``). The engine draws it BEFORE the
+    query rounds so the same id reaches the ChipLedger's row keys
+    (ISSUE 17) and the audit record — one id, both planes."""
+    return f"c{next(_decide_ids):x}"
+
+
 # ---------------------------------------------------------------------------
 # Decision-quality math (pure; oracle-tested in tests/test_quality.py)
 # ---------------------------------------------------------------------------
@@ -116,7 +123,8 @@ def build_audit_record(*, task_id: Optional[str], agent_id: Optional[str],
                        clusters: Sequence[Any], winner_index: Optional[int],
                        sim_margins: Sequence[float],
                        failure_counts: dict[str, dict[str, int]],
-                       corrected: Iterable[str]) -> dict:
+                       corrected: Iterable[str],
+                       decide_id: Optional[str] = None) -> dict:
     """The structured per-decide record (ISSUE 5 audit trail). Pure: reads
     the outcome the engine already computed; every field is
     JSON-serializable so the record rides the bus / the DB / the API
@@ -134,6 +142,8 @@ def build_audit_record(*, task_id: Optional[str], agent_id: Optional[str],
             "kind": f.kind, "error": str(f.error)[:200]}
     for m, ms in outcome.member_latency_ms.items():
         members.setdefault(m, {})["latency_ms"] = round(ms, 2)
+    for m, ms in getattr(outcome, "member_chip_ms", {}).items():
+        members.setdefault(m, {})["chip_ms"] = round(ms, 3)
 
     corrected = sorted(set(corrected))
     proposed = {p.model_spec for p in outcome.proposals}
@@ -141,7 +151,7 @@ def build_audit_record(*, task_id: Optional[str], agent_id: Optional[str],
     return {
         "event": "consensus_audit",
         "ts": time.time(),
-        "decide_id": f"c{next(_decide_ids):x}",
+        "decide_id": decide_id or next_decide_id(),
         "task_id": task_id,
         "agent_id": agent_id,
         "status": outcome.status,
@@ -177,6 +187,11 @@ def build_audit_record(*, task_id: Optional[str], agent_id: Optional[str],
         "spec_accepted_tokens": getattr(outcome, "spec_accepted_tokens",
                                         0),
         "latency_ms": round(outcome.latency_ms, 2),
+        # chip economics (ISSUE 17): what this decide cost in measured
+        # device time and decoded tokens — the adaptive-consensus
+        # roadmap item reads its tokens-per-decide baseline from here
+        "chip_ms": round(getattr(outcome, "chip_ms", 0.0), 3),
+        "tokens_per_decide": getattr(outcome, "completion_tokens", 0),
     }
 
 
@@ -230,9 +245,10 @@ class _Ewma:
 class _MemberStats:
     __slots__ = ("decides", "proposals", "agreements", "dissents",
                  "failed_decides", "failures", "corrections", "recoveries",
-                 "deadline_misses", "latency", "drift")
+                 "deadline_misses", "latency", "drift", "chip_ms")
 
     def __init__(self) -> None:
+        self.chip_ms = 0.0          # measured device wall (ISSUE 17)
         self.decides = 0
         self.proposals = 0          # decides where the member's row was valid
         self.agreements = 0
@@ -273,6 +289,11 @@ class _MemberStats:
             "deadline_misses": self.deadline_misses,
             "latency_p50_ms": self._latency_q(0.50),
             "latency_p95_ms": self._latency_q(0.95),
+            # chip economics (ISSUE 17): measured device time this
+            # member consumed across its decides
+            "chip_ms_total": round(self.chip_ms, 3),
+            "chip_ms_per_decide": (round(self.chip_ms / self.decides, 3)
+                                   if self.decides else None),
             "drift": {sig: e.snapshot() for sig, e in self.drift.items()},
             "drifting": sorted(sig for sig, e in self.drift.items()
                                if e.tripped),
@@ -379,6 +400,9 @@ class ConsensusQuality:
                 if isinstance(latency, (int, float)) and latency > 0:
                     st.latency.append(float(latency))
                     MEMBER_LATENCY_MS.observe(float(latency), model=model)
+                chip = m.get("chip_ms")
+                if isinstance(chip, (int, float)) and chip > 0:
+                    st.chip_ms += float(chip)
                 drift_events += self._update_drift(
                     model, st,
                     dissent=1.0 if (cluster is not None and not agreed)

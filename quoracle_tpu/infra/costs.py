@@ -32,6 +32,12 @@ class CostEntry:
     model_spec: Optional[str] = None
     input_tokens: int = 0
     output_tokens: int = 0
+    # Measured device wall attributed to this entry's decide by the
+    # chip-economics ledger (infra/costobs.py, ISSUE 17).  0.0 when the
+    # accounting plane is off or the call never touched a jitted step.
+    # Kept beside the nominal Decimal so billing and reality sit in the
+    # same row in /api/costs.
+    measured_chip_ms: float = 0.0
     description: str = ""
     ts: float = 0.0
     id: str = dataclasses.field(default_factory=lambda: uuid.uuid4().hex)
@@ -76,6 +82,7 @@ class CostRecorder:
                 "model": entry.model_spec,
                 "input_tokens": entry.input_tokens,
                 "output_tokens": entry.output_tokens,
+                "measured_chip_ms": entry.measured_chip_ms,
             })
         return entry
 

@@ -440,7 +440,9 @@ def local_obs_state() -> dict:
     entry = state.get("quoracle_sched_real_tokens_total")
     if entry:
         tokens = sum(float(v) for _, v in entry.get("series") or [])
-    return {"state": state, "tokens_total": tokens}
+    from quoracle_tpu.infra import costobs
+    return {"state": state, "tokens_total": tokens,
+            "chip_ms_total": costobs.total_chip_ms()}
 
 
 # ---------------------------------------------------------------------------

@@ -349,4 +349,13 @@ FLIGHT_EVENTS: dict = {
                     "(shadow-mode FleetSignals.forecast seam)",
     "sim_gate": "a sim scenario's workload-invariant verdict "
                 "(name, seed, passed, invariants)",
+    # chip economics (ISSUE 17, infra/costobs.py)
+    "mfu_cliff": "a compiled program's observed MFU fell below half "
+                 "its running best for that (model, stage, bucket) — "
+                 "the recompile / padding-regression tripwire; carries "
+                 "both ratios and the token bucket",
+    "budget_burn": "a tenant class's error-budget burn rate crossed "
+                   "the fast (1h) or slow (6h) alert threshold, with a "
+                   "deterministic trip id — observed signal only, no "
+                   "policy acts on it this PR",
 }

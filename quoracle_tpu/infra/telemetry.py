@@ -1040,6 +1040,72 @@ SIM_GATE_FAILURES = METRICS.counter(
     "sim scenarios that failed at least one workload invariant, by "
     "scenario — the acceptance gate's alarm counter")
 
+# -- chip economics (ISSUE 17) -----------------------------------------------
+# Chip-economics plane (infra/costobs.py): per-stage chip-second
+# attribution, roofline/MFU per compiled program, per-decide cost
+# rollups, and tenant error budgets. Everything here is READ-ONLY
+# measurement — the attribution invariant (stage chip-seconds sum to
+# engine busy wall, exactly) and the temp-0 on/off bit-equality gate
+# both depend on these series never touching the serving path.
+COST_CHIP_MS_TOTAL = METRICS.counter(
+    "quoracle_cost_chip_ms_total",
+    "device wall (ms, float) charged by the ChipLedger, by model, "
+    "stage (prefill | decode | verify | restore) and tenant class — "
+    "tenant='overhead' rows are padding/ragged waste; the sum over all "
+    "labels equals the engine's measured busy wall by construction")
+COST_DECIDE_CHIP_MS = METRICS.histogram(
+    "quoracle_cost_decide_chip_ms",
+    "measured chip-ms one consensus decide consumed across all member "
+    "generates and verify chunks — the denominator of the adaptive-"
+    "consensus roadmap item's tokens-per-chip objective")
+COST_DECIDE_TOKENS = METRICS.histogram(
+    "quoracle_cost_decide_tokens",
+    "completion tokens one consensus decide consumed across all pool "
+    "members and rounds (tokens-per-decide, the adaptive-consensus "
+    "baseline)",
+    buckets=(8, 16, 32, 64, 128, 256, 512, 1_024, 2_048, 4_096,
+             8_192, 16_384))
+COST_GOODPUT_PER_CHIP = METRICS.gauge(
+    "quoracle_cost_goodput_per_chip_s",
+    "fleet-wide real chunk tokens per CHIP-SECOND, computed at the "
+    "front door from consecutive federation sweeps' token and chip-ms "
+    "counter deltas — the elastic fleet's cost objective input")
+MFU_RATIO = METRICS.histogram(
+    "quoracle_mfu_ratio",
+    "model FLOPs utilization per charged step: analytic FLOPs of the "
+    "ragged kernel/matmuls (geometry x real tokens, int8-aware) over "
+    "measured step wall x device peak, by model, stage and padded "
+    "token bucket — a cliff at a fixed bucket means a recompile or "
+    "padding regression",
+    buckets=(0.005, 0.01, 0.02, 0.04, 0.08, 0.15, 0.25, 0.4, 0.6, 0.8,
+             1.0))
+MFU_HBM_BOUND = METRICS.gauge(
+    "quoracle_mfu_hbm_bound",
+    "1 while the roofline model says the program's last observation "
+    "was HBM-bandwidth-bound (bytes/peak_bw > flops/peak_flops), per "
+    "model and stage — decode at small batch should sit at 1")
+MFU_CLIFFS_TOTAL = METRICS.counter(
+    "quoracle_mfu_cliffs_total",
+    "MFU-cliff crossings per model, stage and padded token bucket — "
+    "an observation fell below half the program's running best; the "
+    "mfu_cliff flight event's counter twin and the DEPLOY §18 alert "
+    "input (a recompile or padding regression eating chip-seconds)")
+BUDGET_BURN_RATE = METRICS.gauge(
+    "quoracle_budget_burn_rate",
+    "error-budget burn rate per tenant class and window (1h | 6h): "
+    "observed error fraction over the window divided by the class SLO "
+    "error allowance — 1.0 burns the whole budget in exactly one "
+    "window; the multi-window alert input (DEPLOY §18)")
+BUDGET_REMAINING_RATIO = METRICS.gauge(
+    "quoracle_budget_remaining_ratio",
+    "fraction of the tenant class's 6h error budget still unburned "
+    "(1.0 = untouched, 0 = exhausted) — floor-clamped at 0")
+BUDGET_EVENTS_TOTAL = METRICS.counter(
+    "quoracle_budget_events_total",
+    "requests scored against a tenant-class error budget, by class "
+    "and outcome (ok | error) — errors are sheds, deadline drops and "
+    "SLO misses; the budget denominator")
+
 # -- consensus quality (ISSUE 5) ---------------------------------------------
 # Decision-quality instruments (consensus/quality.py): per-decide
 # contestedness and the per-member scorecard counters. Registered at

@@ -463,6 +463,11 @@ class GenResult:
     spec_rounds: int = 0
     spec_drafted_tokens: int = 0
     spec_accepted_tokens: int = 0
+    # Chip-economics attribution (ISSUE 17, infra/costobs.py): this
+    # row's share of the measured device wall for the jitted steps it
+    # rode, split by real tokens. 0.0 with accounting off or on paths
+    # that drive their own jits (v1 batch-1 speculative decoder).
+    chip_ms: float = 0.0
 
 
 PAGE = 128   # tokens per KV page
@@ -2271,6 +2276,24 @@ class GenerateEngine:
         self._pending.padded_tokens = None
         self._note_padding(sum(max(1, len(s)) for s in suffixes),
                            B * T if padded_toks is None else padded_toks)
+        # Chip-economics charge (ISSUE 17): split each phase's measured
+        # wall across the live rows by real tokens; padding waste lands
+        # on the overhead pseudo-tenant. Read-only — consumes the row
+        # keys the batcher declared on this thread, touches no RNG or
+        # device state.
+        from quoracle_tpu.infra import costobs
+        chip_ms_rows = costobs.charge_step(
+            self, n=n,
+            prefill_weights=([max(1, len(s)) for s in suffixes[:n]]
+                             if vrun is None else [int(k) for k in vk]),
+            decode_weights=[int(n_emitted[i]) for i in range(n)],
+            padded_prefill=(B * T if padded_toks is None
+                            else padded_toks),
+            padded_decode=(B * vrun[1] if vrun is not None
+                           else B * max_new),
+            cache_len=cache_len, verify=vrun is not None,
+            prefill_bucket=vrun[1] if vrun is not None else T,
+            decode_bucket=max_new)
         self._record_telemetry(n, B, T, cache_len,
                                vrun[1] if vrun is not None else max_new,
                                "verify" if vrun is not None else paged,
@@ -2285,6 +2308,7 @@ class GenerateEngine:
                 "probs": (np.asarray(vprobs[i, :vk[i]], np.float32)
                           if vprobs is not None else None),
                 "n_cached": reuse_abs[i],
+                "chip_ms": chip_ms_rows[i],
             } for i in range(n)]
 
         results = []
@@ -2309,6 +2333,7 @@ class GenerateEngine:
                 json_state=(int(jstate_f[i]) - grammar_bases[i]
                             if constrain_json is not None
                             and constrain_json[i] else -1),
+                chip_ms=chip_ms_rows[i],
             ))
         return results
 

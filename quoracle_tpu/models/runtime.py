@@ -42,6 +42,17 @@ from quoracle_tpu.serving.admission import (
 logger = logging.getLogger(__name__)
 
 
+def _row_key(r: dict) -> tuple:
+    """Chip-economics attribution key (ISSUE 17) for one generate-row
+    dict — integer QoS priorities render as class names so the ledger
+    shares the budget plane's vocabulary."""
+    from quoracle_tpu.serving.qos import class_name
+    return (str(r.get("tenant") or "-"),
+            class_name(r.get("priority") if r.get("priority") is not None
+                       else 1),
+            str(r.get("task_id") or "-"), str(r.get("decide") or "-"))
+
+
 @dataclasses.dataclass
 class QueryRequest:
     """One model's slice of a consensus round."""
@@ -78,6 +89,12 @@ class QueryRequest:
     # by generate/sampling paths, so temp-0 bits are identical with or
     # without it.
     trace: Optional[dict] = None
+    # -- chip economics (ISSUE 17) -------------------------------------
+    # Attribution keys for the ChipLedger: the owning task/agent-tree
+    # (the PR 5 audit's task_id) and the decide id within it. Read only
+    # by the costobs charge path — never by generate/sampling.
+    task_id: Optional[str] = None
+    decide: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -106,6 +123,10 @@ class QueryResult:
     # speedup attribution at /api/consensus.
     spec_rounds: int = 0
     spec_accepted_tokens: int = 0
+    # Chip economics (ISSUE 17): this result's measured share of device
+    # wall (infra/costobs.ChipLedger row shares, ms). 0.0 with
+    # accounting off or on self-driving paths (v1 spec decoder).
+    chip_ms: float = 0.0
     error: Optional[str] = None        # None = success
     permanent_error: bool = False      # parity: only auth-type errors are
                                        # permanent (model_query.ex:322-332)
@@ -321,6 +342,11 @@ class _MemberBatcher:
         if not live:
             return
         rows = [r for r, _ in live]
+        # chip-economics attribution (ISSUE 17): declare the merged
+        # batch's row keys on the serving thread for the engine's
+        # charge site (dicts carry tenant/priority/task_id/decide)
+        from quoracle_tpu.infra import costobs
+        costobs.set_row_keys([_row_key(r) for r in rows])
         gens = self.engine.generate(
             [r["prompt"] for r in rows],
             temperature=[r["temperature"] for r in rows],
@@ -870,6 +896,7 @@ class TPUBackend(ModelBackend):
                 "action_enum": r.action_enum, "image": img,
                 "priority": r.priority, "tenant": r.tenant,
                 "deadline_s": deadline_s,
+                "task_id": r.task_id, "decide": r.decide,
             })
             live_idxs.append(i)
         return rows, live_idxs
@@ -970,7 +997,8 @@ class TPUBackend(ModelBackend):
                 usage=Usage(g.n_prompt_tokens, g.n_gen_tokens, cost),
                 latency_ms=latency_ms,
                 prefill_ms=prefill_ms, decode_ms=decode_ms,
-                cached_tokens=g.n_cached_tokens)
+                cached_tokens=g.n_cached_tokens,
+                chip_ms=getattr(g, "chip_ms", 0.0))
 
     def _query_member_continuous(self, spec: str, rows: list[dict],
                                  live_idxs: list[int],
@@ -1012,7 +1040,8 @@ class TPUBackend(ModelBackend):
                     constrain_json=r["constrain_json"],
                     action_enum=r["action_enum"],
                     priority=r["priority"], tenant=r["tenant"],
-                    deadline_s=r["deadline_s"]))
+                    deadline_s=r["deadline_s"],
+                    task_id=r.get("task_id"), decide=r.get("decide")))
         for i, f in zip(live_idxs, futs):
             try:
                 g = f.result()
@@ -1044,7 +1073,8 @@ class TPUBackend(ModelBackend):
                 cached_tokens=g.n_cached_tokens,
                 spec_rounds=getattr(g, "spec_rounds", 0),
                 spec_accepted_tokens=getattr(g, "spec_accepted_tokens",
-                                             0))
+                                             0),
+                chip_ms=getattr(g, "chip_ms", 0.0))
 
     def embed(self, texts: Sequence[str]) -> list[np.ndarray]:
         return self.embedder.embed(texts)

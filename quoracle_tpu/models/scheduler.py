@@ -64,7 +64,7 @@ from concurrent.futures import Future
 from typing import Any, Optional, Sequence
 
 from quoracle_tpu.analysis.lockdep import named_lock
-from quoracle_tpu.infra import fleetobs
+from quoracle_tpu.infra import costobs, fleetobs
 from quoracle_tpu.infra.flightrec import FLIGHT
 from quoracle_tpu.infra.telemetry import (
     QOS_ADMIT_WAIT_MS, SCHED_ADMIT_WAIT_MS, SCHED_QUEUE_DEPTH,
@@ -116,6 +116,12 @@ class _Row:
     # span so queue wait is never double-counted in the decomposition.
     trace: Optional[Any] = None
     t_admit: float = 0.0
+    # Chip economics (ISSUE 17): task/decide attribution keys carried
+    # down from the consensus layer, and this row's accumulated share
+    # of measured device wall across every chunk it rode.
+    task_id: Optional[str] = None
+    decide: Optional[str] = None
+    chip_ms: float = 0.0
 
 
 class ContinuousBatcher:
@@ -173,7 +179,9 @@ class ContinuousBatcher:
                action_enum: Optional[Sequence[str]] = None,
                priority=None, tenant: str = "default",
                deadline_s: Optional[float] = None,
-               initial_json_state: Optional[int] = None) -> Future:
+               initial_json_state: Optional[int] = None,
+               task_id: Optional[str] = None,
+               decide: Optional[str] = None) -> Future:
         """``initial_json_state`` resumes a constrained row MID-GRAMMAR:
         the prompt's tail already contains generated JSON (a prefill-tier
         replica's first token after a KV handoff, serving/cluster.py) and
@@ -188,6 +196,7 @@ class ContinuousBatcher:
                    priority=int(coerce_priority(priority)),
                    tenant=tenant, deadline_s=deadline_s,
                    json_state=initial_json_state,
+                   task_id=task_id, decide=decide,
                    # trace capture only while something listens — the
                    # un-traced fast path stays allocation-identical
                    trace=(fleetobs.TraceContext.current()
@@ -217,6 +226,11 @@ class ContinuousBatcher:
                 row.future.set_exception(e)
                 self.failed += 1
                 SCHED_ROWS_TOTAL.inc(model=self._model, status="failed")
+                # error-budget score (ISSUE 17): a shed burns the
+                # tenant class's budget — observed signal only
+                costobs.BUDGET.record(row.tenant,
+                                      class_name(row.priority),
+                                      ok=False, t=time.monotonic())
                 return row.future
         # Reject-after-closed UNDER THE LOCK (ISSUE 3 satellite): close()
         # flips _stop under this same lock, so a row can only enter the
@@ -343,6 +357,9 @@ class ContinuousBatcher:
                               tenant=row.tenant,
                               waited_ms=round(
                                   (now - row.t_submit) * 1000, 1))
+                costobs.BUDGET.record(row.tenant,
+                                      class_name(row.priority),
+                                      ok=False, t=now)
                 continue
             wait_ms = (now - row.t_submit) * 1000
             SCHED_ADMIT_WAIT_MS.observe(wait_ms, model=self._model)
@@ -479,9 +496,17 @@ class ContinuousBatcher:
                 spec_rounds=row.spec_rounds,
                 spec_drafted_tokens=row.spec_drafted,
                 spec_accepted_tokens=row.spec_accepted,
+                chip_ms=round(row.chip_ms, 6),
             ))
         self._drop_row_sessions(row)
         self.retired += 1
+        # error-budget score (ISSUE 17): a retire past its deadline is
+        # an SLO miss; everything else is budget-ok
+        t_done = time.monotonic()
+        costobs.BUDGET.record(
+            row.tenant, class_name(row.priority),
+            ok=not (row.deadline_s is not None and t_done > row.deadline_s),
+            t=t_done)
         SCHED_ROWS_TOTAL.inc(model=self._model, status="retired")
         if TRACER.active():
             # one decode span per row lifetime, anchored at admission
@@ -581,10 +606,20 @@ class ContinuousBatcher:
             return finishes, leftover
         return finishes, []
 
+    def _row_key(self, row) -> tuple:
+        """Chip-economics attribution key (ISSUE 17): the scheduler's
+        integer priority renders as its QoS class name so ledger
+        rollups share the budget plane's vocabulary."""
+        return (str(row.tenant or "-"), class_name(row.priority),
+                str(row.task_id or "-"), str(row.decide or "-"))
+
     def _plain_step(self, rows: list) -> list:
         prompts = [r.prompt + r.emitted for r in rows]
         budgets = [min(self.chunk, r.max_new - len(r.emitted))
                    for r in rows]
+        # declare this chunk's attribution keys on the worker thread —
+        # the engine's charge site consumes them (one call, one set)
+        costobs.set_row_keys([self._row_key(r) for r in rows])
         results = self.engine.generate(
             prompts,
             temperature=[r.temperature for r in rows],
@@ -599,6 +634,7 @@ class ContinuousBatcher:
         for row, res, budget in zip(rows, results, budgets):
             if row.n_cached_first is None:
                 row.n_cached_first = res.n_cached_tokens
+            row.chip_ms += res.chip_ms
             row.emitted.extend(res.token_ids)
             row.json_state = (res.json_state
                               if res.json_state >= 0 else row.json_state)

@@ -65,6 +65,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from quoracle_tpu.analysis.lockdep import named_lock
+from quoracle_tpu.infra import costobs
 from quoracle_tpu.infra.flightrec import FLIGHT
 from quoracle_tpu.infra.telemetry import (
     SPEC_ACCEPTANCE, SPEC_ACCEPTED, SPEC_DRAFTED, SPEC_ENGAGED,
@@ -82,6 +83,17 @@ from quoracle_tpu.models.transformer import (
 
 def _round_up(n: int, mult: int) -> int:
     return ((n + mult - 1) // mult) * mult
+
+
+def _row_keys(rows) -> list:
+    """Chip-economics attribution keys (ISSUE 17) for scheduler
+    _Row-likes — integer QoS priorities render as class names so the
+    ledger shares the budget plane's vocabulary."""
+    from quoracle_tpu.serving.qos import class_name
+    return [(str(getattr(r, "tenant", None) or "-"),
+             class_name(getattr(r, "priority", 1)),
+             str(getattr(r, "task_id", None) or "-"),
+             str(getattr(r, "decide", None) or "-")) for r in rows]
 
 
 @dataclasses.dataclass
@@ -735,6 +747,10 @@ class BatchedSpeculator:
         eos = self.draft.cfg.eos_token_id
         ctxs = [list(r.prompt) + list(r.emitted) for r in rows]
         k_req = [max(1, min(K, r.max_new - len(r.emitted))) for r in rows]
+        # chip-economics attribution (ISSUE 17): the scheduler's active
+        # set shrinks between rounds, so keys are re-declared per engine
+        # call, not per tick — one declaration covers exactly one call
+        costobs.set_row_keys(_row_keys(rows))
         drafts = self.draft.generate(
             ctxs, temperature=0.0, top_p=1.0, max_new_tokens=k_req,
             session_ids=[r.session_id for r in rows],
@@ -742,7 +758,8 @@ class BatchedSpeculator:
             action_enums=[r.action_enum for r in rows],
             initial_json_state=[r.json_state for r in rows])
         proposals = []
-        for g, kq in zip(drafts, k_req):
+        for r, g, kq in zip(rows, drafts, k_req):
+            r.chip_ms = getattr(r, "chip_ms", 0.0) + g.chip_ms
             p = list(g.token_ids)
             if g.finish_reason == "stop" and len(p) < kq:
                 # the engine pops the terminal stop id; re-propose A stop
@@ -751,6 +768,7 @@ class BatchedSpeculator:
                 p.append(eos)
             proposals.append(p or [eos])
         need_probs = any(r.temperature > 0 for r in rows)
+        costobs.set_row_keys(_row_keys(rows))
         vres = self.target.verify_chunk(
             [c + p[:-1] for c, p in zip(ctxs, proposals)],
             [r.session_id for r in rows],
@@ -765,6 +783,7 @@ class BatchedSpeculator:
         drafted = accepted = committed_total = 0
         for r, props, v in zip(rows, proposals, vres):
             ids, probs = v["ids"], v["probs"]
+            r.chip_ms = getattr(r, "chip_ms", 0.0) + v.get("chip_ms", 0.0)
             if r.n_cached_first is None:
                 r.n_cached_first = v["n_cached"]
             j = 0

@@ -140,6 +140,12 @@ class SignalSnapshot:
     hbm_headroom: Optional[float]
     admitted: int
     shed: int
+    # OBSERVED signal only (ISSUE 17): worst error-budget burn rate per
+    # priority class from the chip-economics plane.  The shed ladder
+    # does NOT read this — admission policy is unchanged; it rides the
+    # snapshot so routers/operators see budget pressure beside the
+    # overload signals it correlates with.
+    budget_burn: dict = dataclasses.field(default_factory=dict)
 
     def age_s(self, now: Optional[float] = None) -> float:
         """Seconds since the cached signal window refreshed — the
@@ -296,13 +302,16 @@ class AdmissionController:
                     self._t_refresh = 0.0
         self.refresh_signals(now0)
         depth = self.queue_depth()
+        from quoracle_tpu.infra import costobs
+        burn = costobs.BUDGET.burn_signals() if costobs.enabled() else {}
         with self._sig_lock:
             return SignalSnapshot(
                 ts=now0, refreshed_ts=self._t_refresh,
                 queue_depth=depth,
                 admit_wait_p95_ms=self.admit_wait_p95_ms,
                 hbm_headroom=self.hbm_headroom,
-                admitted=self.admitted, shed=self.shed)
+                admitted=self.admitted, shed=self.shed,
+                budget_burn=burn)
 
     def queue_depth(self) -> int:
         with self._lock:

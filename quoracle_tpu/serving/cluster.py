@@ -810,7 +810,8 @@ class ClusterPlane(ModelBackend):
                 action_enum=row["action_enum"],
                 priority=row["priority"], tenant=row["tenant"],
                 deadline_s=row["deadline_s"],
-                initial_json_state=js)
+                initial_json_state=js,
+                task_id=row.get("task_id"), decide=row.get("decide"))
             return fut.result()
         de = dec.backend.engines[spec]
         return de.generate(

@@ -43,9 +43,9 @@ from quoracle_tpu.analysis.lockdep import named_lock
 from quoracle_tpu.infra import fleetobs
 from quoracle_tpu.infra.flightrec import FLIGHT
 from quoracle_tpu.infra.telemetry import (
-    CLUSTER_REQUESTS_TOTAL, FABRIC_PEERS, FLEETOBS_GOODPUT,
-    FLEETOBS_PEERS, FLEETOBS_SCRAPE_MS, FLEETOBS_SLO_BURN,
-    FLEETOBS_STALENESS_S, TRACER,
+    CLUSTER_REQUESTS_TOTAL, COST_GOODPUT_PER_CHIP, FABRIC_PEERS,
+    FLEETOBS_GOODPUT, FLEETOBS_PEERS, FLEETOBS_SCRAPE_MS,
+    FLEETOBS_SLO_BURN, FLEETOBS_STALENESS_S, TRACER,
 )
 from quoracle_tpu.models.runtime import (
     ModelBackend, QueryRequest, QueryResult,
@@ -161,6 +161,7 @@ class FabricPlane(ModelBackend):
         self._fed: Optional[fleetobs.FederatedMetrics] = None
         self._fed_at = 0.0
         self._fed_tokens: Optional[float] = None
+        self._fed_chip_ms: Optional[float] = None
         self._incident_notifier = self._broadcast_incident
         fleetobs.INCIDENTS.add_notifier(self._incident_notifier)
         self._refresh_peer_gauges()
@@ -368,10 +369,12 @@ class FabricPlane(ModelBackend):
         # the door itself is a peer of the rollup: its router/fabric
         # series ride under peer="door" so the exposition declares each
         # metric name exactly once, all series peer-labeled
-        states: dict = {"door": fleetobs.local_obs_state()["state"]}
+        door = fleetobs.local_obs_state()
+        states: dict = {"door": door["state"]}
         ok = failed = 0
         slo_burn = 0.0
         tokens = 0.0
+        chip_ms = float(door.get("chip_ms_total") or 0.0)
         for p in list(self.peers):
             if not p.alive or not hasattr(p, "obs_metrics"):
                 failed += 1
@@ -385,12 +388,15 @@ class FabricPlane(ModelBackend):
             states[p.replica_id] = out.get("state") or {}
             slo_burn = max(slo_burn, float(out.get("slo_burn") or 0.0))
             tokens += float(out.get("tokens_total") or 0.0)
+            chip_ms += float(out.get("chip_ms_total") or 0.0)
         fed = fleetobs.federate(states)
         now = time.monotonic()
         with self._lock:
             last_at, last_tokens = self._fed_at, self._fed_tokens
+            last_chip = self._fed_chip_ms
             self._fed, self._fed_at = fed, now
             self._fed_tokens = tokens
+            self._fed_chip_ms = chip_ms
         FLEETOBS_SCRAPE_MS.observe((now - t0) * 1000)
         FLEETOBS_PEERS.set(ok, status="ok")
         FLEETOBS_PEERS.set(failed, status="failed")
@@ -400,6 +406,17 @@ class FabricPlane(ModelBackend):
             FLEETOBS_GOODPUT.set(
                 round(max(0.0, tokens - last_tokens)
                       / (now - last_at), 2))
+        if last_chip is not None:
+            # goodput-per-chip-second (ISSUE 17): window token delta over
+            # window chip-second delta across the fleet — efficiency, not
+            # throughput.  Only meaningful when chips actually ran this
+            # window; a zero chip delta leaves the gauge at its last
+            # value rather than exporting an infinity.
+            d_chip_s = max(0.0, chip_ms - last_chip) / 1000.0
+            if d_chip_s > 0:
+                COST_GOODPUT_PER_CHIP.set(
+                    round(max(0.0, tokens - (last_tokens or 0.0))
+                          / d_chip_s, 2))
         return fed
 
     # -- ModelBackend -----------------------------------------------------

@@ -116,6 +116,11 @@ class FleetSignals:
     replicas: tuple
     slo_burn: float = 0.0
     forecast: Optional[tuple] = None
+    # OBSERVED only (ISSUE 17): per-class worst error-budget burn rate
+    # from the chip-economics plane.  Recorded in the tick ledger beside
+    # ``slo_burn``; ``_decide`` does not read it — scaling policy is
+    # unchanged until a budget-aware policy is deliberately introduced.
+    budget_burn: Optional[dict] = None
 
     def tier(self, roles: tuple, serving_only: bool = True) -> list:
         return [r for r in self.replicas
@@ -255,7 +260,11 @@ class FleetController:
                 slo = getattr(rep.backend, "slo", None)
                 if slo is not None:
                     burn = max(burn, slo.burn())
-        return FleetSignals(replicas=tuple(out), slo_burn=burn)
+        from quoracle_tpu.infra import costobs
+        budget = (costobs.BUDGET.burn_signals()
+                  if costobs.enabled() else None)
+        return FleetSignals(replicas=tuple(out), slo_burn=burn,
+                            budget_burn=budget or None)
 
     # -- deterministic policy ---------------------------------------------
 

@@ -791,6 +791,8 @@ class TierManager:
         KV_RESTORES_TOTAL.inc(model=self.model, kind="session",
                               source="host")
         KV_RESTORE_MS.observe(ms, model=self.model, kind="session")
+        from quoracle_tpu.infra import costobs
+        costobs.charge_restore(self.model, ms, source="host")
         FLIGHT.record("kv_restore", model=self.model, what="session",
                       session=key, pages=len(pages), ms=round(ms, 2))
         from quoracle_tpu.infra.telemetry import TRACER
@@ -1020,6 +1022,8 @@ class TierManager:
             KV_RESTORES_TOTAL.inc(model=self.model, kind="prefix",
                                   source=source)
             KV_RESTORE_MS.observe(ms, model=self.model, kind="prefix")
+            from quoracle_tpu.infra import costobs
+            costobs.charge_restore(self.model, ms, source=source)
         if restored:
             from quoracle_tpu.infra.flightrec import FLIGHT
             FLIGHT.record("kv_restore", model=self.model, what="prefix",

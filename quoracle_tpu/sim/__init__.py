@@ -17,6 +17,10 @@ Three parts, one contract:
   with workload-level postconditions (SLO attainment per class, goodput
   floor, no-silent-loss over the full ledger, hibernation-tier
   conservation, temp-0 spot equality), run as tier-1 scenarios.
+* :mod:`quoracle_tpu.sim.calibrate` — measured-profile calibration
+  (ISSUE 17): fit the CapacityModel's service-time parameters from a
+  recorded chip-economics ledger (infra/costobs.py) and gate the fit on
+  the calibrated replay reproducing the measured TTFT distribution.
 
 The simulator is the serving plane's acceptance gate: every later
 policy change (adaptive consensus gating, predictive autoscaling,
@@ -30,4 +34,10 @@ from quoracle_tpu.sim.workload import (  # noqa: F401
 from quoracle_tpu.sim.replay import ReplayDriver, SIM  # noqa: F401
 from quoracle_tpu.sim.gate import (  # noqa: F401
     SIM_SCENARIOS, run_sim_scenario,
+)
+# NOTE: the fit entry point stays at quoracle_tpu.sim.calibrate.calibrate —
+# re-exporting a name equal to its own submodule would shadow the module
+# object on the package.
+from quoracle_tpu.sim.calibrate import (  # noqa: F401
+    CalibrationReport, fit_capacity, record_profile, ttft_gate,
 )

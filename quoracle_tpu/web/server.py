@@ -44,6 +44,13 @@ Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
                             outcomes, tier census, virtual goodput),
                             last gate report, sim counter series
                             (quoracle_tpu/sim/)
+  GET  /api/costs           chip-economics panel (ISSUE 17): nominal
+                            Decimal billing rows beside the measured
+                            chip-second ledgers (per-stage/tenant/class
+                            splits, padding overhead; infra/costobs.py)
+  GET  /api/budget          per-tenant-class SLO error budgets (ISSUE 17):
+                            1h/6h burn rates, remaining-budget ratios,
+                            deterministic trip ids (observed-only)
   GET  /api/models          consensus-quality scorecards (ISSUE 5): rolling
                             per-member agreement/dissent/failure-by-kind/
                             recovery rates, proposal latency, drift state
@@ -85,6 +92,7 @@ import queue
 import sys
 import threading
 import urllib.parse
+from decimal import Decimal
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
@@ -559,6 +567,47 @@ class DashboardServer:
         }
         return payload
 
+    def costs_payload(self) -> dict:
+        """GET /api/costs: the chip-economics panel (ISSUE 17) —
+        nominal Decimal billing (catalog-rate CostEntry rows, newest
+        last, bounded) beside the measured chip-second ledgers
+        (per-model busy wall, per-stage / per-tenant / per-class
+        splits, padding overhead) so billed and burned sit in one
+        response."""
+        from quoracle_tpu.infra import costobs
+        with self.runtime.costs._lock:
+            entries = list(self.runtime.costs._entries[-200:])
+        payload = costobs.costs_payload()
+        payload["nominal"] = {
+            "n_entries": len(entries),
+            "total_amount": str(sum((e.amount for e in entries),
+                                    Decimal("0"))),
+            "measured_chip_ms": round(
+                sum(e.measured_chip_ms for e in entries), 3),
+            "entries": [{
+                "agent_id": e.agent_id, "task_id": e.task_id,
+                "amount": str(e.amount), "type": e.cost_type,
+                "model": e.model_spec,
+                "input_tokens": e.input_tokens,
+                "output_tokens": e.output_tokens,
+                "measured_chip_ms": e.measured_chip_ms,
+                "ts": e.ts,
+            } for e in entries],
+        }
+        return payload
+
+    def budget_payload(self) -> dict:
+        """GET /api/budget: per-tenant-class SLO error budgets
+        (ISSUE 17) — multi-window (1h/6h) burn rates, remaining-budget
+        ratios, and deterministic trip ids from the chip-economics
+        plane's BudgetTracker. Observed-only: nothing in admission or
+        fleet policy acts on these numbers."""
+        from quoracle_tpu.infra import costobs
+        payload = costobs.BUDGET.snapshot()
+        payload["enabled"] = costobs.enabled()
+        payload["slo_targets"] = dict(costobs.SLO_TARGETS)
+        return payload
+
     def qos_payload(self) -> dict:
         """GET /api/qos: the serving-QoS panel (ISSUE 4) — admission
         controller state (signals, thresholds, tenant buckets), the
@@ -794,6 +843,10 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.fleet_payload())
             elif parsed.path == "/api/sim":
                 self._send_json(d.sim_payload())
+            elif parsed.path == "/api/costs":
+                self._send_json(d.costs_payload())
+            elif parsed.path == "/api/budget":
+                self._send_json(d.budget_payload())
             elif parsed.path == "/api/models":
                 self._send_json(d.models_payload())
             elif parsed.path == "/api/consensus":

@@ -416,13 +416,15 @@ def quality_panel(quality: dict) -> str:
             f"<td>{_e(fails)}</td>"
             f"<td>{_rate(s.get('recovery_rate'))}</td>"
             f"<td>{_fmt_ms(s.get('latency_p50_ms'))}</td>"
+            f"<td>{_fmt_ms(s.get('chip_ms_per_decide'))}</td>"
             + (f"<td class=\"lvl-error\">DRIFT: {_e(drifting)}</td>"
                if drifting else "<td></td>")
             + "</tr>")
     parts.append(
         "<table id=\"quality\"><tr><th>model</th><th>decides</th>"
         "<th>agree</th><th>dissent</th><th>failures</th><th>recovery</th>"
-        "<th>latency p50</th><th></th></tr>" + "".join(rows) + "</table>")
+        "<th>latency p50</th><th>chip/decide</th><th></th></tr>"
+        + "".join(rows) + "</table>")
     drifting = (quality or {}).get("drifting") or []
     if drifting:
         parts.append(f"<p class=\"lvl-error\" id=\"quality-drift\">"
