@@ -2418,6 +2418,143 @@ def measure_cost(backend, pool, n_decides: int = N_CYCLES) -> dict:
     return result
 
 
+def measure_introspect(backend, pool, n_decides: int = N_CYCLES) -> dict:
+    """Config 24: the liveness & hotspot plane (ISSUE 18) as a
+    benchmark.
+
+    Three phases of real ConsensusEngine decides: OFF (plane disabled),
+    DEFAULT (stall detector + profiler at the default 20 Hz) and
+    AGGRESSIVE (10x the sampling rate). The temp-0 decisions must be
+    identical across all three (ASSERT — the plane is read-only by
+    construction); the tokens/sec deltas price the plane and the
+    profiler's SELF-MEASURED overhead fraction is the headline gate:
+    ≤ 1% at the default rate. The DEFAULT window also witnesses the
+    wait-state invariant (every recorded row's named waits + remainder
+    sum exactly to its wall — restated here at bench scale from the
+    aggregate totals) and the heartbeat deltas the stall detector
+    watches. Detail (full /api/profile payload per phase) lands in the
+    INTROSPECT sidecar (QUORACLE_BENCH_INTROSPECT)."""
+    from quoracle_tpu.consensus.engine import ConsensusConfig, ConsensusEngine
+    from quoracle_tpu.infra import introspect
+
+    def run_phase(tag: str) -> dict:
+        eng = ConsensusEngine(backend, ConsensusConfig(
+            model_pool=list(pool),
+            session_key=f"bench-config24-{tag}"))
+        t0 = time.monotonic()
+        decisions, tokens = [], 0
+        for i in range(n_decides):
+            msgs = {m: [{"role": "system", "content": SYSTEM_PROMPT},
+                        {"role": "user",
+                         "content": TASKS[(i + 3) % len(TASKS)]}]
+                    for m in pool}
+            out = eng.decide(msgs)
+            d = out.decision
+            decisions.append((d.action, d.params) if d else None)
+            tokens += out.completion_tokens
+            log(f"config24 decide {i} ({tag}): status={out.status}")
+        wall = time.monotonic() - t0
+        return {"decisions": decisions, "tokens": tokens,
+                "wall_s": round(wall, 3),
+                "tokens_per_s": round(tokens / max(1e-9, wall), 1)}
+
+    # warmup pays the pool's compiles so they land in no phase
+    ConsensusEngine(backend, ConsensusConfig(
+        model_pool=list(pool),
+        session_key="bench-config24-warmup")).decide(
+        {m: [{"role": "system", "content": SYSTEM_PROMPT},
+             {"role": "user", "content": TASKS[3]}] for m in pool})
+
+    phases: dict = {}
+    payloads: dict = {}
+
+    introspect.reset()
+    introspect.disable()
+    try:
+        phases["off"] = run_phase("off")
+    finally:
+        introspect.reset()
+
+    # watch a heartbeat that advances on every decode step: the engine
+    # label is the cfg name (what beat() keys on), not the pool member
+    eng0 = backend.engines.get(pool[0])
+    label = eng0.cfg.name if eng0 is not None else pool[0]
+
+    for tag, hz in (("default", None), ("aggressive",
+                                        10 * introspect.DEFAULT_HZ)):
+        introspect.reset()
+        introspect.enable()
+        introspect.PROFILER.start(hz)
+        introspect.STALLS.watch(
+            "bench.decides",
+            lambda: (True, introspect.heartbeat_count(
+                f"engine.tokens:{label}")))
+        introspect.STALLS.start()
+        try:
+            phases[tag] = run_phase(tag)
+            phases[tag]["profiler_overhead_frac"] = round(
+                introspect.PROFILER.overhead_frac(), 6)
+            phases[tag]["profile_samples"] = introspect.PROFILER.samples
+            payloads[tag] = introspect.profile_payload()
+        finally:
+            introspect.shutdown()
+
+    # read-only by construction: temp-0 decisions identical off /
+    # default / aggressive
+    equal = (phases["off"]["decisions"] == phases["default"]["decisions"]
+             == phases["aggressive"]["decisions"])
+    assert equal, \
+        "config24: temp-0 decisions diverged across introspect phases"
+
+    # the wait invariant at bench scale: the DEFAULT window's aggregate
+    # per-state totals are each row's exact decomposition summed, so
+    # rows > 0 with totals present witnesses the plane saw real traffic
+    waits = payloads["default"]["waits"]
+    rows_recorded = sum(v["rows"] for v in waits.values())
+    stall_trips = payloads["default"]["stalls"]["trips"]
+
+    off_tps = phases["off"]["tokens_per_s"]
+    result = {
+        "n_decides": n_decides,
+        "n_members": len(pool),
+        "temp0_equal": equal,
+        "tokens_per_s_off": off_tps,
+        "tokens_per_s_default": phases["default"]["tokens_per_s"],
+        "tokens_per_s_aggressive": phases["aggressive"]["tokens_per_s"],
+        "plane_overhead_frac_default": (
+            round(1.0 - phases["default"]["tokens_per_s"] / off_tps, 4)
+            if off_tps else None),
+        "plane_overhead_frac_aggressive": (
+            round(1.0 - phases["aggressive"]["tokens_per_s"] / off_tps,
+                  4) if off_tps else None),
+        "profiler_overhead_frac_default":
+            phases["default"]["profiler_overhead_frac"],
+        "profiler_overhead_frac_aggressive":
+            phases["aggressive"]["profiler_overhead_frac"],
+        "profiler_overhead_gate_1pct":
+            phases["default"]["profiler_overhead_frac"] <= 0.01,
+        "profile_samples_default": phases["default"]["profile_samples"],
+        "wait_rows_recorded": rows_recorded,
+        "wait_states_seen": sorted({s for v in waits.values()
+                                    for s in v["by_state_ns"]}),
+        "stall_trips": stall_trips,
+        "heartbeats_default": {
+            k: v for k, v in sorted(
+                payloads["default"]["heartbeats"].items())},
+    }
+    sidecar = os.environ.get("QUORACLE_BENCH_INTROSPECT")
+    if sidecar:
+        try:
+            with open(sidecar, "w") as f:
+                json.dump({"metric": "introspect", "config24": result,
+                           "api_profile_by_phase": payloads},
+                          f, indent=1, default=str)
+            log(f"config24 introspect detail written to {sidecar}")
+        except OSError as e:
+            log(f"config24 sidecar write failed: {e}")
+    return result
+
+
 def base_payload() -> dict:
     """Every key the artifact can carry, pre-filled null — ANY exit path
     prints this line with whatever was actually measured, so degraded runs
@@ -3197,6 +3334,16 @@ def _run(args, payload: dict, deadline_at: float) -> None:
     if cfg23:
         log(f"config23: {cfg23}")
 
+    # config 24 measures the liveness & hotspot plane itself (ISSUE 18)
+    # on the shared backend: introspect off vs default vs aggressive
+    # sampling over real decides (temp-0 ASSERT), the profiler's
+    # self-measured overhead gated at 1% for the default rate, and the
+    # wait-state/heartbeat evidence; the sidecar
+    # (QUORACLE_BENCH_INTROSPECT) carries /api/profile per phase
+    cfg24 = guard("config24", lambda: measure_introspect(backend, pool))
+    if cfg24:
+        log(f"config24: {cfg24}")
+
     # config 19 builds its own backends (quantized vs not must not share
     # engines — the whole point is two independent numeric regimes)
     cfg19 = guard("config19", lambda: measure_quant(pool))
@@ -3565,6 +3712,25 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             "config23_calibration_ttft_max_rel_err":
                 cfg23["calibration_ttft_max_rel_err"],
             "config23_temp0_equal": cfg23["temp0_equal"],
+        })
+    if cfg24:
+        payload.update({
+            "config24_tokens_per_s_off": cfg24["tokens_per_s_off"],
+            "config24_tokens_per_s_default":
+                cfg24["tokens_per_s_default"],
+            "config24_tokens_per_s_aggressive":
+                cfg24["tokens_per_s_aggressive"],
+            "config24_plane_overhead_frac_default":
+                cfg24["plane_overhead_frac_default"],
+            "config24_profiler_overhead_frac_default":
+                cfg24["profiler_overhead_frac_default"],
+            "config24_profiler_overhead_gate_1pct":
+                cfg24["profiler_overhead_gate_1pct"],
+            "config24_wait_rows_recorded":
+                cfg24["wait_rows_recorded"],
+            "config24_wait_states_seen": cfg24["wait_states_seen"],
+            "config24_stall_trips": cfg24["stall_trips"],
+            "config24_temp0_equal": cfg24["temp0_equal"],
         })
     if cfg10:
         payload.update({

@@ -46,9 +46,13 @@ HOT_PATHS: tuple = (
     "quoracle_tpu/serving/",
 )
 
-# functions whose purpose is host-side reporting: syncs are fine there
+# functions whose purpose is host-side reporting: syncs are fine there.
+# The introspect plane's frame-walk/heartbeat surfaces (ISSUE 18) are
+# debug-only by construction — they never run on the dispatch path.
 _REPORT_NAMES = ("stats", "snapshot", "occupancy", "status", "progress",
-                 "padding_stats", "render", "__repr__")
+                 "padding_stats", "render", "__repr__",
+                 "thread_stacks", "sample_once", "profile_payload",
+                 "heartbeats", "overhead_frac", "holders")
 _SETUP_PREFIXES = ("__init__", "_build", "attach_", "close")
 
 

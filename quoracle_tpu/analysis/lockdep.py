@@ -115,6 +115,14 @@ HIERARCHY: tuple = (
                                     # flight/metrics (58/60), so it sits
                                     # strictly between them
     # -- observability plane (leaves) -----------------------------------
+    ("introspect",     49, False),  # liveness & hotspot plane (ISSUE 18,
+                                    # infra/introspect.py): heartbeat
+                                    # counters, profiler windows, wait
+                                    # aggregates — beat() runs under any
+                                    # serving lock, so it sits above
+                                    # them all; flight/metric emission
+                                    # and frame walking happen strictly
+                                    # OUTSIDE it (costobs discipline)
     ("quality",        50, False),  # consensus scorecards/drift
     ("quality.sinks",  51, False),  # quality sink list
     ("history",        52, False),  # EventHistory rings (OUTER of bus:
@@ -192,6 +200,10 @@ class LockDep:
         self._inversions: list[dict] = []
         self._seen: set = set()                # (held_name, acq_name)
         self._edges: set = set()               # (outer_name, inner_name)
+        # thread ident -> (thread name, that thread's held stack LIST —
+        # the same object _stack() mutates, so holders() can snapshot
+        # every thread's held locks without stopping the world
+        self._stacks: dict[int, tuple] = {}
 
     # -- held-stack plumbing (called from TrackedLock) -------------------
 
@@ -199,6 +211,9 @@ class LockDep:
         st = getattr(self._tls, "stack", None)
         if st is None:
             st = self._tls.stack = []
+            t = threading.current_thread()
+            with self._lock:
+                self._stacks[t.ident] = (t.name, st)
         return st
 
     def note_acquire(self, lock: "TrackedLock", blocking: bool) -> None:
@@ -292,8 +307,35 @@ class LockDep:
         """This thread's held stack as (name, rank, depth) tuples."""
         return [(f[1], f[2], f[3]) for f in self._stack()]
 
+    def holders(self) -> dict:
+        """EVERY thread's held locks — ``thread-name:ident`` →
+        ``[(name, rank, depth), ...]`` — for the stall detector's
+        capture bundle (ISSUE 18): who holds what while a stage is
+        wedged. Best-effort without stopping the world: each stack
+        list is copied atomically under the GIL, dead threads' entries
+        are pruned as a side effect. Threads holding nothing are
+        omitted."""
+        alive = {t.ident: t.name for t in threading.enumerate()}
+        with self._lock:
+            for ident in [i for i in self._stacks if i not in alive]:
+                del self._stacks[ident]
+            items = list(self._stacks.items())
+        out: dict = {}
+        for ident, (tname, st) in items:
+            frames = [(f[1], f[2], f[3]) for f in list(st)]
+            if frames:
+                out[f"{tname}:{ident}"] = frames
+        return out
+
 
 LOCKDEP = LockDep()
+
+# Contended-acquire wait hook (ISSUE 18): infra/introspect.py installs
+# a ``fn(lock_name, waited_ns)`` here when wait-state decomposition is
+# on. Only a CONTENDED blocking acquire pays the two clock reads — the
+# uncontended fast path is one extra try-acquire. The hook runs while
+# the caller may hold arbitrary ranked locks, so it must take none.
+LOCK_WAIT_HOOK: Optional[Any] = None
 
 
 class TrackedLock:
@@ -312,11 +354,32 @@ class TrackedLock:
 
     def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
         if not _STATE.enabled:
-            return self._base.acquire(blocking, timeout)
+            if LOCK_WAIT_HOOK is None:
+                return self._base.acquire(blocking, timeout)
+            return self._acquire_timed(blocking, timeout)
         LOCKDEP.note_acquire(self, blocking)
-        got = self._base.acquire(blocking, timeout)
+        got = (self._base.acquire(blocking, timeout)
+               if LOCK_WAIT_HOOK is None
+               else self._acquire_timed(blocking, timeout))
         if got:
             LOCKDEP.note_acquired(self)
+        return got
+
+    def _acquire_timed(self, blocking: bool, timeout: float) -> bool:
+        """Acquire with the contended-wait hook armed: try first (free
+        when uncontended — and re-entrant RLocks succeed here), time
+        only the blocking wait."""
+        hook = LOCK_WAIT_HOOK
+        if hook is None or not blocking:
+            return self._base.acquire(blocking, timeout)
+        if self._base.acquire(False):
+            return True
+        t0 = time.monotonic_ns()
+        got = self._base.acquire(True, timeout)
+        try:
+            hook(self.name, time.monotonic_ns() - t0)
+        except Exception:             # noqa: BLE001 — telemetry only
+            pass
         return got
 
     def release(self) -> None:

@@ -581,6 +581,14 @@ class BudgetTracker:
                 FLIGHT.record("budget_burn", trip_id=trip_id,
                               tenant=tenant, cls=cls, window=win,
                               burn=round(burn, 3), threshold=threshold)
+            # burn-triggered capture (ISSUE 18): every trip opens a
+            # deterministic-id incident with profiles + stacks fanned
+            # across the fabric — strictly after our lock released
+            from quoracle_tpu.infra import introspect
+            for win, _threshold, burn, trip_id in fired:
+                introspect.on_burn_trip(tenant=tenant, cls=cls,
+                                        window=win, trip_id=trip_id,
+                                        burn=burn)
 
     def snapshot(self) -> dict:
         """GET /api/budget payload: per-(tenant, class) window burns,

@@ -331,6 +331,35 @@ def assemble_timeline(spans: Iterable[dict],
     elif serve_leg:
         stages = {"serve": serve_leg,
                   "wire": max(0.0, total - serve_leg)}
+    # Wait-state decomposition rollup (ISSUE 18): rows retired under the
+    # introspect plane carry ``waits_ns`` on their sched.decode span —
+    # integer ns that sum EXACTLY to the span's ``wall_ns``. Aggregated
+    # here per trace so /api/timeline answers "what did this session
+    # actually wait on" beside the door-level stage decomposition.
+    wait_by_state: dict = {}
+    wait_rows = 0
+    wait_wall_ns = 0
+    for s in out:
+        w = s.get("waits_ns")
+        if not isinstance(w, dict):
+            continue
+        wait_rows += 1
+        wait_wall_ns += int(s.get("wall_ns") or 0)
+        for state, ns in w.items():
+            try:
+                wait_by_state[state] = (wait_by_state.get(state, 0)
+                                        + int(ns))
+            except (TypeError, ValueError):
+                continue
+    waits = None
+    if wait_rows:
+        waits = {
+            "rows": wait_rows,
+            "wall_ms": round(wait_wall_ns / 1e6, 3),
+            "by_state_ms": {k: round(v / 1e6, 3)
+                            for k, v in sorted(wait_by_state.items())},
+            "exact": sum(wait_by_state.values()) == wait_wall_ns,
+        }
     return {
         "session_id": session_id,
         "trace_ids": trace_ids,
@@ -339,6 +368,7 @@ def assemble_timeline(spans: Iterable[dict],
         "total_ms": round(total, 3),
         "stages": {k: round(v, 3) for k, v in stages.items()},
         "stages_sum_ms": round(sum(stages.values()), 3),
+        "waits": waits,
         "spans": out,
     }
 
@@ -550,11 +580,16 @@ class IncidentManager:
             os.makedirs(bdir, exist_ok=True)
             safe = "".join(c if c.isalnum() or c in "-_" else "-"
                            for c in replica_id)[:48]
-            return FLIGHT.dump(
+            path = FLIGHT.dump(
                 reason=f"incident-peer-{safe}",
                 path=os.path.join(bdir, f"peer-{safe}.json"))
         except Exception:                 # noqa: BLE001 — capture only
             return None
+        # correlated hotspot capture (ISSUE 18): this peer's profile +
+        # stacks + heartbeats land in the SAME bundle as its flight ring
+        from quoracle_tpu.infra import introspect
+        introspect.attach_to_bundle(incident_id, tag=f"peer-{safe}")
+        return path
 
     # -- reads / retention ------------------------------------------------
 

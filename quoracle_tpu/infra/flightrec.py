@@ -358,4 +358,17 @@ FLIGHT_EVENTS: dict = {
                    "the fast (1h) or slow (6h) alert threshold, with a "
                    "deterministic trip id — observed signal only, no "
                    "policy acts on it this PR",
+    # liveness & hotspot plane (ISSUE 18, infra/introspect.py)
+    "stall_detected": "the introspect stall detector tripped: an "
+                      "active progress source's heartbeat froze for "
+                      "two intervals — an all-thread stack capture "
+                      "plus the TrackedLock holder snapshot land in a "
+                      "deterministic-id incident bundle",
+    "profile_window": "the sampled wall-clock profiler rotated a "
+                      "collapsed-stack window (samples, distinct "
+                      "stacks, window wall)",
+    "wait_skew": "a row's measured sub-waits overran its observed "
+                 "wall and were deterministically trimmed — the "
+                 "sum-to-wall invariant held, but the overlap is an "
+                 "instrumentation bug to chase",
 }

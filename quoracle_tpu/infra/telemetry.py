@@ -1106,6 +1106,41 @@ BUDGET_EVENTS_TOTAL = METRICS.counter(
     "and outcome (ok | error) — errors are sheds, deadline drops and "
     "SLO misses; the budget denominator")
 
+# -- liveness & hotspot plane (ISSUE 18) -------------------------------------
+# Introspection plane (infra/introspect.py): progress-heartbeat stall
+# detection, sampled wall-clock profiling, and per-row wait-state
+# decomposition. Read-only measurement like the chip-economics series
+# above — temp-0 on/off bit-equality depends on none of these touching
+# a serving decision.
+INTROSPECT_STALLS_TOTAL = METRICS.counter(
+    "quoracle_introspect_stalls_total",
+    "stall-detector trips per progress source — an ACTIVE source whose "
+    "heartbeat froze for two intervals; each trip ships an all-thread "
+    "stack + lock-holder incident bundle (DEPLOY §19 StallDetected)")
+INTROSPECT_PROFILE_SAMPLES = METRICS.counter(
+    "quoracle_introspect_profile_samples_total",
+    "wall-clock profiler sampling ticks folded into collapsed-stack "
+    "windows — the /api/profile hotspot denominator")
+INTROSPECT_OVERHEAD_RATIO = METRICS.gauge(
+    "quoracle_introspect_profiler_overhead_ratio",
+    "observed fraction of process wall the frame sampler itself "
+    "consumed since start — self-measured, gated at 1 percent for the default "
+    "rate by bench config 24 (DEPLOY §19 ProfilerOverhead)")
+INTROSPECT_WAIT_MS = METRICS.histogram(
+    "quoracle_introspect_wait_ms",
+    "per-row wait-state decomposition by state (admission | queue | "
+    "dispatch | kv_restore | wire | lock | other) and model — the "
+    "named waits plus the exact integer-ns remainder bucket sum to "
+    "each row's observed wall by construction",
+    buckets=(0.1, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500, 1_000,
+             2_500, 5_000, 10_000))
+INTROSPECT_WAIT_SKEW_TOTAL = METRICS.counter(
+    "quoracle_introspect_wait_skew_total",
+    "rows whose measured sub-waits overran the observed wall (clock "
+    "skew / overlapping measurements) and were deterministically "
+    "trimmed to preserve the sum-to-wall invariant — a steady rate "
+    "means an instrumentation bug (DEPLOY §19 WaitStateSkew)")
+
 # -- consensus quality (ISSUE 5) ---------------------------------------------
 # Decision-quality instruments (consensus/quality.py): per-decide
 # contestedness and the per-member scorecard counters. Registered at

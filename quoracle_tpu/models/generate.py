@@ -2294,6 +2294,12 @@ class GenerateEngine:
             cache_len=cache_len, verify=vrun is not None,
             prefill_bucket=vrun[1] if vrun is not None else T,
             decode_bucket=max_new)
+        # Liveness heartbeat (ISSUE 18): tokens the device actually
+        # produced this call — a frozen counter under live rows is the
+        # stall detector's engine-level signal.
+        from quoracle_tpu.infra import introspect
+        introspect.beat(f"engine.tokens:{self.cfg.name}",
+                        sum(int(n_emitted[i]) for i in range(n)))
         self._record_telemetry(n, B, T, cache_len,
                                vrun[1] if vrun is not None else max_new,
                                "verify" if vrun is not None else paged,

@@ -64,7 +64,7 @@ from concurrent.futures import Future
 from typing import Any, Optional, Sequence
 
 from quoracle_tpu.analysis.lockdep import named_lock
-from quoracle_tpu.infra import costobs, fleetobs
+from quoracle_tpu.infra import costobs, fleetobs, introspect
 from quoracle_tpu.infra.flightrec import FLIGHT
 from quoracle_tpu.infra.telemetry import (
     QOS_ADMIT_WAIT_MS, SCHED_ADMIT_WAIT_MS, SCHED_QUEUE_DEPTH,
@@ -122,6 +122,12 @@ class _Row:
     task_id: Optional[str] = None
     decide: Optional[str] = None
     chip_ms: float = 0.0
+    # Wait-state decomposition (ISSUE 18): the row's integer-ns wait
+    # ledger, opened at submit while the introspect plane is on (None
+    # when off — the gated fast path allocates nothing). Closed at
+    # retire; the named waits + exact remainder ride the sched.decode
+    # span as ``waits_ns``.
+    waits: Optional[Any] = None
 
 
 class ContinuousBatcher:
@@ -202,6 +208,8 @@ class ContinuousBatcher:
                    trace=(fleetobs.TraceContext.current()
                           if TRACER.active() else None))
         row.owns_session = session_id is None
+        if introspect.enabled():
+            row.waits = introspect.WaitClock()
         # Per-row admission check: an over-window prompt must fail ONLY
         # its own future — inside a shared chunk the engine's
         # ContextOverflowError would poison every live row's in-flight
@@ -217,11 +225,16 @@ class ContinuousBatcher:
         # overflow check above), never silent queue growth. The
         # controller may clamp the class to the tenant's floor.
         if self.admission is not None:
+            t_adm = (time.monotonic_ns()
+                     if row.waits is not None else 0)
             try:
                 row.priority = int(self.admission.admit(
                     tenant=row.tenant, priority=row.priority,
                     deadline_s=row.deadline_s,
                     queue_depth=self._policy.qsize()))
+                if row.waits is not None:
+                    row.waits.note("admission",
+                                   time.monotonic_ns() - t_adm)
             except AdmissionError as e:
                 row.future.set_exception(e)
                 self.failed += 1
@@ -366,6 +379,13 @@ class ContinuousBatcher:
             QOS_ADMIT_WAIT_MS.observe(wait_ms,
                                       cls=class_name(row.priority))
             row.t_admit = now
+            if row.waits is not None:
+                # batch-queue wait = submit→admit minus the admission
+                # call's own wall (already booked as "admission")
+                row.waits.note(
+                    "queue",
+                    int(wait_ms * 1e6)
+                    - row.waits.waits.get("admission", 0))
             if TRACER.active():
                 # retroactive queue-wait span, parented on the
                 # submitter's (possibly remote) trace context
@@ -408,6 +428,7 @@ class ContinuousBatcher:
                             model=self._model, rows=n_rows,
                             step=self.steps)
             self.steps += 1               # watchdog progress signal
+            introspect.beat(f"sched.tick:{self._model}")
             self._chaos_tick()
         # worker exit (close()): the worker owns _live, so it fails any
         # remaining rows itself — close() only takes over when this
@@ -508,16 +529,29 @@ class ContinuousBatcher:
             ok=not (row.deadline_s is not None and t_done > row.deadline_s),
             t=t_done)
         SCHED_ROWS_TOTAL.inc(model=self._model, status="retired")
+        # Wait-state decomposition (ISSUE 18): close the row's wait
+        # ledger at retire — the named waits + exact remainder sum to
+        # the row's observed wall by construction — and ride it on the
+        # decode span so /api/timeline aggregates it per trace.
+        closed = None
+        if row.waits is not None:
+            closed = row.waits.close()
+            introspect.record_row_waits(self._model, closed)
+            introspect.beat(f"sched.retired:{self._model}")
         if TRACER.active():
             # one decode span per row lifetime, anchored at admission
             # so queue wait is never double-counted in the TTFT
             # decomposition (fleetobs.assemble_timeline)
             dur_ms = (time.monotonic()
                       - (row.t_admit or row.t_submit)) * 1000
+            extra = ({"wall_ns": closed["wall_ns"],
+                      "waits_ns": closed["waits_ns"]}
+                     if closed is not None else {})
             TRACER.emit("sched.decode", dur_ms, parent=row.trace,
                         ts=time.time() - dur_ms / 1000.0,
                         session=row.session_id, model=self._model,
-                        tokens=len(row.emitted), finish=finish_reason)
+                        tokens=len(row.emitted), finish=finish_reason,
+                        **extra)
         if self.slo is not None:
             # per-class tail tracking (serving/slo.py): feeds the
             # INTERACTIVE-burn → BATCH-demotion control loop
@@ -552,7 +586,15 @@ class ContinuousBatcher:
                 else:
                     spec.note_fallback(reason)
             if spec_rows:
+                t_sp = (time.monotonic_ns()
+                        if any(r.waits is not None for r in spec_rows)
+                        else None)
+                if t_sp is not None:
+                    introspect.drain_inner_waits()
                 finishes, leftover = self._spec_step(spec_rows)
+                if t_sp is not None:
+                    self._book_step_waits(
+                        spec_rows, time.monotonic_ns() - t_sp)
                 if leftover:            # speculator failed mid-tick:
                     lids = set(map(id, leftover))   # decode those vanilla
                     spec_rows = [r for r in spec_rows
@@ -613,10 +655,29 @@ class ContinuousBatcher:
         return (str(row.tenant or "-"), class_name(row.priority),
                 str(row.task_id or "-"), str(row.decide or "-"))
 
+    def _book_step_waits(self, rows: list, step_ns: int) -> None:
+        """Partition one device call's wall across its rows' wait
+        ledgers (ISSUE 18). Every row in the batch waits the WHOLE call
+        concurrently, so each is booked the full wall — split into the
+        KV-restore and contended-lock walls this thread accumulated
+        inside the call, with the rest as device dispatch."""
+        restore_ns, lock_ns = introspect.drain_inner_waits()
+        dispatch_ns = max(0, step_ns - restore_ns - lock_ns)
+        for r in rows:
+            if r.waits is None:
+                continue
+            r.waits.note("dispatch", dispatch_ns)
+            r.waits.note("kv_restore", restore_ns)
+            r.waits.note("lock", lock_ns)
+
     def _plain_step(self, rows: list) -> list:
         prompts = [r.prompt + r.emitted for r in rows]
         budgets = [min(self.chunk, r.max_new - len(r.emitted))
                    for r in rows]
+        t_step = (time.monotonic_ns()
+                  if any(r.waits is not None for r in rows) else None)
+        if t_step is not None:
+            introspect.drain_inner_waits()
         # declare this chunk's attribution keys on the worker thread —
         # the engine's charge site consumes them (one call, one set)
         costobs.set_row_keys([self._row_key(r) for r in rows])
@@ -630,6 +691,8 @@ class ContinuousBatcher:
             action_enums=[r.action_enum for r in rows],
             initial_json_state=[r.json_state for r in rows],
         )
+        if t_step is not None:
+            self._book_step_waits(rows, time.monotonic_ns() - t_step)
         still = []
         for row, res, budget in zip(rows, results, budgets):
             if row.n_cached_first is None:

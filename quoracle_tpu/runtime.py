@@ -394,6 +394,10 @@ class Runtime:
         for name, fn in self.backend.watchdog_sources():
             self.watchdog.add_source(name, fn)
         self.watchdog.start()
+        # Liveness & hotspot plane (ISSUE 18): heartbeat stall detector
+        # + sampled wall-clock profiler over the same backend sources.
+        from quoracle_tpu.infra import introspect
+        introspect.start(self.backend.watchdog_sources())
         if self._fleet is not None:
             self._fleet_thread = threading.Thread(
                 target=self._fleet_loop, name="fleet-ticker",
@@ -726,6 +730,8 @@ class Runtime:
                 self._fabric_peer._server is not None:
             self._fabric_peer._server.close()
         self.watchdog.close()
+        from quoracle_tpu.infra import introspect
+        introspect.shutdown()
         METRICS.remove_collector(self._resource_collector)
         TRACER.remove_sink(self._trace_sink)
         QUALITY.remove_sink(self._quality_sink)

@@ -389,6 +389,10 @@ class AdmissionController:
         with self._sig_lock:
             self.admitted += 1
         QOS_ADMITTED_TOTAL.inc(cls=cls.name.lower(), tenant=tenant)
+        # liveness heartbeat (ISSUE 18): admissions flowing — a frozen
+        # counter with queued work means the front of the pipe wedged
+        from quoracle_tpu.infra import introspect
+        introspect.beat("qos.admit")
         return cls
 
     def _shed(self, cls: Priority, tenant: str, depth: int,

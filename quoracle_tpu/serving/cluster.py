@@ -213,6 +213,15 @@ class RemoteReplica:
                 "reason": reason}))
         return wire.decode_json(payload)
 
+    def obs_profile(self) -> dict:
+        """This peer's liveness/hotspot state (ISSUE 18): profiler
+        windows, heartbeats, stall status, wait totals — the front
+        door's fleet-scope /api/profile pull."""
+        from quoracle_tpu.serving.fabric import wire
+        _, payload = self.transport.request(
+            wire.MSG_OBS, wire.encode_json({"op": "profile"}))
+        return wire.decode_json(payload)
+
     def session_resident(self, request) -> bool:
         """Affinity guard: does the peer still hold this session (LRU
         churn can outlive the affinity entry)? Unreachable peers answer

@@ -40,7 +40,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from quoracle_tpu.analysis.lockdep import named_lock
-from quoracle_tpu.infra import fleetobs
+from quoracle_tpu.infra import fleetobs, introspect
 from quoracle_tpu.infra.flightrec import FLIGHT
 from quoracle_tpu.infra.telemetry import (
     CLUSTER_REQUESTS_TOTAL, COST_GOODPUT_PER_CHIP, FABRIC_PEERS,
@@ -352,6 +352,25 @@ class FabricPlane(ModelBackend):
         return fleetobs.assemble_timeline(spans, session_id=session_id,
                                           trace_id=trace_id)
 
+    def pull_profile(self) -> dict:
+        """GET /api/profile?scope=fleet: the door's own liveness/
+        hotspot payload plus every reachable peer's, pulled over the
+        MSG_OBS ``profile`` op (ISSUE 18). Best-effort per peer — a
+        hung peer is often exactly what the profile is for, so a
+        silent one is reported absent, never waited on past the
+        transport timeout."""
+        from quoracle_tpu.infra import introspect
+        out = introspect.profile_payload()
+        out["peers"] = {}
+        for p in list(self.peers):
+            if not p.alive or not hasattr(p, "obs_profile"):
+                continue
+            try:
+                out["peers"][p.replica_id] = p.obs_profile()
+            except WireError:
+                continue
+        return out
+
     def federated_metrics(self,
                           max_age_s: float = 2.0) -> fleetobs.FederatedMetrics:
         """The fleet-wide metrics rollup: every peer's lossless registry
@@ -496,6 +515,11 @@ class FabricPlane(ModelBackend):
     def _disagg(self, r: QueryRequest) -> QueryResult:
         spec = r.model_spec
         t0 = time.monotonic()
+        # door-scope wait decomposition (ISSUE 18): what THE DOOR
+        # waited on — each RPC leg is a "wire" wait from here (the
+        # peer's own rows decompose their inner walls), routing and
+        # placement land in the exact remainder
+        clock = introspect.WaitClock() if introspect.enabled() else None
         pre = self.router.place("prefill")
         hid = r.session_id or self._own_session_id()
         owns = r.session_id is None
@@ -525,6 +549,8 @@ class FabricPlane(ModelBackend):
         with self._lock:
             self.wire_handoffs += 1
         leg_ms = (time.monotonic() - t0) * 1000
+        if clock is not None:
+            clock.note("wire", int(leg_ms * 1e6))
         FLIGHT.record("fabric_handoff_wire", model=spec, session=hid,
                       src=pre.replica_id, bytes=len(env_bytes),
                       ms=round(leg_ms, 2))
@@ -536,11 +562,12 @@ class FabricPlane(ModelBackend):
                         ts=time.time() - leg_ms / 1000.0, session=hid,
                         model=spec, replica=pre.replica_id,
                         bytes=len(env_bytes))
-        return self._decode_phase(r, meta, env_bytes, hid, owns, t0)
+        return self._decode_phase(r, meta, env_bytes, hid, owns, t0,
+                                  clock=clock)
 
     def _decode_phase(self, r: QueryRequest, meta: dict,
                       env_bytes: bytes, hid: str, owns: bool, t0: float,
-                      exclude: tuple = ()) -> QueryResult:
+                      exclude: tuple = (), clock=None) -> QueryResult:
         spec = r.model_spec
         dec = self.router.place("decode", exclude=exclude)
         t_leg = time.monotonic()
@@ -557,7 +584,7 @@ class FabricPlane(ModelBackend):
                 raise
             return self._decode_phase(r, meta, env_bytes, hid, owns, t0,
                                       exclude=exclude
-                                      + (dec.replica_id,))
+                                      + (dec.replica_id,), clock=clock)
         except WireError as e:
             if e.reason == "signature":
                 # version-skewed pair: the BYTES are rejected before
@@ -584,21 +611,30 @@ class FabricPlane(ModelBackend):
                                  "failed_peer": dec.replica_id})
                 return self._decode_phase(
                     r, meta, env_bytes, hid, owns, t0,
-                    exclude=exclude + (dec.replica_id,))
+                    exclude=exclude + (dec.replica_id,), clock=clock)
             raise ReplicaFailedError(
                 f"decode peer {dec.replica_id} died mid-stream and no "
                 f"surviving decode peer could adopt the row: {e}",
                 replica_id=dec.replica_id, phase="decode")
         CLUSTER_REQUESTS_TOTAL.inc(replica=dec.replica_id, path="disagg")
-        if TRACER.active():
+        if clock is not None or TRACER.active():
             dec_ms = (time.monotonic() - t_leg) * 1000
-            TRACER.emit("door.decode_rpc", dec_ms,
-                        ts=time.time() - dec_ms / 1000.0, session=hid,
-                        model=spec, replica=dec.replica_id)
+            if clock is not None:
+                # the decode RPC leg is "wire" at door scope; the
+                # peer's own rows decompose the time inside it
+                clock.note("wire", int(dec_ms * 1e6))
+            if TRACER.active():
+                TRACER.emit("door.decode_rpc", dec_ms,
+                            ts=time.time() - dec_ms / 1000.0, session=hid,
+                            model=spec, replica=dec.replica_id)
         if not owns and r.session_id:
             self.router.set_affinity(r.session_id, dec.replica_id)
         res = wire.result_from_dict(d)
         res.latency_ms = (time.monotonic() - t0) * 1000
+        if clock is not None:
+            # only the innermost successful call closes the ledger —
+            # the re-place paths above return the recursive result
+            introspect.record_row_waits(f"door:{spec}", clock.close())
         return res
 
     # -- pool-wide backend surface ---------------------------------------

@@ -166,7 +166,10 @@ class FabricPeer:
         peer's span-ring slice for a session/trace (the front door's
         timeline pull), ``metrics`` serves the lossless registry state
         (the federation scrape), ``incident`` dumps the flight ring
-        into the named incident bundle (correlated capture)."""
+        into the named incident bundle (correlated capture), and
+        ``profile`` serves this peer's introspect plane — collapsed-
+        stack profiler windows, heartbeats, stall status and wait
+        totals (ISSUE 18)."""
         d = wire.decode_json(payload)
         op = d.get("op")
         if op == "spans":
@@ -194,6 +197,11 @@ class FabricPeer:
             return MSG_OBS_RESULT, wire.encode_json(
                 {"replica_id": self.replica_id, "dumped": bool(path),
                  "path": path})
+        if op == "profile":
+            from quoracle_tpu.infra import introspect
+            out = introspect.profile_payload()
+            out["replica_id"] = self.replica_id
+            return MSG_OBS_RESULT, wire.encode_json(out)
         raise WireError(f"unknown obs op {op!r}", reason="decode")
 
     def _hello(self) -> dict:

@@ -138,6 +138,11 @@ class Transport:
             self.requests += 1
             FABRIC_REQUESTS_TOTAL.inc(op=op, status="ok")
             FABRIC_RTT_MS.observe((time.monotonic() - t0) * 1000, op=op)
+            # liveness heartbeat (ISSUE 18): completed wire RPC frames
+            # — a frozen counter under in-flight serving traffic means
+            # the fabric link (not the device) is the wedge
+            from quoracle_tpu.infra import introspect
+            introspect.beat("wire.frames")
             return rtype, rpayload
         self.errors += 1
         FABRIC_REQUESTS_TOTAL.inc(op=op, status="unreachable")

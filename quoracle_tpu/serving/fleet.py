@@ -362,6 +362,8 @@ class FleetController:
         """Evaluate one policy tick and execute at most one action.
         ``signals`` injects a synthetic trace (tier-1, shadow runs);
         None gathers live from the plane."""
+        from quoracle_tpu.infra import introspect
+        introspect.beat("fleet.tick")
         with self._lock:
             self.tick_count += 1
             if self._cooldown > 0:

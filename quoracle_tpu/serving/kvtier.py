@@ -791,8 +791,13 @@ class TierManager:
         KV_RESTORES_TOTAL.inc(model=self.model, kind="session",
                               source="host")
         KV_RESTORE_MS.observe(ms, model=self.model, kind="session")
-        from quoracle_tpu.infra import costobs
+        from quoracle_tpu.infra import costobs, introspect
         costobs.charge_restore(self.model, ms, source="host")
+        # wait-state + heartbeat (ISSUE 18): the restore wall waits on
+        # the DISPATCHING thread, so the batcher books it against the
+        # step's rows; bytes feed the kv.restore liveness counter
+        introspect.note_restore(ms, nbytes=int(e.k.nbytes)
+                                + int(e.v.nbytes))
         FLIGHT.record("kv_restore", model=self.model, what="session",
                       session=key, pages=len(pages), ms=round(ms, 2))
         from quoracle_tpu.infra.telemetry import TRACER
@@ -1022,8 +1027,10 @@ class TierManager:
             KV_RESTORES_TOTAL.inc(model=self.model, kind="prefix",
                                   source=source)
             KV_RESTORE_MS.observe(ms, model=self.model, kind="prefix")
-            from quoracle_tpu.infra import costobs
+            from quoracle_tpu.infra import costobs, introspect
             costobs.charge_restore(self.model, ms, source=source)
+            introspect.note_restore(ms, nbytes=int(blk.k.nbytes)
+                                    + int(blk.v.nbytes))
         if restored:
             from quoracle_tpu.infra.flightrec import FLIGHT
             FLIGHT.record("kv_restore", model=self.model, what="prefix",

@@ -69,6 +69,10 @@ Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
   GET  /api/incidents       correlated incident bundles (ISSUE 15):
                             deterministic-id directories of every
                             reachable peer's flight-ring dump
+  GET  /api/profile         liveness & hotspot plane (ISSUE 18):
+                            collapsed-stack wall-clock profile windows,
+                            heartbeats, stall status, wait-state totals
+                            (fleet-federated on a front door)
   GET  /api/tasks           tasks + live agent counts
   GET  /api/agents?task_id  agent tree with budget/cost/todo state
   GET  /api/logs?agent_id   durable logs (newest last)
@@ -689,6 +693,18 @@ class DashboardServer:
         return {"incidents": INCIDENTS.list(),
                 **INCIDENTS.status()}
 
+    def profile_payload(self) -> dict:
+        """GET /api/profile: the liveness & hotspot plane (ISSUE 18) —
+        collapsed-stack wall-clock profile windows, heartbeat counters,
+        stall-detector status, and per-state wait totals. On a front
+        door the payload federates every alive peer's view
+        (backend.pull_profile); a single process reports itself."""
+        from quoracle_tpu.infra import introspect
+        fn = getattr(self.runtime.backend, "pull_profile", None)
+        if fn is not None:
+            return fn()
+        return introspect.profile_payload()
+
     def settings_payload(self) -> dict:
         """The settings surface (reference SecretManagementLive): system
         settings, profiles, secret METADATA (values never leave the vault),
@@ -798,7 +814,7 @@ class _Handler(BaseHTTPRequestHandler):
                     d.qos_payload(), d.models_payload(),
                     d.kv_payload(), d.chaos_payload(),
                     d.fleet_payload(), d.timeline_payload(),
-                    d.sim_payload()))
+                    d.sim_payload(), d.profile_payload()))
             elif parsed.path == "/settings":
                 from quoracle_tpu.web import views
                 self._send_html(views.settings_page(
@@ -859,6 +875,8 @@ class _Handler(BaseHTTPRequestHandler):
                     one("session_id"), one("trace_id")))
             elif parsed.path == "/api/incidents":
                 self._send_json(d.incidents_payload())
+            elif parsed.path == "/api/profile":
+                self._send_json(d.profile_payload())
             elif parsed.path == "/metrics":
                 # Prometheus text exposition; gated by the same bearer
                 # token as the API above (scrapers pass it via the

@@ -662,6 +662,18 @@ def timeline_panel(timeline: dict) -> str:
             for k, v in stages.items())
         parts.append("<table id=\"timeline-stages\"><tr><th>stage</th>"
                      "<th>ms</th></tr>" + rows + "</table>")
+    waits = timeline.get("waits") or {}
+    if waits.get("by_state_ms"):
+        rows = "".join(
+            f"<tr class=\"timeline-wait\"><td>{_e(k)}</td>"
+            f"<td>{_fmt_ms(v)}</td></tr>"
+            for k, v in waits["by_state_ms"].items())
+        parts.append(
+            f"<p class=\"meta\">wait states · rows {_e(waits.get('rows'))}"
+            f" · wall {_fmt_ms(waits.get('wall_ms'))}"
+            f" · exact {_e(waits.get('exact'))}</p>"
+            "<table id=\"timeline-waits\"><tr><th>wait state</th>"
+            "<th>ms</th></tr>" + rows + "</table>")
     rows = "".join(
         f"<tr class=\"timeline-span\"><td>{_e(s.get('name'))}</td>"
         f"<td>{_e(s.get('replica') or s.get('model') or '')}</td>"
@@ -674,6 +686,48 @@ def timeline_panel(timeline: dict) -> str:
     return "".join(parts)
 
 
+def introspect_panel(profile: dict) -> str:
+    """Liveness & hotspot panel (ISSUE 18): stall-detector status,
+    the hottest collapsed stacks of the last closed profiler window,
+    heartbeat counters, and per-state wait totals. Renders nothing
+    while the plane is disabled (QUORACLE_INTROSPECT=0)."""
+    profile = profile or {}
+    if not profile.get("enabled"):
+        return ""
+    prof = profile.get("profiler") or {}
+    stalls = profile.get("stalls") or {}
+    parts = [
+        "<h2 class=\"meta\">liveness &amp; hotspots</h2>",
+        f"<p class=\"meta\" id=\"introspect-state\">"
+        f"sample rate {_e(prof.get('hz'))} Hz"
+        f" · samples {_e(prof.get('samples'))}"
+        f" · overhead {_e(prof.get('overhead_frac'))}"
+        f" · stalls {_e(stalls.get('trips'))}"
+        f" ({_e(','.join(stalls.get('tripped') or []) or 'none live')}"
+        f")</p>",
+    ]
+    windows = prof.get("windows") or []
+    if windows:
+        rows = "".join(
+            f"<tr class=\"introspect-stack\"><td>{_e(stack)}</td>"
+            f"<td>{_e(n)}</td></tr>"
+            for stack, n in list(
+                (windows[-1].get("stacks") or {}).items())[:12])
+        parts.append("<table id=\"introspect-stacks\"><tr>"
+                     "<th>collapsed stack</th><th>samples</th></tr>"
+                     + rows + "</table>")
+    beats = profile.get("heartbeats") or {}
+    if beats:
+        rows = "".join(
+            f"<tr class=\"introspect-beat\"><td>{_e(k)}</td>"
+            f"<td>{_e(v)}</td></tr>"
+            for k, v in sorted(beats.items()))
+        parts.append("<table id=\"introspect-beats\"><tr>"
+                     "<th>heartbeat</th><th>count</th></tr>"
+                     + rows + "</table>")
+    return "".join(parts)
+
+
 def telemetry_page(metrics: dict, resources: Optional[dict] = None,
                    qos: Optional[dict] = None,
                    quality: Optional[dict] = None,
@@ -681,7 +735,8 @@ def telemetry_page(metrics: dict, resources: Optional[dict] = None,
                    chaos: Optional[dict] = None,
                    fleet: Optional[dict] = None,
                    timeline: Optional[dict] = None,
-                   sim: Optional[dict] = None) -> str:
+                   sim: Optional[dict] = None,
+                   profile: Optional[dict] = None) -> str:
     """Dev telemetry view (reference LiveDashboard at /dev/dashboard):
     the /api/metrics snapshot as readable tables, led by the latency
     histogram panel, the live resources panel, the QoS panel, the
@@ -707,6 +762,7 @@ def telemetry_page(metrics: dict, resources: Optional[dict] = None,
             + fleet_panel(fleet or {})
             + sim_panel(sim or {})
             + timeline_panel(timeline or {})
+            + introspect_panel(profile or {})
             + quality_panel(quality or {})
             + spec_panel((quality or {}).get("speculative") or {})
             + (table("runtime", flat) if flat else "")
