@@ -2555,6 +2555,201 @@ def measure_introspect(backend, pool, n_decides: int = N_CYCLES) -> dict:
     return result
 
 
+def measure_flywheel(backend, pool, n_rows: int = 6) -> dict:
+    """Config 25: the serving flywheel (ISSUE 19) priced end to end.
+
+    One full capture → train → evaluate → promote cycle against the
+    pool's first member:
+
+    * **capture overhead** — the same temp-0 rows through the
+      continuous self-draft spec path (config 13's isolation choice)
+      with the capture plane off vs on: outputs BIT-IDENTICAL
+      (ASSERT), tokens/sec delta is the tap's price;
+    * **one distillation cycle** — a random-init draft of the member's
+      own geometry vs the same init trained on the captured rounds;
+      held-out replay acceptance through the REAL verify_chunk path
+      before vs after is the headline row;
+    * **live promotion** — the trained candidate hot-swapped into the
+      serving backend while rows are IN FLIGHT: every in-flight row
+      must land ok (swap downtime == 0 ASSERT — drain, never drop),
+      and tokens/sec with the promoted draft vs the random incumbent
+      is the uplift row. Temp-0 texts stay identical across ALL
+      phases (greedy equality holds for ANY draft — the §8 invariant
+      the whole loop leans on).
+
+    Detail (capture stats, eval report, promoter ledger) lands in the
+    FLYWHEEL sidecar (QUORACLE_BENCH_FLYWHEEL)."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from quoracle_tpu.models.generate import GenerateEngine
+    from quoracle_tpu.models.runtime import TPUBackend
+    from quoracle_tpu.models.tokenizer import get_tokenizer
+    from quoracle_tpu.models.transformer import init_params
+    from quoracle_tpu.training.capture import CAPTURE, CaptureStore
+    from quoracle_tpu.training.evaluate import compare, greedy_equal
+    from quoracle_tpu.training.promote import Promoter, PromotionPolicy
+    from quoracle_tpu.training.trainer import (
+        TrainerConfig, heldout_split, train_from_capture,
+    )
+
+    member = pool[0]
+    target = backend.engines[member]
+    tok = get_tokenizer(member)
+    prompts = [
+        tok.encode(f"[agent {i}] {TASKS[i % len(TASKS)]}", add_bos=True)
+        for i in range(n_rows)]
+    cap_dir = tempfile.mkdtemp(prefix="bench-flywheel-")
+
+    def mk_backend() -> TPUBackend:
+        return TPUBackend([member], engines=backend.engines,
+                          embedder=backend.embedder, continuous=True,
+                          continuous_chunk=16, continuous_slots=8,
+                          draft_map={member: member}, draft_k=4)
+
+    def serve(b, warm: bool = True) -> dict:
+        cb = b._cbatchers[member]
+        if warm:    # pays the draft/verify compiles for EVERY prompt
+            # bucket outside the window (one cold bucket inside it
+            # would swamp the capture-overhead delta with XLA wall)
+            for f in [cb.submit(p, temperature=0.0,
+                                max_new_tokens=MAX_NEW)
+                      for p in prompts]:
+                f.result(900)
+        t0 = time.monotonic()
+        futs = [cb.submit(p, temperature=0.0, max_new_tokens=MAX_NEW)
+                for p in prompts]
+        gens = [f.result(900) for f in futs]
+        wall = time.monotonic() - t0
+        toks = sum(g.n_gen_tokens for g in gens)
+        return {"texts": [g.text for g in gens],
+                "wall_s": round(wall, 3), "tokens": toks,
+                "tokens_per_s": round(toks / max(1e-9, wall), 1)}
+
+    # -- phase 1: capture off vs on (self-draft spec serving) -------------
+    b = mk_backend()
+    try:
+        off = serve(b)
+    finally:
+        b.close()
+    CAPTURE.install(cap_dir, budget_mb=64.0)
+    try:
+        b = mk_backend()
+        try:
+            on = serve(b)
+        finally:
+            b.close()
+        CAPTURE.store.flush()
+        cap_stats = CAPTURE.stats().get("store") or {}
+    finally:
+        CAPTURE.uninstall()
+    assert on["texts"] == off["texts"], \
+        "config25: temp-0 outputs diverged with capture on"
+
+    # -- phase 2: one distillation cycle on the captured rounds -----------
+    store = CaptureStore(cap_dir, budget_mb=64.0)
+    records = list(store.read_all("spec"))
+    log(f"config25: {len(records)} captured rounds "
+        f"({cap_stats.get('disk_bytes')} bytes)")
+    _, held = heldout_split(records, frac=0.25, seed=0)
+    held = held[:40]     # bound the replay wall on big captures
+    cfg = target.cfg
+    cand_init = init_params(cfg, jax.random.PRNGKey(25),
+                            dtype=jnp.float32)
+    rand_init = init_params(cfg, jax.random.PRNGKey(26),
+                            dtype=jnp.float32)
+    tcfg = TrainerConfig(steps=40, batch=8, seq=160, lr=1e-3, seed=0,
+                         accept_weight=0.25, dp=1)
+    t0 = time.monotonic()
+    trainer, treport = train_from_capture(cfg, cand_init, store,
+                                          tcfg=tcfg)
+    train_wall = time.monotonic() - t0
+    incumbent = GenerateEngine(cfg, rand_init, target.tokenizer,
+                               max_seq=512,
+                               prompt_buckets=(64, 128, 256))
+    candidate = GenerateEngine(cfg, trainer.params, target.tokenizer,
+                               max_seq=512,
+                               prompt_buckets=(64, 128, 256))
+    report = compare(target, incumbent, candidate, held, max_k=6)
+    g_ok = greedy_equal(target, candidate, [prompts[0]], k=4,
+                        max_new=24)
+
+    # -- phase 3: live promotion with rows in flight ----------------------
+    b = mk_backend()
+    try:
+        b.swap_draft(member, incumbent, name="rand-incumbent")
+        base = serve(b)                     # random-draft baseline
+        promoter = Promoter(PromotionPolicy(
+            margin_p50=0.01, min_examples=4,
+            min_rounds=10 ** 9,             # bench: guard never trips
+            require_greedy_equal=True))
+        cb = b._cbatchers[member]
+        inflight = [cb.submit(p, temperature=0.0,
+                              max_new_tokens=MAX_NEW) for p in prompts]
+        t0 = time.monotonic()
+        res = promoter.promote_backend(
+            b, member, lambda: candidate, draft_name="flywheel-cand",
+            report=report, greedy_ok=g_ok)
+        swap_ms = (time.monotonic() - t0) * 1000
+        landed = [f.result(900) for f in inflight]
+        dropped = sum(1 for g in landed if not g.text)
+        assert res["promoted"], res
+        assert dropped == 0, \
+            "config25: in-flight rows lost across the hot-swap"
+        promoted = serve(b, warm=False)     # trained-draft uplift
+        promoter_stats = promoter.stats()
+    finally:
+        b.close()
+    assert promoted["texts"] == off["texts"], \
+        "config25: temp-0 outputs diverged after promotion"
+    shutil.rmtree(cap_dir, ignore_errors=True)
+
+    result = {
+        "n_rows": n_rows,
+        "max_new": MAX_NEW,
+        "captured_rounds": len(records),
+        "capture_bytes": cap_stats.get("disk_bytes"),
+        "tokens_per_s_capture_off": off["tokens_per_s"],
+        "tokens_per_s_capture_on": on["tokens_per_s"],
+        "capture_overhead_frac": (
+            round(1.0 - on["tokens_per_s"] / off["tokens_per_s"], 4)
+            if off["tokens_per_s"] else None),
+        "train_steps": treport["steps_run"],
+        "train_wall_s": round(train_wall, 3),
+        "final_loss": treport.get("final_loss"),
+        "heldout_examples": report["candidate"]["n"],
+        "acceptance_p50_before": report["incumbent"]["p50"],
+        "acceptance_p50_after": report["candidate"]["p50"],
+        "acceptance_margin_p50": report["margin_p50"],
+        "greedy_equal": g_ok,
+        "promoted": res["promoted"],
+        "swap_ms": round(swap_ms, 1),
+        "inflight_rows_dropped": dropped,
+        "tokens_per_s_incumbent": base["tokens_per_s"],
+        "tokens_per_s_promoted": promoted["tokens_per_s"],
+        "promotion_uplift": (
+            round(promoted["tokens_per_s"] / base["tokens_per_s"], 3)
+            if base["tokens_per_s"] else None),
+        "temp0_equal": True,                # asserted above, twice
+    }
+    sidecar = os.environ.get("QUORACLE_BENCH_FLYWHEEL")
+    if sidecar:
+        try:
+            with open(sidecar, "w") as f:
+                json.dump({"metric": "flywheel", "config25": result,
+                           "capture_stats": cap_stats,
+                           "eval_report": report,
+                           "promoter": promoter_stats},
+                          f, indent=1, default=str)
+            log(f"config25 flywheel detail written to {sidecar}")
+        except OSError as e:
+            log(f"config25 sidecar write failed: {e}")
+    return result
+
+
 def base_payload() -> dict:
     """Every key the artifact can carry, pre-filled null — ANY exit path
     prints this line with whatever was actually measured, so degraded runs
@@ -3344,6 +3539,16 @@ def _run(args, payload: dict, deadline_at: float) -> None:
     if cfg24:
         log(f"config24: {cfg24}")
 
+    # config 25 turns the serving flywheel once (ISSUE 19): capture
+    # on/off overhead with the temp-0 ASSERT, a distillation cycle's
+    # held-out replay acceptance before/after, and a live hot-swap
+    # promotion with in-flight rows (downtime == 0 ASSERT); the sidecar
+    # (QUORACLE_BENCH_FLYWHEEL) carries capture stats + the full eval
+    # report + the promoter ledger
+    cfg25 = guard("config25", lambda: measure_flywheel(backend, pool))
+    if cfg25:
+        log(f"config25: {cfg25}")
+
     # config 19 builds its own backends (quantized vs not must not share
     # engines — the whole point is two independent numeric regimes)
     cfg19 = guard("config19", lambda: measure_quant(pool))
@@ -3731,6 +3936,24 @@ def _run(args, payload: dict, deadline_at: float) -> None:
             "config24_wait_states_seen": cfg24["wait_states_seen"],
             "config24_stall_trips": cfg24["stall_trips"],
             "config24_temp0_equal": cfg24["temp0_equal"],
+        })
+    if cfg25:
+        payload.update({
+            "config25_captured_rounds": cfg25["captured_rounds"],
+            "config25_capture_overhead_frac":
+                cfg25["capture_overhead_frac"],
+            "config25_acceptance_p50_before":
+                cfg25["acceptance_p50_before"],
+            "config25_acceptance_p50_after":
+                cfg25["acceptance_p50_after"],
+            "config25_acceptance_margin_p50":
+                cfg25["acceptance_margin_p50"],
+            "config25_promoted": cfg25["promoted"],
+            "config25_swap_ms": cfg25["swap_ms"],
+            "config25_inflight_rows_dropped":
+                cfg25["inflight_rows_dropped"],
+            "config25_promotion_uplift": cfg25["promotion_uplift"],
+            "config25_temp0_equal": cfg25["temp0_equal"],
         })
     if cfg10:
         payload.update({

@@ -56,6 +56,15 @@ HIERARCHY: tuple = (
     #    board is pure bookkeeping, but an engine-sampled replay calls
     #    straight into ClusterPlane.query, so the sim lock must release
     #    before any serving lock is taken) -----------------------------
+    # -- serving flywheel (outermost of everything — the promotion
+    #    orchestrator drains replicas through the fleet controller (5)
+    #    and reaches engine locks (25) while holding it, so it must
+    #    sit in front of the whole serving hierarchy) ------------------
+    ("train.promote",   2, False),  # training/promote.py incumbent
+                                    # ledger + guard state: pure
+                                    # bookkeeping, the drain/swap work
+                                    # happens through fleet/cluster
+                                    # locks acquired under it
     ("sim.replay",      3, False),  # sim/replay.py SIM status board
     # -- cluster plane (outermost serving lock — the router sits in
     #    FRONT of every replica's batcher, so its locks must release
@@ -108,6 +117,14 @@ HIERARCHY: tuple = (
     ("cache.lru",      42, False),  # utils/cache.TTLCache
     ("engine.rng",     43, False),  # engine RNG split
     ("native.build",   45, True),   # serialize native toolchain builds
+    ("train.capture",  46, True),   # replay capture store buffer +
+                                    # segment ledger: the sealed-
+                                    # segment file write under it is
+                                    # its purpose (coarse); taken with
+                                    # no serving lock held (speculator
+                                    # tap and quality sink both fire
+                                    # outside their planes' locks) and
+                                    # may fire chaos.plan (48) beneath
     # -- chaos plane (ISSUE 11) -----------------------------------------
     ("chaos.plan",     48, False),  # ChaosPlane armed-plan + fire ledger:
                                     # fire() is called under store/tier

@@ -129,6 +129,18 @@ INJECTION_POINTS: dict = {
                      "died with sessions still aboard, which must "
                      "degrade to mark-failed + re-prefill, never "
                      "silent loss",
+    "train.capture": "capture-plane record write dropped / corrupted / "
+                     "crashed (ISSUE 19) — fires per capture batch on "
+                     "the store's append path; serving must neither "
+                     "block nor change a single output bit, and a "
+                     "corrupt frame must be skipped-and-unlinked at "
+                     "read like any disk rot",
+    "train.promote": "draft hot-swap failure mid-promotion (ISSUE 19) "
+                     "— fires per replica on the promotion rollout; a "
+                     "crash means the fleet was left half-swapped, "
+                     "which must roll back to the incumbent on every "
+                     "replica with zero downtime (train_rollback "
+                     "flight event present)",
 }
 
 

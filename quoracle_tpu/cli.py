@@ -92,7 +92,9 @@ async def cmd_run(args: argparse.Namespace) -> int:
                                fleet_max=args.fleet_max,
                                fleet_tick_s=args.fleet_tick_s,
                                sim_trace=args.sim_trace,
-                               sim_seed=args.sim_seed))
+                               sim_seed=args.sim_seed,
+                               capture_dir=args.capture_dir,
+                               capture_mb=args.capture_mb))
     _attach_printer(rt)
     if pool is None and args.profile is None:
         pool = rt.default_pool()
@@ -138,7 +140,9 @@ async def cmd_resume(args: argparse.Namespace) -> int:
                                fleet_max=args.fleet_max,
                                fleet_tick_s=args.fleet_tick_s,
                                sim_trace=args.sim_trace,
-                               sim_seed=args.sim_seed))
+                               sim_seed=args.sim_seed,
+                               capture_dir=args.capture_dir,
+                               capture_mb=args.capture_mb))
     _attach_printer(rt)
     result = await rt.boot()
     print(json.dumps(result), flush=True)
@@ -176,7 +180,8 @@ async def cmd_serve(args: argparse.Namespace) -> int:
         quantize_kv=args.quantize_kv,
         fleet_min=args.fleet_min, fleet_max=args.fleet_max,
         fleet_tick_s=args.fleet_tick_s,
-        sim_trace=args.sim_trace, sim_seed=args.sim_seed))
+        sim_trace=args.sim_trace, sim_seed=args.sim_seed,
+        capture_dir=args.capture_dir, capture_mb=args.capture_mb))
     # Validate host/token BEFORE boot so a refused bind exits with a clean
     # message instead of a traceback over a half-started runtime.
     try:
@@ -362,6 +367,18 @@ def build_parser() -> argparse.ArgumentParser:
                         help="fleet simulator: with no --sim-trace, "
                              "generate and replay the canonical "
                              "diurnal-mix trace from this seed")
+        sp.add_argument("--capture-dir", dest="capture_dir", default=None,
+                        metavar="DIR",
+                        help="serving flywheel (ISSUE 19): install the "
+                             "replay capture store here — speculative "
+                             "rounds + consensus audits append as "
+                             "crc-framed training examples for the "
+                             "offline draft-distillation trainer; "
+                             "env-killable via QUORACLE_TRAIN_CAPTURE=0")
+        sp.add_argument("--capture-mb", dest="capture_mb", type=float,
+                        default=256.0,
+                        help="capture store disk budget; oldest "
+                             "segments evict first (default 256)")
         sp.add_argument("--qos", action="store_true",
                         help="serving QoS (ISSUE 4): weighted-fair "
                              "admission + overload shedding + SLO "

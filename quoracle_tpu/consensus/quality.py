@@ -483,3 +483,16 @@ class ConsensusQuality:
 # Process-wide instance (the METRICS/TRACER/FLIGHT pattern): records carry
 # task/agent attribution, so cross-Runtime isolation comes from filtering.
 QUALITY = ConsensusQuality()
+
+
+def _capture_sink(record: dict) -> None:
+    """Serving-flywheel intake (ISSUE 19): every audit record is offered
+    to the replay capture store. The plane's fast path is one attribute
+    read when no store is installed, and it absorbs every failure, so
+    registering unconditionally costs serving nothing. Lazy import:
+    quality must not pull the training package at module load."""
+    from quoracle_tpu.training.capture import CAPTURE
+    CAPTURE.observe_consensus(record)
+
+
+QUALITY.add_sink(_capture_sink)

@@ -151,6 +151,14 @@ TOPIC_FLEET = "fleet:events"
 # replay surfaces its result on the SSE stream and the EventHistory
 # ring exactly like a chaos report, without polling GET /api/sim.
 TOPIC_SIM = "sim:events"
+# Serving flywheel (ISSUE 19): draft-promotion lifecycle events — a
+# candidate promoted through the fleet's drain/hot-swap, a failed
+# promotion restoring the incumbent, a live acceptance regression
+# auto-rolling back — broadcast by training/promote.py when a bus is
+# attached and ring-buffered by EventHistory (the /api/history "train"
+# key); the SSE stream tails them live so an open dashboard sees a
+# rollback the moment the guard trips.
+TOPIC_TRAIN = "train:events"
 
 
 def topic_agent_state(agent_id: str) -> str:

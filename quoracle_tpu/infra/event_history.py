@@ -16,6 +16,7 @@ from quoracle_tpu.infra.bus import (
     EventBus, Subscription, TOPIC_ACTIONS, TOPIC_CLUSTER, TOPIC_CONSENSUS,
     TOPIC_FABRIC, TOPIC_FLEET,
     TOPIC_LIFECYCLE, TOPIC_RESOURCES, TOPIC_SERVING, TOPIC_TRACE,
+    TOPIC_TRAIN,
 )
 
 MAX_LOGS_PER_AGENT = 100      # reference ui/event_history.ex:17-20
@@ -66,6 +67,7 @@ class EventHistory:
         self._cluster: deque = deque(maxlen=max_logs)
         self._fabric: deque = deque(maxlen=max_logs)
         self._fleet: deque = deque(maxlen=max_logs)
+        self._train: deque = deque(maxlen=max_logs)
         self._tasks: set[str] = set()
         self._lock = named_lock("history")
         self._closed = False
@@ -79,6 +81,7 @@ class EventHistory:
             bus.subscribe(TOPIC_CLUSTER, self._on_cluster),
             bus.subscribe(TOPIC_FABRIC, self._on_fabric),
             bus.subscribe(TOPIC_FLEET, self._on_fleet),
+            bus.subscribe(TOPIC_TRAIN, self._on_train),
         ]
 
     # Agent log/message topics are per-agent; the runtime calls track_agent
@@ -171,6 +174,10 @@ class EventHistory:
         with self._lock:
             self._fleet.append(event)
 
+    def _on_train(self, topic: str, event: dict) -> None:
+        with self._lock:
+            self._train.append(event)
+
     def _on_task_message(self, topic: str, event: dict) -> None:
         # topic is "tasks:<id>:messages". Ring under the TASK key always
         # (the mailbox replay), and ALSO under the SENDER when the message
@@ -246,6 +253,13 @@ class EventHistory:
         serving/fleet.py). Backs the /api/history "fleet" key."""
         with self._lock:
             return list(self._fleet)
+
+    def replay_train(self) -> list[dict]:
+        """Recent serving-flywheel events (promotions, rollbacks —
+        TOPIC_TRAIN, training/promote.py). Backs the /api/history
+        "train" key."""
+        with self._lock:
+            return list(self._train)
 
     def replay_traces(self, trace_id: Optional[str] = None) -> list[dict]:
         """Recent finished spans (infra/telemetry.py), optionally filtered

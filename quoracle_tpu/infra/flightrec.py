@@ -371,4 +371,23 @@ FLIGHT_EVENTS: dict = {
                  "wall and were deterministically trimmed — the "
                  "sum-to-wall invariant held, but the overlap is an "
                  "instrumentation bug to chase",
+    # serving flywheel (ISSUE 19, quoracle_tpu/training/)
+    "train_capture_degraded": "the capture plane absorbed a write "
+                              "failure (real or injected) and dropped "
+                              "the record — serving is unaffected by "
+                              "construction; recorded once per store "
+                              "so a flapping disk cannot flood the "
+                              "ring",
+    "train_capture_evict": "the capture store unlinked its oldest "
+                           "sealed segment to hold the --capture-mb "
+                           "budget (bytes and records given up)",
+    "train_promote": "a candidate draft rolled through the fleet via "
+                     "drain/hot-swap — carries the offline p50s, the "
+                     "per-replica swap count, and the live floor the "
+                     "acceptance guard will hold it to",
+    "train_rollback": "the incumbent draft was restored — either the "
+                      "promotion failed mid-swap (outcome=failed) or "
+                      "the live acceptance EWMA fell below the "
+                      "offline-measured floor (outcome=regression); "
+                      "zero-downtime either way",
 }

@@ -1141,6 +1141,47 @@ INTROSPECT_WAIT_SKEW_TOTAL = METRICS.counter(
     "trimmed to preserve the sum-to-wall invariant — a steady rate "
     "means an instrumentation bug (DEPLOY §19 WaitStateSkew)")
 
+# -- serving flywheel (ISSUE 19) ---------------------------------------------
+# Training plane (quoracle_tpu/training/): replay capture store,
+# draft-distillation trainer, and the bench-gated promotion pipeline.
+# The capture series is read-only measurement like the two planes above
+# — temp-0 on/off bit-equality depends on capture never touching a
+# serving decision (QUORACLE_TRAIN_CAPTURE=0 kills the whole plane).
+TRAIN_CAPTURE_RECORDS_TOTAL = METRICS.counter(
+    "quoracle_train_capture_records_total",
+    "capture-plane record dispositions by source (spec | consensus) "
+    "and status (ok | sampled_out | dropped) — dropped counts faults "
+    "and errors the serving path absorbed without blocking")
+TRAIN_CAPTURE_BYTES = METRICS.gauge(
+    "quoracle_train_capture_bytes",
+    "sealed on-disk bytes in the replay capture store — maintained "
+    "incrementally (O(1), no per-scrape directory walk) and bounded "
+    "by --capture-mb (DEPLOY §20 CaptureStoreFull)")
+TRAIN_CAPTURE_EVICTIONS_TOTAL = METRICS.counter(
+    "quoracle_train_capture_evictions_total",
+    "oldest capture segments unlinked to hold the size budget — a "
+    "steady rate means the budget is smaller than the retention the "
+    "trainer needs (DEPLOY §20 CaptureStoreFull)")
+TRAIN_STEPS_TOTAL = METRICS.counter(
+    "quoracle_train_steps_total",
+    "optimizer steps taken by the pjit distillation trainer, by model")
+TRAIN_LOSS = METRICS.gauge(
+    "quoracle_train_loss",
+    "last observed distillation loss (weighted CE against recorded "
+    "target tokens), by model")
+TRAIN_EVAL_ACCEPTANCE = METRICS.gauge(
+    "quoracle_train_eval_acceptance",
+    "offline replay acceptance through the real verify_chunk path, by "
+    "model, role (candidate | incumbent) and stat (p50 | p95 | mean) — "
+    "the promotion gate's evidence")
+TRAIN_PROMOTIONS_TOTAL = METRICS.counter(
+    "quoracle_train_promotions_total",
+    "draft promotion attempts by model and outcome (promoted | "
+    "rejected | failed | rolled_back) — failed means the hot-swap "
+    "aborted mid-fleet and the incumbent was restored; rolled_back "
+    "means the live acceptance guard tripped after promotion "
+    "(DEPLOY §20 PromotionRollback / AcceptanceRegression)")
+
 # -- consensus quality (ISSUE 5) ---------------------------------------------
 # Decision-quality instruments (consensus/quality.py): per-decide
 # contestedness and the per-member scorecard counters. Registered at

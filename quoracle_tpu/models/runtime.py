@@ -660,6 +660,22 @@ class TPUBackend(ModelBackend):
     def scheduler_stats(self) -> dict:
         return {spec: cb.stats() for spec, cb in self._cbatchers.items()}
 
+    def swap_draft(self, tspec: str, engine, name: Optional[str] = None):
+        """Hot-swap the draft engine behind ``tspec``'s continuous-mode
+        speculator (ISSUE 19 promotion path) and return the incumbent
+        engine for instant rollback. The caller owns both engines'
+        lifecycles — the swapped-out incumbent is NOT closed (a rollback
+        re-installs the same object), and ``close()`` never reaches a
+        swapped-in engine. Draft KV is derived state: rows cold
+        re-prefill into the new draft's sessions on their next round."""
+        speculator = self._speculators.get(tspec)
+        if speculator is None:
+            raise KeyError(f"no continuous speculator for {tspec!r} "
+                           f"(draft_map: {sorted(self.draft_map)})")
+        old = speculator.swap_draft(engine)
+        self.draft_map[tspec] = name or engine.cfg.name
+        return old
+
     def spec_stats(self) -> dict:
         if not self._speculators and not self._spec_decoders:
             return {"enabled": False}

@@ -76,6 +76,7 @@ from quoracle_tpu.models.generate import (
     grammar_mask, prefill, prefill_chunk,
 )
 from quoracle_tpu.models.sampling import sample_tokens
+from quoracle_tpu.training.capture import CAPTURE, spec_example
 from quoracle_tpu.models.transformer import (
     KVCache, forward_hidden, init_cache, project_logits,
 )
@@ -719,6 +720,34 @@ class BatchedSpeculator:
         target session is dropped by the scheduler/engine as usual)."""
         self.draft.drop_session(session_id)
 
+    def swap_draft(self, new_engine):
+        """Hot-swap the draft engine (ISSUE 19 promotion path) and
+        return the incumbent for instant rollback.
+
+        Safe mid-serving because draft KV is DERIVED state: the new
+        engine simply has no sessions yet, so each row's next round
+        cold-prefills its context into the new draft — exactly the
+        longest-common-prefix resume path a rejected chunk already
+        takes. Adaptive state resets to a fresh measurement window
+        (k_init, no EWMA) so the incumbent's acceptance history cannot
+        disengage — or shield — the candidate."""
+        assert new_engine.cfg.vocab_size == self.target.cfg.vocab_size, \
+            "draft and target must share one tokenizer/vocab"
+        assert new_engine.cfg.sliding_window is None, \
+            "speculative serving requires full attention"
+        with self._lock:
+            old = self.draft
+            self.draft = new_engine
+            self._k = self.k_init
+            self._engaged = True
+            self._ewma = None
+            self._vanilla_ticks = 0
+            self._rounds_since_probe = 0
+            self._tables = {}
+        SPEC_K.set(self._k, model=self.model)
+        SPEC_ENGAGED.set(1.0, model=self.model)
+        return old
+
     # -- the round ------------------------------------------------------
 
     def _host_table(self, action_enum) -> tuple:
@@ -781,7 +810,12 @@ class BatchedSpeculator:
 
         finishes: dict = {}
         drafted = accepted = committed_total = 0
-        for r, props, v in zip(rows, proposals, vres):
+        # serving flywheel intake (ISSUE 19): when the capture plane is
+        # live, copy each row's (ctx, proposal, verdicts, correction)
+        # AFTER the commit math below — pure reads of values the round
+        # computed anyway, so temp-0 bits are identical on or off
+        cap_rows: Optional[list] = [] if CAPTURE.active else None
+        for r, ctx, props, v in zip(rows, ctxs, proposals, vres):
             ids, probs = v["ids"], v["probs"]
             r.chip_ms = getattr(r, "chip_ms", 0.0) + v.get("chip_ms", 0.0)
             if r.n_cached_first is None:
@@ -844,6 +878,11 @@ class BatchedSpeculator:
                         s = int(table[s, t])
                 r.json_state = s
             finishes[id(r)] = finish
+            if cap_rows is not None:
+                cap_rows.append(spec_example(
+                    ctx, props, [int(x) for x in ids[:len(props)]],
+                    j, correction, r.temperature, r.constrain,
+                    r.action_enum))
 
         with self._lock:
             self.rounds += 1
@@ -866,6 +905,10 @@ class BatchedSpeculator:
             SPEC_K.set(self._k, model=self.model)
             SPEC_ENGAGED.set(1.0 if self._engaged else 0.0,
                              model=self.model)
+        if cap_rows:
+            # outside every lock; the plane absorbs all failures
+            CAPTURE.observe_spec_round(self.model, self.draft.cfg.name,
+                                       cap_rows)
         return finishes
 
     def _adapt_locked(self) -> bool:

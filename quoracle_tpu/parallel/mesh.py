@@ -192,7 +192,10 @@ def pool_sizing(pool: Sequence[str], n_devices: int = 8,
                 quantize_weights: bool = False,
                 quantize_kv: bool = False,
                 fleet_min: int = 1,
-                fleet_max: int = 0) -> dict:
+                fleet_max: int = 0,
+                trainer_chips: int = 0,
+                capture_events_per_s: float = 0.0,
+                capture_mb: float = 256.0) -> dict:
     """Explicit HBM budget for a model pool on a v5e sub-mesh partition
     (VERDICT r4 item 4): per member — chips (= recommended_tp), bf16
     weight bytes per chip, the page-pool bytes left after the tail
@@ -350,7 +353,54 @@ def pool_sizing(pool: Sequence[str], n_devices: int = 8,
                 "devices_at_max": devices_at_max,
                 "fits_at_max": devices_at_max <= total_devices,
             }
+    if trainer_chips:
+        out["trainer"] = _trainer_sizing(list(pool), trainer_chips,
+                                         capture_events_per_s,
+                                         capture_mb)
     return out
+
+
+# Nominal crc-framed JSON bytes per captured spec round: CTX_TAIL token
+# ids (~6 chars each serialized) plus proposal/verified arrays and the
+# fixed fields — measured ~3.5 KiB on the CPU smoke corpus, planned at
+# 4 KiB so the retention figure errs conservative.
+CAPTURE_RECORD_BYTES = 4096
+
+
+def _trainer_sizing(pool: list, trainer_chips: int,
+                    capture_events_per_s: float,
+                    capture_mb: float) -> dict:
+    """The serving-flywheel block of a --plan (ISSUE 19): the
+    distillation job's submesh (pure data-parallel over the draft — the
+    draft is small enough that tp=1 always fits, which is why it IS the
+    draft), the capture store's ingest rate vs its disk budget (how
+    many days of traffic the ``--capture-mb`` budget retains before
+    oldest-first eviction), and the checkpoint footprint (fp32 params
+    plus the two adamw moment trees)."""
+    from quoracle_tpu.models.config import get_model_config
+    # the flywheel trains the DRAFT: size against the pool's smallest
+    # member, which is the one a speculator would propose with
+    cfgs = [get_model_config(s) for s in pool]
+    draft = min(cfgs, key=lambda c: c.n_params)
+    layout = host_layout(1, trainer_chips, tp=1)
+    ckpt_bytes = draft.n_params * 4 * 3
+    daily_bytes = capture_events_per_s * CAPTURE_RECORD_BYTES * 86400
+    budget_bytes = capture_mb * (1 << 20)
+    return {
+        "draft_model": draft.name,
+        "chips": trainer_chips,
+        "layout": layout,
+        "batch_rows_per_step_min": layout["dp"],
+        "checkpoint_gb": round(ckpt_bytes / 1024 ** 3, 3),
+        "capture": {
+            "events_per_s": capture_events_per_s,
+            "record_bytes_nominal": CAPTURE_RECORD_BYTES,
+            "mb_per_day": round(daily_bytes / (1 << 20), 1),
+            "budget_mb": capture_mb,
+            "retention_days": (round(budget_bytes / daily_bytes, 2)
+                               if daily_bytes else None),
+        },
+    }
 
 
 def _replica_tiers(pool: list, members: list, chips_per_replica: int,
@@ -509,6 +559,19 @@ def _main(argv=None) -> int:
                     default=0,
                     help="elastic fleet: plan the capacity envelope "
                          "the autoscaler moves within (0 = static)")
+    ap.add_argument("--trainer-chips", dest="trainer_chips", type=int,
+                    default=0,
+                    help="serving flywheel (ISSUE 19): size the draft "
+                         "distillation job's data-parallel submesh "
+                         "(0 = no trainer section)")
+    ap.add_argument("--capture-events-per-s", dest="capture_events_per_s",
+                    type=float, default=0.0,
+                    help="flywheel capture ingest rate for the "
+                         "retention estimate")
+    ap.add_argument("--capture-mb", dest="capture_mb", type=float,
+                    default=256.0,
+                    help="flywheel capture store disk budget "
+                         "(training/capture.py oldest-first eviction)")
     ap.add_argument("--quantize-weights", dest="quantize_weights",
                     action="store_true",
                     help="plan at the int8 weight byte rate (ISSUE 13)")
@@ -530,7 +593,10 @@ def _main(argv=None) -> int:
                        quantize_weights=args.quantize_weights,
                        quantize_kv=args.quantize_kv,
                        fleet_min=args.fleet_min,
-                       fleet_max=args.fleet_max)
+                       fleet_max=args.fleet_max,
+                       trainer_chips=args.trainer_chips,
+                       capture_events_per_s=args.capture_events_per_s,
+                       capture_mb=args.capture_mb)
     print(json.dumps(plan, indent=2))
     return 0 if plan["fits"] else 1
 

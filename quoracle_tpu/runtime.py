@@ -322,6 +322,16 @@ class RuntimeConfig:
     # (the default) = no simulator thread at all.
     sim_trace: Optional[str] = None
     sim_seed: Optional[int] = None
+    # Serving flywheel (ISSUE 19, quoracle_tpu/training/):
+    # ``capture_dir`` installs the replay capture store at boot — the
+    # BatchedSpeculator and consensus-quality taps start feeding it
+    # crc-framed training examples, size-bounded to ``capture_mb``
+    # (oldest-first segment eviction). Serving only ever APPENDS here;
+    # the trainer/evaluator read it offline. None (the default) = no
+    # store, and the taps cost one attribute read per round. The whole
+    # plane is env-killable via QUORACLE_TRAIN_CAPTURE=0.
+    capture_dir: Optional[str] = None
+    capture_mb: float = 256.0
 
 
 class Runtime:
@@ -387,6 +397,12 @@ class Runtime:
         if config.chaos_plan:
             from quoracle_tpu.chaos.faults import CHAOS, FaultPlan
             CHAOS.arm(FaultPlan.from_json(config.chaos_plan))
+        # Serving flywheel (ISSUE 19): install the replay capture store
+        # before traffic so the first speculative round is captured.
+        if config.capture_dir:
+            from quoracle_tpu.training.capture import CAPTURE
+            CAPTURE.install(config.capture_dir,
+                            budget_mb=config.capture_mb)
         from quoracle_tpu.infra.resources import ResourceCollector
         self._resource_collector = ResourceCollector(self)
         METRICS.register_collector(self._resource_collector)
@@ -730,6 +746,9 @@ class Runtime:
                 self._fabric_peer._server is not None:
             self._fabric_peer._server.close()
         self.watchdog.close()
+        if self.config.capture_dir:
+            from quoracle_tpu.training.capture import CAPTURE
+            CAPTURE.uninstall()
         from quoracle_tpu.infra import introspect
         introspect.shutdown()
         METRICS.remove_collector(self._resource_collector)

@@ -855,6 +855,25 @@ class ClusterPlane(ModelBackend):
                 out[f"{rep.replica_id}@{t}"] = f"{rep.replica_id}@{d}"
         return out
 
+    def swap_draft(self, tspec: str, engine_factory, *,
+                   name: Optional[str] = None) -> list:
+        """Plane-level draft hot-swap (ISSUE 19): every live replica
+        whose backend drafts ``tspec`` receives its OWN engine from
+        ``engine_factory`` (separate session stores — a shared engine
+        would alias paged KV across replicas). Returns
+        ``[(replica_id, incumbent_engine)]`` for instant rollback.
+        The fleet controller's ``swap_draft`` is the production path —
+        per-replica quiesce plus the deterministic action ledger; this
+        primitive is what it (and the mono promoter) drive."""
+        out = []
+        for rep in self.replicas:
+            if not rep.alive or tspec not in rep.backend.draft_map:
+                continue
+            out.append((rep.replica_id,
+                        rep.backend.swap_draft(tspec, engine_factory(),
+                                               name=name)))
+        return out
+
     @property
     def qos_controller(self):
         """The web edge's shed gate (server._qos_shed): the ROUTER is

@@ -162,7 +162,7 @@ class FleetAction:
     reason strings — the ledger is comparable wholesale."""
 
     tick: int
-    action: str                    # scale_up | scale_down | retier | drain
+    action: str          # scale_up | scale_down | retier | drain | swap_draft
     target: str
     role: str
     reason: str
@@ -409,6 +409,60 @@ class FleetController:
             self.drain(a.target, new_role=a.role, reason=a.reason)
             return a
         return a
+
+    # -- draft hot-swap: the promotion primitive (ISSUE 19) ----------------
+
+    def swap_draft(self, replica_id: str, tspec: str, engine_factory,
+                   *, draft_name: str, reason: str = "promotion",
+                   chaos_point: Optional[str] = "train.promote") -> dict:
+        """Zero-downtime per-replica draft hot-swap, ledgered as a
+        ``swap_draft`` :class:`FleetAction`.
+
+        Reuses the drain machinery's quiesce half — mark the replica
+        draining (no new placements), wait for in-flight rows to settle
+        — but sessions STAY aboard: the target's paged KV is untouched
+        and draft KV is derived state that cold re-prefills into the
+        new engine on each row's next round, so there is nothing to
+        migrate. The swap itself is a pointer exchange under the
+        speculator's lock.
+
+        ``chaos_point`` fires before the swap (``train.promote`` on the
+        promotion rollout): a crash there leaves the INCUMBENT serving
+        — the exchange never started — and propagates so the promoter
+        rolls back the replicas already swapped. The rollback direction
+        passes ``chaos_point=None``: restoring an engine object that
+        was serving minutes ago has no build/disk step to fail, so it
+        carries no injection point of its own.
+
+        Returns ``{"action", "incumbent", "ms"}`` — the ledgered action
+        and the swapped-out engine for instant rollback."""
+        rep = self._replica(replica_id)
+        router = self.plane.router
+        router.mark_draining(replica_id)
+        FLEET_DRAINING.set(len(router.stats()["draining"]))
+        t0 = self._clock()
+        try:
+            self._settle(rep)
+            if chaos_point is not None:
+                from quoracle_tpu.chaos.faults import CHAOS
+                CHAOS.fire(chaos_point, replica=replica_id, model=tspec)
+            incumbent = rep.backend.swap_draft(tspec, engine_factory(),
+                                               name=draft_name)
+        finally:
+            router.clear_draining(replica_id)
+            FLEET_DRAINING.set(len(router.stats()["draining"]))
+        ms = (self._clock() - t0) * 1000
+        with self._lock:
+            action = FleetAction(tick=self.tick_count,
+                                 action="swap_draft", target=replica_id,
+                                 role=rep.role,
+                                 reason=f"{reason}:{tspec}->{draft_name}")
+            self._ledger.append(action)
+        FLEET_ACTIONS_TOTAL.inc(action="swap_draft", role=rep.role)
+        FLIGHT.record("fleet_action", **action.as_dict())
+        self._broadcast({"event": "fleet_action", **action.as_dict()})
+        return {"action": action.as_dict(), "incumbent": incumbent,
+                "ms": round(ms, 2)}
 
     # -- drain: the live-migration primitive ------------------------------
 

@@ -1,0 +1,24 @@
+"""Serving flywheel (ISSUE 19): capture -> train -> evaluate -> promote.
+
+The fleet already generates its own training signal — accepted/rejected
+speculation chunks (ISSUE 6) and consensus winners with full audit
+records (ISSUE 5) — and the fleet controller's drain (ISSUE 14) gives
+zero-downtime model hot-swap. This package connects them:
+
+* :mod:`quoracle_tpu.training.capture` — a bounded, crash-safe,
+  append-only replay store of training examples tapped read-only off
+  the serving path (``QUORACLE_TRAIN_CAPTURE=0`` kills the plane;
+  temp-0 bits are identical either way).
+* :mod:`quoracle_tpu.training.trainer` — a pjit data-parallel
+  distillation trainer over ``parallel/mesh`` submeshes: hard CE on
+  target corrections + acceptance-weighted CE on accepted chunks.
+* :mod:`quoracle_tpu.training.evaluate` — offline acceptance replay of
+  a held-out capture slice through the REAL ``verify_chunk`` path.
+* :mod:`quoracle_tpu.training.promote` — the bench-gated promotion:
+  margin + greedy-equality gate, per-replica drain/hot-swap through
+  the fleet controller's deterministic ledger, instant rollback, and
+  a live acceptance-regression guard that auto-rolls back.
+* :mod:`quoracle_tpu.training.draft_check` — the subsumed
+  ``tools/train_draft.py`` smoke (``--check`` now exercises the pjit
+  step on a 1-device mesh so the sharded path is in tier-1).
+"""

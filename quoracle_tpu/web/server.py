@@ -44,6 +44,10 @@ Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
                             outcomes, tier census, virtual goodput),
                             last gate report, sim counter series
                             (quoracle_tpu/sim/)
+  GET  /api/train           serving flywheel (ISSUE 19): capture store
+                            census/budget/degraded state, promoter
+                            rollout + acceptance-guard table, flywheel
+                            counter series (quoracle_tpu/training/)
   GET  /api/costs           chip-economics panel (ISSUE 17): nominal
                             Decimal billing rows beside the measured
                             chip-second ledgers (per-stage/tenant/class
@@ -243,6 +247,9 @@ class DashboardServer:
             # fleet-controller events (ISSUE 14): scale / re-tier /
             # drain actions + migration totals — TOPIC_FLEET ring
             "fleet": h.replay_fleet(),
+            # serving-flywheel events (ISSUE 19): promotions and
+            # rollbacks — TOPIC_TRAIN ring
+            "train": h.replay_train(),
         }
         if agent_id:
             payload["logs"] = h.replay_logs(agent_id)
@@ -571,6 +578,30 @@ class DashboardServer:
         }
         return payload
 
+    def train_payload(self) -> dict:
+        """GET /api/train: the serving flywheel (ISSUE 19) — capture
+        store state (segment census, byte budget, degraded flag), the
+        promoter's rollout/guard table when one is registered, and the
+        flywheel counter series. ``capture.installed`` False when no
+        --capture-dir was given."""
+        from quoracle_tpu.infra.telemetry import (
+            TRAIN_CAPTURE_EVICTIONS_TOTAL, TRAIN_CAPTURE_RECORDS_TOTAL,
+            TRAIN_PROMOTIONS_TOTAL, TRAIN_STEPS_TOTAL,
+        )
+        from quoracle_tpu.training.capture import CAPTURE
+        payload: dict = {"capture": CAPTURE.stats()}
+        promoter = getattr(self.runtime, "_promoter", None)
+        payload["promoter"] = (promoter.stats() if promoter is not None
+                               else {"enabled": False})
+        payload["counters"] = {
+            "capture_records": TRAIN_CAPTURE_RECORDS_TOTAL._snapshot(),
+            "capture_evictions":
+                TRAIN_CAPTURE_EVICTIONS_TOTAL._snapshot(),
+            "steps": TRAIN_STEPS_TOTAL._snapshot(),
+            "promotions": TRAIN_PROMOTIONS_TOTAL._snapshot(),
+        }
+        return payload
+
     def costs_payload(self) -> dict:
         """GET /api/costs: the chip-economics panel (ISSUE 17) —
         nominal Decimal billing (catalog-rate CostEntry rows, newest
@@ -859,6 +890,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.fleet_payload())
             elif parsed.path == "/api/sim":
                 self._send_json(d.sim_payload())
+            elif parsed.path == "/api/train":
+                self._send_json(d.train_payload())
             elif parsed.path == "/api/costs":
                 self._send_json(d.costs_payload())
             elif parsed.path == "/api/budget":
