@@ -2750,6 +2750,171 @@ def measure_flywheel(backend, pool, n_rows: int = 6) -> dict:
     return result
 
 
+def measure_treeobs(backend, pool, n_decides: int = N_CYCLES) -> dict:
+    """Config 26: the session-graph plane (ISSUE 20) as a benchmark.
+
+    Two phases of real ConsensusEngine decides under a stamped agent
+    tree: OFF (plane disabled) and ON (lineage registered, every
+    decide booked to its node). The temp-0 decisions must be identical
+    (ASSERT — the plane is read-only by construction); the tokens/sec
+    delta prices the bookkeeping. The ON window then re-checks the
+    rollup conservation contract on the assembled view (recursive
+    subtree totals == flat sums, exact integers), times a fleet-wide
+    ``tree_payload`` assembly, and replays the canonical agent-tree
+    sim trace through a standalone TreeRegistry to produce the
+    critical-path column over every generated tree. Detail (full
+    /api/tree view + per-tree sim critical paths) lands in the
+    TREEOBS sidecar (QUORACLE_BENCH_TREEOBS)."""
+    from quoracle_tpu.consensus.engine import ConsensusConfig, ConsensusEngine
+    from quoracle_tpu.infra import treeobs
+
+    def run_phase(tag: str, tree) -> dict:
+        eng = ConsensusEngine(backend, ConsensusConfig(
+            model_pool=list(pool),
+            session_key=f"bench-config26-{tag}",
+            tree=tree))
+        t0 = time.monotonic()
+        decisions, tokens = [], 0
+        for i in range(n_decides):
+            msgs = {m: [{"role": "system", "content": SYSTEM_PROMPT},
+                        {"role": "user",
+                         "content": TASKS[(i + 5) % len(TASKS)]}]
+                    for m in pool}
+            out = eng.decide(msgs)
+            d = out.decision
+            decisions.append((d.action, d.params) if d else None)
+            tokens += out.completion_tokens
+            log(f"config26 decide {i} ({tag}): status={out.status}")
+        wall = time.monotonic() - t0
+        return {"decisions": decisions, "tokens": tokens,
+                "wall_s": round(wall, 3),
+                "tokens_per_s": round(tokens / max(1e-9, wall), 1)}
+
+    # warmup pays the pool's compiles so they land in no phase
+    ConsensusEngine(backend, ConsensusConfig(
+        model_pool=list(pool),
+        session_key="bench-config26-warmup")).decide(
+        {m: [{"role": "system", "content": SYSTEM_PROMPT},
+             {"role": "user", "content": TASKS[0]}] for m in pool})
+
+    phases: dict = {}
+    treeobs.reset()
+    treeobs.disable()
+    try:
+        phases["off"] = run_phase("off", None)
+    finally:
+        treeobs.reset()
+
+    treeobs.enable()
+    treeobs.register_spawn("bench26-root", tree_id="bench26-tree")
+    kid = treeobs.register_spawn("bench26-kid",
+                                 parent_id="bench26-root")
+    phases["on"] = run_phase("on", kid.to_dict())
+
+    # read-only by construction: temp-0 decisions identical off / on
+    equal = phases["off"]["decisions"] == phases["on"]["decisions"]
+    assert equal, \
+        "config26: temp-0 decisions diverged with treeobs on"
+
+    # fleet-wide assembly wall + the conservation recheck: the
+    # assembled view's recursive rollup equals the flat node sums
+    # (tree_view asserts it internally; restate the arithmetic here
+    # from the emitted rows so the bench record is self-evident)
+    t0 = time.monotonic()
+    view = treeobs.tree_payload("bench26-tree")
+    assembly_ms = (time.monotonic() - t0) * 1000.0
+    assert view["conserved"], "config26: rollup conservation broken"
+    rows = {n["node_id"]: n for n in view["nodes"]}
+    flat = {k: sum(n[k] for n in view["nodes"])
+            for k in ("chip_ns", "tokens", "wait_ns")}
+    conserved = flat == view["totals"] == \
+        rows["bench26-root"]["subtree"]
+    assert conserved, "config26: rollup recheck failed"
+    booked = rows["bench26-kid"]
+
+    # the critical-path column over the canonical agent-tree sim
+    # trace: every generated tree replayed into a standalone registry
+    # (modeled decode chip time at the scenario capacity), then viewed
+    from quoracle_tpu.sim.gate import SIM_SCENARIOS
+    from quoracle_tpu.sim.replay import ReplayDriver
+    from quoracle_tpu.sim.workload import (
+        canonical_spec, generate, tree_id_of,
+    )
+    sc = SIM_SCENARIOS["agent_tree"]
+    trace = generate(canonical_spec("agent_tree", seed=0))
+    ledger = ReplayDriver(trace, capacity=sc.capacity).run()
+    reg = treeobs.TreeRegistry()
+    by_eid = {e.eid: e for e in trace.events}
+    # register parents before children (dot-depth order) so depth
+    # derives from the parent record, then book each replayed row
+    ctxs: dict = {}
+    tree_events = [e for e in trace.events if tree_id_of(e)]
+    for e in sorted(tree_events,
+                    key=lambda e: (e.session.count("."), e.session)):
+        parent = (e.session.rsplit(".", 1)[0]
+                  if "." in e.session else None)
+        ctxs[e.session] = reg.register_spawn(
+            e.session, parent_id=parent, tree_id=tree_id_of(e))
+    for r in ledger.rows:
+        if not r[9]:
+            continue
+        chip_ms = 1000.0 * r[8] / sc.capacity.decode_tok_s
+        reg.charge_decide(ctxs[by_eid[r[0]].session], chip_ms, r[8])
+    tree_ids = sorted({tree_id_of(e) for e in trace.events
+                       if tree_id_of(e)})
+    sim_paths = []
+    for tid in tree_ids:
+        v = treeobs.tree_view(tid, [reg.local_state(tid)],
+                              registry=reg)
+        assert v["conserved"] and not v["orphans"]
+        sim_paths.append({
+            "tree_id": tid, "n_nodes": v["n_nodes"],
+            "max_depth": v["max_depth"],
+            "critical_path": v["critical_path"]["node_ids"],
+            "critical_path_cost_ns":
+                v["critical_path"]["cost_ns"],
+            "total_chip_ns": v["totals"]["chip_ns"],
+        })
+    longest = max(sim_paths,
+                  key=lambda p: (len(p["critical_path"]),
+                                 p["critical_path_cost_ns"]))
+
+    off_tps = phases["off"]["tokens_per_s"]
+    result = {
+        "n_decides": n_decides,
+        "n_members": len(pool),
+        "temp0_equal": equal,
+        "tokens_per_s_off": off_tps,
+        "tokens_per_s_on": phases["on"]["tokens_per_s"],
+        "plane_overhead_frac": (
+            round(1.0 - phases["on"]["tokens_per_s"] / off_tps, 4)
+            if off_tps else None),
+        "conservation_exact": conserved,
+        "booked_decides": booked["decides"],
+        "booked_chip_ns": booked["chip_ns"],
+        "booked_tokens": booked["tokens"],
+        "assembly_wall_ms": round(assembly_ms, 3),
+        "sim_trees": len(sim_paths),
+        "sim_nodes": sum(p["n_nodes"] for p in sim_paths),
+        "sim_critical_path_max_len": len(longest["critical_path"]),
+        "sim_critical_path_max_cost_ns":
+            longest["critical_path_cost_ns"],
+        "sim_critical_path_tree": longest["tree_id"],
+    }
+    sidecar = os.environ.get("QUORACLE_BENCH_TREEOBS")
+    if sidecar:
+        try:
+            with open(sidecar, "w") as f:
+                json.dump({"metric": "treeobs", "config26": result,
+                           "api_tree_view": view,
+                           "sim_critical_paths": sim_paths},
+                          f, indent=1, default=str)
+            log(f"config26 treeobs detail written to {sidecar}")
+        except OSError as e:
+            log(f"config26 sidecar write failed: {e}")
+    return result
+
+
 def base_payload() -> dict:
     """Every key the artifact can carry, pre-filled null — ANY exit path
     prints this line with whatever was actually measured, so degraded runs
@@ -3549,6 +3714,17 @@ def _run(args, payload: dict, deadline_at: float) -> None:
     if cfg25:
         log(f"config25: {cfg25}")
 
+    # config 26 prices the session-graph plane (ISSUE 20): treeobs
+    # off/on tokens-per-second over real decides under a stamped
+    # lineage (temp-0 ASSERT — the plane is observed-only), the exact
+    # rollup-conservation recheck on the assembled /api/tree view plus
+    # its assembly wall, and the critical-path column over the
+    # canonical agent-tree sim trace; the sidecar
+    # (QUORACLE_BENCH_TREEOBS) carries the full view + per-tree paths
+    cfg26 = guard("config26", lambda: measure_treeobs(backend, pool))
+    if cfg26:
+        log(f"config26: {cfg26}")
+
     # config 19 builds its own backends (quantized vs not must not share
     # engines — the whole point is two independent numeric regimes)
     cfg19 = guard("config19", lambda: measure_quant(pool))
@@ -3954,6 +4130,23 @@ def _run(args, payload: dict, deadline_at: float) -> None:
                 cfg25["inflight_rows_dropped"],
             "config25_promotion_uplift": cfg25["promotion_uplift"],
             "config25_temp0_equal": cfg25["temp0_equal"],
+        })
+    if cfg26:
+        payload.update({
+            "config26_temp0_equal": cfg26["temp0_equal"],
+            "config26_tokens_per_s_off": cfg26["tokens_per_s_off"],
+            "config26_tokens_per_s_on": cfg26["tokens_per_s_on"],
+            "config26_plane_overhead_frac":
+                cfg26["plane_overhead_frac"],
+            "config26_conservation_exact":
+                cfg26["conservation_exact"],
+            "config26_assembly_wall_ms": cfg26["assembly_wall_ms"],
+            "config26_sim_trees": cfg26["sim_trees"],
+            "config26_sim_nodes": cfg26["sim_nodes"],
+            "config26_sim_critical_path_max_len":
+                cfg26["sim_critical_path_max_len"],
+            "config26_sim_critical_path_max_cost_ns":
+                cfg26["sim_critical_path_max_cost_ns"],
         })
     if cfg10:
         payload.update({

@@ -53,6 +53,7 @@ from quoracle_tpu.context.history import (
 from quoracle_tpu.context.message_builder import build_messages_for_model
 from quoracle_tpu.governance.capabilities import filter_actions
 from quoracle_tpu.infra.costs import CostEntry
+from quoracle_tpu.infra import treeobs
 from quoracle_tpu.infra.injection import UNTRUSTED_ACTIONS, wrap_untrusted
 from quoracle_tpu.infra.telemetry import TRACER
 from quoracle_tpu.utils.normalize import to_json
@@ -146,12 +147,27 @@ class AgentCore:
             self.skills_loader = deps.skills
         self.active_skills: list[str] = list(config.active_skills)
 
+        # Session-graph lineage (ISSUE 20): stamp this agent into the
+        # tree registry BEFORE the engine builds so priority_for_depth
+        # can read depth O(1).  register_spawn is idempotent — the
+        # supervisor may have pre-registered us at start_agent.
+        self._tree_ctx = treeobs.register_spawn(
+            self.agent_id, config.parent_id, tree_id=config.task_id,
+            deadline_ms=config.deadline_ms,
+            token_budget=config.token_budget)
+
         self.engine = self._build_engine()
 
     def _tree_depth(self) -> int:
-        """Distance from the task root, walked through the live registry
-        (parents register before spawning children, so the chain is
-        complete at build time; a cycle guard covers restore oddities)."""
+        """Distance from the task root.  Fast path (ISSUE 20): the
+        treeobs TreeRegistry already holds our depth O(1) — parents
+        register before spawning children, so our record derived its
+        depth from the parent's at spawn.  Fallback (treeobs disabled
+        or record evicted): walk the live agent registry parent chain
+        (a cycle guard covers restore oddities)."""
+        d = treeobs.depth_of(self.agent_id)
+        if d is not None:
+            return int(d)
         depth, cur, seen = 0, self.config.parent_id, set()
         while cur is not None and cur not in seen:
             seen.add(cur)
@@ -188,6 +204,10 @@ class AgentCore:
                 # decide's audit record lands under this task at
                 # /api/consensus?task_id=… (consensus/quality.py)
                 task_id=config.task_id,
+                # session-graph lineage (ISSUE 20): every decide this
+                # engine issues books chip/tokens/waits to our tree node
+                tree=(self._tree_ctx.to_dict()
+                      if self._tree_ctx is not None else None),
             ),
             log=lambda event, data: deps.events.log(
                 self.agent_id, "debug", event, **data))
@@ -374,7 +394,8 @@ class AgentCore:
         current span thread-locally is safe here — this runs on an
         executor thread, one tick at a time per agent."""
         with TRACER.span("agent.decide_tick", trace_id=self.config.task_id,
-                         parent=None, agent_id=self.agent_id):
+                         parent=None, agent_id=self.agent_id), \
+                treeobs.bind(self._tree_ctx):
             # Tiered-KV prefetch (ISSUE 7): this agent is about to run a
             # consensus round keyed by its own id — warm any hibernated
             # session now so the page-in overlaps prompt building and
@@ -656,4 +677,7 @@ class AgentCore:
                 logger.exception("agent %s: ACE persist on terminate failed",
                                  self.agent_id)
         deps.events.agent_terminated(self.agent_id, self.stop_reason)
+        # session-graph lineage (ISSUE 20): the node's measurements stay
+        # queryable until its whole tree completes and ages off the LRU
+        treeobs.complete_node(self.agent_id)
         self.stopped.set()

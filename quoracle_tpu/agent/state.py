@@ -74,6 +74,14 @@ class AgentConfig:
     tenant: str = "default"
     qos_priority: Optional[int] = None
 
+    # session-graph observability (ISSUE 20): OBSERVED-ONLY inherited
+    # limits.  When set they ride the TreeContext into infra/treeobs —
+    # children spawned with None inherit the parent's values; a subtree
+    # exceeding token_budget fires the tree_budget_overrun flight event.
+    # Nothing in the decide path enforces these; they are signals.
+    deadline_ms: Optional[int] = None
+    token_budget: Optional[int] = None
+
     # actions
     working_dir: str = "/tmp"
     max_consensus_retries: int = 3                  # agent AGENTS.md:204-214

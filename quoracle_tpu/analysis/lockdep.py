@@ -125,6 +125,13 @@ HIERARCHY: tuple = (
                                     # tap and quality sink both fire
                                     # outside their planes' locks) and
                                     # may fire chaos.plan (48) beneath
+    ("treeobs",        47, False),  # session-graph registry (ISSUE 20,
+                                    # infra/treeobs.py): node records +
+                                    # integer rollup counters — charge
+                                    # sites run under serving locks, so
+                                    # it sits above them; metric/flight
+                                    # emission happens strictly OUTSIDE
+                                    # it (costobs discipline)
     # -- chaos plane (ISSUE 11) -----------------------------------------
     ("chaos.plan",     48, False),  # ChaosPlane armed-plan + fire ledger:
                                     # fire() is called under store/tier

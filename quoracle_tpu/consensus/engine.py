@@ -85,6 +85,12 @@ class ConsensusConfig:
     # decisions are bit-identical with it on or off.
     task_id: Optional[str] = None
     quality: bool = True
+    # Session-graph observability (ISSUE 20): the owning agent's tree
+    # context dict (treeobs.TreeContext.to_dict), stamped onto every
+    # QueryRequest this engine issues so remote peers book waits to the
+    # same tree node, and consumed by the decide chokepoint's per-node
+    # chip/token charge. Observed-only; never read by decision logic.
+    tree: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -199,6 +205,17 @@ class ConsensusEngine:
             # the histogram's zero bucket is the "unmetered" population.
             COST_DECIDE_CHIP_MS.observe(outcome.chip_ms)
             COST_DECIDE_TOKENS.observe(float(outcome.completion_tokens))
+        from quoracle_tpu.infra import treeobs
+        if treeobs.enabled():
+            # Session-graph rollup (ISSUE 20): exactly ONE node charge
+            # per decide — the unit the subtree conservation contract
+            # counts. Falls back to the thread binding so engines built
+            # without an explicit tree (tests, bench) still attribute
+            # when a caller bound one.
+            treeobs.charge_decide(
+                self.config.tree or treeobs.current(),
+                outcome.chip_ms, outcome.completion_tokens,
+                audit=outcome.audit)
         if outcome.audit is not None:
             # Scorecards + entropy/margin instruments + drift detection +
             # audit-record fan-out (consensus/quality.py). After the
@@ -380,6 +397,9 @@ class ConsensusEngine:
                 # round's device wall up by (task, decide)
                 task_id=cfg.task_id,
                 decide=outcome.decide_id,
+                # session-graph lineage (ISSUE 20): rides rows + wire
+                # headers so every peer books to the same tree node
+                tree=cfg.tree,
             )
             for m in pool
         ]

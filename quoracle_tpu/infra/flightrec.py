@@ -371,6 +371,17 @@ FLIGHT_EVENTS: dict = {
                  "wall and were deterministically trimmed — the "
                  "sum-to-wall invariant held, but the overlap is an "
                  "instrumentation bug to chase",
+    # session-graph observability (ISSUE 20, infra/treeobs.py)
+    "tree_orphan": "a tree node's parent record is missing from the "
+                   "assembled view (the parent's peer crashed before "
+                   "its registry state was federated) — the node is "
+                   "FLAGGED, never silently unparented; fires once per "
+                   "(tree, node)",
+    "tree_budget_overrun": "a node's subtree spent more completion "
+                           "tokens than the budget it inherited at "
+                           "spawn — observed signal only (no policy "
+                           "acts on it this PR); fires once per "
+                           "(tree, node) with the overspend",
     # serving flywheel (ISSUE 19, quoracle_tpu/training/)
     "train_capture_degraded": "the capture plane absorbed a write "
                               "failure (real or injected) and dropped "

@@ -1182,6 +1182,38 @@ TRAIN_PROMOTIONS_TOTAL = METRICS.counter(
     "means the live acceptance guard tripped after promotion "
     "(DEPLOY §20 PromotionRollback / AcceptanceRegression)")
 
+# -- session-graph observability (ISSUE 20) ----------------------------------
+# Agent-tree plane (infra/treeobs.py): lineage registry and subtree
+# rollups over what the planes above already measure. Read-only like
+# costobs/introspect — temp-0 on/off bit-equality depends on tree
+# bookkeeping never touching a serving decision (QUORACLE_TREEOBS=0
+# kills the whole plane).
+TREE_NODES_TOTAL = METRICS.counter(
+    "quoracle_tree_nodes_total",
+    "agent-tree node registrations by event (spawned | completed) — "
+    "the spawned-minus-completed gap is the live node census")
+TREE_ORPHANS_TOTAL = METRICS.counter(
+    "quoracle_tree_orphans_total",
+    "nodes flagged orphaned at tree assembly: the parent record is "
+    "missing (its peer crashed before federation) — flagged, never "
+    "silently unparented (DEPLOY §21 TreeOrphanRate)")
+TREE_BUDGET_OVERRUNS_TOTAL = METRICS.counter(
+    "quoracle_tree_budget_overruns_total",
+    "subtrees that overspent the token budget inherited at spawn — "
+    "observed only, no policy acts on it (DEPLOY §21 "
+    "TreeBudgetOverrun)")
+TREE_DEPTH = METRICS.histogram(
+    "quoracle_tree_depth",
+    "spawn depth of each registered agent-tree node (root = 0) — a "
+    "drifting upper tail is runaway recursion (DEPLOY §21 "
+    "TreeDepthRunaway)",
+    buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16, 24))
+TREE_FANOUT = METRICS.gauge(
+    "quoracle_tree_fanout",
+    "mean children per node at each depth over the registry's current "
+    "window, by depth — the fan-out prior exported read-only into "
+    "FleetSignals for the elastic-fleet roadmap item")
+
 # -- consensus quality (ISSUE 5) ---------------------------------------------
 # Decision-quality instruments (consensus/quality.py): per-decide
 # contestedness and the per-member scorecard counters. Registered at

@@ -95,6 +95,14 @@ class QueryRequest:
     # by the costobs charge path — never by generate/sampling.
     task_id: Optional[str] = None
     decide: Optional[str] = None
+    # -- session-graph observability (ISSUE 20) ------------------------
+    # Compact tree context (infra/treeobs.TreeContext.to_dict: tree /
+    # node / parent ids + depth + spawn ordinal) stamped at the agent
+    # spawn that issued this request, riding rows and wire headers like
+    # ``trace`` above. Read only by treeobs charge sites — never by
+    # generate/sampling, so temp-0 bits are identical with or without
+    # it.
+    tree: Optional[dict] = None
 
 
 @dataclasses.dataclass
@@ -913,6 +921,7 @@ class TPUBackend(ModelBackend):
                 "priority": r.priority, "tenant": r.tenant,
                 "deadline_s": deadline_s,
                 "task_id": r.task_id, "decide": r.decide,
+                "tree": r.tree,
             })
             live_idxs.append(i)
         return rows, live_idxs
@@ -1057,7 +1066,8 @@ class TPUBackend(ModelBackend):
                     action_enum=r["action_enum"],
                     priority=r["priority"], tenant=r["tenant"],
                     deadline_s=r["deadline_s"],
-                    task_id=r.get("task_id"), decide=r.get("decide")))
+                    task_id=r.get("task_id"), decide=r.get("decide"),
+                    tree=r.get("tree")))
         for i, f in zip(live_idxs, futs):
             try:
                 g = f.result()

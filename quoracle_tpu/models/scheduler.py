@@ -64,7 +64,7 @@ from concurrent.futures import Future
 from typing import Any, Optional, Sequence
 
 from quoracle_tpu.analysis.lockdep import named_lock
-from quoracle_tpu.infra import costobs, fleetobs, introspect
+from quoracle_tpu.infra import costobs, fleetobs, introspect, treeobs
 from quoracle_tpu.infra.flightrec import FLIGHT
 from quoracle_tpu.infra.telemetry import (
     QOS_ADMIT_WAIT_MS, SCHED_ADMIT_WAIT_MS, SCHED_QUEUE_DEPTH,
@@ -128,6 +128,11 @@ class _Row:
     # retire; the named waits + exact remainder ride the sched.decode
     # span as ``waits_ns``.
     waits: Optional[Any] = None
+    # Session-graph observability (ISSUE 20): the submitting agent's
+    # tree context dict (treeobs.TreeContext.to_dict), carried so the
+    # retire site can book this row's wait decomposition to the right
+    # tree node — on whichever peer the row lands after a handoff.
+    tree: Optional[dict] = None
 
 
 class ContinuousBatcher:
@@ -187,7 +192,8 @@ class ContinuousBatcher:
                deadline_s: Optional[float] = None,
                initial_json_state: Optional[int] = None,
                task_id: Optional[str] = None,
-               decide: Optional[str] = None) -> Future:
+               decide: Optional[str] = None,
+               tree: Optional[dict] = None) -> Future:
         """``initial_json_state`` resumes a constrained row MID-GRAMMAR:
         the prompt's tail already contains generated JSON (a prefill-tier
         replica's first token after a KV handoff, serving/cluster.py) and
@@ -203,6 +209,7 @@ class ContinuousBatcher:
                    tenant=tenant, deadline_s=deadline_s,
                    json_state=initial_json_state,
                    task_id=task_id, decide=decide,
+                   tree=(tree if treeobs.enabled() else None),
                    # trace capture only while something listens — the
                    # un-traced fast path stays allocation-identical
                    trace=(fleetobs.TraceContext.current()
@@ -538,6 +545,11 @@ class ContinuousBatcher:
             closed = row.waits.close()
             introspect.record_row_waits(self._model, closed)
             introspect.beat(f"sched.retired:{self._model}")
+            # Session-graph rollup (ISSUE 20): the same exact-sum wait
+            # decomposition, booked to the tree node this row belongs
+            # to — on THIS peer's registry; the front door federates.
+            if row.tree is not None and treeobs.enabled():
+                treeobs.charge_row_waits(row.tree, closed)
         if TRACER.active():
             # one decode span per row lifetime, anchored at admission
             # so queue wait is never double-counted in the TTFT

@@ -142,11 +142,24 @@ class RemoteReplica:
         ctx = fleetobs.TraceContext.current()
         return ctx.to_dict() if ctx is not None else None
 
+    @staticmethod
+    def _tree_dict() -> Optional[dict]:
+        """The calling thread's tree context as a wire-able dict —
+        lineage for peers whose charges must land on the caller's
+        tree node (ISSUE 20)."""
+        from quoracle_tpu.infra import treeobs
+        if not treeobs.enabled():
+            return None
+        ctx = treeobs.current()
+        return ctx.to_dict() if ctx is not None else None
+
     def serve(self, request):
         from quoracle_tpu.serving.fabric import wire
         d = wire.request_to_dict(request)
         if d.get("trace") is None:
             d["trace"] = self._trace_dict()
+        if d.get("tree") is None:
+            d["tree"] = self._tree_dict()
         _, payload = self.transport.request(
             wire.MSG_SERVE, wire.encode_json(d))
         return wire.result_from_dict(wire.decode_json(payload))
@@ -159,6 +172,8 @@ class RemoteReplica:
         d = wire.request_to_dict(request)
         if d.get("trace") is None:
             d["trace"] = self._trace_dict()
+        if d.get("tree") is None:
+            d["tree"] = self._tree_dict()
         _, payload = self.transport.request(
             wire.MSG_PREFILL,
             wire.encode_json({
@@ -178,7 +193,8 @@ class RemoteReplica:
                   "model_spec": meta["model_spec"],
                   "prompt": meta["prompt"], "row": meta["row"],
                   "g1": meta["g1"], "owns": owns,
-                  "trace": self._trace_dict()}
+                  "trace": self._trace_dict(),
+                  "tree": self._tree_dict()}
         _, payload = self.transport.request(
             wire.MSG_DECODE, wire.pack_blob(header, env_bytes))
         return wire.decode_json(payload)
@@ -194,6 +210,17 @@ class RemoteReplica:
                 "trace_id": trace_id}))
         out = wire.decode_json(payload)
         return list(out.get("spans") or ())
+
+    def pull_tree(self, tree_id: str) -> dict:
+        """This peer's local tree-registry state for one tree — the
+        MSG_OBS ``tree`` op the front door's /api/tree assembly pulls
+        (ISSUE 20). The payload is registry-tagged so the merge counts
+        loopback peers (shared process registry) exactly once."""
+        from quoracle_tpu.serving.fabric import wire
+        _, payload = self.transport.request(
+            wire.MSG_OBS, wire.encode_json({
+                "op": "tree", "tree_id": tree_id}))
+        return wire.decode_json(payload)
 
     def obs_metrics(self) -> dict:
         """This peer's lossless metrics state (MetricsRegistry.
@@ -473,6 +500,13 @@ class ClusterPlane(ModelBackend):
         return fleetobs.assemble_timeline(
             fleetobs.SPANS.spans(), session_id=session_id,
             trace_id=trace_id)
+
+    def pull_tree(self, tree_id: str) -> dict:
+        """One coherent agent-tree view (ISSUE 20): in-process replicas
+        share the process-wide tree registry, so the pull is local —
+        the wire twin lives on FabricPlane.pull_tree."""
+        from quoracle_tpu.infra import treeobs
+        return treeobs.tree_payload(tree_id)
 
     # -- elastic topology (ISSUE 14, serving/fleet.py) --------------------
 
@@ -820,7 +854,8 @@ class ClusterPlane(ModelBackend):
                 priority=row["priority"], tenant=row["tenant"],
                 deadline_s=row["deadline_s"],
                 initial_json_state=js,
-                task_id=row.get("task_id"), decide=row.get("decide"))
+                task_id=row.get("task_id"), decide=row.get("decide"),
+                tree=row.get("tree"))
             return fut.result()
         de = dec.backend.engines[spec]
         return de.generate(

@@ -352,6 +352,29 @@ class FabricPlane(ModelBackend):
         return fleetobs.assemble_timeline(spans, session_id=session_id,
                                           trace_id=trace_id)
 
+    def pull_tree(self, tree_id: str) -> dict:
+        """GET /api/tree?tree_id=…: ONE coherent agent-tree view
+        assembled across scattered peers (ISSUE 20) — the door's own
+        registry slice plus every reachable peer's, pulled over the
+        MSG_OBS ``tree`` op and merged by treeobs.tree_view (payloads
+        dedup by registry id, so loopback peers sharing this process's
+        registry are counted exactly once; subtree rollup conservation
+        is asserted exact on the merged result). A dead peer's slice is
+        absent — its nodes surface as ORPHANS, never silently
+        unparented."""
+        from quoracle_tpu.infra import treeobs
+        if not treeobs.enabled():
+            return {"enabled": False, "tree_id": tree_id}
+        states = [treeobs.local_tree_state(tree_id)]
+        for p in list(self.peers):
+            if not p.alive or not hasattr(p, "pull_tree"):
+                continue
+            try:
+                states.append(p.pull_tree(tree_id))
+            except WireError:
+                continue
+        return treeobs.tree_payload(tree_id, states)
+
     def pull_profile(self) -> dict:
         """GET /api/profile?scope=fleet: the door's own liveness/
         hotspot payload plus every reachable peer's, pulled over the

@@ -202,6 +202,16 @@ class FabricPeer:
             out = introspect.profile_payload()
             out["replica_id"] = self.replica_id
             return MSG_OBS_RESULT, wire.encode_json(out)
+        if op == "tree":
+            # session-graph observability (ISSUE 20): this peer's local
+            # tree-registry slice for one tree — the front door merges
+            # every peer's slice into a single coherent /api/tree view
+            # (payloads are registry-tagged, so loopback peers sharing
+            # one process registry are counted exactly once)
+            from quoracle_tpu.infra import treeobs
+            out = treeobs.local_tree_state(d.get("tree_id"))
+            out["replica_id"] = self.replica_id
+            return MSG_OBS_RESULT, wire.encode_json(out)
         raise WireError(f"unknown obs op {op!r}", reason="decode")
 
     def _hello(self) -> dict:
@@ -225,7 +235,9 @@ class FabricPeer:
         # rebind the caller's trace (ISSUE 15): this peer's spans —
         # admit, queue-wait, decode — land in the front door's trace
         ctx = fleetobs.TraceContext.from_dict(d.get("trace"))
-        with fleetobs.bind_remote(ctx):
+        from quoracle_tpu.infra import treeobs
+        tctx = treeobs.TreeContext.from_dict(d.get("tree"))
+        with fleetobs.bind_remote(ctx), treeobs.bind(tctx):
             with fleetobs.request_span("peer.serve", r.session_id,
                                        model=r.model_spec,
                                        replica=self.replica_id):
@@ -254,7 +266,10 @@ class FabricPeer:
                 reason="decode")
         ctx = fleetobs.TraceContext.from_dict(
             (d["request"] or {}).get("trace"))
-        with fleetobs.bind_remote(ctx), \
+        from quoracle_tpu.infra import treeobs
+        tctx = treeobs.TreeContext.from_dict(
+            (d["request"] or {}).get("tree"))
+        with fleetobs.bind_remote(ctx), treeobs.bind(tctx), \
                 fleetobs.request_span("peer.prefill", hid, model=spec,
                                       replica=self.replica_id):
             t0 = time.monotonic()
@@ -302,6 +317,9 @@ class FabricPeer:
                 "priority": row["priority"],
                 "tenant": row["tenant"],
                 "deadline_ms_left": deadline_ms_left,
+                # lineage (ISSUE 20): the decode peer's continuation
+                # row books its waits to the same tree node
+                "tree": row.get("tree"),
             },
             "g1": {
                 "token_ids": [int(t) for t in g1.token_ids],
@@ -339,7 +357,16 @@ class FabricPeer:
         # spans land in the front door's trace (ISSUE 15)
         ctx = (fleetobs.TraceContext.from_dict(header.get("trace"))
                or fleetobs.TraceContext.from_dict(env.trace))
-        with fleetobs.bind_remote(ctx), \
+        # same header-first / envelope-fallback for lineage (ISSUE 20):
+        # a drain-migrated envelope carries its own tree stamp even
+        # when the re-placing door thread has none bound
+        from quoracle_tpu.infra import treeobs
+        tctx = (treeobs.TreeContext.from_dict(
+                    (header.get("row") or {}).get("tree"))
+                or treeobs.TreeContext.from_dict(header.get("tree"))
+                or treeobs.TreeContext.from_dict(
+                    getattr(env, "tree", None)))
+        with fleetobs.bind_remote(ctx), treeobs.bind(tctx), \
                 fleetobs.request_span("peer.decode", hid, model=spec,
                                       replica=self.replica_id):
             self.handoff.adopt(de, env, dst_replica=self.replica_id)
@@ -404,7 +431,7 @@ class FabricPeer:
                 session_id=hid, constrain_json=row["constrain_json"],
                 action_enum=ae, priority=row["priority"],
                 tenant=row["tenant"], deadline_s=deadline_s,
-                initial_json_state=js)
+                initial_json_state=js, tree=row.get("tree"))
             return fut.result()
         return de.generate(
             [continuation], temperature=row["temperature"],

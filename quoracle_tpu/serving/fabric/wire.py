@@ -334,6 +334,10 @@ def encode_envelope(env) -> bytes:
     # with a peer that has never heard of tracing.
     if getattr(env, "trace", None):
         header["trace"] = dict(env.trace)
+    # Tree context (ISSUE 20) rides the same header contract: the
+    # adopting peer books the continued row's waits to the same node.
+    if getattr(env, "tree", None):
+        header["tree"] = dict(env.tree)
     chunks = [k.view(np.uint8).reshape(-1).tobytes(),
               v.view(np.uint8).reshape(-1).tobytes()]
     if k_scale is not None:
@@ -430,7 +434,9 @@ def decode_envelope(payload: bytes, expect_signature: Optional[str] = None):
         json_state=header.get("json_state"),
         src_replica=header.get("src_replica", ""),
         trace=header.get("trace") if isinstance(header.get("trace"),
-                                                dict) else None)
+                                                dict) else None,
+        tree=header.get("tree") if isinstance(header.get("tree"),
+                                              dict) else None)
 
 
 # ---------------------------------------------------------------------------
@@ -459,6 +465,8 @@ def request_to_dict(r) -> dict:
         # trace context (ISSUE 15): an un-upgraded peer ignores unknown
         # JSON keys, so a trace-carrying request interops either way
         "trace": r.trace,
+        # tree context (ISSUE 20): same interop contract as trace
+        "tree": r.tree,
     }
 
 
@@ -475,6 +483,8 @@ def request_from_dict(d: dict):
         tenant=d.get("tenant", "default"), priority=d.get("priority"),
         deadline_ms=d.get("deadline_ms"),
         trace=d.get("trace") if isinstance(d.get("trace"), dict)
+        else None,
+        tree=d.get("tree") if isinstance(d.get("tree"), dict)
         else None)
 
 

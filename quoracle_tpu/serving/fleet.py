@@ -121,6 +121,12 @@ class FleetSignals:
     # ``slo_burn``; ``_decide`` does not read it — scaling policy is
     # unchanged until a budget-aware policy is deliberately introduced.
     budget_burn: Optional[dict] = None
+    # OBSERVED only (ISSUE 20): per-depth agent-tree fan-out priors
+    # (mean children per node over the tree registry's current window)
+    # — the predictive input the elastic-fleet roadmap item wants for
+    # spawn-ahead capacity. ``_decide`` does not read it; nothing
+    # scales on a tree shape yet.
+    tree_fanout: Optional[dict] = None
 
     def tier(self, roles: tuple, serving_only: bool = True) -> list:
         return [r for r in self.replicas
@@ -260,11 +266,14 @@ class FleetController:
                 slo = getattr(rep.backend, "slo", None)
                 if slo is not None:
                     burn = max(burn, slo.burn())
-        from quoracle_tpu.infra import costobs
+        from quoracle_tpu.infra import costobs, treeobs
         budget = (costobs.BUDGET.burn_signals()
                   if costobs.enabled() else None)
+        fanout = (treeobs.fanout_signals()
+                  if treeobs.enabled() else None)
         return FleetSignals(replicas=tuple(out), slo_burn=burn,
-                            budget_burn=budget or None)
+                            budget_burn=budget or None,
+                            tree_fanout=fanout or None)
 
     # -- deterministic policy ---------------------------------------------
 

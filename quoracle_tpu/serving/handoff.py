@@ -84,6 +84,11 @@ class HandoffEnvelope:
     # same trace. Rides the wire blob's JSON header; un-upgraded peers
     # skip it (unknown header keys are ignored by construction).
     trace: Optional[dict] = None
+    # Tree context (ISSUE 20): the owning agent's lineage stamp
+    # (treeobs.TreeContext.to_dict) so the adopting peer's continuation
+    # books its waits to the SAME tree node. Same wire contract as
+    # ``trace``: unknown header keys are ignored by un-upgraded peers.
+    tree: Optional[dict] = None
 
     @property
     def n_tokens(self) -> int:
@@ -139,12 +144,15 @@ class KVHandoff:
                 f"session {session_id!r} not exportable from "
                 f"{engine.cfg.name}", reason="export_failed")
         ctx = fleetobs.TraceContext.current()
+        from quoracle_tpu.infra import treeobs
+        tctx = treeobs.current() if treeobs.enabled() else None
         env = HandoffEnvelope(
             session_id=session_id, model_spec=model_spec,
             signature=engine.kv_signature(), entry=entry,
             json_state=json_state, src_replica=src_replica,
             ts=time.monotonic(),
-            trace=ctx.to_dict() if ctx is not None else None)
+            trace=ctx.to_dict() if ctx is not None else None,
+            tree=tctx.to_dict() if tctx is not None else None)
         if getattr(entry, "k_scale", None) is not None:
             # int8 entry (ISSUE 13): this envelope ships ~half the
             # bytes its bf16 twin would — count the savings per tier

@@ -73,7 +73,12 @@ def priority_for_depth(depth: int) -> Priority:
     root agents (depth 0) are the user's direct delegates and outrank
     grandchildren — the deeper the subtree, the more the work resembles
     batch fan-out. INTERACTIVE is reserved for requests a human is
-    actively waiting on (web submissions), never derived from depth."""
+    actively waiting on (web submissions), never derived from depth.
+
+    Depth comes from the O(1) treeobs TreeRegistry record when the
+    session-graph plane is on (ISSUE 20 — stamped at spawn, no registry
+    walk per decide tick); AgentCore._tree_depth falls back to the
+    agent-registry parent-chain walk when treeobs is disabled."""
     if depth <= 0:
         return Priority.AGENT
     if depth <= 2:

@@ -159,7 +159,7 @@ def record_profile(trace: Trace, capacity: CapacityModel,
     restore_ms = dict(capacity.restore_ms)
     by_eid = {e.eid: e for e in trace.events}
     for eid, _t, _cls, outcome, _reason, _ttft, tier_from, _to, \
-            tokens in replay.rows:
+            tokens, _tree in replay.rows:
         if outcome != "ok":
             continue                      # shed work never ran on chips
         e = by_eid[eid]

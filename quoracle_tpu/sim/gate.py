@@ -18,6 +18,11 @@ ledger instead of a storm window:
   hibernation-tier conservation law);
 * ``ledger_deterministic``  — two replays of one trace serialize to
   byte-identical ledgers;
+* ``sim_tree_conservation`` — agent-tree lineage ids in the ledger
+  reconcile EXACTLY with the generated trace (ISSUE 20): per-tree row
+  counts equal per-tree trace event counts, per-tree delivered-token
+  sums equal the trace-side recomputation, and no row carries a tree
+  id its trace event disagrees with;
 * ``temp0_spot_equal``      — when a real plane rides along, the
   sampled temperature-0 texts from both replays are identical and
   every sampled failure is structured.
@@ -42,7 +47,9 @@ from quoracle_tpu.chaos.invariants import (
 from quoracle_tpu.sim.replay import (
     SIM, TIERS, CapacityModel, ReplayDriver, ReplayLedger,
 )
-from quoracle_tpu.sim.workload import Trace, canonical_spec, generate
+from quoracle_tpu.sim.workload import (
+    Trace, canonical_spec, generate, tree_id_of,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -130,6 +137,61 @@ def ledger_deterministic(a: ReplayLedger,
         f"digests {a.digest()} vs {b.digest()}, "
         f"{len(a)} vs {len(b)} rows"
         + ("" if ja == jb else " — NOT byte-identical"))
+
+
+def sim_tree_conservation(trace: Trace,
+                          ledger: ReplayLedger) -> InvariantResult:
+    """Agent-tree lineage accounting (ISSUE 20): every ledger row's
+    tree id matches the trace event it came from, per-tree node (row)
+    counts equal per-tree trace event counts, and per-tree delivered
+    tokens equal the trace-side recomputation (``max_new_tokens *
+    max(1, consensus_k)`` on ok rows, 0 on shed/deadline). EXACT
+    integer equality — never approximate; scenarios without tree
+    streams pass vacuously."""
+    by_eid = {e.eid: e for e in trace.events}
+    want_count: dict = {}
+    for e in trace.events:
+        tid = tree_id_of(e)
+        if tid:
+            want_count[tid] = want_count.get(tid, 0) + 1
+    got_count: dict = {}
+    got_tokens: dict = {}
+    want_tokens: dict = {}
+    for r in ledger.rows:
+        tid = r[9] if len(r) > 9 else ""
+        e = by_eid.get(r[0])
+        expect = tree_id_of(e) if e is not None else ""
+        if tid != expect:
+            return InvariantResult(
+                "sim_tree_conservation", False,
+                f"row {r[0]} tree id {tid!r} != trace {expect!r}")
+        if not tid:
+            continue
+        got_count[tid] = got_count.get(tid, 0) + 1
+        got_tokens[tid] = got_tokens.get(tid, 0) + r[8]
+        want_tokens[tid] = want_tokens.get(tid, 0) + (
+            e.max_new_tokens * max(1, e.consensus_k)
+            if r[3] == "ok" else 0)
+    if not want_count:
+        return InvariantResult(
+            "sim_tree_conservation", True, "no agent-tree events")
+    if got_count != want_count:
+        bad = sorted(set(want_count) ^ set(got_count)
+                     | {t for t in want_count
+                        if got_count.get(t) != want_count[t]})
+        return InvariantResult(
+            "sim_tree_conservation", False,
+            f"node-count mismatch on trees {bad[:4]}")
+    if got_tokens != want_tokens:
+        bad = sorted(t for t in want_tokens
+                     if got_tokens.get(t) != want_tokens[t])
+        return InvariantResult(
+            "sim_tree_conservation", False,
+            f"token-sum mismatch on trees {bad[:4]}")
+    return InvariantResult(
+        "sim_tree_conservation", True,
+        f"{len(want_count)} trees, {sum(want_count.values())} nodes, "
+        f"{sum(got_tokens.values())} tokens reconciled exactly")
 
 
 def temp0_spot_equal(samples_a: list, samples_b: list) -> InvariantResult:
@@ -272,6 +334,7 @@ def run_sim_scenario(name: str, seed: int = 0, plane=None,
         results.append(goodput_floor(ledgers[0], spec.horizon_ms,
                                      sc.goodput_floor_tok_s))
         results.append(tier_conservation(drivers[0].ladder))
+        results.append(sim_tree_conservation(trace, ledgers[0]))
         results.append(temp0_spot_equal(drivers[0].samples,
                                         drivers[1].samples))
     finally:

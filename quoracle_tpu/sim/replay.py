@@ -47,7 +47,7 @@ from typing import Optional
 
 from quoracle_tpu.analysis.lockdep import named_lock
 from quoracle_tpu.sim.workload import (
-    CLASSES, Trace, event_prompt_text,
+    CLASSES, Trace, event_prompt_text, tree_id_of,
 )
 
 logger = logging.getLogger(__name__)
@@ -149,17 +149,20 @@ class TierLadder:
 class ReplayLedger:
     """Per-event outcomes, canonically serializable. One row per trace
     event: ``[eid, t_ms, cls, outcome, reason, ttft_us, tier_from,
-    tier_to, tokens]`` — ints and strings only, so the digest is a
-    byte-level determinism check."""
+    tier_to, tokens, tree]`` — ints and strings only, so the digest is
+    a byte-level determinism check. ``tree`` (ISSUE 20) is the
+    agent-tree lineage id for tree-stream events, empty otherwise; the
+    sim_tree_conservation gate invariant reconciles it against the
+    generated trace exactly."""
 
     def __init__(self):
         self.rows: list = []
 
     def append(self, eid: str, t_ms: int, cls: str, outcome: str,
                reason: str, ttft_us: int, tier_from: str,
-               tier_to: str, tokens: int) -> None:
+               tier_to: str, tokens: int, tree: str = "") -> None:
         self.rows.append([eid, t_ms, cls, outcome, reason, ttft_us,
-                          tier_from, tier_to, tokens])
+                          tier_from, tier_to, tokens, tree])
 
     def __len__(self) -> int:
         return len(self.rows)
@@ -298,7 +301,7 @@ class ReplayDriver:
                                   + decode)
             ledger.append(e.eid, e.t_ms, e.cls, outcome, reason,
                           int(round(ttft_ms * 1000.0)), tier_from,
-                          "resident", tokens)
+                          "resident", tokens, tree_id_of(e))
             key = (e.stream.split(":", 1)[0], outcome)
             event_counts[key] = event_counts.get(key, 0) + 1
             if outcome == "ok" and idx % observe_stride == 0:

@@ -331,6 +331,17 @@ def _event(seed: int, stream: str, n: int, t_ms: int, session: str,
         consensus_k=k)
 
 
+def tree_id_of(e) -> str:
+    """Agent-tree lineage id for a trace event (ISSUE 20 satellite):
+    tree sessions are named ``tree{idx}-r{r}`` at the root and
+    ``{parent}.{c}`` down the spawn chain, so the root segment before
+    the first dot IS the tree id. Non-tree events (any stream other
+    than ``tree:*``) carry no lineage — empty string."""
+    if not getattr(e, "stream", "").startswith("tree:"):
+        return ""
+    return e.session.split(".", 1)[0]
+
+
 def _gen_tenant(spec: WorkloadSpec, t: TenantSpec, out: list) -> None:
     stream = f"tenant:{t.name}"
     n = 0

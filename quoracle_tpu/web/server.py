@@ -77,6 +77,11 @@ Endpoints (reference routes at lib/quoracle_web/router.ex:22-32):
                             collapsed-stack wall-clock profile windows,
                             heartbeats, stall status, wait-state totals
                             (fleet-federated on a front door)
+  GET  /api/tree?tree_id    one agent tree's session graph (ISSUE 20):
+                            per-node + subtree chip-ns/token/wait
+                            rollups (conservation exact), critical
+                            path, orphan flags — assembled across
+                            every fabric peer on a front door
   GET  /api/tasks           tasks + live agent counts
   GET  /api/agents?task_id  agent tree with budget/cost/todo state
   GET  /api/logs?agent_id   durable logs (newest last)
@@ -736,6 +741,27 @@ class DashboardServer:
             return fn()
         return introspect.profile_payload()
 
+    def tree_payload(self, tree_id: Optional[str] = None) -> dict:
+        """GET /api/tree?tree_id=…: one coherent agent-tree view
+        (ISSUE 20) — per-node and per-subtree rollups (chip-ns, tokens,
+        wait-ns; conservation exact), the critical path, and orphan
+        flags. On a front door the view assembles every alive peer's
+        registry slice (backend.pull_tree); a single process reports
+        its own. With no filter, the most recently registered tree is
+        shown."""
+        from quoracle_tpu.infra import treeobs
+        if not treeobs.enabled():
+            return {"enabled": False, "tree_id": tree_id}
+        if tree_id is None:
+            trees = treeobs.local_tree_state().get("trees") or {}
+            tree_id = next(reversed(trees), None)
+            if tree_id is None:
+                return {"enabled": True, "tree_id": None, "nodes": []}
+        fn = getattr(self.runtime.backend, "pull_tree", None)
+        if fn is not None:
+            return fn(tree_id)
+        return treeobs.tree_payload(tree_id)
+
     def settings_payload(self) -> dict:
         """The settings surface (reference SecretManagementLive): system
         settings, profiles, secret METADATA (values never leave the vault),
@@ -910,6 +936,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_json(d.incidents_payload())
             elif parsed.path == "/api/profile":
                 self._send_json(d.profile_payload())
+            elif parsed.path == "/api/tree":
+                self._send_json(d.tree_payload(one("tree_id")))
             elif parsed.path == "/metrics":
                 # Prometheus text exposition; gated by the same bearer
                 # token as the API above (scrapers pass it via the

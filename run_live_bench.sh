@@ -6,7 +6,7 @@
 # mid-calibration the round lost its primary bench record entirely; the
 # header claimed "commit immediately" but the script never committed.)
 cd /root/repo
-LOG=RELAY_POLL_r21.log
+LOG=RELAY_POLL_r22.log
 echo "$(date -u +%FT%TZ) direct run: device confirmed live (probe ok)" >> "$LOG"
 
 # Primary record first. If a previous run left calibration gates behind,
@@ -38,25 +38,25 @@ echo "$(date -u +%FT%TZ) direct run: device confirmed live (probe ok)" >> "$LOG"
 # over the loopback wire (handoff p95 + per-row serialization
 # overhead, temp-0 equality ASSERT), measures the fleet prefix hit
 # rate cold-start with vs without prefixd, and front-door throughput
-# at N loopback peers; detail in FABRIC_r21_live.json
+# at N loopback peers; detail in FABRIC_r22_live.json
 # (QUORACLE_BENCH_FABRIC). In r15 config 19 landed — quantized
 # serving (int8 weights + int8 KV pages): byte-rate/handoff/spill
 # ratios, tokens/sec and scorecard deltas quantized vs not, with a
-# self-consistency assert; detail in QUANT_r21_live.json
+# self-consistency assert; detail in QUANT_r22_live.json
 # (QUORACLE_BENCH_QUANT). In r16 config 20 landed — the elastic fleet
 # controller (ISSUE 14): the same mixed traffic through a 3-replica
 # prefill/decode QoS cluster static vs scale events forced
 # mid-traffic (policy scale-up, forced drain with live session
 # migration, re-tier round trip, scale-down) — goodput delta, SLO
 # burn during the drain/re-tier window, sessions migrated/sec, and
-# the temp-0 equality assert; detail in FLEET_r21_live.json
+# the temp-0 equality assert; detail in FLEET_r22_live.json
 # (QUORACLE_BENCH_FLEET). In r17 config 21 landed — fleet observability
 # (ISSUE 15): the same disaggregated traffic through a loopback
 # prefill+decode fabric tracing off vs on (tokens/sec delta + temp-0
 # equality ASSERT), one traced session's cross-peer TTFT
 # decomposition (stages sum to the door-observed wall), and the
 # metrics-federation sweep wall with rollup quantiles checked against
-# the lossless-merge oracle; detail in FLEETOBS_r21_live.json
+# the lossless-merge oracle; detail in FLEETOBS_r22_live.json
 # (QUORACLE_BENCH_FLEETOBS). In r18 config 22 landed — the fleet
 # simulator (ISSUE 16): the canonical workload traces (diurnal mix,
 # burst storm, agent tree, 100k-session long-tail ladder) generated
@@ -64,22 +64,22 @@ echo "$(date -u +%FT%TZ) direct run: device confirmed live (probe ok)" >> "$LOG"
 # invariant gate at compressed time — replay events/sec, compression
 # factor, outcome mixes, the long-tail tier census, and the ledger
 # digests that witness determinism across revisions; detail in
-# SIM_r21_live.json (QUORACLE_BENCH_SIM). In r19 config 23 landed —
+# SIM_r22_live.json (QUORACLE_BENCH_SIM). In r19 config 23 landed —
 # the chip-economics plane (ISSUE 17): real decides with cost
 # accounting off vs on (tokens/sec delta + temp-0 equality ASSERT),
 # the ON window's per-stage chip-second decomposition with the
 # exact-sum invariant re-checked at bench scale, best MFU per
 # compiled program with cliff counts, and the sim-calibration loop
 # fitted from the live ledger profile gated on reproducing measured
-# TTFT quantiles; detail in COST_r21_live.json
+# TTFT quantiles; detail in COST_r22_live.json
 # (QUORACLE_BENCH_COST). In r20 config 24 landed — the liveness &
 # hotspot plane (ISSUE 18): real decides with introspect off vs
 # default vs aggressive sampling (temp-0 equality ASSERT), the
 # profiler's SELF-MEASURED overhead fraction gated at 1% for the
 # default rate, the wait-state decomposition totals (named waits +
 # exact remainder sum to each row's wall), heartbeat deltas and
-# stall-detector status; detail in INTROSPECT_r21_live.json
-# (QUORACLE_BENCH_INTROSPECT). NEW in r21: config 25 — the serving
+# stall-detector status; detail in INTROSPECT_r22_live.json
+# (QUORACLE_BENCH_INTROSPECT). In r21 config 25 landed — the serving
 # flywheel (ISSUE 19): one full capture → train → evaluate → promote
 # cycle on the live chip — the same temp-0 rows through the
 # continuous self-draft spec path with the replay capture plane off
@@ -88,49 +88,60 @@ echo "$(date -u +%FT%TZ) direct run: device confirmed live (probe ok)" >> "$LOG"
 # through the real verify_chunk path, and a live hot-swap promotion
 # with rows IN FLIGHT (every row must land — swap downtime == 0
 # ASSERT — plus the promoted-draft tokens/sec uplift); detail in
-# FLYWHEEL_r21_live.json (QUORACLE_BENCH_FLYWHEEL). Config 15's
-# detail lands in the RAGGED_r21_live.json sidecar, config 16's in
-# CLUSTER_r21_live.json, config 17's in CHAOS_r21_live.json,
+# FLYWHEEL_r22_live.json (QUORACLE_BENCH_FLYWHEEL). NEW in r22:
+# config 26 — the session-graph plane (ISSUE 20): real decides under
+# a stamped agent-tree lineage with treeobs off vs on (temp-0
+# decisions BIT-EQUAL ASSERT — the plane is observed-only — plus the
+# tokens/sec delta pricing the bookkeeping), the exact
+# rollup-conservation recheck on the assembled /api/tree view
+# (recursive subtree totals == flat node sums, exact integers) with
+# the fleet-wide assembly wall, and the critical-path column over
+# every tree in the canonical agent-tree sim trace; detail in
+# TREEOBS_r22_live.json (QUORACLE_BENCH_TREEOBS). Config 15's
+# detail lands in the RAGGED_r22_live.json sidecar, config 16's in
+# CLUSTER_r22_live.json, config 17's in CHAOS_r22_live.json,
 # committed with the bench record alongside the
 # RESOURCES/QUALITY/SPEC/KVTIER sidecars.
 [ -f /root/repo/calib_v5e.json ] && export QUORACLE_PAGED_CALIB=/root/repo/calib_v5e.json
-export QUORACLE_BENCH_RESOURCES=/root/repo/RESOURCES_r21_live.json
-export QUORACLE_BENCH_QUALITY=/root/repo/QUALITY_r21_live.json
-export QUORACLE_BENCH_SPEC=/root/repo/SPEC_r21_live.json
-export QUORACLE_BENCH_KV=/root/repo/KVTIER_r21_live.json
-export QUORACLE_BENCH_RAGGED=/root/repo/RAGGED_r21_live.json
-export QUORACLE_BENCH_CLUSTER=/root/repo/CLUSTER_r21_live.json
-export QUORACLE_BENCH_CHAOS=/root/repo/CHAOS_r21_live.json
-export QUORACLE_BENCH_FABRIC=/root/repo/FABRIC_r21_live.json
-export QUORACLE_BENCH_QUANT=/root/repo/QUANT_r21_live.json
-export QUORACLE_BENCH_FLEET=/root/repo/FLEET_r21_live.json
-export QUORACLE_BENCH_FLEETOBS=/root/repo/FLEETOBS_r21_live.json
-export QUORACLE_BENCH_SIM=/root/repo/SIM_r21_live.json
-export QUORACLE_BENCH_COST=/root/repo/COST_r21_live.json
-export QUORACLE_BENCH_INTROSPECT=/root/repo/INTROSPECT_r21_live.json
-export QUORACLE_BENCH_FLYWHEEL=/root/repo/FLYWHEEL_r21_live.json
-timeout 5400 python bench.py > /root/repo/BENCH_r21_live.json 2>> "$LOG"
+export QUORACLE_BENCH_RESOURCES=/root/repo/RESOURCES_r22_live.json
+export QUORACLE_BENCH_QUALITY=/root/repo/QUALITY_r22_live.json
+export QUORACLE_BENCH_SPEC=/root/repo/SPEC_r22_live.json
+export QUORACLE_BENCH_KV=/root/repo/KVTIER_r22_live.json
+export QUORACLE_BENCH_RAGGED=/root/repo/RAGGED_r22_live.json
+export QUORACLE_BENCH_CLUSTER=/root/repo/CLUSTER_r22_live.json
+export QUORACLE_BENCH_CHAOS=/root/repo/CHAOS_r22_live.json
+export QUORACLE_BENCH_FABRIC=/root/repo/FABRIC_r22_live.json
+export QUORACLE_BENCH_QUANT=/root/repo/QUANT_r22_live.json
+export QUORACLE_BENCH_FLEET=/root/repo/FLEET_r22_live.json
+export QUORACLE_BENCH_FLEETOBS=/root/repo/FLEETOBS_r22_live.json
+export QUORACLE_BENCH_SIM=/root/repo/SIM_r22_live.json
+export QUORACLE_BENCH_COST=/root/repo/COST_r22_live.json
+export QUORACLE_BENCH_INTROSPECT=/root/repo/INTROSPECT_r22_live.json
+export QUORACLE_BENCH_FLYWHEEL=/root/repo/FLYWHEEL_r22_live.json
+export QUORACLE_BENCH_TREEOBS=/root/repo/TREEOBS_r22_live.json
+timeout 5400 python bench.py > /root/repo/BENCH_r22_live.json 2>> "$LOG"
 rc=$?
-echo "$(date -u +%FT%TZ) bench rc=$rc artifact=BENCH_r21_live.json" >> "$LOG"
+echo "$(date -u +%FT%TZ) bench rc=$rc artifact=BENCH_r22_live.json" >> "$LOG"
 if [ "$rc" -eq 0 ] && python - <<'EOF'
 import json
-d = json.load(open("/root/repo/BENCH_r21_live.json"))
+d = json.load(open("/root/repo/BENCH_r22_live.json"))
 ok = (not d.get("device_unavailable")) and d.get("value")
 raise SystemExit(0 if ok else 1)
 EOF
 then
     echo "$(date -u +%FT%TZ) BENCH SUCCESS — committing the record" >> "$LOG"
-    git add BENCH_r21_live.json RESOURCES_r21_live.json \
-        QUALITY_r21_live.json SPEC_r21_live.json \
-        KVTIER_r21_live.json RAGGED_r21_live.json \
-        CLUSTER_r21_live.json CHAOS_r21_live.json \
-        FABRIC_r21_live.json QUANT_r21_live.json \
-        FLEET_r21_live.json FLEETOBS_r21_live.json \
-        SIM_r21_live.json COST_r21_live.json \
-        INTROSPECT_r21_live.json FLYWHEEL_r21_live.json \
+    git add BENCH_r22_live.json RESOURCES_r22_live.json \
+        QUALITY_r22_live.json SPEC_r22_live.json \
+        KVTIER_r22_live.json RAGGED_r22_live.json \
+        CLUSTER_r22_live.json CHAOS_r22_live.json \
+        FABRIC_r22_live.json QUANT_r22_live.json \
+        FLEET_r22_live.json FLEETOBS_r22_live.json \
+        SIM_r22_live.json COST_r22_live.json \
+        INTROSPECT_r22_live.json FLYWHEEL_r22_live.json \
+        TREEOBS_r22_live.json \
         "$LOG" 2>/dev/null
     git -c user.name=distsys-graft -c user.email=graft@localhost \
-        commit -m "Chip-verified BENCH_r21_live artifact (direct run)" >> "$LOG" 2>&1 \
+        commit -m "Chip-verified BENCH_r22_live artifact (direct run)" >> "$LOG" 2>&1 \
         || echo "$(date -u +%FT%TZ) commit failed (artifact still on disk)" >> "$LOG"
 else
     echo "$(date -u +%FT%TZ) bench artifact not clean; bonus captures may still run" >> "$LOG"
@@ -143,7 +154,7 @@ fi
 # realized row depends on.
 timeout 900 env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu \
     python -m quoracle_tpu.tools.train_draft --check \
-    > /root/repo/SPEC_CHECK_r21.json 2>> "$LOG" \
+    > /root/repo/SPEC_CHECK_r22.json 2>> "$LOG" \
     && echo "$(date -u +%FT%TZ) draft check passed" >> "$LOG" \
     || echo "$(date -u +%FT%TZ) draft check FAILED (bench record already safe)" >> "$LOG"
 timeout 2400 python -m quoracle_tpu.tools.calibrate_paged \
@@ -152,9 +163,9 @@ timeout 2400 python -m quoracle_tpu.tools.calibrate_paged \
     || echo "$(date -u +%FT%TZ) calibration FAILED (bench record already safe)" >> "$LOG"
 timeout 1800 python -m quoracle_tpu.tools.bench_longctx \
     --resident 16384 --rounds 3 \
-    > /root/repo/LONGCTX_r21.json 2>> "$LOG" \
+    > /root/repo/LONGCTX_r22.json 2>> "$LOG" \
     || echo "$(date -u +%FT%TZ) longctx FAILED (bench record already safe)" >> "$LOG"
-git add calib_v5e.json LONGCTX_r21.json SPEC_CHECK_r21.json "$LOG" 2>/dev/null
+git add calib_v5e.json LONGCTX_r22.json SPEC_CHECK_r22.json "$LOG" 2>/dev/null
 git -c user.name=distsys-graft -c user.email=graft@localhost \
     commit -m "Post-bench chip captures: draft check + paged-gate calibration + long-context sweep" >> "$LOG" 2>&1 \
     || true
